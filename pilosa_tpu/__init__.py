@@ -1,0 +1,39 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch rebuild of the capabilities of Pilosa (the distributed
+roaring-bitmap index; see SURVEY.md for the reference layer map), designed
+TPU-first on JAX/XLA:
+
+- The roaring container boolean algebra (reference: ``roaring/roaring.go``,
+  SURVEY.md §3.1) becomes fused bitwise+popcount XLA kernels over packed
+  ``uint32`` device arrays (:mod:`pilosa_tpu.engine`).
+- The per-shard map-reduce executor (reference: ``executor.go#mapReduce``,
+  SURVEY.md §4.2) becomes a sharded, jit-compiled program over a
+  ``jax.sharding.Mesh`` with ICI collective reductions in place of HTTP
+  merges (:mod:`pilosa_tpu.engine.mesh`, :mod:`pilosa_tpu.executor`).
+- Host-side storage keeps a roaring-style container format on disk with an
+  op-log + snapshot durability model (reference: ``fragment.go``, SURVEY.md
+  §3.1/§6) (:mod:`pilosa_tpu.store`).
+- The PQL query language front end is re-implemented as a hand-rolled
+  lexer + recursive-descent parser (reference: ``pql/``, SURVEY.md §3.2)
+  (:mod:`pilosa_tpu.pql`).
+
+Layer map (mirrors SURVEY.md §2):
+
+====  =====================  ===========================================
+L0    pilosa_tpu.engine      packed-word bitmap kernels (XLA), BSI, TopN
+L1    pilosa_tpu.store       holder/index/field/view/fragment, codec
+L2    pilosa_tpu.pql         PQL front end
+L2    pilosa_tpu.executor    AST -> jitted kernels over shards
+L3    pilosa_tpu.cluster     placement, mesh distribution, control plane
+L5    pilosa_tpu.api         HTTP surface + client
+L6    pilosa_tpu.cli         command line
+LX    pilosa_tpu.obs         metrics / tracing / logging
+====  =====================  ===========================================
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.engine.words import SHARD_WIDTH, WORD_BITS, WORDS_PER_SHARD
+
+__all__ = ["SHARD_WIDTH", "WORD_BITS", "WORDS_PER_SHARD", "__version__"]

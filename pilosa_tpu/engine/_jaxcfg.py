@@ -1,0 +1,11 @@
+"""One-time JAX runtime configuration for the compute path.
+
+Imported by every jax-using engine module (kernels, bsi, mesh) and nothing
+else, so ``import pilosa_tpu`` stays side-effect free while any actual
+device compute gets x64 reductions (cluster-wide counts on 1B+ columns
+exceed int32; see engine/__init__ docstring).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

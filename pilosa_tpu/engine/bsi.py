@@ -1,0 +1,220 @@
+"""Bit-sliced integer (BSI) kernels: Range / Sum / Min / Max over bit planes.
+
+Reference: ``field.go#bsiGroup`` + ``fragment.go`` range decomposition
+(``fragment.rangeOp``, ``fragment.sum``; SURVEY.md §3.1, §4.4).  The
+reference stores an int field as one roaring row per bit position plus an
+existence ("not null") row and a sign row, and answers ``Range``/``Sum``
+with boolean algebra over those rows.  We keep exactly that encoding — it
+is already the right layout for a vector machine — as a dense plane:
+
+    plane: uint32[..., depth + 2, W]
+      plane[..., EXISTS_ROW, :]   not-null bitmap
+      plane[..., SIGN_ROW, :]     sign bitmap (1 = negative)
+      plane[..., OFFSET_ROW+b, :] bit b of |value - base|
+
+Invariant (maintained by the store): a column never has SIGN set with zero
+magnitude — there is no negative zero.
+
+Predicates arrive as *traced* scalars/bit-masks so one compiled kernel
+serves every predicate value (no recompile per query): ``pred_masks`` is
+``uint32[depth]`` with lane-broadcast 0x00000000/0xFFFFFFFF per bit of
+``|p|``, built by :func:`predicate_masks`.
+
+All kernels accept arbitrary leading batch axes (the executor batches
+``[n_shards, depth+2, W]``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (enables x64)
+from pilosa_tpu.engine import kernels
+
+EXISTS_ROW = 0
+SIGN_ROW = 1
+OFFSET_ROW = 2
+
+_FULL = jnp.uint32(0xFFFFFFFF)
+
+
+def depth_of(plane: jax.Array) -> int:
+    return plane.shape[-2] - OFFSET_ROW
+
+
+def predicate_masks(magnitude: int, depth: int) -> np.ndarray:
+    """Lane-broadcast per-bit masks of ``|p|`` for :func:`unsigned_cmp`.
+
+    Raises if ``|p|`` does not fit in ``depth`` bits — silently truncating
+    would invert comparison results.  Callers (the executor) must saturate
+    out-of-depth predicates first: a bound beyond the representable range
+    has a trivial answer (everything / nothing) that needs no kernel.
+    """
+    if magnitude < 0:
+        raise ValueError("magnitude must be non-negative")
+    if depth < 64 and magnitude >= (1 << depth):
+        raise ValueError(f"predicate magnitude {magnitude} exceeds bit depth {depth}")
+    bits = [(magnitude >> b) & 1 for b in range(depth)]
+    return np.array([0xFFFFFFFF if b else 0 for b in bits], dtype=np.uint32)
+
+
+def unsigned_cmp(
+    mag: jax.Array, pred_masks: jax.Array, universe: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Columns' magnitude vs predicate magnitude: (lt, eq, gt) bitmaps.
+
+    MSB->LSB digital comparison, the same strictly-greater accumulator
+    pattern as the reference's ``fragment.rangeOp`` walk (SURVEY.md §4.4),
+    vectorized over 2**20 columns at once.
+
+    mag: uint32[..., depth, W]; pred_masks: uint32[depth] (see
+    :func:`predicate_masks`); universe: uint32[..., W] — the columns under
+    consideration (typically the exists row).
+    """
+    depth = mag.shape[-2]
+    eq = universe
+    lt = jnp.zeros_like(universe)
+    gt = jnp.zeros_like(universe)
+    for b in reversed(range(depth)):
+        bitplane = mag[..., b, :]
+        pmask = pred_masks[b]
+        lt = jnp.bitwise_or(lt, eq & ~bitplane & pmask)
+        gt = jnp.bitwise_or(gt, eq & bitplane & ~pmask)
+        eq = eq & ~(bitplane ^ pmask)
+    return lt, eq, gt
+
+
+def range_cmp(
+    plane: jax.Array,
+    pred_masks: jax.Array,
+    pred_negative: jax.Array,
+    filter_words: jax.Array | None = None,
+) -> dict[str, jax.Array]:
+    """All six signed comparisons of stored values vs predicate ``p``.
+
+    Returns bitmaps {"lt","le","gt","ge","eq","ne"}; the executor picks one
+    (or combines two for between).  ``pred_negative`` is a traced bool
+    scalar (sign of p); ``pred_masks`` encodes ``|p|``.
+    """
+    exists = plane[..., EXISTS_ROW, :]
+    if filter_words is not None:
+        exists = exists & filter_words
+    sign = plane[..., SIGN_ROW, :] & exists
+    pos = exists & ~sign
+    mag = plane[..., OFFSET_ROW:, :]
+
+    m_lt, m_eq, m_gt = unsigned_cmp(mag, pred_masks, exists)
+
+    # p >= 0: v < p  <=>  v negative, or v >= 0 with |v| < |p|
+    lt_nonneg = sign | (pos & m_lt)
+    # p < 0:  v < p  <=>  v negative with |v| > |p|
+    lt_neg = sign & m_gt
+    # p >= 0: v > p  <=>  v >= 0 with |v| > |p|
+    gt_nonneg = pos & m_gt
+    # p < 0:  v > p  <=>  v >= 0, or v negative with |v| < |p|
+    gt_neg = pos | (sign & m_lt)
+    eq_signed = jnp.where(pred_negative, sign & m_eq, pos & m_eq)
+
+    lt = jnp.where(pred_negative, lt_neg, lt_nonneg)
+    gt = jnp.where(pred_negative, gt_neg, gt_nonneg)
+    return {
+        "lt": lt,
+        "le": lt | eq_signed,
+        "gt": gt,
+        "ge": gt | eq_signed,
+        "eq": eq_signed,
+        "ne": exists & ~eq_signed,
+    }
+
+
+def not_null(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.Array:
+    exists = plane[..., EXISTS_ROW, :]
+    if filter_words is not None:
+        exists = exists & filter_words
+    return exists
+
+
+def sum_count(
+    plane: jax.Array, filter_words: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """(sum of offsets, count of non-null) per batch element -> int64[...].
+
+    Reference: ``fragment.sum`` — per bit b, ``popcount(filter & bitrow_b)
+    << b``, negatives subtracted via the sign row (SURVEY.md §4.4).  The
+    caller adds ``base * count`` to recover absolute values.
+    """
+    exists = not_null(plane, filter_words)
+    sign = plane[..., SIGN_ROW, :] & exists
+    pos = exists & ~sign
+    depth = depth_of(plane)
+    total = jnp.zeros(plane.shape[:-2], dtype=jnp.int64)
+    for b in range(depth):
+        bitplane = plane[..., OFFSET_ROW + b, :]
+        pos_c = kernels.count(bitplane & pos)
+        neg_c = kernels.count(bitplane & sign)
+        total = total + ((pos_c - neg_c) << b)
+    return total, kernels.count(exists)
+
+
+def _mag_max(cand: jax.Array, mag: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Largest magnitude among candidate columns: (value int64[...], final
+    candidate bitmap).  Data-dependent bit descent done branch-free with
+    ``where`` on per-batch "any" scalars (jit/TPU friendly)."""
+    depth = mag.shape[-2]
+    val = jnp.zeros(cand.shape[:-1], dtype=jnp.int64)
+    for b in reversed(range(depth)):
+        hit = cand & mag[..., b, :]
+        has = kernels.any_bit(hit)
+        cand = jnp.where(has[..., None], hit, cand)
+        val = val | (has.astype(jnp.int64) << b)
+    return val, cand
+
+
+def _mag_min(cand: jax.Array, mag: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Smallest magnitude among candidate columns."""
+    depth = mag.shape[-2]
+    val = jnp.zeros(cand.shape[:-1], dtype=jnp.int64)
+    for b in reversed(range(depth)):
+        zero_side = cand & ~mag[..., b, :]
+        has_zero = kernels.any_bit(zero_side)
+        cand = jnp.where(has_zero[..., None], zero_side, cand)
+        val = val | ((~has_zero).astype(jnp.int64) << b)
+    # columns that survived only because no zero-side existed at some bit
+    # all share the same magnitude, so val is exact
+    return val, cand
+
+
+def min_max(
+    plane: jax.Array, filter_words: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-batch (min_offset, min_count, max_offset, max_count), int64.
+
+    Reference: ``fragment.min``/``fragment.max`` bit descent (SURVEY.md
+    §3.1).  Offsets are relative to base; counts are 0 when no non-null
+    column exists (caller must check before using the values).
+    """
+    exists = not_null(plane, filter_words)
+    sign = plane[..., SIGN_ROW, :] & exists
+    pos = exists & ~sign
+    mag = plane[..., OFFSET_ROW:, :]
+
+    has_neg = kernels.any_bit(sign)
+    has_pos = kernels.any_bit(pos)
+
+    # min: most-negative (largest |.| among negatives) else smallest positive
+    neg_val, neg_cand = _mag_max(sign, mag)
+    posmin_val, posmin_cand = _mag_min(pos, mag)
+    min_val = jnp.where(has_neg, -neg_val, posmin_val)
+    min_cand = jnp.where(has_neg[..., None], neg_cand, posmin_cand)
+    min_cnt = jnp.where(has_neg | has_pos, kernels.count(min_cand), 0)
+
+    # max: largest positive else least-negative (smallest |.| among negatives)
+    posmax_val, posmax_cand = _mag_max(pos, mag)
+    negmin_val, negmin_cand = _mag_min(sign, mag)
+    max_val = jnp.where(has_pos, posmax_val, -negmin_val)
+    max_cand = jnp.where(has_pos[..., None], posmax_cand, negmin_cand)
+    max_cnt = jnp.where(has_neg | has_pos, kernels.count(max_cand), 0)
+
+    return min_val, min_cnt, max_val, max_cnt

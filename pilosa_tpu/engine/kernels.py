@@ -1,0 +1,170 @@
+"""Core bitmap kernels: XLA bitwise + popcount over packed uint32 words.
+
+These replace the reference's pairwise container kernels — nine
+container-type-pair specializations per op like ``intersectArrayBitmap`` /
+``unionBitmapBitmap`` / ``intersectionCountArrayRun`` in
+``roaring/roaring.go`` (SURVEY.md §3.1) — with single dense ops that XLA
+fuses end-to-end (e.g. ``Intersect + Count`` compiles to one
+and+popcount+reduce pass at HBM bandwidth).
+
+All kernels are shape-polymorphic over leading batch axes: a "bitmap" is
+``uint32[..., W]`` where the trailing axis is packed words.  The executor
+batches ``[n_shards, W]`` (one row across resident shards) or
+``[n_shards, n_rows, W]`` (a whole field plane) and the same kernels apply.
+
+Counts are ``int64`` (JAX x64 is enabled at engine import): a single shard
+row fits int32 but cluster-wide counts on 1B+ columns do not.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (enables x64)
+
+# ---------------------------------------------------------------------------
+# Boolean algebra (reference: roaring.Bitmap Intersect/Union/Difference/Xor)
+# ---------------------------------------------------------------------------
+
+
+def intersect(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.bitwise_and(a, b)
+
+
+def union(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.bitwise_or(a, b)
+
+
+def difference(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.bitwise_and(a, jnp.bitwise_not(b))
+
+
+def xor(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.bitwise_xor(a, b)
+
+
+def complement(a: jax.Array, exists: jax.Array) -> jax.Array:
+    """``Not(a)`` against an existence bitmap (reference: ``Not`` via the
+    ``_exists`` field ANDNOT, ``executor.go#executeNot``; SURVEY.md §3.2)."""
+    return jnp.bitwise_and(exists, jnp.bitwise_not(a))
+
+
+# ---------------------------------------------------------------------------
+# Popcount / Count (reference: Bitmap.Count, IntersectionCount)
+# ---------------------------------------------------------------------------
+
+
+def popcount(words: jax.Array) -> jax.Array:
+    return lax.population_count(words)
+
+
+def count(words: jax.Array) -> jax.Array:
+    """Total set bits over the trailing word axis -> int64[...]."""
+    return jnp.sum(popcount(words).astype(jnp.int64), axis=-1)
+
+
+def intersection_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Fused and+popcount+sum (reference: ``Bitmap.IntersectionCount`` — the
+    no-materialize fast path used by ``Count(Intersect(..))``)."""
+    return count(jnp.bitwise_and(a, b))
+
+
+def union_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return count(jnp.bitwise_or(a, b))
+
+
+def difference_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return count(jnp.bitwise_and(a, jnp.bitwise_not(b)))
+
+
+def xor_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return count(jnp.bitwise_xor(a, b))
+
+
+def any_bit(words: jax.Array) -> jax.Array:
+    """True if any bit set over trailing axis (reference: ``Bitmap.Any``)."""
+    return jnp.any(words != 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Plane-level kernels: one field's rows as uint32[..., n_rows, W]
+# ---------------------------------------------------------------------------
+
+
+def row_counts(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.Array:
+    """Per-row popcounts, optionally intersected with a filter bitmap.
+
+    This is the brute-force TPU replacement for the reference's per-fragment
+    rank/LRU TopN cache (``cache.go#RankCache``, ``fragment.top``; SURVEY.md
+    §3.2/§4.3): recount every row at HBM bandwidth instead of maintaining a
+    cache + two-phase threshold protocol.
+
+    plane: uint32[..., R, W]; filter: uint32[..., W] -> int64[..., R].
+    """
+    if filter_words is not None:
+        plane = jnp.bitwise_and(plane, filter_words[..., None, :])
+    return count(plane)
+
+
+def top_n(counts: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
+    """(values, row_ids) of the n largest counts (reference: two-phase
+    ``executeTopN`` merge, SURVEY.md §4.3 — exact by construction here).
+
+    counts: int64[R] (already reduced across shards) -> (int64[k], int64[k])
+    with ``k = min(n, R)`` — an oversized ``n`` returns every row, matching
+    the reference's TopN semantics.  Rows with zero count may appear;
+    callers filter them.
+    """
+    vals, idx = lax.top_k(counts, min(n, counts.shape[-1]))
+    return vals, idx.astype(jnp.int64)
+
+
+def union_rows(plane: jax.Array, row_mask: jax.Array) -> jax.Array:
+    """OR together the rows of ``plane`` selected by boolean ``row_mask``.
+
+    Used for time-quantum range unions (reference: ``viewsByTimeRange`` then
+    row union; SURVEY.md §3.1) and ``Rows``-driven unions.
+    plane: uint32[..., R, W], row_mask: bool[R] -> uint32[..., W].
+    """
+    masked = jnp.where(row_mask[..., :, None], plane, jnp.uint32(0))
+    return jax.lax.reduce(
+        masked,
+        jnp.uint32(0),
+        lambda x, y: jnp.bitwise_or(x, y),
+        dimensions=(masked.ndim - 2,),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mutation kernels (device-side scatter of bit updates)
+# ---------------------------------------------------------------------------
+#
+# Device analogue of ``fragment.setBit``/``clearBit`` bulk application
+# (SURVEY.md §4.5).  The host op-log remains the durability truth; these
+# kernels refresh a resident plane in place without a full rebuild.  To keep
+# the scatter well-defined under XLA (duplicate scatter indices have
+# unspecified combine order), the *host* first reduces raw bit positions to
+# unique ``(word_idx, word_mask)`` pairs (``coalesce_updates``); the device
+# then applies one gather + bitwise op + scatter with unique indices.
+# Padding entries use ``word_idx >= n_words`` (out-of-bounds high; JAX wraps
+# negative indices, so -1 is NOT a safe sentinel) and are dropped.
+
+
+def apply_word_or(words: jax.Array, word_idx: jax.Array, word_mask: jax.Array) -> jax.Array:
+    """words[idx] |= mask over trailing word axis; idx unique, >=W = pad."""
+    words = jnp.asarray(words)
+    gathered = words.at[..., word_idx].get(mode="fill", fill_value=0)
+    return words.at[..., word_idx].set(
+        jnp.bitwise_or(gathered, word_mask), mode="drop"
+    )
+
+
+def apply_word_andnot(words: jax.Array, word_idx: jax.Array, word_mask: jax.Array) -> jax.Array:
+    """words[idx] &= ~mask over trailing word axis; idx unique, >=W = pad."""
+    words = jnp.asarray(words)
+    gathered = words.at[..., word_idx].get(mode="fill", fill_value=0)
+    return words.at[..., word_idx].set(
+        jnp.bitwise_and(gathered, jnp.bitwise_not(word_mask)), mode="drop"
+    )

@@ -1,0 +1,120 @@
+"""Packed-word layout constants and host-side (numpy) pack/unpack helpers.
+
+Layout decision (SURVEY.md §8): a shard is ``SHARD_WIDTH = 2**20`` columns
+(reference: ``pilosa.ShardWidth``, root pkg const) packed into
+``WORDS_PER_SHARD = 32768`` little-endian ``uint32`` words — uint32 is the
+native TPU lane width, so bitwise ops and ``lax.population_count`` map
+directly onto the VPU without 64-bit emulation.
+
+Bit order: column ``c`` of a shard lives at word ``c >> 5``, bit ``c & 31``
+(LSB-first within a word).  This matches numpy ``unpackbits`` with
+``bitorder='little'`` over the words viewed as bytes, which the host codec
+relies on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# One shard = 2**20 columns; the unit of distribution, parallelism and
+# storage (reference: ShardWidth const, SURVEY.md §1).
+SHARD_WIDTH = 1 << 20
+
+WORD_BITS = 32
+WORDS_PER_SHARD = SHARD_WIDTH // WORD_BITS  # 32768
+
+_ONE = np.uint32(1)
+
+
+def pack_columns(cols: np.ndarray, n_words: int = WORDS_PER_SHARD) -> np.ndarray:
+    """Pack sorted-or-not column offsets (within one shard) into uint32 words.
+
+    Host-side analogue of building one dense row from roaring containers
+    (reference: ``fragment.row`` materializing a ``*Row`` from container
+    slices; SURVEY.md §4.2).
+    """
+    words = np.zeros(n_words, dtype=np.uint32)
+    if len(cols) == 0:
+        return words
+    cols = np.asarray(cols, dtype=np.uint64)
+    if cols.max() >= n_words * WORD_BITS:
+        raise ValueError(
+            f"column {cols.max()} out of range for {n_words * WORD_BITS} bits"
+        )
+    np.bitwise_or.at(words, (cols >> 5).astype(np.int64),
+                     _ONE << (cols & np.uint64(31)).astype(np.uint32))
+    return words
+
+
+def unpack_columns(words: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`pack_columns`: set-bit positions, sorted ascending."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint64)
+
+
+def bsi_encode(
+    cols: np.ndarray,
+    values: np.ndarray,
+    base: int,
+    depth: int,
+    n_words: int = WORDS_PER_SHARD,
+) -> np.ndarray:
+    """Encode (column, int value) pairs into a dense BSI plane.
+
+    Layout matches :mod:`pilosa_tpu.engine.bsi` (exists row, sign row, then
+    ``depth`` magnitude bit rows of ``value - base``); the reference
+    analogue is ``bsiGroup`` writing one roaring row per bit
+    (``field.go#SetValue``, SURVEY.md §3.1).  Returns
+    ``uint32[depth + 2, n_words]``.
+    """
+    plane = np.zeros((depth + 2, n_words), dtype=np.uint32)
+    cols = np.asarray(cols, dtype=np.uint64)
+    offs = np.asarray(values, dtype=np.int64) - np.int64(base)
+    if len(cols) == 0:
+        return plane
+    mag = np.abs(offs).astype(np.uint64)
+    if depth < 64 and mag.max() >= (1 << depth):
+        raise ValueError(f"magnitude {mag.max()} exceeds bit depth {depth}")
+    plane[0] = pack_columns(cols, n_words)                      # exists
+    plane[1] = pack_columns(cols[offs < 0], n_words)            # sign
+    for b in range(depth):
+        hit = (mag >> np.uint64(b)) & np.uint64(1) != 0
+        plane[2 + b] = pack_columns(cols[hit], n_words)
+    return plane
+
+
+def coalesce_updates(
+    positions: np.ndarray, n_words: int = WORDS_PER_SHARD
+) -> tuple[np.ndarray, np.ndarray]:
+    """Reduce raw bit positions to unique ``(word_idx, word_mask)`` pairs.
+
+    Host half of the device mutation path (see
+    :func:`pilosa_tpu.engine.kernels.apply_word_or`): XLA scatter with
+    duplicate indices has unspecified combine order, so the host ORs all
+    bits that land in the same word first.
+
+    Raises on positions outside ``n_words * 32`` bits: the device scatter
+    drops out-of-bounds indices as padding, so an unvalidated bad position
+    would be a silently lost write.
+    """
+    positions = np.asarray(positions, dtype=np.uint64)
+    if len(positions) == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.uint32))
+    if positions.max() >= n_words * WORD_BITS:
+        raise ValueError(
+            f"position {positions.max()} out of range for {n_words * WORD_BITS} bits"
+        )
+    idx = (positions >> 5).astype(np.int64)
+    bit = _ONE << (positions & np.uint64(31)).astype(np.uint32)
+    order = np.argsort(idx, kind="stable")
+    idx, bit = idx[order], bit[order]
+    uniq, starts = np.unique(idx, return_index=True)
+    masks = np.bitwise_or.reduceat(bit, starts)
+    return uniq, masks
+
+
+def popcount_words(words: np.ndarray) -> int:
+    """Host/numpy popcount oracle (used by tests and the CPU fallback)."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    return int(np.unpackbits(words.view(np.uint8)).sum())

@@ -1,0 +1,38 @@
+"""Test harness configuration.
+
+Forces CPU JAX with 8 virtual devices *before* jax initializes, so the full
+mesh/collective distribution path runs in pytest without TPU hardware —
+the rebuild's equivalent of the reference's in-process multi-node cluster
+harness (``test/cluster.go#MustRunCluster``; SURVEY.md §5).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# This image injects a TPU-tunnel PJRT plugin ("axon") into every Python
+# process via sitecustomize; initializing it claims the single TPU grant
+# and can block for minutes when another process holds it.  Unit tests are
+# CPU-only by design, so drop the plugin from jax's backend factory
+# registry before any backend is initialized.
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize imported jax with
+# JAX_PLATFORMS=axon already read; override the live config too.
+for _name in list(getattr(_xb, "_backend_factories", {})):
+    if _name != "cpu":
+        _xb._backend_factories.pop(_name, None)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

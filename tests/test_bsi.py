@@ -1,0 +1,112 @@
+"""BSI kernel tests against a numpy oracle.
+
+Mirrors the reference's BSI range/sum edge-case tests (sign, base,
+boundaries; ``fragment_test.go``, SURVEY.md §5)."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from pilosa_tpu.engine import bsi, kernels, words
+
+W = 64
+NBITS = W * 32
+DEPTH = 12
+LO, HI = -(1 << (DEPTH - 1)), (1 << (DEPTH - 1)) - 1
+
+
+def encode(cols, vals, base=0):
+    return words.bsi_encode(np.array(cols, np.uint64), np.array(vals, np.int64),
+                            base, DEPTH, W)
+
+
+def to_set(ws):
+    return set(words.unpack_columns(np.asarray(ws)).tolist())
+
+
+values_strategy = st.lists(
+    st.tuples(
+        st.integers(min_value=0, max_value=NBITS - 1),
+        st.integers(min_value=LO, max_value=HI),
+    ),
+    max_size=100,
+    unique_by=lambda t: t[0],
+)
+
+
+@settings(max_examples=20, deadline=None)
+@given(pairs=values_strategy, pred=st.integers(min_value=LO, max_value=HI))
+def test_range_cmp(pairs, pred):
+    cols = [c for c, _ in pairs]
+    vals = [v for _, v in pairs]
+    plane = encode(cols, vals)
+    masks = jnp.asarray(bsi.predicate_masks(abs(pred), DEPTH))
+    out = bsi.range_cmp(plane, masks, jnp.asarray(pred < 0))
+    d = dict(zip(cols, vals))
+    oracles = {
+        "lt": {c for c, v in d.items() if v < pred},
+        "le": {c for c, v in d.items() if v <= pred},
+        "gt": {c for c, v in d.items() if v > pred},
+        "ge": {c for c, v in d.items() if v >= pred},
+        "eq": {c for c, v in d.items() if v == pred},
+        "ne": {c for c, v in d.items() if v != pred},
+    }
+    for op, expect in oracles.items():
+        assert to_set(out[op]) == expect, op
+
+
+@settings(max_examples=20, deadline=None)
+@given(pairs=values_strategy)
+def test_sum_count_min_max(pairs):
+    cols = [c for c, _ in pairs]
+    vals = [v for _, v in pairs]
+    plane = encode(cols, vals)
+    total, cnt = bsi.sum_count(plane)
+    assert int(cnt) == len(cols)
+    assert int(total) == sum(vals)
+
+    mn, mn_c, mx, mx_c = bsi.min_max(plane)
+    if cols:
+        assert int(mn) == min(vals)
+        assert int(mn_c) == vals.count(min(vals))
+        assert int(mx) == max(vals)
+        assert int(mx_c) == vals.count(max(vals))
+    else:
+        assert int(mn_c) == 0 and int(mx_c) == 0
+
+
+def test_base_offset_encoding():
+    # base shifts stored offsets; kernels work in offset space
+    cols, vals = [1, 2, 3], [100, 150, 90]
+    base = 100
+    plane = words.bsi_encode(np.array(cols, np.uint64), np.array(vals, np.int64),
+                             base, DEPTH, W)
+    total, cnt = bsi.sum_count(plane)
+    assert int(total) + base * int(cnt) == sum(vals)
+    masks = jnp.asarray(bsi.predicate_masks(abs(120 - base), DEPTH))
+    out = bsi.range_cmp(plane, masks, jnp.asarray(120 - base < 0))
+    assert to_set(out["lt"]) == {1, 3}  # values < 120
+
+
+def test_filtered_sum_and_range():
+    cols, vals = [0, 1, 2, 3], [5, -7, 9, 11]
+    plane = encode(cols, vals)
+    filt = words.pack_columns(np.array([0, 1], np.uint64), W)
+    total, cnt = bsi.sum_count(plane, jnp.asarray(filt))
+    assert (int(total), int(cnt)) == (-2, 2)
+    mn, mn_c, mx, mx_c = bsi.min_max(plane, jnp.asarray(filt))
+    assert (int(mn), int(mn_c), int(mx), int(mx_c)) == (-7, 1, 5, 1)
+
+
+def test_batched_shard_axis(rng):
+    # [n_shards, depth+2, W] batching
+    p0 = encode([1, 2], [3, -4])
+    p1 = encode([5], [7])
+    planes = jnp.stack([jnp.asarray(p0), jnp.asarray(p1)])
+    total, cnt = bsi.sum_count(planes)
+    assert np.asarray(total).tolist() == [-1, 7]
+    assert np.asarray(cnt).tolist() == [2, 1]
+    mn, mn_c, mx, mx_c = bsi.min_max(planes)
+    assert np.asarray(mn).tolist() == [-4, 7]
+    assert np.asarray(mx).tolist() == [3, 7]

@@ -1,0 +1,134 @@
+"""L0 kernel tests against a numpy oracle.
+
+Mirrors the reference's exhaustive roaring kernel tests
+(``roaring/roaring_test.go``; SURVEY.md §5): every boolean op and count
+checked against an independent set-based oracle, plus hypothesis
+property tests over random bit patterns.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from pilosa_tpu.engine import kernels, words
+
+W = 64  # small word count for tests; kernels are trailing-axis polymorphic
+NBITS = W * 32
+
+
+def mk(positions):
+    return words.pack_columns(np.array(positions, dtype=np.uint64), W)
+
+
+def oracle_count(ws):
+    return words.popcount_words(ws)
+
+
+positions_strategy = st.lists(
+    st.integers(min_value=0, max_value=NBITS - 1), max_size=200, unique=True
+)
+
+
+def test_pack_unpack_roundtrip(rng):
+    cols = np.sort(rng.choice(NBITS, size=500, replace=False)).astype(np.uint64)
+    ws = words.pack_columns(cols, W)
+    assert np.array_equal(words.unpack_columns(ws), cols)
+    assert words.popcount_words(ws) == 500
+
+
+def test_pack_out_of_range():
+    with pytest.raises(ValueError):
+        words.pack_columns(np.array([NBITS], dtype=np.uint64), W)
+
+
+@settings(max_examples=25, deadline=None)
+@given(a=positions_strategy, b=positions_strategy)
+def test_boolean_algebra_matches_set_oracle(a, b):
+    sa, sb = set(a), set(b)
+    wa, wb = mk(a), mk(b)
+    cases = {
+        kernels.intersect: sa & sb,
+        kernels.union: sa | sb,
+        kernels.difference: sa - sb,
+        kernels.xor: sa ^ sb,
+    }
+    for fn, expect in cases.items():
+        got = set(words.unpack_columns(np.asarray(fn(wa, wb))).tolist())
+        assert got == expect, fn.__name__
+
+
+@settings(max_examples=25, deadline=None)
+@given(a=positions_strategy, b=positions_strategy)
+def test_counts_match(a, b):
+    sa, sb = set(a), set(b)
+    wa, wb = mk(a), mk(b)
+    assert int(kernels.count(wa)) == len(sa)
+    assert int(kernels.intersection_count(wa, wb)) == len(sa & sb)
+    assert int(kernels.union_count(wa, wb)) == len(sa | sb)
+    assert int(kernels.difference_count(wa, wb)) == len(sa - sb)
+    assert int(kernels.xor_count(wa, wb)) == len(sa ^ sb)
+
+
+def test_complement_against_existence():
+    exists = mk(range(100))
+    a = mk([5, 10, 99])
+    got = set(words.unpack_columns(np.asarray(kernels.complement(a, exists))).tolist())
+    assert got == set(range(100)) - {5, 10, 99}
+
+
+def test_batched_axes(rng):
+    # kernels must be polymorphic over leading axes: [n_shards, W]
+    planes = rng.integers(0, 2**32, size=(4, W), dtype=np.uint32)
+    counts = np.asarray(kernels.count(planes))
+    assert counts.shape == (4,)
+    for i in range(4):
+        assert counts[i] == oracle_count(planes[i])
+
+
+def test_row_counts_and_topn(rng):
+    n_rows = 16
+    plane = rng.integers(0, 2**32, size=(n_rows, W), dtype=np.uint32)
+    filt = rng.integers(0, 2**32, size=(W,), dtype=np.uint32)
+    counts = np.asarray(kernels.row_counts(plane, filt))
+    expect = np.array([oracle_count(plane[r] & filt) for r in range(n_rows)])
+    assert np.array_equal(counts, expect)
+
+    vals, ids = kernels.top_n(kernels.row_counts(plane, None), 5)
+    vals, ids = np.asarray(vals), np.asarray(ids)
+    order = np.argsort(-np.array([oracle_count(plane[r]) for r in range(n_rows)]),
+                       kind="stable")
+    assert np.array_equal(np.sort(vals)[::-1], vals)  # descending
+    assert set(vals.tolist()) == set(
+        np.array([oracle_count(plane[r]) for r in range(n_rows)])[order[:5]].tolist()
+    )
+
+
+def test_union_rows(rng):
+    plane = rng.integers(0, 2**32, size=(8, W), dtype=np.uint32)
+    mask = np.array([1, 0, 1, 0, 0, 1, 0, 0], dtype=bool)
+    got = np.asarray(kernels.union_rows(plane, mask))
+    expect = plane[0] | plane[2] | plane[5]
+    assert np.array_equal(got, expect)
+    # empty mask -> zeros
+    got0 = np.asarray(kernels.union_rows(plane, np.zeros(8, bool)))
+    assert not got0.any()
+
+
+def test_apply_word_updates(rng):
+    base = rng.integers(0, 2**32, size=(W,), dtype=np.uint32)
+    positions = rng.choice(NBITS, size=300, replace=False)
+    idx, mask = words.coalesce_updates(positions)
+    got = np.asarray(kernels.apply_word_or(base, idx, mask))
+    expect_set = set(words.unpack_columns(base).tolist()) | set(positions.tolist())
+    assert set(words.unpack_columns(got).tolist()) == expect_set
+
+    got2 = np.asarray(kernels.apply_word_andnot(got, idx, mask))
+    assert set(words.unpack_columns(got2).tolist()) == expect_set - set(positions.tolist())
+
+
+def test_apply_word_updates_padding():
+    base = np.zeros(W, dtype=np.uint32)
+    idx = np.array([W, 3], dtype=np.int64)  # W = out-of-bounds pad sentinel
+    mask = np.array([0xFFFFFFFF, 0b101], dtype=np.uint32)
+    got = np.asarray(kernels.apply_word_or(base, idx, mask))
+    assert got[3] == 0b101 and got.sum() == 0b101  # pad entry dropped
