@@ -1,0 +1,13 @@
+"""PQL front end: lexer, recursive-descent parser, AST.
+
+Reference: the ``pql/`` package (PEG grammar ``pql/pql.peg`` + generated
+parser + ``pql/ast.go``; SURVEY.md §3.2).  The language is small, so a
+hand-rolled recursive-descent parser replaces the PEG machinery; the AST
+(`Call` with name, keyword args, children) is semantically identical to
+upstream ``*pql.Call``.
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query
+from pilosa_tpu.pql.parser import ParseError, parse
+
+__all__ = ["Call", "Condition", "Query", "ParseError", "parse"]

@@ -1,0 +1,86 @@
+"""PQL AST node types.
+
+Reference: ``pql/ast.go`` — ``pql.Query`` (list of calls), ``pql.Call``
+(name, Args map, Children), ``pql.Condition`` (Op + Value) (SURVEY.md
+§3.2).  Conventions kept from upstream:
+
+- positional scalar args are stored under reserved keys the way the
+  upstream grammar rewrites them (``Set(10, f=1)`` → ``_col=10``,
+  trailing timestamp → ``_timestamp``, ``TopN(f, n=5)`` → ``_field=f``),
+  so the executor sees one uniform Args map;
+- a BSI condition arg (``Row(amount > 5)``) is stored as
+  ``args[field] = Condition(op, value)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+# Condition ops.  Six scalar comparisons plus the four "between" variants
+# the upstream grammar distinguishes (BETWEEN_LT_LT etc. in pql/token.go):
+# the op string spells the two bounds' strictness, value is [lo, hi].
+SCALAR_OPS = ("==", "!=", "<", "<=", ">", ">=")
+BETWEEN_OPS = ("<><", "<=><", "<><=", "<=><=")  # lo(op)x(op)hi: <>< means lo<x<hi
+
+
+@dataclass(frozen=True)
+class Condition:
+    """A comparison against a BSI field: op + predicate value(s)."""
+
+    op: str
+    value: Any  # int | float | [lo, hi] for between ops
+
+    def __post_init__(self):
+        if self.op not in SCALAR_OPS + BETWEEN_OPS:
+            raise ValueError(f"unknown condition op {self.op!r}")
+
+    def __str__(self) -> str:
+        if self.op in BETWEEN_OPS:
+            lo_op = "<" if self.op.startswith("<>") else "<="
+            hi_op = "<" if self.op.endswith("><") else "<="
+            return f"{self.value[0]} {lo_op} x {hi_op} {self.value[1]}"
+        return f"x {self.op} {self.value}"
+
+
+@dataclass
+class Call:
+    """One PQL call: ``Name(child, ..., key=value, ...)``."""
+
+    name: str
+    args: dict[str, Any] = dc_field(default_factory=dict)
+    children: list["Call"] = dc_field(default_factory=list)
+
+    def field_arg(self, reserved: frozenset[str]) -> tuple[str, Any] | None:
+        """The single (field, value) arg that is not a reserved option key —
+        upstream resolves ``Row(f=1)``'s field name the same way at
+        execution time, not parse time."""
+        hits = [(k, v) for k, v in self.args.items()
+                if k not in reserved and not k.startswith("_")]
+        if not hits:
+            return None
+        if len(hits) > 1:
+            raise ValueError(
+                f"{self.name}: ambiguous field args {[k for k, _ in hits]}")
+        return hits[0]
+
+    def __str__(self) -> str:
+        parts = [str(c) for c in self.children]
+        for k, v in self.args.items():
+            if isinstance(v, Condition):
+                parts.append(f"{k} {v.op} {v.value}")
+            elif isinstance(v, str):
+                parts.append(f'{k}="{v}"')
+            else:
+                parts.append(f"{k}={v}")
+        return f"{self.name}({', '.join(parts)})"
+
+
+@dataclass
+class Query:
+    """A parsed PQL string: one or more top-level calls."""
+
+    calls: list[Call]
+
+    def __str__(self) -> str:
+        return "\n".join(str(c) for c in self.calls)

@@ -1,0 +1,114 @@
+"""PQL lexer.
+
+Reference: token layer of the PEG grammar ``pql/pql.peg`` (SURVEY.md
+§3.2).  Token set: identifiers (call + field + option names; dashes
+allowed as in upstream field names), integers, floats, quoted strings,
+bare timestamps (``2017-01-02T03:04``), punctuation, and the six
+comparison operators used by BSI conditions.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+# token kinds
+IDENT = "IDENT"
+INT = "INT"
+FLOAT = "FLOAT"
+STRING = "STRING"
+TIMESTAMP = "TIMESTAMP"
+LPAREN, RPAREN = "(", ")"
+LBRACK, RBRACK = "[", "]"
+COMMA, ASSIGN = ",", "="
+CMP = "CMP"  # value: one of == != < <= > >=
+EOF = "EOF"
+
+_TIMESTAMP_RE = re.compile(
+    r"\d{4}-\d{2}-\d{2}(?:T\d{2}:\d{2}(?::\d{2})?)?"
+)
+_NUM_RE = re.compile(r"-?\d+(\.\d+)?")
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_-]*")
+_WS_RE = re.compile(r"\s+")
+
+
+class LexError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str
+    value: object
+    pos: int
+
+    def __repr__(self) -> str:
+        return f"Token({self.kind}, {self.value!r}@{self.pos})"
+
+
+def tokenize(src: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(src)
+    while i < n:
+        m = _WS_RE.match(src, i)
+        if m:
+            i = m.end()
+            continue
+        c = src[i]
+        if c in "()[],=<>!":
+            # multi-char operators first
+            two = src[i:i + 2]
+            if two in ("==", "!=", "<=", ">="):
+                toks.append(Token(CMP, two, i))
+                i += 2
+                continue
+            if c in "<>":
+                toks.append(Token(CMP, c, i))
+                i += 1
+                continue
+            if c == "!":
+                raise LexError(f"unexpected '!' at {i} (did you mean '!=')")
+            if c == "=":
+                toks.append(Token(ASSIGN, "=", i))
+            else:
+                toks.append(Token(c, c, i))
+            i += 1
+            continue
+        if c in "'\"":
+            j = i + 1
+            buf = []
+            while j < n and src[j] != c:
+                if src[j] == "\\" and j + 1 < n:
+                    buf.append(src[j + 1])
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            if j >= n:
+                raise LexError(f"unterminated string starting at {i}")
+            toks.append(Token(STRING, "".join(buf), i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "-" and i + 1 < n and src[i + 1].isdigit()):
+            # timestamp wins over int at digit positions: 2017-01-02T03:04
+            m = _TIMESTAMP_RE.match(src, i)
+            if m and c != "-" and "-" in m.group(0):
+                toks.append(Token(TIMESTAMP, m.group(0), i))
+                i = m.end()
+                continue
+            m = _NUM_RE.match(src, i)
+            text = m.group(0)
+            if "." in text:
+                toks.append(Token(FLOAT, float(text), i))
+            else:
+                toks.append(Token(INT, int(text), i))
+            i = m.end()
+            continue
+        m = _IDENT_RE.match(src, i)
+        if m:
+            toks.append(Token(IDENT, m.group(0), i))
+            i = m.end()
+            continue
+        raise LexError(f"unexpected character {c!r} at {i}")
+    toks.append(Token(EOF, None, n))
+    return toks

@@ -1,0 +1,211 @@
+"""PQL recursive-descent parser.
+
+Reference: ``pql/pql.peg`` grammar + generated parser (SURVEY.md §3.2).
+Grammar (informally):
+
+    query      = call*
+    call       = IDENT '(' [arg (',' arg)*] ')'
+    arg        = call                       # child
+               | IDENT '=' value            # keyword arg
+               | IDENT CMP value            # condition: amount > 5
+               | value CMP IDENT CMP value  # between: 5 < amount < 10
+               | value                      # positional (rewritten, see below)
+    value      = INT | FLOAT | STRING | TIMESTAMP | list | true|false|null
+               | IDENT                      # bareword == string
+
+Positional rewrites mirror what the upstream grammar does so the executor
+sees a uniform ``Args`` map (``pql/ast.go``):
+
+    Set(10, f=1, 2017-01-01T00:00)   → _col=10, f=1, _timestamp=...
+    Clear(10, f=1)                   → _col=10
+    TopN(f, n=5) / Rows(f)           → _field="f"
+    SetRowAttrs(f, 10, x=1)          → _field="f", _row=10
+    SetColumnAttrs(10, x=1)          → _col=10
+
+Bare timestamps anywhere map to ``_timestamp``; bareword identifiers in
+positional position map to ``_field``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pilosa_tpu.pql import lexer as lx
+from pilosa_tpu.pql.ast import Call, Condition, Query
+
+# calls whose non-timestamp, non-bareword positional scalars fill these keys
+_POSITIONAL_SLOTS: dict[str, list[str]] = {
+    "Set": ["_col"],
+    "Clear": ["_col"],
+    "SetColumnAttrs": ["_col"],
+    "SetRowAttrs": ["_row"],  # _field consumed by the bareword rule
+}
+
+_CMP_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, pos: int):
+        super().__init__(f"{msg} (at offset {pos})")
+        self.pos = pos
+
+
+class _Parser:
+    def __init__(self, src: str):
+        try:
+            self.toks = lx.tokenize(src)
+        except lx.LexError as e:
+            raise ParseError(str(e), 0) from e
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self, ahead: int = 0) -> lx.Token:
+        j = min(self.i + ahead, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> lx.Token:
+        t = self.toks[self.i]
+        if t.kind != lx.EOF:
+            self.i += 1
+        return t
+
+    def expect(self, kind: str) -> lx.Token:
+        t = self.next()
+        if t.kind != kind:
+            raise ParseError(f"expected {kind}, got {t.kind} {t.value!r}", t.pos)
+        return t
+
+    # -- grammar ---------------------------------------------------------
+    def query(self) -> Query:
+        calls = []
+        while self.peek().kind != lx.EOF:
+            calls.append(self.call())
+        if not calls:
+            raise ParseError("empty query", 0)
+        return Query(calls)
+
+    def call(self) -> Call:
+        name_tok = self.expect(lx.IDENT)
+        self.expect(lx.LPAREN)
+        call = Call(str(name_tok.value))
+        positional_used = 0
+        if self.peek().kind != lx.RPAREN:
+            while True:
+                self._arg(call, positional_used)
+                positional_used = sum(
+                    1 for k in _POSITIONAL_SLOTS.get(call.name, [])
+                    if k in call.args
+                )
+                if self.peek().kind == lx.COMMA:
+                    self.next()
+                    continue
+                break
+        self.expect(lx.RPAREN)
+        return call
+
+    def _arg(self, call: Call, positional_used: int) -> None:
+        t = self.peek()
+        if t.kind == lx.IDENT:
+            nxt = self.peek(1)
+            if nxt.kind == lx.LPAREN:
+                call.children.append(self.call())
+                return
+            if nxt.kind == lx.ASSIGN:
+                key = str(self.next().value)
+                self.next()  # '='
+                if key in call.args:
+                    raise ParseError(f"duplicate arg {key!r}", t.pos)
+                call.args[key] = self.value()
+                return
+            if nxt.kind == lx.CMP:
+                # condition with field on the left: amount > 5
+                field = str(self.next().value)
+                op = str(self.next().value)
+                val = self.value()
+                self._set_cond(call, field, Condition(op, val), t.pos)
+                return
+            # bareword positional → _field (TopN(f), Rows(f), SetRowAttrs(f,...))
+            word = str(self.next().value)
+            if word in ("true", "false", "null"):
+                raise ParseError(f"unexpected positional literal {word!r}", t.pos)
+            if "_field" in call.args:
+                raise ParseError(f"unexpected bareword {word!r}", t.pos)
+            call.args["_field"] = word
+            return
+
+        if t.kind in (lx.INT, lx.FLOAT) and self.peek(1).kind == lx.CMP:
+            # between: 5 < amount < 10  (lo CMP field CMP hi)
+            lo = self.next().value
+            lo_op = str(self.expect(lx.CMP).value)
+            field = str(self.expect(lx.IDENT).value)
+            if self.peek().kind == lx.CMP:
+                hi_op = str(self.next().value)
+                hi = self.value()
+                if lo_op not in ("<", "<=") or hi_op not in ("<", "<="):
+                    raise ParseError(
+                        f"between bounds must use < or <=, got {lo_op} {hi_op}",
+                        t.pos)
+                op = ("<" if lo_op == "<" else "<=") + ">" + \
+                    ("<" if hi_op == "<" else "<=")
+                self._set_cond(call, field, Condition(op, [lo, hi]), t.pos)
+            else:
+                # value on the left only: 5 < amount  ≡  amount > 5
+                self._set_cond(
+                    call, field, Condition(_CMP_FLIP[lo_op], lo), t.pos)
+            return
+
+        if t.kind == lx.TIMESTAMP:
+            self.next()
+            if "_timestamp" in call.args:
+                raise ParseError("duplicate timestamp arg", t.pos)
+            call.args["_timestamp"] = str(t.value)
+            return
+
+        # positional scalar → per-call slot (_col / _row)
+        val = self.value()
+        slots = _POSITIONAL_SLOTS.get(call.name, [])
+        if positional_used >= len(slots):
+            raise ParseError(
+                f"{call.name}: unexpected positional argument {val!r}", t.pos)
+        call.args[slots[positional_used]] = val
+
+    def _set_cond(self, call: Call, field: str, cond: Condition, pos: int) -> None:
+        if field in call.args:
+            raise ParseError(f"duplicate condition on field {field!r}", pos)
+        call.args[field] = cond
+
+    def value(self) -> Any:
+        # call-valued args: GroupBy(Rows(a), filter=Row(x=1))
+        if self.peek().kind == lx.IDENT and self.peek(1).kind == lx.LPAREN:
+            return self.call()
+        t = self.next()
+        if t.kind == lx.INT or t.kind == lx.FLOAT or t.kind == lx.STRING:
+            return t.value
+        if t.kind == lx.TIMESTAMP:
+            return str(t.value)
+        if t.kind == lx.IDENT:
+            if t.value == "true":
+                return True
+            if t.value == "false":
+                return False
+            if t.value == "null":
+                return None
+            return str(t.value)  # bareword value == string (field=amount)
+        if t.kind == lx.LBRACK:
+            items = []
+            if self.peek().kind != lx.RBRACK:
+                while True:
+                    items.append(self.value())
+                    if self.peek().kind == lx.COMMA:
+                        self.next()
+                        continue
+                    break
+            self.expect(lx.RBRACK)
+            return items
+        raise ParseError(f"expected value, got {t.kind} {t.value!r}", t.pos)
+
+
+def parse(src: str) -> Query:
+    """Parse a PQL string into a :class:`Query` (reference:
+    ``pql.ParseString``)."""
+    return _Parser(src).query()

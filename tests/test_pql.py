@@ -1,0 +1,191 @@
+"""PQL parser tests (reference test model: ``pql/pql_test.go`` grammar +
+error cases; SURVEY.md §5)."""
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.pql.ast import Condition
+
+
+def parse1(src):
+    q = pql.parse(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+class TestBasicCalls:
+    def test_row(self):
+        c = parse1("Row(f=1)")
+        assert c.name == "Row"
+        assert c.args == {"f": 1}
+        assert c.children == []
+
+    def test_row_string_key(self):
+        c = parse1('Row(f="foo")')
+        assert c.args == {"f": "foo"}
+
+    def test_single_quotes(self):
+        c = parse1("Row(f='foo')")
+        assert c.args == {"f": "foo"}
+
+    def test_nested(self):
+        c = parse1("Count(Intersect(Row(a=1), Row(b=2)))")
+        assert c.name == "Count"
+        inner = c.children[0]
+        assert inner.name == "Intersect"
+        assert [ch.name for ch in inner.children] == ["Row", "Row"]
+        assert inner.children[0].args == {"a": 1}
+        assert inner.children[1].args == {"b": 2}
+
+    def test_multiple_toplevel_calls(self):
+        q = pql.parse("Row(f=1) Count(Row(f=2))")
+        assert [c.name for c in q.calls] == ["Row", "Count"]
+
+    def test_all_no_args(self):
+        c = parse1("All()")
+        assert c.name == "All"
+        assert c.args == {} and c.children == []
+
+    def test_mixed_children_and_args(self):
+        c = parse1("TopN(f, Row(other=5), n=10)")
+        assert c.args["_field"] == "f"
+        assert c.args["n"] == 10
+        assert c.children[0].name == "Row"
+
+    def test_bool_null_values(self):
+        c = parse1("Options(Row(f=1), excludeColumns=true, x=null, y=false)")
+        assert c.args == {"excludeColumns": True, "x": None, "y": False}
+
+    def test_list_value(self):
+        c = parse1("Options(Row(f=1), shards=[0, 2, 4])")
+        assert c.args["shards"] == [0, 2, 4]
+
+    def test_list_of_strings(self):
+        c = parse1('Rows(f, in=["a", "b"])')
+        assert c.args["in"] == ["a", "b"]
+
+    def test_bareword_value_is_string(self):
+        c = parse1("Sum(field=amount)")
+        assert c.args == {"field": "amount"}
+
+    def test_negative_and_float(self):
+        calls = pql.parse("Row(f=-3) Row(g=1.5)").calls
+        assert calls[0].args == {"f": -3}
+        assert calls[1].args == {"g": 1.5}
+
+    def test_dashed_field_name(self):
+        c = parse1("Row(my-field=1)")
+        assert c.args == {"my-field": 1}
+
+
+class TestPositionalRewrites:
+    def test_set(self):
+        c = parse1("Set(10, f=1)")
+        assert c.args == {"_col": 10, "f": 1}
+
+    def test_set_with_timestamp(self):
+        c = parse1("Set(10, f=1, 2017-01-02T03:04)")
+        assert c.args == {"_col": 10, "f": 1, "_timestamp": "2017-01-02T03:04"}
+
+    def test_set_string_col_key(self):
+        c = parse1('Set("col-key", f="row-key")')
+        assert c.args == {"_col": "col-key", "f": "row-key"}
+
+    def test_clear(self):
+        c = parse1("Clear(7, f=2)")
+        assert c.args == {"_col": 7, "f": 2}
+
+    def test_topn_field(self):
+        c = parse1("TopN(f, n=25)")
+        assert c.args == {"_field": "f", "n": 25}
+
+    def test_rows_field(self):
+        c = parse1("Rows(f)")
+        assert c.args == {"_field": "f"}
+
+    def test_setrowattrs(self):
+        c = parse1('SetRowAttrs(f, 10, color="red")')
+        assert c.args == {"_field": "f", "_row": 10, "color": "red"}
+
+    def test_setcolumnattrs(self):
+        c = parse1("SetColumnAttrs(10, active=true)")
+        assert c.args == {"_col": 10, "active": True}
+
+    def test_row_time_range(self):
+        c = parse1("Row(f=1, from='2010-01-01T00:00', to='2012-01-01T00:00')")
+        assert c.args["from"] == "2010-01-01T00:00"
+        assert c.args["to"] == "2012-01-01T00:00"
+
+    def test_bare_timestamp_value(self):
+        c = parse1("Row(f=1, from=2010-01-01T00:00)")
+        assert c.args["from"] == "2010-01-01T00:00"
+
+
+class TestConditions:
+    @pytest.mark.parametrize("op", ["==", "!=", "<", "<=", ">", ">="])
+    def test_scalar_ops(self, op):
+        c = parse1(f"Row(amount {op} 5)")
+        assert c.args["amount"] == Condition(op, 5)
+
+    def test_negative_predicate(self):
+        c = parse1("Row(amount > -10)")
+        assert c.args["amount"] == Condition(">", -10)
+
+    def test_between_strict(self):
+        c = parse1("Row(5 < amount < 10)")
+        assert c.args["amount"] == Condition("<><", [5, 10])
+
+    def test_between_inclusive(self):
+        c = parse1("Row(5 <= amount <= 10)")
+        assert c.args["amount"] == Condition("<=><=", [5, 10])
+
+    def test_between_mixed(self):
+        c = parse1("Row(5 <= amount < 10)")
+        assert c.args["amount"] == Condition("<=><", [5, 10])
+
+    def test_left_bound_only_flips(self):
+        c = parse1("Row(5 < amount)")
+        assert c.args["amount"] == Condition(">", 5)
+
+    def test_condition_ne_null(self):
+        c = parse1("Row(amount != null)")
+        assert c.args["amount"] == Condition("!=", None)
+
+    def test_condition_in_count(self):
+        c = parse1("Count(Row(amount >= 100))")
+        assert c.children[0].args["amount"] == Condition(">=", 100)
+
+
+class TestCallValuedArgs:
+    def test_groupby_filter(self):
+        c = parse1("GroupBy(Rows(a), Rows(b), filter=Row(x=1), limit=10)")
+        assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+        filt = c.args["filter"]
+        assert filt.name == "Row" and filt.args == {"x": 1}
+        assert c.args["limit"] == 10
+
+
+class TestErrors:
+    @pytest.mark.parametrize("src", [
+        "",
+        "Row(",
+        "Row)",
+        "Row(f=)",
+        "Row(f=1",
+        "Row(f==)",
+        "Set(10, 20, f=1)",          # too many positionals
+        "TopN(f, g)",                 # two barewords
+        "Row(f=1, f=2)",              # duplicate key
+        "Row(amount > 5, amount < 3)",  # duplicate condition
+        "Row(5 > amount > 3)",        # bad between ops
+        'Row(f="unterminated)',
+        "Row(f=1) garbage(",
+    ])
+    def test_raises(self, src):
+        with pytest.raises(pql.ParseError):
+            pql.parse(src)
+
+    def test_roundtrip_str(self):
+        src = "Count(Intersect(Row(a=1), Row(b=2)))"
+        c = parse1(src)
+        assert pql.parse(str(c)).calls[0] == c
