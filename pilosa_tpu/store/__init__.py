@@ -1,0 +1,18 @@
+"""Host-truth storage tree (L1/L4 of SURVEY.md §2).
+
+Device arrays are a cache; these modules are the durable truth —
+roaring-format snapshot files plus CRC-framed op-logs under
+``<data>/<index>/<field>/views/<view>/fragments/<shard>``.
+"""
+
+from pilosa_tpu.store.field import Field, FieldOptions
+from pilosa_tpu.store.fragment import Fragment
+from pilosa_tpu.store.holder import Holder
+from pilosa_tpu.store.index import EXISTENCE_FIELD, Index
+from pilosa_tpu.store.row import RowBits
+from pilosa_tpu.store.view import VIEW_STANDARD, View
+
+__all__ = [
+    "Field", "FieldOptions", "Fragment", "Holder", "Index", "RowBits",
+    "View", "VIEW_STANDARD", "EXISTENCE_FIELD",
+]

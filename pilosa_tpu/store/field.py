@@ -1,0 +1,329 @@
+"""Field: a typed attribute group within an index.
+
+Reference: ``field.go`` (SURVEY.md §3.1) — field types ``set``, ``int``
+(BSI), ``time``, ``mutex``, ``bool`` plus v2's ``decimal`` and
+``timestamp``; options (cache type/size kept for API parity, keys, time
+quantum, min/max); the ``bsiGroup`` bit-sliced encoding with an exists
+row, a sign row, and one row per magnitude bit of ``value - base``.
+
+BSI row layout matches :mod:`pilosa_tpu.engine.bsi` exactly (EXISTS=0,
+SIGN=1, OFFSET=2) — the device kernels consume fragment planes without
+re-indexing.  ``bit_depth`` grows dynamically as larger values arrive
+(reference: ``bsiGroup.bitDepth`` growth) and is persisted in the field
+meta.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, dataclass, field as dc_field
+from datetime import datetime, timezone
+
+import numpy as np
+
+from pilosa_tpu.engine.bsi import EXISTS_ROW, OFFSET_ROW, SIGN_ROW
+from pilosa_tpu.store import timeq
+from pilosa_tpu.store.view import VIEW_BSI_PREFIX, VIEW_STANDARD, View
+
+TYPE_SET = "set"
+TYPE_INT = "int"
+TYPE_TIME = "time"
+TYPE_MUTEX = "mutex"
+TYPE_BOOL = "bool"
+TYPE_DECIMAL = "decimal"
+TYPE_TIMESTAMP = "timestamp"
+
+BSI_TYPES = (TYPE_INT, TYPE_DECIMAL, TYPE_TIMESTAMP)
+
+_UNIX_EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+_TS_UNITS = {"s": 1, "ms": 10**3, "us": 10**6, "ns": 10**9}
+
+
+@dataclass
+class FieldOptions:
+    """Reference: ``field.go#FieldOptions`` / ``fieldOptions``."""
+
+    type: str = TYPE_SET
+    keys: bool = False
+    cache_type: str = "ranked"   # ranked | lru | none (API parity; the TPU
+    cache_size: int = 50000      # TopN path recounts, caches are not used)
+    time_quantum: str = ""
+    min: int | None = None
+    max: int | None = None
+    base: int = 0
+    bit_depth: int = 0
+    scale: int = 0               # decimal: value stored as int(v * 10^scale)
+    epoch: str = ""              # timestamp: ISO epoch, default Unix
+    time_unit: str = "s"         # timestamp: s | ms | us | ns
+
+    def __post_init__(self):
+        if self.type not in (TYPE_SET, TYPE_INT, TYPE_TIME, TYPE_MUTEX,
+                             TYPE_BOOL, TYPE_DECIMAL, TYPE_TIMESTAMP):
+            raise ValueError(f"invalid field type {self.type!r}")
+        if self.type == TYPE_TIME and self.time_quantum:
+            self.time_quantum = timeq.validate_quantum(self.time_quantum)
+        if self.type == TYPE_TIMESTAMP and self.time_unit not in _TS_UNITS:
+            raise ValueError(f"invalid timestamp unit {self.time_unit!r}")
+        if self.type in BSI_TYPES and self.min is not None and self.max is not None:
+            if self.min > self.max:
+                raise ValueError("field min > max")
+            # base minimizes stored magnitudes (reference: v2 base offset)
+            if self.base == 0:
+                if self.min > 0:
+                    self.base = self.min
+                elif self.max < 0:
+                    self.base = self.max
+            if self.bit_depth == 0:
+                span = max(abs(self.min - self.base), abs(self.max - self.base))
+                self.bit_depth = max(1, int(span).bit_length())
+        if self.type in BSI_TYPES and self.bit_depth == 0:
+            self.bit_depth = 1
+
+
+class Field:
+    def __init__(self, path: str, index_name: str, name: str,
+                 options: FieldOptions | None = None, *, fsync: bool = False):
+        self.path = path
+        self.index_name = index_name
+        self.name = name
+        self.options = options or FieldOptions()
+        self.fsync = fsync
+        self.views: dict[str, View] = {}
+        self._lock = threading.RLock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Field":
+        meta = os.path.join(self.path, ".meta")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.options = FieldOptions(**json.load(f))
+        views_dir = os.path.join(self.path, "views")
+        if os.path.isdir(views_dir):
+            for name in os.listdir(views_dir):
+                v = View(os.path.join(views_dir, name), name, fsync=self.fsync)
+                self.views[name] = v.open()
+        return self
+
+    def save_meta(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = os.path.join(self.path, ".meta.tmp")
+        with open(tmp, "w") as f:
+            json.dump(asdict(self.options), f)
+        os.replace(tmp, os.path.join(self.path, ".meta"))
+
+    def close(self) -> None:
+        for v in self.views.values():
+            v.close()
+
+    # -- views --------------------------------------------------------------
+
+    def view(self, name: str, create: bool = False) -> View | None:
+        with self._lock:
+            v = self.views.get(name)
+            if v is None and create:
+                v = View(os.path.join(self.path, "views", name), name,
+                         fsync=self.fsync).open()
+                self.views[name] = v
+            return v
+
+    @property
+    def bsi_view_name(self) -> str:
+        return VIEW_BSI_PREFIX + self.name
+
+    def standard_view(self, create: bool = False) -> View | None:
+        return self.view(VIEW_STANDARD, create)
+
+    def bsi_view(self, create: bool = False) -> View | None:
+        return self.view(self.bsi_view_name, create)
+
+    def available_shards(self) -> list[int]:
+        shards: set[int] = set()
+        with self._lock:
+            for v in self.views.values():
+                shards.update(v.available_shards())
+        return sorted(shards)
+
+    def max_row_id(self) -> int:
+        v = self.standard_view()
+        return v.max_row_id() if v else 0
+
+    # -- bit writes (set / time / mutex / bool) -----------------------------
+
+    def set_bit(self, row_id: int, col: int, timestamp: datetime | None = None) -> bool:
+        return self.import_bits(np.array([row_id], np.uint64),
+                                np.array([col], np.uint64),
+                                [timestamp] if timestamp else None) > 0
+
+    def clear_bit(self, row_id: int, col: int) -> bool:
+        if self.options.type in BSI_TYPES:
+            raise ValueError(f"field {self.name}: Clear on BSI field")
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        shard, off = col // SHARD_WIDTH, col % SHARD_WIDTH
+        changed = 0
+        with self._lock:
+            for v in self.views.values():
+                frag = v.fragment(shard)
+                if frag is not None:
+                    changed += frag.clear_bits(np.array([row_id], np.uint64),
+                                               np.array([off], np.uint64))
+        return changed > 0
+
+    def import_bits(self, row_ids: np.ndarray, cols: np.ndarray,
+                    timestamps: list[datetime | None] | None = None) -> int:
+        """Bulk (row, col[, ts]) writes routed to standard + time views
+        (reference: ``field.Import`` → view fan-out, SURVEY.md §4.5)."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        opts = self.options
+        if opts.type in BSI_TYPES:
+            raise ValueError(f"field {self.name}: bit import on BSI field")
+        row_ids = np.asarray(row_ids, np.uint64)
+        cols = np.asarray(cols, np.uint64)
+        if opts.type == TYPE_BOOL and len(row_ids) and int(row_ids.max()) > 1:
+            raise ValueError("bool field rows must be 0 or 1")
+        shards = cols // np.uint64(SHARD_WIDTH)
+        offs = cols % np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            m = shards == shard
+            r, c = row_ids[m], offs[m]
+            if opts.type in (TYPE_MUTEX, TYPE_BOOL):
+                changed += self._set_mutex(int(shard), r, c)
+            else:
+                frag = self.standard_view(create=True).fragment(int(shard), create=True)
+                changed += frag.set_bits(r, c)
+            if opts.type == TYPE_TIME and timestamps is not None and opts.time_quantum:
+                idx = np.nonzero(m)[0]
+                for j, (rr, cc) in enumerate(zip(r, c)):
+                    ts = timestamps[idx[j]] if idx[j] < len(timestamps) else None
+                    if ts is None:
+                        continue
+                    for vname in timeq.views_by_time(VIEW_STANDARD, ts, opts.time_quantum):
+                        tf = self.view(vname, create=True).fragment(int(shard), create=True)
+                        tf.set_bits(np.array([rr], np.uint64), np.array([cc], np.uint64))
+        return changed
+
+    def _set_mutex(self, shard: int, row_ids: np.ndarray, cols: np.ndarray) -> int:
+        """Mutex semantics: setting (row, col) clears every other row of
+        col (reference: mutex enforcement in ``fragment.setMutex``)."""
+        frag = self.standard_view(create=True).fragment(shard, create=True)
+        changed = 0
+        # last write per column wins within the batch
+        _, last_idx = np.unique(cols[::-1], return_index=True)
+        keep = len(cols) - 1 - last_idx
+        for i in keep:
+            r, c = int(row_ids[i]), int(cols[i])
+            for existing in frag.row_ids():
+                if existing != r and frag.row(existing).contains(c):
+                    frag.clear_bits(np.array([existing], np.uint64),
+                                    np.array([c], np.uint64))
+            changed += frag.set_bits(np.array([r], np.uint64),
+                                     np.array([c], np.uint64))
+        return changed
+
+    # -- BSI value writes ---------------------------------------------------
+
+    def to_stored(self, value) -> int:
+        """API value -> stored integer (decimal scaling / timestamp epoch)."""
+        opts = self.options
+        if opts.type == TYPE_DECIMAL:
+            return int(round(float(value) * 10**opts.scale))
+        if opts.type == TYPE_TIMESTAMP:
+            if isinstance(value, str):
+                value = timeq.parse_pql_time(value).replace(tzinfo=timezone.utc)
+            if isinstance(value, datetime):
+                epoch = (datetime.fromisoformat(opts.epoch)
+                         if opts.epoch else _UNIX_EPOCH)
+                if value.tzinfo is None:
+                    value = value.replace(tzinfo=timezone.utc)
+                return int((value - epoch).total_seconds() * _TS_UNITS[opts.time_unit])
+            return int(value)
+        return int(value)
+
+    def from_stored(self, stored: int):
+        opts = self.options
+        if opts.type == TYPE_DECIMAL:
+            return stored / 10**opts.scale
+        return stored
+
+    def set_value(self, col: int, value) -> bool:
+        return self.import_values(np.array([col], np.uint64), [value]) > 0
+
+    def import_values(self, cols: np.ndarray, values) -> int:
+        """Bulk BSI writes: per bit-plane set/clear so overwrites need no
+        read-back (reference: ``field.importValue`` → ``fragment.importValue``)."""
+        opts = self.options
+        if opts.type not in BSI_TYPES:
+            raise ValueError(f"field {self.name}: value import on non-BSI field")
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        cols = np.asarray(cols, np.uint64)
+        stored = np.array([self.to_stored(v) for v in values], dtype=np.int64)
+        if opts.min is not None and (stored < self.to_stored(opts.min)).any():
+            raise ValueError(f"value below field min {opts.min}")
+        if opts.max is not None and (stored > self.to_stored(opts.max)).any():
+            raise ValueError(f"value above field max {opts.max}")
+        offs = stored - np.int64(opts.base)
+        mag = np.abs(offs).astype(np.uint64)
+        need = max((int(m).bit_length() for m in mag), default=1) or 1
+        if need > opts.bit_depth:
+            opts.bit_depth = need
+            self.save_meta()
+        depth = opts.bit_depth
+
+        shards = cols // np.uint64(SHARD_WIDTH)
+        col_offs = cols % np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            m = shards == shard
+            c, o, g = col_offs[m], offs[m], mag[m]
+            frag = self.bsi_view(create=True).fragment(int(shard), create=True)
+            # last write per column wins within the batch
+            _, last = np.unique(c[::-1], return_index=True)
+            keep = len(c) - 1 - last
+            c, o, g = c[keep], o[keep], g[keep]
+            changed += frag.set_bits(np.full(len(c), EXISTS_ROW, np.uint64), c)
+            neg = o < 0
+            frag.set_bits(np.full(neg.sum(), SIGN_ROW, np.uint64), c[neg])
+            frag.clear_bits(np.full((~neg).sum(), SIGN_ROW, np.uint64), c[~neg])
+            for b in range(depth):
+                hit = (g >> np.uint64(b)) & np.uint64(1) != 0
+                row = np.uint64(OFFSET_ROW + b)
+                frag.set_bits(np.full(hit.sum(), row, np.uint64), c[hit])
+                frag.clear_bits(np.full((~hit).sum(), row, np.uint64), c[~hit])
+        return changed
+
+    def value(self, col: int) -> tuple[int, bool]:
+        """Read one column's BSI value: (value, exists)."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        opts = self.options
+        v = self.bsi_view()
+        if v is None:
+            return 0, False
+        frag = v.fragment(col // SHARD_WIDTH)
+        if frag is None:
+            return 0, False
+        off = col % SHARD_WIDTH
+        if not frag.row(EXISTS_ROW).contains(off):
+            return 0, False
+        mag = 0
+        for b in range(opts.bit_depth):
+            if frag.row(OFFSET_ROW + b).contains(off):
+                mag |= 1 << b
+        if frag.row(SIGN_ROW).contains(off):
+            mag = -mag
+        return self.from_stored(mag + opts.base), True
+
+    def clear_value(self, col: int) -> bool:
+        """Remove a column's BSI value entirely."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        v = self.bsi_view()
+        if v is None:
+            return False
+        frag = v.fragment(col // SHARD_WIDTH)
+        if frag is None:
+            return False
+        off = col % SHARD_WIDTH
+        rows = np.arange(OFFSET_ROW + self.options.bit_depth, dtype=np.uint64)
+        return frag.clear_bits(rows, np.full(len(rows), off, np.uint64)) > 0

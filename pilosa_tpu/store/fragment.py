@@ -1,0 +1,289 @@
+"""Fragment: one (field, view, shard) storage unit.
+
+Reference: ``fragment.go`` (SURVEY.md §3.1) — bits of all rows of one view
+of one shard in a single roaring bitmap keyed by
+``rowID * ShardWidth + column``, persisted as an mmap'd snapshot plus an
+op-log, compacted when ``opN > MaxOpN``.
+
+This rebuild keeps the same on-disk contract (roaring snapshot file +
+CRC-framed op-log, same position encoding) but host memory is per-row
+:class:`~pilosa_tpu.store.row.RowBits` (sparse/dense auto-converting) —
+the natural shape for assembling dense device planes.  The reference's
+per-fragment TopN rank/LRU cache (``cache.go``) is intentionally absent:
+on TPU, TopN recounts every row at HBM bandwidth (``engine.kernels.row_counts``),
+so there is no cache to maintain or invalidate.
+
+Concurrency: one RLock per fragment (reference: per-fragment
+``sync.RWMutex``); mutators and plane assembly take it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+import numpy as np
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.store import roaring
+from pilosa_tpu.store.oplog import OP_CLEAR_BITS, OP_CLEAR_ROW, OP_SET_BITS, OpLog
+from pilosa_tpu.store.row import RowBits
+
+# Reference default: compact the op-log into a snapshot after ~2000 ops.
+MAX_OP_N = 2000
+
+# Rows per anti-entropy checksum block (reference: HashBlockSize = 100).
+HASH_BLOCK_SIZE = 100
+
+_SW = np.uint64(SHARD_WIDTH)
+
+
+class Fragment:
+    """Bits of one (field, view, shard)."""
+
+    def __init__(self, path: str, shard: int, *, max_op_n: int = MAX_OP_N,
+                 fsync: bool = False):
+        self.path = path                      # snapshot file
+        self.shard = shard
+        self.max_op_n = max_op_n
+        self.rows: dict[int, RowBits] = {}
+        self.op_n = 0
+        self.generation = 0                   # bumped per mutation; device
+                                              # plane caches key on this
+        self.lock = threading.RLock()
+        self._oplog = OpLog(path + ".oplog", fsync=fsync)
+        self._open = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Fragment":
+        with self.lock:
+            if self._open:
+                return self
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    self._load_positions(roaring.deserialize(f.read()))
+            for op, aux, positions in self._oplog.replay():
+                self._apply(op, aux, positions)
+                self.op_n += 1
+            self._open = True
+        return self
+
+    def close(self) -> None:
+        with self.lock:
+            if self.op_n > 0:
+                self.snapshot()
+            self._oplog.close()
+            self._open = False
+
+    # -- reads --------------------------------------------------------------
+
+    def row(self, row_id: int) -> RowBits:
+        with self.lock:
+            return self.rows.get(row_id) or RowBits()
+
+    def row_ids(self) -> list[int]:
+        with self.lock:
+            return sorted(r for r, b in self.rows.items() if b.any())
+
+    def max_row_id(self) -> int:
+        ids = self.row_ids()
+        return ids[-1] if ids else 0
+
+    def cardinality(self) -> int:
+        with self.lock:
+            return sum(b.cardinality for b in self.rows.values())
+
+    def positions(self) -> np.ndarray:
+        """All set bits as sorted uint64 ``row*ShardWidth + col``."""
+        with self.lock:
+            parts = [
+                np.uint64(r) * _SW + b.columns().astype(np.uint64)
+                for r, b in sorted(self.rows.items())
+                if b.any()
+            ]
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    # -- mutation -----------------------------------------------------------
+
+    def set_bit(self, row_id: int, col: int) -> bool:
+        return self.set_bits(np.array([row_id], np.uint64),
+                             np.array([col], np.uint64)) > 0
+
+    def clear_bit(self, row_id: int, col: int) -> bool:
+        return self.clear_bits(np.array([row_id], np.uint64),
+                               np.array([col], np.uint64)) > 0
+
+    def set_bits(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
+        """Bulk set; returns number of newly-set bits (reference:
+        ``fragment.bulkImport``, SURVEY.md §4.5)."""
+        positions = (np.asarray(row_ids, np.uint64) * _SW
+                     + np.asarray(cols, np.uint64))
+        with self.lock:
+            changed = self._apply(OP_SET_BITS, 0, positions)
+            if changed:
+                self._log(OP_SET_BITS, 0, positions)
+            return changed
+
+    def clear_bits(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
+        positions = (np.asarray(row_ids, np.uint64) * _SW
+                     + np.asarray(cols, np.uint64))
+        with self.lock:
+            changed = self._apply(OP_CLEAR_BITS, 0, positions)
+            if changed:
+                self._log(OP_CLEAR_BITS, 0, positions)
+            return changed
+
+    def clear_row(self, row_id: int) -> int:
+        """Clear every bit of a row (reference: ``fragment.clearRow``)."""
+        with self.lock:
+            changed = self._apply(OP_CLEAR_ROW, row_id, None)
+            if changed:
+                self._log(OP_CLEAR_ROW, row_id, None)
+            return changed
+
+    def set_row(self, row_id: int, cols: np.ndarray) -> bool:
+        """Replace a row's bits wholesale (reference: ``Store()`` /
+        ``fragment.setRow``)."""
+        with self.lock:
+            before = self.rows.get(row_id)
+            new = RowBits.from_columns(cols)
+            if before is not None and np.array_equal(before.columns(), new.columns()):
+                return False
+            self._apply(OP_CLEAR_ROW, row_id, None)
+            self._log(OP_CLEAR_ROW, row_id, None)
+            if new.any():
+                positions = np.uint64(row_id) * _SW + new.columns().astype(np.uint64)
+                self._apply(OP_SET_BITS, 0, positions)
+                self._log(OP_SET_BITS, 0, positions)
+            return True
+
+    def import_roaring(self, blob: bytes, clear: bool = False) -> int:
+        """Union (or clear) an already-roaring-encoded bit set — the bulk
+        loader fast path (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
+        positions = roaring.deserialize(blob)
+        op = OP_CLEAR_BITS if clear else OP_SET_BITS
+        with self.lock:
+            changed = self._apply(op, 0, positions)
+            if changed:
+                self._log(op, 0, positions)
+            return changed
+
+    # -- durability ---------------------------------------------------------
+
+    def snapshot(self) -> None:
+        """Rewrite the snapshot file from memory and truncate the op-log
+        (reference: ``fragment.snapshot``).  Atomic via temp+rename."""
+        with self.lock:
+            blob = roaring.serialize(self.positions())
+            tmp = self.path + ".tmp"
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._oplog.truncate()
+            self.op_n = 0
+
+    # -- anti-entropy -------------------------------------------------------
+
+    def blocks(self) -> dict[int, int]:
+        """Per-block checksums: block = ``row_id // HASH_BLOCK_SIZE``;
+        checksum = crc32 over the block's sorted positions (reference:
+        ``fragment.Blocks``, SURVEY.md §4.6)."""
+        out: dict[int, int] = {}
+        with self.lock:
+            by_block: dict[int, list[tuple[int, RowBits]]] = {}
+            for r, b in self.rows.items():
+                if b.any():
+                    by_block.setdefault(r // HASH_BLOCK_SIZE, []).append((r, b))
+            for blk, members in by_block.items():
+                crc = 0
+                for r, b in sorted(members):
+                    pos = np.uint64(r) * _SW + b.columns().astype(np.uint64)
+                    crc = zlib.crc32(pos.astype("<u8").tobytes(), crc)
+                out[blk] = crc
+        return out
+
+    def block_positions(self, block: int) -> np.ndarray:
+        """All positions of one checksum block (for AAE data exchange)."""
+        lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
+        with self.lock:
+            parts = [
+                np.uint64(r) * _SW + b.columns().astype(np.uint64)
+                for r, b in sorted(self.rows.items())
+                if lo <= r < hi and b.any()
+            ]
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def merge_positions(self, positions: np.ndarray) -> int:
+        """Union positions in (AAE repair receive path)."""
+        with self.lock:
+            changed = self._apply(OP_SET_BITS, 0, positions)
+            if changed:
+                self._log(OP_SET_BITS, 0, positions)
+            return changed
+
+    # -- internal -----------------------------------------------------------
+
+    def _apply(self, op: int, aux: int, positions: np.ndarray | None) -> int:
+        """Apply an op to memory; returns bits changed.  Shared by the
+        mutation API and op-log replay."""
+        changed = 0
+        if op == OP_CLEAR_ROW:
+            row = self.rows.get(aux)
+            if row is not None and row.any():
+                changed = row.cardinality
+                del self.rows[aux]
+        elif op in (OP_SET_BITS, OP_CLEAR_BITS):
+            assert positions is not None
+            self._check_rows(positions)
+            row_ids = positions // _SW
+            cols = (positions % _SW).astype(np.uint32)
+            uniq, starts = np.unique(row_ids, return_index=True)
+            bounds = np.append(starts, len(positions))
+            for i, r in enumerate(uniq):
+                r = int(r)
+                chunk = cols[bounds[i]:bounds[i + 1]]
+                if op == OP_SET_BITS:
+                    row = self.rows.get(r)
+                    if row is None:
+                        row = self.rows[r] = RowBits()
+                    changed += row.add(chunk)
+                else:
+                    row = self.rows.get(r)
+                    if row is not None:
+                        changed += row.remove(chunk)
+                        if not row.any():
+                            del self.rows[r]
+        else:
+            raise ValueError(f"fragment: unknown op {op}")
+        if changed:
+            self.generation += 1
+        return changed
+
+    def _check_rows(self, positions: np.ndarray) -> None:
+        if len(positions) and int(positions.max() // _SW) >= (1 << 40):
+            raise ValueError("row id out of range (>= 2^40)")
+
+    def _log(self, op: int, aux: int, positions: np.ndarray | None) -> None:
+        self._oplog.append(op, aux, positions)
+        self.op_n += 1
+        if self.op_n > self.max_op_n:
+            self.snapshot()
+
+    def _load_positions(self, positions: np.ndarray) -> None:
+        if len(positions) == 0:
+            return
+        row_ids = positions // _SW
+        cols = (positions % _SW).astype(np.uint32)
+        uniq, starts = np.unique(row_ids, return_index=True)
+        bounds = np.append(starts, len(positions))
+        for i, r in enumerate(uniq):
+            self.rows[int(r)] = RowBits.from_columns(cols[bounds[i]:bounds[i + 1]])

@@ -1,0 +1,133 @@
+"""Holder: root of the storage tree.
+
+Reference: ``holder.go`` (SURVEY.md §3.1) — owns the data directory layout
+
+    <data>/<index>/.meta
+    <data>/<index>/<field>/.meta
+    <data>/<index>/<field>/views/<view>/fragments/<shard>[.oplog]
+
+and opens everything on startup.  Meta files are JSON (the reference uses
+protobuf ``.meta``; JSON is a deliberate rebuild simplification — the
+schema is tiny and human-debuggable).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+from pilosa_tpu.store.field import FieldOptions
+from pilosa_tpu.store.index import Index
+
+
+class Holder:
+    def __init__(self, path: str, *, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self.indexes: dict[str, Index] = {}
+        self._lock = threading.RLock()
+
+    def open(self) -> "Holder":
+        os.makedirs(self.path, exist_ok=True)
+        for entry in sorted(os.listdir(self.path)):
+            ipath = os.path.join(self.path, entry)
+            if os.path.isdir(ipath) and not entry.startswith("."):
+                self.indexes[entry] = Index(ipath, entry,
+                                            fsync=self.fsync).open()
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            for idx in self.indexes.values():
+                idx.close()
+            self.indexes.clear()
+
+    # -- index management ---------------------------------------------------
+
+    def create_index(self, name: str, *, keys: bool = False,
+                     track_existence: bool = True) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                raise ValueError(f"index {name!r} already exists")
+            _validate_name(name)
+            idx = Index(os.path.join(self.path, name), name, keys=keys,
+                        track_existence=track_existence, fsync=self.fsync)
+            os.makedirs(idx.path, exist_ok=True)
+            idx.save_meta()
+            idx.open()
+            self.indexes[name] = idx
+            return idx
+
+    def ensure_index(self, name: str, **kw) -> Index:
+        with self._lock:
+            return self.indexes.get(name) or self.create_index(name, **kw)
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise KeyError(name)
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
+
+    # -- schema -------------------------------------------------------------
+
+    def schema(self) -> list[dict]:
+        """JSON-able schema dump (reference: ``API.Schema``)."""
+        out = []
+        with self._lock:
+            for iname, idx in sorted(self.indexes.items()):
+                fields = []
+                for fname, f in sorted(idx.fields.items()):
+                    if fname.startswith("_"):
+                        continue
+                    o = f.options
+                    fields.append({
+                        "name": fname,
+                        "options": {
+                            "type": o.type, "keys": o.keys,
+                            "cacheType": o.cache_type, "cacheSize": o.cache_size,
+                            "timeQuantum": o.time_quantum,
+                            "min": o.min, "max": o.max, "base": o.base,
+                            "bitDepth": o.bit_depth, "scale": o.scale,
+                        },
+                    })
+                out.append({"name": iname,
+                            "options": {"keys": idx.keys,
+                                        "trackExistence": idx.track_existence},
+                            "fields": fields})
+        return out
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        """Create any missing indexes/fields from a schema dump (used by
+        restore and cluster schema sync)."""
+        for ispec in schema:
+            idx = self.ensure_index(
+                ispec["name"],
+                keys=ispec.get("options", {}).get("keys", False),
+                track_existence=ispec.get("options", {}).get("trackExistence", True),
+            )
+            for fspec in ispec.get("fields", []):
+                if fspec["name"] in idx.fields:
+                    continue
+                o = fspec.get("options", {})
+                idx.create_field(fspec["name"], FieldOptions(
+                    type=o.get("type", "set"), keys=o.get("keys", False),
+                    cache_type=o.get("cacheType", "ranked"),
+                    cache_size=o.get("cacheSize", 50000),
+                    time_quantum=o.get("timeQuantum", ""),
+                    min=o.get("min"), max=o.get("max"),
+                    base=o.get("base", 0), bit_depth=o.get("bitDepth", 0),
+                    scale=o.get("scale", 0),
+                ))
+
+
+def _validate_name(name: str) -> None:
+    """Index/field naming rules (reference: lowercase, digits, -_)."""
+    import re
+    if not re.fullmatch(r"[a-z][a-z0-9_-]{0,229}", name):
+        raise ValueError(f"invalid name {name!r}")

@@ -1,0 +1,133 @@
+"""Index: a collection of fields over one column space.
+
+Reference: ``index.go`` (SURVEY.md §3.1) — per-index options ``keys`` and
+``trackExistence``; when existence is tracked, an internal ``_exists``
+field (one row, row 0) records which columns exist, enabling ``Not`` and
+``All`` (``executor.go#executeNot``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from datetime import datetime
+
+import numpy as np
+
+from pilosa_tpu.store.field import Field, FieldOptions
+
+EXISTENCE_FIELD = "_exists"
+
+
+class Index:
+    def __init__(self, path: str, name: str, *, keys: bool = False,
+                 track_existence: bool = True, fsync: bool = False):
+        self.path = path
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.fsync = fsync
+        self.fields: dict[str, Field] = {}
+        self._lock = threading.RLock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Index":
+        meta = os.path.join(self.path, ".meta")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                opts = json.load(f)
+            self.keys = opts.get("keys", False)
+            self.track_existence = opts.get("track_existence", True)
+        for entry in sorted(os.listdir(self.path)) if os.path.isdir(self.path) else []:
+            fpath = os.path.join(self.path, entry)
+            if os.path.isdir(fpath) and not entry.startswith("."):
+                self.fields[entry] = Field(fpath, self.name, entry,
+                                           fsync=self.fsync).open()
+        if self.track_existence and EXISTENCE_FIELD not in self.fields:
+            self._create_existence()
+        return self
+
+    def save_meta(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = os.path.join(self.path, ".meta.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"keys": self.keys,
+                       "track_existence": self.track_existence}, f)
+        os.replace(tmp, os.path.join(self.path, ".meta"))
+
+    def close(self) -> None:
+        for f in self.fields.values():
+            f.close()
+
+    # -- fields -------------------------------------------------------------
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            if name in self.fields:
+                raise ValueError(f"field {name!r} already exists")
+            f = Field(os.path.join(self.path, name), self.name, name,
+                      options or FieldOptions(), fsync=self.fsync)
+            os.makedirs(f.path, exist_ok=True)
+            f.save_meta()
+            self.fields[name] = f
+            return f
+
+    def ensure_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            return self.fields.get(name) or self.create_field(name, options)
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def delete_field(self, name: str) -> None:
+        import shutil
+        with self._lock:
+            f = self.fields.pop(name, None)
+            if f is None:
+                raise KeyError(name)
+            f.close()
+            shutil.rmtree(f.path, ignore_errors=True)
+
+    def _create_existence(self) -> Field:
+        return self.create_field(EXISTENCE_FIELD, FieldOptions(type="set"))
+
+    @property
+    def existence_field(self) -> Field | None:
+        return self.fields.get(EXISTENCE_FIELD)
+
+    # -- column tracking ----------------------------------------------------
+
+    def note_columns(self, cols: np.ndarray) -> None:
+        """Record columns in the existence field (row 0) — called by every
+        write path when ``trackExistence`` (reference: ``index.go``)."""
+        ef = self.existence_field
+        if ef is not None and len(cols):
+            ef.import_bits(np.zeros(len(cols), np.uint64),
+                           np.asarray(cols, np.uint64))
+
+    def available_shards(self) -> list[int]:
+        shards: set[int] = set()
+        for f in self.fields.values():
+            shards.update(f.available_shards())
+        return sorted(shards)
+
+    # -- write facade (used by API/executor) --------------------------------
+
+    def set_bit(self, field: str, row_id: int, col: int,
+                timestamp: datetime | None = None) -> bool:
+        f = self.fields.get(field)
+        if f is None:
+            raise KeyError(f"field {field!r} not found")
+        changed = f.set_bit(row_id, col, timestamp)
+        self.note_columns(np.array([col], np.uint64))
+        return changed
+
+    def set_value(self, field: str, col: int, value) -> bool:
+        f = self.fields.get(field)
+        if f is None:
+            raise KeyError(f"field {field!r} not found")
+        changed = f.set_value(col, value)
+        self.note_columns(np.array([col], np.uint64))
+        return changed

@@ -1,0 +1,99 @@
+"""Append-only operation log for fragment durability.
+
+Reference: the op-log appended after a fragment snapshot, replayed on open
+and compacted into a new snapshot when ``opN > MaxOpN``
+(``fragment.go#snapshot``; SURVEY.md §3.1, §4.5).  Here the log is a
+separate file beside the snapshot; records are CRC-framed so a torn tail
+write truncates cleanly on replay instead of corrupting the fragment.
+
+Record layout (little-endian):
+
+    u32 crc32 (of everything after this field)
+    u8  op     (1=SET_BITS, 2=CLEAR_BITS, 3=CLEAR_ROW)
+    u64 aux    (row id for CLEAR_ROW, else 0)
+    u32 len    payload byte length
+    payload    roaring-serialized bit positions (SET/CLEAR_BITS)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator
+
+import numpy as np
+
+from pilosa_tpu.store import roaring
+
+OP_SET_BITS = 1
+OP_CLEAR_BITS = 2
+OP_CLEAR_ROW = 3
+
+_HEADER = struct.Struct("<IBQI")
+
+
+class OpLog:
+    """One fragment's op log.  Not thread-safe; the fragment serializes."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._f = None
+
+    def _file(self):
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def append(self, op: int, aux: int = 0, positions: np.ndarray | None = None) -> None:
+        payload = b"" if positions is None else roaring.serialize(positions)
+        body = struct.pack("<BQI", op, aux, len(payload)) + payload
+        f = self._file()
+        f.write(struct.pack("<I", zlib.crc32(body)) + body)
+        f.flush()
+        if self.fsync:
+            os.fsync(f.fileno())
+
+    def replay(self) -> Iterator[tuple[int, int, np.ndarray | None]]:
+        """Yield (op, aux, positions).  Stops (and truncates the file) at
+        the first torn/corrupt record — crash-consistent replay."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        pos = 0
+        good_end = 0
+        while pos + _HEADER.size <= len(buf):
+            crc, op, aux, plen = _HEADER.unpack_from(buf, pos)
+            end = pos + _HEADER.size + plen
+            if end > len(buf):
+                break
+            body = buf[pos + 4:end]
+            if zlib.crc32(body) != crc:
+                break
+            payload = buf[pos + _HEADER.size:end]
+            positions = roaring.deserialize(payload) if plen else None
+            yield op, aux, positions
+            pos = end
+            good_end = end
+        if good_end < len(buf):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+
+    def truncate(self) -> None:
+        """Discard the log (after a snapshot compaction)."""
+        self.close()
+        with open(self.path, "wb"):
+            pass
+
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -1,0 +1,297 @@
+"""Roaring bitmap file codec (host/disk format).
+
+Device side is dense packed words (SURVEY.md §8); roaring remains the
+disk/interchange format for compactness and reference compatibility.
+
+Two formats:
+
+- **Pilosa 64-bit format** (primary, used for fragment snapshots).
+  Layout reconstructed from memory of the reference
+  (``roaring/roaring.go#WriteTo/UnmarshalBinary`` — unverified, the
+  reference tree was not available; see SURVEY.md §0):
+
+      bytes 0:2   magic   = 12348  (uint16 LE)
+      bytes 2:4   version = 0      (uint16 LE)
+      bytes 4:8   container count  (uint32 LE)
+      per container, 12-byte descriptive header:
+          key (uint64 LE, = position >> 16), type (uint16: 1=array,
+          2=bitmap, 3=run), cardinality-1 (uint16)
+      per container, offset header: uint32 LE byte offset of its data
+      container data:
+          array:  sorted uint16 LE values
+          bitmap: 1024 × uint64 LE (8192 bytes)
+          run:    uint16 run count, then (start, last) uint16 LE pairs
+                  (inclusive intervals, as the reference's interval16)
+
+- **Standard 32-bit roaring** (``RoaringFormatSpec``: cookies 12346/12347)
+  for interop with other roaring implementations, used by import/export
+  when positions fit in 32 bits.  Runs here are (start, length-1) pairs
+  per the public spec — note the difference from the pilosa format.
+
+All container assembly/expansion is vectorized numpy; the C++ codec
+(store/native) accelerates the same formats with an identical interface.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+MAGIC = 12348
+VERSION = 0
+
+TYPE_ARRAY = 1
+TYPE_BITMAP = 2
+TYPE_RUN = 3
+
+ARRAY_MAX = 4096  # array container cardinality bound (standard roaring)
+
+# standard-format cookies
+COOKIE_NO_RUN = 12346
+COOKIE_RUN = 12347
+NO_OFFSET_THRESHOLD = 4
+
+
+# ---------------------------------------------------------------------------
+# container assembly from sorted low-16 values
+# ---------------------------------------------------------------------------
+
+
+def _runs_of(lows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(starts, lasts) of maximal consecutive runs in sorted uint16 array."""
+    breaks = np.nonzero(np.diff(lows.astype(np.int64)) != 1)[0]
+    starts = lows[np.concatenate(([0], breaks + 1))]
+    lasts = lows[np.concatenate((breaks, [len(lows) - 1]))]
+    return starts, lasts
+
+
+def _best_container(lows: np.ndarray) -> tuple[int, object]:
+    """Pick the smallest encoding for one container's sorted values.
+
+    Returns (type, payload) where payload is the values array, a packed
+    bitmap uint64[1024], or (starts, lasts).
+    """
+    n = len(lows)
+    starts, lasts = _runs_of(lows)
+    run_bytes = 2 + 4 * len(starts)
+    array_bytes = 2 * n
+    if run_bytes < min(array_bytes, 8192):
+        return TYPE_RUN, (starts, lasts)
+    if n <= ARRAY_MAX:
+        return TYPE_ARRAY, lows
+    bits = np.zeros(65536, dtype=np.uint8)
+    bits[lows] = 1
+    words = np.packbits(bits, bitorder="little").view(np.uint64)
+    return TYPE_BITMAP, words
+
+
+def _expand_bitmap(words8192: bytes) -> np.ndarray:
+    buf = np.frombuffer(words8192, dtype=np.uint8)
+    return np.nonzero(np.unpackbits(buf, bitorder="little"))[0].astype(np.uint16)
+
+
+def _expand_runs(starts: np.ndarray, lasts: np.ndarray) -> np.ndarray:
+    lens = lasts.astype(np.int64) - starts.astype(np.int64) + 1
+    total = int(lens.sum())
+    # vectorized multi-arange: offsets within concatenated runs
+    idx = np.arange(total, dtype=np.int64)
+    run_id = np.repeat(np.arange(len(starts), dtype=np.int64), lens)
+    run_base = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return (starts.astype(np.int64)[run_id] + (idx - run_base[run_id])).astype(np.uint16)
+
+
+def _group_by_high(positions: np.ndarray, shift: int) -> tuple[np.ndarray, list[np.ndarray]]:
+    """Split sorted positions into per-container low-16 arrays.
+
+    Returns (keys, [lows...]) with keys = unique ``positions >> shift``.
+    """
+    highs = positions >> np.uint64(shift)
+    keys, starts = np.unique(highs, return_index=True)
+    bounds = np.append(starts, len(positions))
+    lows = [
+        (positions[bounds[i]:bounds[i + 1]] & np.uint64(0xFFFF)).astype(np.uint16)
+        for i in range(len(keys))
+    ]
+    return keys, lows
+
+
+# ---------------------------------------------------------------------------
+# pilosa 64-bit format
+# ---------------------------------------------------------------------------
+
+
+def serialize(positions: np.ndarray) -> bytes:
+    """Sorted-or-not uint64 bit positions -> pilosa-format bytes."""
+    positions = np.unique(np.asarray(positions, dtype=np.uint64))
+    keys, lows_per = _group_by_high(positions, 16)
+    n = len(keys)
+    out = bytearray()
+    out += struct.pack("<HHI", MAGIC, VERSION, n)
+    payloads: list[bytes] = []
+    meta: list[tuple[int, int, int]] = []  # key, type, cardinality
+    for key, lows in zip(keys, lows_per):
+        ctype, payload = _best_container(lows)
+        if ctype == TYPE_ARRAY:
+            data = payload.astype("<u2").tobytes()
+        elif ctype == TYPE_BITMAP:
+            data = payload.astype("<u8").tobytes()
+        else:
+            starts, lasts = payload
+            data = struct.pack("<H", len(starts)) + np.column_stack(
+                (starts, lasts)
+            ).astype("<u2").tobytes()
+        payloads.append(data)
+        meta.append((int(key), ctype, len(lows)))
+    for key, ctype, card in meta:
+        out += struct.pack("<QHH", key, ctype, card - 1)
+    data_start = len(out) + 4 * n
+    off = data_start
+    for data in payloads:
+        out += struct.pack("<I", off)
+        off += len(data)
+    for data in payloads:
+        out += data
+    return bytes(out)
+
+
+def deserialize(buf: bytes | memoryview) -> np.ndarray:
+    """Pilosa-format or standard-32-bit bytes -> sorted uint64 positions."""
+    buf = memoryview(buf)
+    if len(buf) < 4:
+        raise ValueError("roaring: buffer too short")
+    magic, = struct.unpack_from("<H", buf, 0)
+    if magic == MAGIC:
+        return _deserialize_pilosa(buf)
+    cookie, = struct.unpack_from("<I", buf, 0)
+    if cookie == COOKIE_NO_RUN or (cookie & 0xFFFF) == COOKIE_RUN:
+        return read_standard32(buf).astype(np.uint64)
+    raise ValueError(f"roaring: unknown magic/cookie {magic}/{cookie}")
+
+
+def _deserialize_pilosa(buf: memoryview) -> np.ndarray:
+    magic, version, n = struct.unpack_from("<HHI", buf, 0)
+    if version != VERSION:
+        raise ValueError(f"roaring: unsupported version {version}")
+    pos = 8
+    keys = np.empty(n, dtype=np.uint64)
+    types = np.empty(n, dtype=np.uint16)
+    cards = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        k, t, c = struct.unpack_from("<QHH", buf, pos)
+        keys[i], types[i], cards[i] = k, t, c + 1
+        pos += 12
+    offsets = np.frombuffer(buf, dtype="<u4", count=n, offset=pos).astype(np.int64)
+    parts: list[np.ndarray] = []
+    for i in range(n):
+        off = int(offsets[i])
+        if types[i] == TYPE_ARRAY:
+            lows = np.frombuffer(buf, dtype="<u2", count=int(cards[i]), offset=off)
+        elif types[i] == TYPE_BITMAP:
+            lows = _expand_bitmap(bytes(buf[off:off + 8192]))
+        elif types[i] == TYPE_RUN:
+            nr, = struct.unpack_from("<H", buf, off)
+            pairs = np.frombuffer(buf, dtype="<u2", count=2 * nr, offset=off + 2)
+            lows = _expand_runs(pairs[0::2], pairs[1::2])
+        else:
+            raise ValueError(f"roaring: bad container type {types[i]}")
+        parts.append((keys[i] << np.uint64(16)) | lows.astype(np.uint64))
+    if not parts:
+        return np.empty(0, dtype=np.uint64)
+    return np.concatenate(parts)
+
+
+# ---------------------------------------------------------------------------
+# standard 32-bit roaring (public spec)
+# ---------------------------------------------------------------------------
+
+
+def write_standard32(values: np.ndarray) -> bytes:
+    """Sorted-or-not uint32 values -> standard roaring format bytes."""
+    values = np.unique(np.asarray(values, dtype=np.uint64))
+    if len(values) and int(values[-1]) >> 32:
+        raise ValueError("standard32: value exceeds 32 bits")
+    keys, lows_per = _group_by_high(values, 16)
+    n = len(keys)
+    conts = [_best_container(lows) for lows in lows_per]
+    has_run = any(t == TYPE_RUN for t, _ in conts)
+    out = bytearray()
+    if has_run:
+        out += struct.pack("<I", COOKIE_RUN | ((n - 1) << 16))
+        flags = np.zeros((n + 7) // 8, dtype=np.uint8)
+        for i, (t, _) in enumerate(conts):
+            if t == TYPE_RUN:
+                flags[i // 8] |= 1 << (i % 8)
+        out += flags.tobytes()
+    else:
+        out += struct.pack("<II", COOKIE_NO_RUN, n)
+    for (t, _), key, lows in zip(conts, keys, lows_per):
+        out += struct.pack("<HH", int(key), len(lows) - 1)
+    payloads = []
+    for t, payload in conts:
+        if t == TYPE_ARRAY:
+            payloads.append(payload.astype("<u2").tobytes())
+        elif t == TYPE_BITMAP:
+            payloads.append(payload.astype("<u8").tobytes())
+        else:
+            starts, lasts = payload
+            lens1 = (lasts.astype(np.int64) - starts.astype(np.int64)).astype("<u2")
+            payloads.append(
+                struct.pack("<H", len(starts))
+                + np.column_stack((starts.astype("<u2"), lens1)).tobytes()
+            )
+    if not has_run or n >= NO_OFFSET_THRESHOLD:
+        off = len(out) + 4 * n
+        for data in payloads:
+            out += struct.pack("<I", off)
+            off += len(data)
+    for data in payloads:
+        out += data
+    return bytes(out)
+
+
+def read_standard32(buf: bytes | memoryview) -> np.ndarray:
+    """Standard roaring format bytes -> sorted uint32 values (as uint64)."""
+    buf = memoryview(buf)
+    cookie, = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    run_flags = None
+    if cookie == COOKIE_NO_RUN:
+        n, = struct.unpack_from("<I", buf, pos)
+        pos += 4
+    elif (cookie & 0xFFFF) == COOKIE_RUN:
+        n = (cookie >> 16) + 1
+        nb = (n + 7) // 8
+        run_flags = np.frombuffer(buf, dtype=np.uint8, count=nb, offset=pos)
+        pos += nb
+    else:
+        raise ValueError(f"standard32: bad cookie {cookie}")
+    keys = np.empty(n, dtype=np.uint64)
+    cards = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        k, c = struct.unpack_from("<HH", buf, pos)
+        keys[i], cards[i] = k, c + 1
+        pos += 4
+    if run_flags is None or n >= NO_OFFSET_THRESHOLD:
+        pos += 4 * n  # skip offset header; data is sequential anyway
+    parts = []
+    for i in range(n):
+        is_run = run_flags is not None and (run_flags[i // 8] >> (i % 8)) & 1
+        if is_run:
+            nr, = struct.unpack_from("<H", buf, pos)
+            pos += 2
+            pairs = np.frombuffer(buf, dtype="<u2", count=2 * nr, offset=pos)
+            pos += 4 * nr
+            starts = pairs[0::2]
+            lasts = (pairs[0::2].astype(np.int64) + pairs[1::2]).astype(np.uint16)
+            lows = _expand_runs(starts, lasts)
+        elif cards[i] > ARRAY_MAX:
+            lows = _expand_bitmap(bytes(buf[pos:pos + 8192]))
+            pos += 8192
+        else:
+            lows = np.frombuffer(buf, dtype="<u2", count=int(cards[i]), offset=pos)
+            pos += 2 * int(cards[i])
+        parts.append((keys[i] << np.uint64(16)) | lows.astype(np.uint64))
+    if not parts:
+        return np.empty(0, dtype=np.uint64)
+    return np.concatenate(parts)

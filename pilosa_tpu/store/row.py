@@ -1,0 +1,146 @@
+"""Host-side representation of one row's bits within one shard.
+
+The reference keeps rows inside a fragment's single roaring bitmap and
+materializes ``*Row`` objects as container slices (``fragment.go#row``,
+SURVEY.md §3.1).  Host truth here is per-row: a row is either a sorted
+unique ``uint32`` array of column offsets (sparse) or a packed
+``uint32[WORDS_PER_SHARD]`` word array (dense), auto-converting at the
+break-even cardinality — the same array↔bitmap economics as roaring's
+container conversion at 4096, applied at shard (2^20) granularity because
+the device side is dense anyway.
+
+All mutation is via numpy set algebra; no Python-level bit loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.engine.words import (
+    WORDS_PER_SHARD,
+    SHARD_WIDTH,
+    pack_columns,
+    unpack_columns,
+    popcount_words,
+)
+
+# A dense row costs WORDS_PER_SHARD uint32s; a sparse row of cardinality n
+# costs n uint32s.  Convert to dense at equal footprint.
+DENSE_THRESHOLD = WORDS_PER_SHARD
+
+
+class RowBits:
+    """Bits of one (row, shard) pair.  Not thread-safe; the owning
+    fragment serializes access."""
+
+    __slots__ = ("_cols", "_words", "_card")
+
+    def __init__(self) -> None:
+        self._cols: np.ndarray | None = np.empty(0, dtype=np.uint32)
+        self._words: np.ndarray | None = None
+        self._card: int = 0
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_columns(cls, cols: np.ndarray) -> "RowBits":
+        r = cls()
+        cols = np.unique(np.asarray(cols, dtype=np.uint32))
+        if len(cols) and int(cols[-1]) >= SHARD_WIDTH:
+            raise ValueError(f"column {cols[-1]} out of shard range")
+        r._cols = cols
+        r._card = len(cols)
+        r._maybe_densify()
+        return r
+
+    @classmethod
+    def from_words(cls, words: np.ndarray) -> "RowBits":
+        r = cls()
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+        if words.shape != (WORDS_PER_SHARD,):
+            raise ValueError(f"expected {WORDS_PER_SHARD} words, got {words.shape}")
+        r._cols = None
+        r._words = words.copy()
+        r._card = popcount_words(words)
+        return r
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def cardinality(self) -> int:
+        return self._card
+
+    def any(self) -> bool:
+        return self._card > 0
+
+    def columns(self) -> np.ndarray:
+        """Sorted set-column offsets, uint32."""
+        if self._cols is not None:
+            return self._cols
+        return unpack_columns(self._words).astype(np.uint32)
+
+    def words(self) -> np.ndarray:
+        """Packed uint32[WORDS_PER_SHARD].  Dense rows return the internal
+        buffer — callers must not mutate it (plane assembly copies)."""
+        if self._words is not None:
+            return self._words
+        return pack_columns(self._cols)
+
+    def contains(self, col: int) -> bool:
+        if self._words is not None:
+            return bool((int(self._words[col >> 5]) >> (col & 31)) & 1)
+        return bool(np.searchsorted(self._cols, np.uint32(col)) < len(self._cols)
+                    and self._cols[np.searchsorted(self._cols, np.uint32(col))] == col)
+
+    # -- mutation -----------------------------------------------------------
+
+    def add(self, cols: np.ndarray) -> int:
+        """Set columns; returns how many were newly set."""
+        cols = np.unique(np.asarray(cols, dtype=np.uint32))
+        if len(cols) == 0:
+            return 0
+        if int(cols[-1]) >= SHARD_WIDTH:
+            raise ValueError(f"column {cols[-1]} out of shard range")
+        if self._words is not None:
+            idx = (cols >> np.uint32(5)).astype(np.int64)
+            bit = np.uint32(1) << (cols & np.uint32(31))
+            before = self._card
+            np.bitwise_or.at(self._words, idx, bit)
+            self._card = popcount_words(self._words)
+            return self._card - before
+        merged = np.union1d(self._cols, cols)
+        added = len(merged) - self._card
+        self._cols = merged
+        self._card = len(merged)
+        self._maybe_densify()
+        return added
+
+    def remove(self, cols: np.ndarray) -> int:
+        """Clear columns; returns how many were previously set."""
+        cols = np.unique(np.asarray(cols, dtype=np.uint32))
+        if len(cols) == 0 or self._card == 0:
+            return 0
+        if self._words is not None:
+            idx = (cols >> np.uint32(5)).astype(np.int64)
+            bit = np.uint32(1) << (cols & np.uint32(31))
+            before = self._card
+            np.bitwise_and.at(self._words, idx, ~bit)
+            self._card = popcount_words(self._words)
+            return before - self._card
+        kept = np.setdiff1d(self._cols, cols, assume_unique=True)
+        removed = self._card - len(kept)
+        self._cols = kept
+        self._card = len(kept)
+        return removed
+
+    def clear(self) -> None:
+        self._cols = np.empty(0, dtype=np.uint32)
+        self._words = None
+        self._card = 0
+
+    # -- internal -----------------------------------------------------------
+
+    def _maybe_densify(self) -> None:
+        if self._cols is not None and self._card >= DENSE_THRESHOLD:
+            self._words = pack_columns(self._cols)
+            self._cols = None

@@ -1,0 +1,61 @@
+"""View: groups the fragments of one flavor of one field.
+
+Reference: ``view.go`` (SURVEY.md §3.1) — a field has a ``standard`` view
+plus time-quantum views (``standard_2017``, …); an int (BSI) field keeps
+its bit-planes in a ``bsi_<field>`` view.  Fragments are created on
+demand per shard.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from pilosa_tpu.store.fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsi_"
+
+
+class View:
+    def __init__(self, path: str, name: str, *, fsync: bool = False):
+        self.path = path  # <field>/views/<name>
+        self.name = name
+        self.fsync = fsync
+        self.fragments: dict[int, Fragment] = {}
+        self._lock = threading.RLock()
+
+    def open(self) -> "View":
+        frag_dir = os.path.join(self.path, "fragments")
+        if os.path.isdir(frag_dir):
+            for entry in os.listdir(frag_dir):
+                if entry.isdigit():
+                    shard = int(entry)
+                    frag = Fragment(os.path.join(frag_dir, entry), shard,
+                                    fsync=self.fsync)
+                    self.fragments[shard] = frag.open()
+        return self
+
+    def fragment(self, shard: int, create: bool = False) -> Fragment | None:
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None and create:
+                path = os.path.join(self.path, "fragments", str(shard))
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                frag = Fragment(path, shard, fsync=self.fsync).open()
+                self.fragments[shard] = frag
+            return frag
+
+    def available_shards(self) -> list[int]:
+        with self._lock:
+            return sorted(s for s, f in self.fragments.items() if f.rows)
+
+    def max_row_id(self) -> int:
+        with self._lock:
+            return max((f.max_row_id() for f in self.fragments.values()),
+                       default=0)
+
+    def close(self) -> None:
+        with self._lock:
+            for frag in self.fragments.values():
+                frag.close()

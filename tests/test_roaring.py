@@ -1,0 +1,97 @@
+"""Roaring codec round-trips, mirroring the reference's serialization tests
+(``roaring/roaring_test.go``: container-type boundaries, conversion at
+4096, run edges; SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.store import roaring
+
+
+def rt(positions):
+    positions = np.asarray(positions, dtype=np.uint64)
+    out = roaring.deserialize(roaring.serialize(positions))
+    np.testing.assert_array_equal(out, np.unique(positions))
+
+
+def test_empty():
+    blob = roaring.serialize(np.empty(0, np.uint64))
+    assert len(roaring.deserialize(blob)) == 0
+
+
+def test_small_array():
+    rt([0, 1, 5, 100, 65535])
+
+
+def test_cross_container():
+    rt([0, 65535, 65536, 65537, 1 << 20, (1 << 20) + 3])
+
+
+def test_64bit_keys():
+    rt([0, 1 << 32, (1 << 40) + 7, (1 << 45)])
+
+
+def test_array_bitmap_boundary():
+    # exactly 4096 stays array; 4097 becomes bitmap
+    rt(np.arange(0, 8192, 2, dtype=np.uint64))          # 4096 spread values
+    rt(np.arange(0, 8194, 2, dtype=np.uint64))          # 4097 values
+
+
+def test_run_container():
+    # a long run compresses to a run container and round-trips
+    positions = np.arange(10, 50000, dtype=np.uint64)
+    blob = roaring.serialize(positions)
+    assert len(blob) < 1000  # run-encoded, not bitmap/array
+    rt(positions)
+
+
+def test_full_container():
+    rt(np.arange(65536, dtype=np.uint64))
+
+
+def test_run_edges():
+    rt([0])
+    rt([65535])
+    rt(np.concatenate([np.arange(100, 200), np.arange(300, 400),
+                       np.array([65535])]).astype(np.uint64))
+
+
+def test_duplicates_and_unsorted():
+    out = roaring.deserialize(roaring.serialize(
+        np.array([5, 1, 5, 3, 1], np.uint64)))
+    np.testing.assert_array_equal(out, [1, 3, 5])
+
+
+def test_random_mixed(rng):
+    # mixes sparse containers, dense containers, runs
+    sparse = rng.choice(1 << 22, size=5000, replace=False)
+    dense = rng.choice(65536, size=30000, replace=False) + (5 << 16)
+    run = np.arange(200000, 270000)
+    rt(np.concatenate([sparse, dense, run]).astype(np.uint64))
+
+
+def test_bad_magic():
+    with pytest.raises(ValueError):
+        roaring.deserialize(b"\x00\x00\x00\x00\x00\x00\x00\x00")
+
+
+class TestStandard32:
+    def test_round_trip(self, rng):
+        vals = rng.choice(1 << 21, size=10000, replace=False).astype(np.uint64)
+        out = roaring.read_standard32(roaring.write_standard32(vals))
+        np.testing.assert_array_equal(out, np.sort(vals))
+
+    def test_runs(self):
+        vals = np.arange(1000, 200000, dtype=np.uint64)
+        blob = roaring.write_standard32(vals)
+        assert len(blob) < 2000
+        np.testing.assert_array_equal(roaring.read_standard32(blob), vals)
+
+    def test_deserialize_detects_format(self):
+        vals = np.array([1, 2, 3, 100000], np.uint64)
+        out = roaring.deserialize(roaring.write_standard32(vals))
+        np.testing.assert_array_equal(out, vals)
+
+    def test_rejects_wide_values(self):
+        with pytest.raises(ValueError):
+            roaring.write_standard32(np.array([1 << 33], np.uint64))

@@ -1,0 +1,291 @@
+"""Storage tree tests: RowBits, fragment persistence + op-log replay,
+field types (set/int/time/mutex/bool), holder reopen — the rebuild's
+equivalent of ``fragment_test.go`` / ``field_test.go`` temp-dir fixtures
+with crash-replay (SURVEY.md §5)."""
+
+import os
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.store import (EXISTENCE_FIELD, FieldOptions, Fragment, Holder,
+                              RowBits)
+from pilosa_tpu.store import timeq
+from pilosa_tpu.store.oplog import OpLog, OP_SET_BITS
+
+
+class TestRowBits:
+    def test_add_remove(self):
+        r = RowBits()
+        assert r.add(np.array([1, 5, 9])) == 3
+        assert r.add(np.array([5, 7])) == 1
+        assert r.cardinality == 4
+        assert r.remove(np.array([5, 100])) == 1
+        np.testing.assert_array_equal(r.columns(), [1, 7, 9])
+
+    def test_dense_conversion(self, rng):
+        cols = rng.choice(SHARD_WIDTH, size=40000, replace=False)
+        r = RowBits.from_columns(cols)
+        assert r._words is not None  # crossed DENSE_THRESHOLD
+        np.testing.assert_array_equal(r.columns(), np.sort(cols))
+        assert r.contains(int(cols[0]))
+
+    def test_dense_mutation(self, rng):
+        cols = rng.choice(SHARD_WIDTH, size=40000, replace=False)
+        r = RowBits.from_columns(cols)
+        extra = np.setdiff1d(np.arange(50000, 50100, dtype=np.uint32), cols)
+        assert r.add(extra) == len(extra)
+        assert r.remove(extra) == len(extra)
+        np.testing.assert_array_equal(r.columns(), np.sort(cols))
+
+    def test_words_round_trip(self, rng):
+        cols = rng.choice(SHARD_WIDTH, size=1000, replace=False)
+        r = RowBits.from_columns(cols)
+        r2 = RowBits.from_words(r.words())
+        np.testing.assert_array_equal(r2.columns(), np.sort(cols))
+
+    def test_out_of_range(self):
+        with pytest.raises(ValueError):
+            RowBits.from_columns(np.array([SHARD_WIDTH]))
+
+
+class TestFragment:
+    def test_set_clear_persist(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        assert f.set_bit(3, 100)
+        assert not f.set_bit(3, 100)  # already set
+        assert f.set_bit(7, 200)
+        assert f.clear_bit(7, 200)
+        f.close()
+
+        g = Fragment(path, 0).open()
+        assert g.row(3).contains(100)
+        assert not g.row(7).any()
+        assert g.row_ids() == [3]
+
+    def test_oplog_replay_without_snapshot(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bits(np.array([1, 1, 2], np.uint64), np.array([10, 11, 12], np.uint64))
+        # no close/snapshot — simulate crash; oplog alone must restore
+        g = Fragment(path, 0).open()
+        assert g.row(1).cardinality == 2
+        assert g.row(2).contains(12)
+
+    def test_torn_oplog_tail(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bit(1, 1)
+        f.set_bit(2, 2)
+        with open(path + ".oplog", "ab") as fh:
+            fh.write(b"\x01\x02\x03")  # torn partial record
+        g = Fragment(path, 0).open()
+        assert g.row(1).contains(1) and g.row(2).contains(2)
+
+    def test_auto_snapshot_at_max_op_n(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0, max_op_n=10).open()
+        for i in range(12):
+            f.set_bit(0, i)
+        assert f.op_n <= 10
+        assert os.path.exists(path)
+        g = Fragment(path, 0).open()
+        assert g.row(0).cardinality == 12
+
+    def test_set_row_and_clear_row(self, tmp_path):
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        f.set_bits(np.array([5, 5, 5], np.uint64), np.array([1, 2, 3], np.uint64))
+        assert f.set_row(5, np.array([2, 9]))
+        np.testing.assert_array_equal(f.row(5).columns(), [2, 9])
+        assert f.clear_row(5) == 2
+        assert not f.row(5).any()
+
+    def test_blocks_checksums(self, tmp_path):
+        f = Fragment(str(tmp_path / "a"), 0).open()
+        g = Fragment(str(tmp_path / "b"), 0).open()
+        f.set_bit(5, 100)
+        g.set_bit(5, 100)
+        assert f.blocks() == g.blocks()
+        g.set_bit(205, 1)  # different block
+        bf, bg = f.blocks(), g.blocks()
+        assert bf[0] == bg[0] and 2 in bg and 2 not in bf
+
+    def test_import_roaring(self, tmp_path):
+        from pilosa_tpu.store import roaring
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        positions = np.array([0, 1, SHARD_WIDTH + 5], np.uint64)  # rows 0,1
+        assert f.import_roaring(roaring.serialize(positions)) == 3
+        assert f.row(1).contains(5)
+
+
+class TestOpLog:
+    def test_crc_rejects_corruption(self, tmp_path):
+        path = str(tmp_path / "log")
+        log = OpLog(path)
+        log.append(OP_SET_BITS, 0, np.array([1, 2, 3], np.uint64))
+        log.close()
+        data = bytearray(open(path, "rb").read())
+        data[10] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert list(OpLog(path).replay()) == []
+
+
+class TestField:
+    def make(self, tmp_path, **opts):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        return h, idx
+
+    def test_set_field(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("f")
+        idx.set_bit("f", 1, 10)
+        idx.set_bit("f", 1, SHARD_WIDTH + 3)  # second shard
+        assert f.available_shards() == [0, 1]
+        assert idx.existence_field.available_shards() == [0, 1]
+
+    def test_int_field_round_trip(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("amount", FieldOptions(type="int", min=-1000, max=1000))
+        idx.set_value("amount", 5, -42)
+        idx.set_value("amount", 9, 977)
+        assert f.value(5) == (-42, True)
+        assert f.value(9) == (977, True)
+        assert f.value(6) == (0, False)
+        # overwrite clears stale bits
+        idx.set_value("amount", 5, 7)
+        assert f.value(5) == (7, True)
+
+    def test_int_field_bit_depth_growth(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("n", FieldOptions(type="int"))
+        f.set_value(1, 3)
+        d1 = f.options.bit_depth
+        f.set_value(2, 1 << 20)
+        assert f.options.bit_depth > d1
+        assert f.value(2) == (1 << 20, True)
+        assert f.value(1) == (3, True)
+
+    def test_bounds_enforced(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("n", FieldOptions(type="int", min=0, max=10))
+        with pytest.raises(ValueError):
+            f.set_value(1, 11)
+
+    def test_mutex_field(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("m", FieldOptions(type="mutex"))
+        f.set_bit(1, 100)
+        f.set_bit(2, 100)  # must clear row 1
+        assert not f.standard_view().fragment(0).row(1).contains(100)
+        assert f.standard_view().fragment(0).row(2).contains(100)
+
+    def test_bool_field(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("b", FieldOptions(type="bool"))
+        f.set_bit(1, 7)
+        f.set_bit(0, 7)
+        frag = f.standard_view().fragment(0)
+        assert frag.row(0).contains(7) and not frag.row(1).contains(7)
+        with pytest.raises(ValueError):
+            f.set_bit(2, 7)
+
+    def test_time_field_views(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+        f.set_bit(1, 5, timestamp=datetime(2017, 1, 2))
+        names = set(f.views.keys())
+        assert {"standard", "standard_2017", "standard_201701",
+                "standard_20170102"} <= names
+
+    def test_decimal_field(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("d", FieldOptions(type="decimal", scale=2))
+        f.set_value(1, 12.34)
+        assert f.value(1) == (12.34, True)
+
+    def test_timestamp_field(self, tmp_path):
+        h, idx = self.make(tmp_path)
+        f = idx.create_field("ts", FieldOptions(type="timestamp"))
+        f.set_value(1, "2020-06-01T12:00:00")
+        stored, ok = f.value(1)
+        assert ok and stored == int(datetime(2020, 6, 1, 12).timestamp())
+
+
+class TestHolder:
+    def test_reopen_preserves_everything(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("myidx", keys=False)
+        idx.create_field("f")
+        idx.create_field("amount", FieldOptions(type="int", min=0, max=100))
+        idx.set_bit("f", 1, 10)
+        idx.set_value("amount", 10, 55)
+        h.close()
+
+        h2 = Holder(str(tmp_path)).open()
+        idx2 = h2.index("myidx")
+        assert idx2 is not None
+        assert idx2.field("f").standard_view().fragment(0).row(1).contains(10)
+        assert idx2.field("amount").value(10) == (55, True)
+        assert idx2.field("amount").options.type == "int"
+        assert EXISTENCE_FIELD in idx2.fields
+
+    def test_schema_dump_apply(self, tmp_path):
+        h = Holder(str(tmp_path / "a")).open()
+        idx = h.create_index("i1", keys=True)
+        idx.create_field("f1", FieldOptions(type="time", time_quantum="YM"))
+        schema = h.schema()
+
+        h2 = Holder(str(tmp_path / "b")).open()
+        h2.apply_schema(schema)
+        assert h2.index("i1").keys
+        assert h2.index("i1").field("f1").options.time_quantum == "YM"
+
+    def test_delete_index(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        h.create_index("gone")
+        h.delete_index("gone")
+        assert h.index("gone") is None
+        assert not os.path.exists(os.path.join(str(tmp_path), "gone"))
+
+    def test_invalid_names(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        for bad in ("Upper", "1num", "sp ace", ""):
+            with pytest.raises(ValueError):
+                h.create_index(bad)
+
+
+class TestTimeQuantum:
+    def test_views_by_time(self):
+        t = datetime(2017, 1, 2, 3)
+        assert timeq.views_by_time("standard", t, "YMDH") == [
+            "standard_2017", "standard_201701", "standard_20170102",
+            "standard_2017010203"]
+
+    def test_range_cover_exact(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2016, 11, 2), datetime(2017, 2, 3), "YMD")
+        assert views == [
+            "standard_20161102", "standard_20161103", "standard_20161104",
+            "standard_20161105", "standard_20161106", "standard_20161107",
+            "standard_20161108", "standard_20161109", "standard_20161110",
+            "standard_20161111", "standard_20161112", "standard_20161113",
+            "standard_20161114", "standard_20161115", "standard_20161116",
+            "standard_20161117", "standard_20161118", "standard_20161119",
+            "standard_20161120", "standard_20161121", "standard_20161122",
+            "standard_20161123", "standard_20161124", "standard_20161125",
+            "standard_20161126", "standard_20161127", "standard_20161128",
+            "standard_20161129", "standard_20161130", "standard_201612",
+            "standard_201701", "standard_20170201", "standard_20170202"]
+
+    def test_range_cover_uses_coarse_middle(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2016, 1, 1), datetime(2018, 1, 1), "YMDH")
+        assert views == ["standard_2016", "standard_2017"]
+
+    def test_invalid_quantum(self):
+        with pytest.raises(ValueError):
+            timeq.validate_quantum("YD")
