@@ -1,0 +1,11 @@
+"""Query execution (L2 of SURVEY.md §2): PQL AST → TPU kernels."""
+
+from pilosa_tpu.exec.executor import ExecutionError, Executor
+from pilosa_tpu.exec.result import (GroupCountsResult, Pair, PairsResult,
+                                    RowIdsResult, RowResult, ValCount,
+                                    result_to_json)
+
+__all__ = [
+    "Executor", "ExecutionError", "RowResult", "PairsResult", "Pair",
+    "ValCount", "RowIdsResult", "GroupCountsResult", "result_to_json",
+]

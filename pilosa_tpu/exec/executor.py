@@ -1,0 +1,601 @@
+"""Executor: PQL AST → jitted TPU kernels over the holder.
+
+Reference: ``executor.go`` (SURVEY.md §3.2, §4.2–§4.5) — per-call
+dispatch (``executeCall`` → ``executeIntersect/executeTopN/…``) with a
+per-shard map-reduce over cluster nodes.  The TPU rebuild replaces the
+fan-out/merge entirely: every resident shard is one slice of a batched
+device array (``uint32[n_shards, W]``), one XLA program evaluates the
+call tree for all shards at once, and cross-shard reduction is a dense
+``sum``/``top_k`` — compiled to ICI collectives when the shard axis is
+sharded over a mesh (see ``pilosa_tpu.parallel``), not an HTTP merge.
+
+Key translation happens on ingress (args) and egress (results), as in
+the reference (``executor.Execute`` translate steps).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.engine import bsi as bsik
+from pilosa_tpu.engine import kernels
+from pilosa_tpu.engine.words import SHARD_WIDTH, WORDS_PER_SHARD, unpack_columns
+from pilosa_tpu.exec.planes import PAD_SHARD, PlaneCache
+from pilosa_tpu.exec.result import (FieldRow, GroupCount, GroupCountsResult,
+                                    Pair, PairsResult, RowIdsResult,
+                                    RowResult, ValCount)
+from pilosa_tpu.pql import parse
+from pilosa_tpu.pql.ast import BETWEEN_OPS, Call, Condition, Query
+from pilosa_tpu.store.field import BSI_TYPES, Field
+from pilosa_tpu.store.holder import Holder
+from pilosa_tpu.store.index import Index
+from pilosa_tpu.store.timeq import (parse_pql_time, view_span,
+                                    views_by_time_range)
+from pilosa_tpu.store.translate import TranslateStore
+from pilosa_tpu.store.view import VIEW_STANDARD
+
+# option keys that are never field names in call args
+RESERVED_KEYS = frozenset({
+    "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
+    "like", "previous", "aggregate", "sort", "shards", "index",
+})
+
+_BITMAP_CALLS = frozenset({
+    "Row", "Intersect", "Union", "Difference", "Xor", "Not", "All", "Range",
+})
+
+_SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
+                  "==": "eq", "!=": "ne"}
+
+
+class ExecutionError(Exception):
+    pass
+
+
+@dataclass
+class _Ctx:
+    index: Index
+    shards: tuple[int, ...]
+
+
+class Executor:
+    def __init__(self, holder: Holder, translate: TranslateStore | None = None,
+                 place=None, plane_budget: int | None = None):
+        self.holder = holder
+        self.translate = translate or TranslateStore(holder.path)
+        kw = {"budget_bytes": plane_budget} if plane_budget else {}
+        self.planes = PlaneCache(place, **kw)
+
+    # ------------------------------------------------------------------ api
+
+    def execute(self, index_name: str, query: str | Query,
+                shards: list[int] | None = None) -> list:
+        """Run every top-level call; returns one result per call
+        (reference: ``Executor.Execute`` → ``QueryResponse.Results``)."""
+        index = self.holder.index(index_name)
+        if index is None:
+            raise ExecutionError(f"index {index_name!r} not found")
+        if isinstance(query, str):
+            query = parse(query)
+        results = []
+        for call in query.calls:
+            ctx = _Ctx(index, self._shards_for(index, shards, call))
+            results.append(self._call(ctx, call))
+        return results
+
+    def _shards_for(self, index: Index, shards, call: Call) -> tuple[int, ...]:
+        opts = call.args.get("shards") if call.name == "Options" else None
+        if opts is not None:
+            return tuple(int(s) for s in opts)
+        if shards is not None:
+            return tuple(shards)
+        avail = index.available_shards()
+        return tuple(avail) if avail else (0,)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _call(self, ctx: _Ctx, call: Call):
+        if call.name == "Options":
+            if len(call.children) != 1:
+                raise ExecutionError("Options: exactly one child required")
+            return self._call(ctx, call.children[0])
+        if call.name in _BITMAP_CALLS:
+            words = self._bitmap(ctx, call)
+            return self._to_row_result(ctx, words)
+        handler = getattr(self, "_execute_" + call.name.lower(), None)
+        if handler is None:
+            raise ExecutionError(f"unknown call {call.name!r}")
+        return handler(ctx, call)
+
+    # -- bitmap calls -------------------------------------------------------
+
+    def _bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
+        """Evaluate a bitmap-valued call to uint32[n_shards, W]."""
+        name = call.name
+        if name == "Row" or name == "Range":  # Range is the legacy alias
+            return self._row_bitmap(ctx, call)
+        if name == "All":
+            return self._exists(ctx)
+        if name == "Not":
+            if len(call.children) != 1:
+                raise ExecutionError("Not: exactly one child required")
+            return kernels.complement(self._bitmap(ctx, call.children[0]),
+                                      self._exists(ctx))
+        kids = call.children
+        if name == "Union":
+            if not kids:
+                return self._zeros(ctx)
+            acc = self._bitmap(ctx, kids[0])
+            for k in kids[1:]:
+                acc = kernels.union(acc, self._bitmap(ctx, k))
+            return acc
+        if name == "Intersect":
+            if not kids:
+                raise ExecutionError("Intersect: at least one child required")
+            acc = self._bitmap(ctx, kids[0])
+            for k in kids[1:]:
+                acc = kernels.intersect(acc, self._bitmap(ctx, k))
+            return acc
+        if name == "Difference":
+            if not kids:
+                raise ExecutionError("Difference: at least one child required")
+            acc = self._bitmap(ctx, kids[0])
+            for k in kids[1:]:
+                acc = kernels.difference(acc, self._bitmap(ctx, k))
+            return acc
+        if name == "Xor":
+            if not kids:
+                raise ExecutionError("Xor: at least one child required")
+            acc = self._bitmap(ctx, kids[0])
+            for k in kids[1:]:
+                acc = kernels.xor(acc, self._bitmap(ctx, k))
+            return acc
+        raise ExecutionError(f"not a bitmap call: {name}")
+
+    def _row_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
+        hit = call.field_arg(RESERVED_KEYS)
+        if hit is None:
+            raise ExecutionError(f"{call.name}: missing field argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        if isinstance(value, Condition):
+            return self._bsi_condition(ctx, field, value)
+        if field.options.type in BSI_TYPES:
+            # Row(amount=5) on BSI ≡ amount == 5
+            return self._bsi_condition(ctx, field, Condition("==", value))
+        row_id = self._row_id(field, value, create=False)
+        if row_id is None:
+            return self._zeros(ctx)
+        if "from" in call.args or "to" in call.args:
+            return self._time_row(ctx, field, row_id, call)
+        return self.planes.row_words(ctx.index.name, field, VIEW_STANDARD,
+                                     row_id, ctx.shards)
+
+    def _time_row(self, ctx: _Ctx, field: Field, row_id: int,
+                  call: Call) -> jax.Array:
+        q = field.options.time_quantum
+        if not q:
+            raise ExecutionError(f"field {field.name!r} is not a time field")
+        # clamp the range to the span actually covered by existing views:
+        # an omitted bound would otherwise enumerate views unit-by-unit
+        # across the whole calendar
+        spans = []
+        prefix = VIEW_STANDARD + "_"
+        for vname in field.views:
+            if vname.startswith(prefix):
+                try:
+                    spans.append(view_span(vname[len(prefix):]))
+                except ValueError:
+                    continue
+        if not spans:
+            return self._zeros(ctx)
+        vmin = min(s for s, _ in spans)
+        vmax = max(e for _, e in spans)
+        frm = call.args.get("from")
+        to = call.args.get("to")
+        start = max(parse_pql_time(str(frm)) if frm is not None else vmin, vmin)
+        end = min(parse_pql_time(str(to)) if to is not None else vmax, vmax)
+        acc = self._zeros(ctx)
+        for vname in views_by_time_range(VIEW_STANDARD, start, end, q):
+            if field.view(vname) is None:
+                continue
+            acc = kernels.union(acc, self.planes.row_words(
+                ctx.index.name, field, vname, row_id, ctx.shards))
+        return acc
+
+    def _bsi_condition(self, ctx: _Ctx, field: Field,
+                       cond: Condition) -> jax.Array:
+        if field.options.type not in BSI_TYPES:
+            raise ExecutionError(
+                f"field {field.name!r}: condition on non-BSI field")
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        if cond.op in BETWEEN_OPS:
+            lo_op = "gt" if cond.op.startswith("<>") else "ge"
+            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo = self._bsi_cmp(field, ps, lo_op, cond.value[0])
+            hi = self._bsi_cmp(field, ps, hi_op, cond.value[1])
+            return kernels.intersect(lo, hi)
+        return self._bsi_cmp(field, ps, _SCALAR_TO_KEY[cond.op], cond.value)
+
+    def _bsi_cmp(self, field: Field, ps, op_key: str, value) -> jax.Array:
+        """One signed comparison with out-of-depth predicate saturation
+        (everything/nothing cases need no kernel; see
+        ``engine.bsi.predicate_masks``)."""
+        opts = field.options
+        depth = opts.bit_depth
+        offset = field.to_stored(value) - opts.base
+        exists = ps.plane[..., bsik.EXISTS_ROW, :]
+        bound = (1 << depth) - 1
+        if offset > bound:
+            if op_key in ("lt", "le", "ne"):
+                return exists
+            return jnp.zeros_like(exists)
+        if offset < -bound:
+            if op_key in ("gt", "ge", "ne"):
+                return exists
+            return jnp.zeros_like(exists)
+        masks = bsik.predicate_masks(abs(offset), depth)
+        cmp = bsik.range_cmp(ps.plane, jnp.asarray(masks),
+                             jnp.asarray(offset < 0))
+        return cmp[op_key]
+
+    # -- helpers ------------------------------------------------------------
+
+    def _field(self, ctx: _Ctx, name: str) -> Field:
+        field = ctx.index.field(name)
+        if field is None:
+            raise ExecutionError(
+                f"field {name!r} not found in index {ctx.index.name!r}")
+        return field
+
+    def _row_id(self, field: Field, value, create: bool) -> int | None:
+        if isinstance(value, bool):
+            return int(value)
+        if isinstance(value, str):
+            if not field.options.keys:
+                raise ExecutionError(
+                    f"field {field.name!r}: string row on unkeyed field")
+            log = self.translate.rows(field.index_name, field.name)
+            return log.translate([value], create=create)[0]
+        if field.options.keys:
+            raise ExecutionError(
+                f"field {field.name!r}: integer row on keyed field")
+        return int(value)
+
+    def _col_id(self, ctx: _Ctx, value, create: bool) -> int | None:
+        if isinstance(value, str):
+            if not ctx.index.keys:
+                raise ExecutionError(
+                    f"index {ctx.index.name!r}: string column on unkeyed index")
+            log = self.translate.columns(ctx.index.name)
+            return log.translate([value], create=create)[0]
+        if ctx.index.keys:
+            raise ExecutionError(
+                f"index {ctx.index.name!r}: integer column on keyed index")
+        return int(value)
+
+    def _exists(self, ctx: _Ctx) -> jax.Array:
+        ef = ctx.index.existence_field
+        if ef is None:
+            raise ExecutionError(
+                f"index {ctx.index.name!r} does not track existence "
+                "(required for Not/All)")
+        return self.planes.row_words(ctx.index.name, ef, VIEW_STANDARD, 0,
+                                     ctx.shards)
+
+    def _zeros(self, ctx: _Ctx) -> jax.Array:
+        return jnp.zeros((len(ctx.shards), WORDS_PER_SHARD), dtype=jnp.uint32)
+
+    def _to_row_result(self, ctx: _Ctx, words: jax.Array) -> RowResult:
+        host = np.asarray(words)
+        parts = []
+        for si, s in enumerate(ctx.shards):
+            if s == PAD_SHARD:
+                continue
+            cols = unpack_columns(host[si])
+            if len(cols):
+                parts.append(cols + np.uint64(s * SHARD_WIDTH))
+        columns = (np.concatenate(parts) if parts
+                   else np.empty(0, np.uint64))
+        if ctx.index.keys:
+            log = self.translate.columns(ctx.index.name)
+            return RowResult(keys=log.keys_of(columns))
+        return RowResult(columns=columns)
+
+    def _filter_words(self, ctx: _Ctx, call: Call) -> jax.Array | None:
+        """Optional bitmap-call filter child (TopN/Sum/Rows/GroupBy)."""
+        flt = call.args.get("filter")
+        if flt is None and call.children:
+            flt = call.children[0]
+        if flt is None:
+            return None
+        if not isinstance(flt, Call):
+            raise ExecutionError("filter must be a bitmap call")
+        return self._bitmap(ctx, flt)
+
+    # -- scalar / aggregate calls ------------------------------------------
+
+    def _execute_count(self, ctx: _Ctx, call: Call) -> int:
+        if len(call.children) != 1:
+            raise ExecutionError("Count: exactly one child required")
+        words = self._bitmap(ctx, call.children[0])
+        return int(jnp.sum(kernels.count(words)))
+
+    def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
+        field, filter_words = self._agg_args(ctx, call)
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        total, cnt = bsik.sum_count(ps.plane, filter_words)
+        total, cnt = int(jnp.sum(total)), int(jnp.sum(cnt))
+        value = total + field.options.base * cnt
+        return ValCount(value=field.from_stored(value) if cnt else 0,
+                        count=cnt)
+
+    def _execute_min(self, ctx: _Ctx, call: Call) -> ValCount:
+        return self._min_max(ctx, call, want_min=True)
+
+    def _execute_max(self, ctx: _Ctx, call: Call) -> ValCount:
+        return self._min_max(ctx, call, want_min=False)
+
+    def _min_max(self, ctx: _Ctx, call: Call, want_min: bool) -> ValCount:
+        field, filter_words = self._agg_args(ctx, call)
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        mn, mn_c, mx, mx_c = bsik.min_max(ps.plane, filter_words)
+        mn, mn_c = np.asarray(mn), np.asarray(mn_c)
+        mx, mx_c = np.asarray(mx), np.asarray(mx_c)
+        # reduce across the shard axis on host (scalar per shard)
+        vals, cnts = (mn, mn_c) if want_min else (mx, mx_c)
+        mask = cnts > 0
+        if not mask.any():
+            return ValCount(0, 0)
+        best = int(vals[mask].min() if want_min else vals[mask].max())
+        total = int(cnts[mask][vals[mask] == best].sum())
+        value = best + field.options.base
+        return ValCount(value=field.from_stored(value), count=total)
+
+    def _agg_args(self, ctx: _Ctx, call: Call):
+        fname = call.args.get("field") or call.args.get("_field")
+        if fname is None:
+            raise ExecutionError(f"{call.name}: missing field argument")
+        field = self._field(ctx, str(fname))
+        if field.options.type not in BSI_TYPES:
+            raise ExecutionError(f"{call.name}: field {fname!r} is not BSI")
+        return field, self._filter_words(ctx, call)
+
+    # -- TopN ---------------------------------------------------------------
+
+    def _execute_topn(self, ctx: _Ctx, call: Call) -> PairsResult:
+        fname = call.args.get("_field") or call.args.get("field")
+        if fname is None:
+            raise ExecutionError("TopN: missing field argument")
+        field = self._field(ctx, str(fname))
+        n = call.args.get("n")
+        filter_words = self._filter_words(ctx, call)
+        ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
+                                     ctx.shards)
+        if ps.n_rows == 0:
+            return PairsResult([])
+        counts = kernels.row_counts(ps.plane, filter_words)  # [S, R_pad]
+        totals = jnp.sum(counts, axis=0)                     # [R_pad]
+        ids_arg = call.args.get("ids")
+        if ids_arg is not None:
+            keep = np.zeros(totals.shape[0], dtype=bool)
+            for rid in ids_arg:
+                slot = ps.slot_of.get(int(rid))
+                if slot is not None:
+                    keep[slot] = True
+            totals = jnp.where(jnp.asarray(keep), totals, 0)
+        k = ps.n_rows if n is None else min(int(n), ps.n_rows)
+        vals, slots = kernels.top_n(totals, k)
+        vals, slots = np.asarray(vals), np.asarray(slots)
+        live = (vals > 0) & (slots < ps.n_rows)
+        row_ids = ps.row_ids[slots[live]]
+        vals = vals[live]
+        if field.options.keys:
+            log = self.translate.rows(ctx.index.name, field.name)
+            return PairsResult([Pair(key=log.key_of(int(r)), count=int(c))
+                                for r, c in zip(row_ids, vals)])
+        return PairsResult([Pair(id=int(r), count=int(c))
+                            for r, c in zip(row_ids, vals)])
+
+    # -- Rows ---------------------------------------------------------------
+
+    def _execute_rows(self, ctx: _Ctx, call: Call) -> RowIdsResult:
+        fname = call.args.get("_field") or call.args.get("field")
+        if fname is None:
+            raise ExecutionError("Rows: missing field argument")
+        field = self._field(ctx, str(fname))
+        rows = self._rows_of(ctx, field, call)
+        if field.options.keys:
+            log = self.translate.rows(ctx.index.name, field.name)
+            return RowIdsResult(keys=[log.key_of(int(r)) for r in rows])
+        return RowIdsResult(rows=rows)
+
+    def _rows_of(self, ctx: _Ctx, field: Field, call: Call) -> np.ndarray:
+        """Row IDs with ≥1 bit, honoring column=, previous=, limit=."""
+        ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
+                                     ctx.shards)
+        if ps.n_rows == 0:
+            return np.empty(0, np.uint64)
+        column = call.args.get("column")
+        if column is not None:
+            col_id = self._col_id(ctx, column, create=False)
+            if col_id is None:
+                return np.empty(0, np.uint64)
+            filter_words = self._column_bitmap(ctx, col_id)
+            counts = np.asarray(jnp.sum(
+                kernels.row_counts(ps.plane, filter_words), axis=0))
+        else:
+            counts = np.asarray(jnp.sum(kernels.row_counts(ps.plane), axis=0))
+        live = counts[:ps.n_rows] > 0
+        rows = ps.row_ids[live]
+        prev = call.args.get("previous")
+        if prev is not None:
+            prev_id = self._row_id(field, prev, create=False)
+            if prev_id is not None:
+                rows = rows[rows > prev_id]
+        limit = call.args.get("limit")
+        if limit is not None:
+            rows = rows[: int(limit)]
+        return rows
+
+    def _column_bitmap(self, ctx: _Ctx, col_id: int) -> jax.Array:
+        host = np.zeros((len(ctx.shards), WORDS_PER_SHARD), dtype=np.uint32)
+        shard, off = col_id // SHARD_WIDTH, col_id % SHARD_WIDTH
+        for si, s in enumerate(ctx.shards):
+            if s == shard:
+                host[si, off >> 5] = np.uint32(1) << np.uint32(off & 31)
+        return self.planes.place(host)
+
+    # -- GroupBy ------------------------------------------------------------
+
+    def _execute_groupby(self, ctx: _Ctx, call: Call) -> GroupCountsResult:
+        rows_calls = [c for c in call.children if c.name == "Rows"]
+        if not rows_calls:
+            raise ExecutionError("GroupBy: at least one Rows child required")
+        filter_words = None
+        flt = call.args.get("filter")
+        if isinstance(flt, Call):
+            filter_words = self._bitmap(ctx, flt)
+        agg = call.args.get("aggregate")
+        agg_field = None
+        if isinstance(agg, Call):
+            if agg.name != "Sum":
+                raise ExecutionError("GroupBy: only Sum aggregate supported")
+            aname = agg.args.get("field") or agg.args.get("_field")
+            agg_field = self._field(ctx, str(aname))
+
+        specs = []  # (field, row_ids, PlaneSet)
+        for rc in rows_calls:
+            f = self._field(ctx, str(rc.args.get("_field") or
+                                     rc.args.get("field")))
+            rows = self._rows_of(ctx, f, rc)
+            ps = self.planes.field_plane(ctx.index.name, f, VIEW_STANDARD,
+                                         ctx.shards)
+            specs.append((f, rows, ps))
+
+        limit = call.args.get("limit")
+        groups: list[GroupCount] = []
+
+        def recurse(level: int, prefix_words, prefix_rows: list[tuple[Field, int]]):
+            if limit is not None and len(groups) >= int(limit):
+                return
+            f, rows, ps = specs[level]
+            for rid in rows:
+                row_w = ps.plane[:, ps.slot_of[int(rid)], :]
+                words = (row_w if prefix_words is None
+                         else kernels.intersect(prefix_words, row_w))
+                if level + 1 < len(specs):
+                    recurse(level + 1, words, prefix_rows + [(f, int(rid))])
+                    if limit is not None and len(groups) >= int(limit):
+                        return
+                    continue
+                cnt = int(jnp.sum(kernels.count(words)))
+                if cnt == 0:
+                    continue
+                group = [self._field_row(ctx, gf, gr)
+                         for gf, gr in prefix_rows + [(f, int(rid))]]
+                agg_val = None
+                if agg_field is not None:
+                    aps = self.planes.bsi_plane(ctx.index.name, agg_field,
+                                                ctx.shards)
+                    t, c = bsik.sum_count(aps.plane, words)
+                    agg_val = (int(jnp.sum(t))
+                               + agg_field.options.base * int(jnp.sum(c)))
+                groups.append(GroupCount(group, cnt, agg_val))
+                if limit is not None and len(groups) >= int(limit):
+                    return
+
+        recurse(0, filter_words, [])
+        return GroupCountsResult(groups)
+
+    def _field_row(self, ctx: _Ctx, field: Field, row_id: int) -> FieldRow:
+        if field.options.keys:
+            log = self.translate.rows(ctx.index.name, field.name)
+            return FieldRow(field.name, row_key=log.key_of(row_id))
+        return FieldRow(field.name, row_id=row_id)
+
+    # -- writes -------------------------------------------------------------
+
+    def _execute_set(self, ctx: _Ctx, call: Call) -> bool:
+        col = call.args.get("_col")
+        if col is None:
+            raise ExecutionError("Set: missing column argument")
+        col_id = self._col_id(ctx, col, create=True)
+        hit = call.field_arg(RESERVED_KEYS | {"_col", "_timestamp"})
+        if hit is None:
+            raise ExecutionError("Set: missing field=value argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        if field.options.type in BSI_TYPES:
+            changed = field.set_value(col_id, value)
+        else:
+            row_id = self._row_id(field, value, create=True)
+            ts = call.args.get("_timestamp")
+            changed = field.set_bit(
+                row_id, col_id,
+                parse_pql_time(ts) if ts is not None else None)
+        ctx.index.note_columns(np.array([col_id], np.uint64))
+        return changed
+
+    def _execute_clear(self, ctx: _Ctx, call: Call) -> bool:
+        col = call.args.get("_col")
+        if col is None:
+            raise ExecutionError("Clear: missing column argument")
+        col_id = self._col_id(ctx, col, create=False)
+        if col_id is None:
+            return False
+        hit = call.field_arg(RESERVED_KEYS | {"_col", "_timestamp"})
+        if hit is None:
+            raise ExecutionError("Clear: missing field argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        if field.options.type in BSI_TYPES:
+            return field.clear_value(col_id)
+        row_id = self._row_id(field, value, create=False)
+        if row_id is None:
+            return False
+        return field.clear_bit(row_id, col_id)
+
+    def _execute_clearrow(self, ctx: _Ctx, call: Call) -> bool:
+        hit = call.field_arg(RESERVED_KEYS)
+        if hit is None:
+            raise ExecutionError("ClearRow: missing field=row argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        row_id = self._row_id(field, value, create=False)
+        if row_id is None:
+            return False
+        view = field.standard_view()
+        changed = 0
+        if view is not None:
+            for s in ctx.shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    changed += frag.clear_row(row_id)
+        return changed > 0
+
+    def _execute_store(self, ctx: _Ctx, call: Call) -> bool:
+        if len(call.children) != 1:
+            raise ExecutionError("Store: exactly one bitmap child required")
+        hit = call.field_arg(RESERVED_KEYS)
+        if hit is None:
+            raise ExecutionError("Store: missing field=row argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        row_id = self._row_id(field, value, create=True)
+        words = np.asarray(self._bitmap(ctx, call.children[0]))
+        view = field.standard_view(create=True)
+        changed = False
+        for si, s in enumerate(ctx.shards):
+            if s == PAD_SHARD:
+                continue
+            frag = view.fragment(s, create=True)
+            cols = unpack_columns(words[si]).astype(np.uint32)
+            changed |= frag.set_row(row_id, cols)
+        return changed

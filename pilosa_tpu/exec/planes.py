@@ -1,0 +1,196 @@
+"""Device-resident plane cache: host fragments → packed uint32 arrays in HBM.
+
+The device is a cache over host truth (SURVEY.md §8): a (field, view) is
+materialized as ``uint32[n_shards, R_pad, W]`` (set fields) or
+``uint32[n_shards, depth+2, W]`` (BSI), placed via an optional
+``jax.sharding.Sharding`` so the shard axis lands across the mesh — the
+TPU analogue of the reference's shard→node placement
+(``cluster.go#shardNodes``).
+
+Invalidation: entries remember the source fragments' generation counters
+and rebuild when any changed (fragment mutations bump them).  Row-count
+padding to the next power of two bounds XLA recompiles (one compile per
+row bucket, SURVEY.md §8 "static shapes vs dynamic row sets").
+
+Eviction: byte-budgeted LRU — the working-set management half of the
+"host→HBM streaming" hard part; fields that exceed the budget are
+rebuilt per query rather than cached.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from pilosa_tpu.engine.bsi import OFFSET_ROW
+from pilosa_tpu.engine.words import WORDS_PER_SHARD
+from pilosa_tpu.store.field import Field
+
+PAD_SHARD = -1  # shard-list padding entry (meshed execution): all-zero words
+
+DEFAULT_BUDGET = 4 << 30
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+@dataclass
+class PlaneSet:
+    """One materialized (field, view): device plane + row-slot mapping."""
+
+    plane: jax.Array          # uint32[n_shards, R_pad, W]
+    shards: tuple[int, ...]   # axis-0 ids, PAD_SHARD entries are zeros
+    row_ids: np.ndarray       # uint64[R] real rows (slots beyond are pad)
+    slot_of: dict[int, int]
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.row_ids)
+
+
+class PlaneCache:
+    def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET):
+        """``place(np_array) -> jax.Array`` controls device placement /
+        mesh sharding; default is plain ``jax.device_put``."""
+        self.place = place or jax.device_put
+        self.budget = budget_bytes
+        self._entries: OrderedDict[tuple, tuple[tuple, object, int]] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.RLock()
+
+    # -- public -------------------------------------------------------------
+
+    def field_plane(self, index: str, field: Field, view_name: str,
+                    shards: tuple[int, ...]) -> PlaneSet:
+        """Whole-view plane (TopN / Rows / GroupBy path)."""
+        key = ("plane", index, field.name, view_name, shards)
+        return self._get(key, field, view_name, shards, self._build_plane)
+
+    def bsi_plane(self, index: str, field: Field,
+                  shards: tuple[int, ...]) -> PlaneSet:
+        """BSI bit-plane: rows are the fixed exists/sign/bit layout."""
+        view_name = field.bsi_view_name
+        key = ("bsi", index, field.name, view_name, shards,
+               field.options.bit_depth)
+        return self._get(key, field, view_name, shards, self._build_bsi)
+
+    def row_words(self, index: str, field: Field, view_name: str,
+                  row_id: int, shards: tuple[int, ...]) -> jax.Array:
+        """One row across shards: uint32[n_shards, W] (Row-call fast path —
+        avoids materializing the whole plane for wide fields)."""
+        key = ("row", index, field.name, view_name, row_id, shards)
+        ps = self._get(key, field, view_name, shards,
+                       lambda f, v, s: self._build_row(f, v, s, row_id))
+        return ps.plane
+
+    def invalidate(self, index: str | None = None) -> None:
+        with self._lock:
+            if index is None:
+                self._entries.clear()
+                self._bytes = 0
+                return
+            for key in [k for k in self._entries if k[1] == index]:
+                _, _, nbytes = self._entries.pop(key)
+                self._bytes -= nbytes
+
+    # -- internal -----------------------------------------------------------
+
+    def _gens(self, field: Field, view_name: str,
+              shards: tuple[int, ...]) -> tuple:
+        view = field.view(view_name)
+        if view is None:
+            return ()
+        out = []
+        for s in shards:
+            frag = view.fragment(s) if s != PAD_SHARD else None
+            out.append(frag.generation if frag is not None else -1)
+        return tuple(out)
+
+    def _get(self, key, field: Field, view_name: str,
+             shards: tuple[int, ...], build) -> PlaneSet:
+        gens = self._gens(field, view_name, shards)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0] == gens:
+                self._entries.move_to_end(key)
+                return hit[1]
+        ps = build(field, view_name, shards)
+        nbytes = ps.plane.size * 4
+        with self._lock:
+            if nbytes <= self.budget:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[2]
+                self._entries[key] = (gens, ps, nbytes)
+                self._bytes += nbytes
+                while self._bytes > self.budget and len(self._entries) > 1:
+                    _, (_, _, old_bytes) = self._entries.popitem(last=False)
+                    self._bytes -= old_bytes
+        return ps
+
+    def _build_plane(self, field: Field, view_name: str,
+                     shards: tuple[int, ...]) -> PlaneSet:
+        view = field.view(view_name)
+        row_set: set[int] = set()
+        if view is not None:
+            for s in shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    row_set.update(frag.row_ids())
+        row_ids = np.array(sorted(row_set), dtype=np.uint64)
+        r_pad = _pow2(max(1, len(row_ids)))
+        host = np.zeros((len(shards), r_pad, WORDS_PER_SHARD), dtype=np.uint32)
+        slot_of = {int(r): i for i, r in enumerate(row_ids)}
+        if view is not None:
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                with frag.lock:
+                    for r in frag.row_ids():
+                        host[si, slot_of[r]] = frag.rows[r].words()
+        return PlaneSet(self.place(host), shards, row_ids, slot_of)
+
+    def _build_bsi(self, field: Field, view_name: str,
+                   shards: tuple[int, ...]) -> PlaneSet:
+        depth = field.options.bit_depth
+        n_rows = OFFSET_ROW + depth
+        host = np.zeros((len(shards), n_rows, WORDS_PER_SHARD), dtype=np.uint32)
+        view = field.view(view_name)
+        if view is not None:
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                with frag.lock:
+                    for r in frag.row_ids():
+                        if r < n_rows:
+                            host[si, r] = frag.rows[r].words()
+        row_ids = np.arange(n_rows, dtype=np.uint64)
+        return PlaneSet(self.place(host), shards, row_ids,
+                        {i: i for i in range(n_rows)})
+
+    def _build_row(self, field: Field, view_name: str,
+                   shards: tuple[int, ...], row_id: int) -> PlaneSet:
+        host = np.zeros((len(shards), WORDS_PER_SHARD), dtype=np.uint32)
+        view = field.view(view_name)
+        if view is not None:
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    host[si] = frag.row(row_id).words()
+        return PlaneSet(self.place(host), shards,
+                        np.array([row_id], np.uint64), {row_id: 0})

@@ -1,0 +1,116 @@
+"""Query result types.
+
+Reference: ``row.go`` / ``executor.go`` result values — ``Row``,
+``PairsField`` (TopN), ``ValCount`` (Sum/Min/Max), ``GroupCount``,
+plus plain bool/int for writes and Count (SURVEY.md §3.2).  Each type
+knows its REST JSON shape (``http/handler.go`` response encoding).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+
+@dataclass
+class RowResult:
+    """Set of columns (one PQL bitmap call's result), already translated
+    to absolute column IDs; ``keys`` filled instead when the index is
+    keyed."""
+
+    columns: np.ndarray = dc_field(
+        default_factory=lambda: np.empty(0, np.uint64))
+    keys: list[str] | None = None
+
+    def to_json(self):
+        if self.keys is not None:
+            return {"keys": self.keys}
+        return {"columns": [int(c) for c in self.columns]}
+
+
+@dataclass
+class Pair:
+    id: int = 0
+    key: str | None = None
+    count: int = 0
+
+    def to_json(self):
+        if self.key is not None:
+            return {"key": self.key, "count": self.count}
+        return {"id": self.id, "count": self.count}
+
+
+@dataclass
+class PairsResult:
+    """TopN result."""
+
+    pairs: list[Pair]
+
+    def to_json(self):
+        return [p.to_json() for p in self.pairs]
+
+
+@dataclass
+class ValCount:
+    """Sum/Min/Max result: aggregate value + contributing column count."""
+
+    value: int | float = 0
+    count: int = 0
+
+    def to_json(self):
+        return {"value": self.value, "count": self.count}
+
+
+@dataclass
+class RowIdsResult:
+    """``Rows()`` result: row IDs (or keys) of a field."""
+
+    rows: np.ndarray = dc_field(
+        default_factory=lambda: np.empty(0, np.uint64))
+    keys: list[str] | None = None
+
+    def to_json(self):
+        if self.keys is not None:
+            return {"keys": self.keys}
+        return {"rows": [int(r) for r in self.rows]}
+
+
+@dataclass
+class FieldRow:
+    field: str
+    row_id: int = 0
+    row_key: str | None = None
+
+    def to_json(self):
+        if self.row_key is not None:
+            return {"field": self.field, "rowKey": self.row_key}
+        return {"field": self.field, "rowID": self.row_id}
+
+
+@dataclass
+class GroupCount:
+    group: list[FieldRow]
+    count: int
+    agg: int | None = None  # aggregate value when GroupBy has one
+
+    def to_json(self):
+        out = {"group": [g.to_json() for g in self.group], "count": self.count}
+        if self.agg is not None:
+            out["agg"] = self.agg
+        return out
+
+
+@dataclass
+class GroupCountsResult:
+    groups: list[GroupCount]
+
+    def to_json(self):
+        return [g.to_json() for g in self.groups]
+
+
+def result_to_json(r):
+    """Any handler result -> JSON-able value (bool/int pass through)."""
+    if hasattr(r, "to_json"):
+        return r.to_json()
+    return r

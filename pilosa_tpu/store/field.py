@@ -181,6 +181,9 @@ class Field:
             raise ValueError(f"field {self.name}: bit import on BSI field")
         row_ids = np.asarray(row_ids, np.uint64)
         cols = np.asarray(cols, np.uint64)
+        if len(row_ids) != len(cols):
+            raise ValueError(
+                f"import_bits: {len(row_ids)} rows vs {len(cols)} columns")
         if opts.type == TYPE_BOOL and len(row_ids) and int(row_ids.max()) > 1:
             raise ValueError("bool field rows must be 0 or 1")
         shards = cols // np.uint64(SHARD_WIDTH)
@@ -207,20 +210,22 @@ class Field:
 
     def _set_mutex(self, shard: int, row_ids: np.ndarray, cols: np.ndarray) -> int:
         """Mutex semantics: setting (row, col) clears every other row of
-        col (reference: mutex enforcement in ``fragment.setMutex``)."""
+        col (reference: mutex enforcement in ``fragment.setMutex``).
+        Vectorized: one clear per existing row, one set per target row."""
         frag = self.standard_view(create=True).fragment(shard, create=True)
-        changed = 0
         # last write per column wins within the batch
         _, last_idx = np.unique(cols[::-1], return_index=True)
         keep = len(cols) - 1 - last_idx
-        for i in keep:
-            r, c = int(row_ids[i]), int(cols[i])
-            for existing in frag.row_ids():
-                if existing != r and frag.row(existing).contains(c):
-                    frag.clear_bits(np.array([existing], np.uint64),
-                                    np.array([c], np.uint64))
-            changed += frag.set_bits(np.array([r], np.uint64),
-                                     np.array([c], np.uint64))
+        row_ids, cols = row_ids[keep].astype(np.uint64), cols[keep].astype(np.uint32)
+        changed = 0
+        for existing in frag.row_ids():
+            # clear batch columns set in `existing` unless being set there
+            to_clear = cols[np.isin(cols, frag.row(existing).columns())
+                            & (row_ids != existing)]
+            if len(to_clear):
+                changed += frag.clear_bits(
+                    np.full(len(to_clear), existing, np.uint64), to_clear)
+        changed += frag.set_bits(row_ids, cols)
         return changed
 
     # -- BSI value writes ---------------------------------------------------
@@ -285,13 +290,13 @@ class Field:
             c, o, g = c[keep], o[keep], g[keep]
             changed += frag.set_bits(np.full(len(c), EXISTS_ROW, np.uint64), c)
             neg = o < 0
-            frag.set_bits(np.full(neg.sum(), SIGN_ROW, np.uint64), c[neg])
-            frag.clear_bits(np.full((~neg).sum(), SIGN_ROW, np.uint64), c[~neg])
+            changed += frag.set_bits(np.full(neg.sum(), SIGN_ROW, np.uint64), c[neg])
+            changed += frag.clear_bits(np.full((~neg).sum(), SIGN_ROW, np.uint64), c[~neg])
             for b in range(depth):
                 hit = (g >> np.uint64(b)) & np.uint64(1) != 0
                 row = np.uint64(OFFSET_ROW + b)
-                frag.set_bits(np.full(hit.sum(), row, np.uint64), c[hit])
-                frag.clear_bits(np.full((~hit).sum(), row, np.uint64), c[~hit])
+                changed += frag.set_bits(np.full(hit.sum(), row, np.uint64), c[hit])
+                changed += frag.clear_bits(np.full((~hit).sum(), row, np.uint64), c[~hit])
         return changed
 
     def value(self, col: int) -> tuple[int, bool]:

@@ -151,10 +151,12 @@ class Fragment:
         with self.lock:
             before = self.rows.get(row_id)
             new = RowBits.from_columns(cols)
-            if before is not None and np.array_equal(before.columns(), new.columns()):
+            before_cols = before.columns() if before is not None else np.empty(0, np.uint32)
+            if np.array_equal(before_cols, new.columns()):
                 return False
-            self._apply(OP_CLEAR_ROW, row_id, None)
-            self._log(OP_CLEAR_ROW, row_id, None)
+            if len(before_cols):
+                self._apply(OP_CLEAR_ROW, row_id, None)
+                self._log(OP_CLEAR_ROW, row_id, None)
             if new.any():
                 positions = np.uint64(row_id) * _SW + new.columns().astype(np.uint64)
                 self._apply(OP_SET_BITS, 0, positions)
@@ -244,13 +246,7 @@ class Fragment:
         elif op in (OP_SET_BITS, OP_CLEAR_BITS):
             assert positions is not None
             self._check_rows(positions)
-            row_ids = positions // _SW
-            cols = (positions % _SW).astype(np.uint32)
-            uniq, starts = np.unique(row_ids, return_index=True)
-            bounds = np.append(starts, len(positions))
-            for i, r in enumerate(uniq):
-                r = int(r)
-                chunk = cols[bounds[i]:bounds[i + 1]]
+            for r, chunk in _split_by_row(positions):
                 if op == OP_SET_BITS:
                     row = self.rows.get(r)
                     if row is None:
@@ -279,11 +275,21 @@ class Fragment:
             self.snapshot()
 
     def _load_positions(self, positions: np.ndarray) -> None:
-        if len(positions) == 0:
-            return
-        row_ids = positions // _SW
-        cols = (positions % _SW).astype(np.uint32)
-        uniq, starts = np.unique(row_ids, return_index=True)
-        bounds = np.append(starts, len(positions))
-        for i, r in enumerate(uniq):
-            self.rows[int(r)] = RowBits.from_columns(cols[bounds[i]:bounds[i + 1]])
+        for r, cols in _split_by_row(positions):
+            self.rows[r] = RowBits.from_columns(cols)
+
+
+def _split_by_row(positions: np.ndarray) -> list[tuple[int, np.ndarray]]:
+    """Split positions (any order, duplicates OK) into per-row column
+    chunks: [(row_id, uint32 cols), ...].  The single place that owns the
+    position→(row, col) segmentation invariant."""
+    positions = np.asarray(positions, dtype=np.uint64)
+    if len(positions) == 0:
+        return []
+    positions = np.sort(positions)
+    row_ids = positions // _SW
+    cols = (positions % _SW).astype(np.uint32)
+    uniq, starts = np.unique(row_ids, return_index=True)
+    bounds = np.append(starts, len(positions))
+    return [(int(uniq[i]), cols[bounds[i]:bounds[i + 1]])
+            for i in range(len(uniq))]

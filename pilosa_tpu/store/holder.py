@@ -94,6 +94,7 @@ class Holder:
                             "timeQuantum": o.time_quantum,
                             "min": o.min, "max": o.max, "base": o.base,
                             "bitDepth": o.bit_depth, "scale": o.scale,
+                            "epoch": o.epoch, "timeUnit": o.time_unit,
                         },
                     })
                 out.append({"name": iname,
@@ -122,7 +123,8 @@ class Holder:
                     time_quantum=o.get("timeQuantum", ""),
                     min=o.get("min"), max=o.get("max"),
                     base=o.get("base", 0), bit_depth=o.get("bitDepth", 0),
-                    scale=o.get("scale", 0),
+                    scale=o.get("scale", 0), epoch=o.get("epoch", ""),
+                    time_unit=o.get("timeUnit", "s"),
                 ))
 
 

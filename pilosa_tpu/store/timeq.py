@@ -93,6 +93,24 @@ def views_by_time_range(base: str, start: datetime, end: datetime,
     return cover(start, end, quantum)
 
 
+_SUFFIX_UNIT = {4: "Y", 6: "M", 8: "D", 10: "H"}
+
+
+def parse_view_time(suffix: str) -> tuple[datetime, str]:
+    """Inverse of :func:`view_name`'s suffix: ``"201701"`` →
+    ``(2017-01-01, "M")``.  Raises ValueError for non-time suffixes."""
+    unit = _SUFFIX_UNIT.get(len(suffix))
+    if unit is None or not suffix.isdigit():
+        raise ValueError(f"not a time view suffix: {suffix!r}")
+    return datetime.strptime(suffix, _FMT[unit]), unit
+
+
+def view_span(suffix: str) -> tuple[datetime, datetime]:
+    """The ``[start, end)`` period a time view covers."""
+    t, unit = parse_view_time(suffix)
+    return t, _next(t, unit)
+
+
 def parse_pql_time(s: str) -> datetime:
     """Timestamps as PQL accepts them (reference grammar's timestamp
     literal): ``2017-01-02T03:04`` (seconds optional) or ``2017-01-02``."""

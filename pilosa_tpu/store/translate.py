@@ -1,0 +1,154 @@
+"""String key ↔ uint64 ID translation stores.
+
+Reference: ``translate.go`` (SURVEY.md §3.3) — per-index column-key store
+and per-field row-key store; v1 used an append-only translate log
+replicated from the coordinator.  This rebuild keeps the append-only log
+(CRC-framed, replayed into memory on open); IDs are assigned
+sequentially from 1 (0 never maps to a key, so a zero result can't be
+mistranslated).
+
+Cluster note: upstream v2 partitions column keys over 256 hash
+partitions with per-partition primaries; here partition assignment
+(``partition_of``) is computed the same way for placement parity, while
+ID allocation stays sequential per store — the cluster layer routes
+keyed writes through the partition owner.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+PARTITION_N = 256  # reference: cluster-wide constant
+
+
+def fnv1a64(data: bytes) -> int:
+    """FNV-1a 64-bit — the reference's key-hash for partition placement."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def partition_of(key: str, n: int = PARTITION_N) -> int:
+    return fnv1a64(key.encode()) % n
+
+
+class KeyLog:
+    """One append-only key log: record = u32 crc | u32 len | utf8 key.
+    ID of the i-th appended key is ``i + 1``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._keys: list[str] = []
+        self._ids: dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._f = None
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        pos, good = 0, 0
+        while pos + 8 <= len(buf):
+            crc, ln = struct.unpack_from("<II", buf, pos)
+            end = pos + 8 + ln
+            if end > len(buf) or zlib.crc32(buf[pos + 4:end]) != crc:
+                break
+            key = buf[pos + 8:end].decode()
+            self._ids[key] = len(self._keys) + 1
+            self._keys.append(key)
+            pos = good = end
+        if good < len(buf):
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+
+    def _append(self, key: str) -> None:
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._f = open(self.path, "ab")
+        data = key.encode()
+        body = struct.pack("<I", len(data)) + data
+        self._f.write(struct.pack("<I", zlib.crc32(body)) + body)
+        self._f.flush()
+
+    # -- api ----------------------------------------------------------------
+
+    def translate(self, keys: list[str], create: bool = False) -> list[int | None]:
+        """Keys → IDs; unknown keys get new IDs if ``create`` else None."""
+        out: list[int | None] = []
+        with self._lock:
+            for k in keys:
+                kid = self._ids.get(k)
+                if kid is None and create:
+                    self._append(k)
+                    kid = len(self._keys) + 1
+                    self._ids[k] = kid
+                    self._keys.append(k)
+                out.append(kid)
+        return out
+
+    def key_of(self, kid: int) -> str | None:
+        with self._lock:
+            if 1 <= kid <= len(self._keys):
+                return self._keys[kid - 1]
+            return None
+
+    def keys_of(self, ids: np.ndarray) -> list[str]:
+        with self._lock:
+            out = []
+            for kid in ids:
+                k = self.key_of(int(kid))
+                if k is None:
+                    raise KeyError(f"no key for id {kid}")
+                out.append(k)
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class TranslateStore:
+    """All key logs of one holder: ``<data>/<index>/_keys/_columns.keys``
+    for column keys, ``<data>/<index>/_keys/<field>.keys`` per field."""
+
+    def __init__(self, holder_path: str):
+        self.holder_path = holder_path
+        self._logs: dict[tuple[str, str | None], KeyLog] = {}
+        self._lock = threading.Lock()
+
+    def _log(self, index: str, field: str | None) -> KeyLog:
+        with self._lock:
+            log = self._logs.get((index, field))
+            if log is None:
+                name = "_columns" if field is None else field
+                path = os.path.join(self.holder_path, index, "_keys",
+                                    f"{name}.keys")
+                log = self._logs[(index, field)] = KeyLog(path)
+            return log
+
+    def columns(self, index: str) -> KeyLog:
+        return self._log(index, None)
+
+    def rows(self, index: str, field: str) -> KeyLog:
+        return self._log(index, field)
+
+    def close(self) -> None:
+        with self._lock:
+            for log in self._logs.values():
+                log.close()
+            self._logs.clear()

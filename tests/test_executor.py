@@ -1,0 +1,301 @@
+"""Executor integration tests: table-driven PQL → expected results against
+a temp-dir holder, mirroring the reference's ``executor_test.go`` strategy
+(SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import ExecutionError, Executor
+from pilosa_tpu.store import FieldOptions, Holder
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("amount", FieldOptions(type="int", min=-1000, max=1000))
+    ex = Executor(holder)
+    return holder, idx, ex
+
+
+def q(ex, pql, index="i", shards=None):
+    return ex.execute(index, pql, shards=shards)
+
+
+class TestBitmapCalls:
+    def test_row_and_set(self, env):
+        _, _, ex = env
+        assert q(ex, "Set(10, f=1)") == [True]
+        assert q(ex, "Set(10, f=1)") == [False]  # already set
+        (r,) = q(ex, "Row(f=1)")
+        np.testing.assert_array_equal(r.columns, [10])
+
+    def test_cross_shard_row(self, env):
+        _, _, ex = env
+        c2 = SHARD_WIDTH + 7
+        q(ex, f"Set(3, f=1) Set({c2}, f=1)")
+        (r,) = q(ex, "Row(f=1)")
+        np.testing.assert_array_equal(r.columns, [3, c2])
+
+    def test_boolean_algebra(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(3, f=1)"
+              "Set(2, g=1) Set(3, g=1) Set(4, g=1)")
+        (i,) = q(ex, "Intersect(Row(f=1), Row(g=1))")
+        np.testing.assert_array_equal(i.columns, [2, 3])
+        (u,) = q(ex, "Union(Row(f=1), Row(g=1))")
+        np.testing.assert_array_equal(u.columns, [1, 2, 3, 4])
+        (d,) = q(ex, "Difference(Row(f=1), Row(g=1))")
+        np.testing.assert_array_equal(d.columns, [1])
+        (x,) = q(ex, "Xor(Row(f=1), Row(g=1))")
+        np.testing.assert_array_equal(x.columns, [1, 4])
+
+    def test_not_and_all(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(5, g=1)")
+        (n,) = q(ex, "Not(Row(f=1))")
+        np.testing.assert_array_equal(n.columns, [5])
+        (a,) = q(ex, "All()")
+        np.testing.assert_array_equal(a.columns, [1, 2, 5])
+
+    def test_count(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(2, g=1)")
+        assert q(ex, "Count(Row(f=1))") == [2]
+        assert q(ex, "Count(Intersect(Row(f=1), Row(g=1)))") == [1]
+
+    def test_missing_row_is_empty(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1)")
+        (r,) = q(ex, "Row(f=99)")
+        assert len(r.columns) == 0
+
+    def test_unknown_field_errors(self, env):
+        _, _, ex = env
+        with pytest.raises(ExecutionError):
+            q(ex, "Row(nope=1)")
+
+    def test_clear_and_clearrow(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+        assert q(ex, "Clear(1, f=1)") == [True]
+        assert q(ex, "Clear(1, f=1)") == [False]
+        (r,) = q(ex, "Row(f=1)")
+        np.testing.assert_array_equal(r.columns, [2])
+        assert q(ex, "ClearRow(f=1)") == [True]
+        assert q(ex, "Count(Row(f=1))") == [0]
+
+    def test_store(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+        assert q(ex, "Store(Row(f=1), g=7)") == [True]
+        (r,) = q(ex, "Row(g=7)")
+        np.testing.assert_array_equal(r.columns, [1, 2])
+
+
+class TestBSI:
+    def test_range_operators(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=-42) Set(2, amount=0) Set(3, amount=7)"
+              "Set(4, amount=977)")
+        cases = {
+            "Row(amount > 0)": [3, 4],
+            "Row(amount >= 0)": [2, 3, 4],
+            "Row(amount < 0)": [1],
+            "Row(amount <= 7)": [1, 2, 3],
+            "Row(amount == 7)": [3],
+            "Row(amount != 7)": [1, 2, 4],
+            "Row(0 < amount < 100)": [3],
+            "Row(0 <= amount <= 7)": [2, 3],
+        }
+        for pql, expect in cases.items():
+            (r,) = q(ex, pql)
+            np.testing.assert_array_equal(r.columns, expect, err_msg=pql)
+
+    def test_range_saturation(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=5)")
+        (r,) = q(ex, "Row(amount < 100000000)")
+        np.testing.assert_array_equal(r.columns, [1])
+        (r,) = q(ex, "Row(amount > 100000000)")
+        assert len(r.columns) == 0
+        (r,) = q(ex, "Row(amount > -100000000)")
+        np.testing.assert_array_equal(r.columns, [1])
+
+    def test_sum_min_max(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=-42) Set(2, amount=0) Set(3, amount=7)"
+              "Set(4, amount=977)")
+        (s,) = q(ex, "Sum(field=amount)")
+        assert (s.value, s.count) == (-42 + 0 + 7 + 977, 4)
+        (mn,) = q(ex, "Min(field=amount)")
+        assert (mn.value, mn.count) == (-42, 1)
+        (mx,) = q(ex, "Max(field=amount)")
+        assert (mx.value, mx.count) == (977, 1)
+
+    def test_sum_with_filter(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=10) Set(2, amount=20) Set(1, f=1)")
+        (s,) = q(ex, "Sum(Row(f=1), field=amount)")
+        assert (s.value, s.count) == (10, 1)
+
+    def test_cross_shard_bsi(self, env):
+        _, _, ex = env
+        c2 = SHARD_WIDTH + 1
+        q(ex, f"Set(1, amount=5) Set({c2}, amount=9)")
+        (s,) = q(ex, "Sum(field=amount)")
+        assert (s.value, s.count) == (14, 2)
+        (r,) = q(ex, "Row(amount > 6)")
+        np.testing.assert_array_equal(r.columns, [c2])
+
+    def test_row_equals_on_bsi(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=7)")
+        (r,) = q(ex, "Row(amount=7)")
+        np.testing.assert_array_equal(r.columns, [1])
+
+
+class TestTopNRowsGroupBy:
+    def test_topn(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=10)"
+              "Set(1, f=20) Set(2, f=20) Set(9, f=30)")
+        (p,) = q(ex, "TopN(f, n=2)")
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 3), (20, 2)]
+        (p_all,) = q(ex, "TopN(f)")
+        assert [(x.id, x.count) for x in p_all.pairs] == [
+            (10, 3), (20, 2), (30, 1)]
+
+    def test_topn_with_filter(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(2, f=20) Set(2, g=1)")
+        (p,) = q(ex, "TopN(f, filter=Row(g=1), n=5)")
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 1), (20, 1)]
+
+    def test_topn_ids_restriction(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=20)")
+        (p,) = q(ex, "TopN(f, ids=[20])")
+        assert [(x.id, x.count) for x in p.pairs] == [(20, 1)]
+
+    def test_topn_cross_shard_merge(self, env):
+        _, _, ex = env
+        c2 = SHARD_WIDTH
+        q(ex, f"Set(1, f=10) Set({c2}, f=10) Set({c2 + 1}, f=20)")
+        (p,) = q(ex, "TopN(f, n=1)")
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 2)]
+
+    def test_rows(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(1, f=20) Set(2, f=30)")
+        (r,) = q(ex, "Rows(f)")
+        np.testing.assert_array_equal(r.rows, [10, 20, 30])
+        (r,) = q(ex, "Rows(f, limit=2)")
+        np.testing.assert_array_equal(r.rows, [10, 20])
+        (r,) = q(ex, "Rows(f, previous=10)")
+        np.testing.assert_array_equal(r.rows, [20, 30])
+        (r,) = q(ex, "Rows(f, column=2)")
+        np.testing.assert_array_equal(r.rows, [30])
+
+    def test_groupby(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(1, g=5) Set(2, g=6)")
+        (g,) = q(ex, "GroupBy(Rows(f), Rows(g))")
+        got = [([fr.row_id for fr in gc.group], gc.count) for gc in g.groups]
+        assert got == [([10, 5], 1), ([10, 6], 1)]
+
+    def test_groupby_filter_and_aggregate(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(1, amount=100) Set(2, amount=50)")
+        (g,) = q(ex, "GroupBy(Rows(f), filter=Row(amount > 60),"
+                     "aggregate=Sum(field=amount))")
+        assert len(g.groups) == 1
+        gc = g.groups[0]
+        assert gc.count == 1 and gc.agg == 100
+
+
+class TestTimeFields:
+    def test_time_range_row(self, env):
+        holder, idx, ex = env
+        idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+        q(ex, "Set(1, t=1, 2017-01-02T00:00)"
+              "Set(2, t=1, 2017-03-05T00:00)"
+              "Set(3, t=1, 2018-01-01T00:00)")
+        (r,) = q(ex, "Row(t=1, from=2017-01-01T00:00, to=2017-12-31T00:00)")
+        np.testing.assert_array_equal(r.columns, [1, 2])
+        (r_all,) = q(ex, "Row(t=1)")
+        np.testing.assert_array_equal(r_all.columns, [1, 2, 3])
+
+
+class TestKeys:
+    def test_keyed_index_and_field(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("k", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        assert q(ex, 'Set("alice", f="admin")', index="k") == [True]
+        assert q(ex, 'Set("bob", f="admin")', index="k") == [True]
+        (r,) = q(ex, 'Row(f="admin")', index="k")
+        assert sorted(r.keys) == ["alice", "bob"]
+        (p,) = q(ex, "TopN(f)", index="k")
+        assert [(x.key, x.count) for x in p.pairs] == [("admin", 2)]
+
+    def test_missing_key_reads_empty(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("k", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        q(ex, 'Set("alice", f="admin")', index="k")
+        (r,) = q(ex, 'Row(f="nosuch")', index="k")
+        assert r.keys == []
+
+    def test_type_mismatch_errors(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("k", keys=True)
+        idx.create_field("f")
+        ex = Executor(holder)
+        with pytest.raises(ExecutionError):
+            q(ex, "Set(1, f=1)", index="k")  # int col on keyed index
+
+
+class TestPersistenceAcrossReopen:
+    def test_query_after_reopen(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        ex = Executor(holder)
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+        holder.close()
+
+        h2 = Holder(str(tmp_path)).open()
+        ex2 = Executor(h2)
+        assert q(ex2, "Count(Row(f=1))") == [2]
+
+    def test_plane_cache_invalidation(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1)")
+        assert q(ex, "Count(Row(f=1))") == [1]
+        q(ex, "Set(2, f=1)")  # mutation bumps generation → rebuild
+        assert q(ex, "Count(Row(f=1))") == [2]
+
+
+class TestTimeRangeClamping:
+    def test_open_ended_range_terminates(self, env):
+        """Regression: omitted from/to used year-1/year-9999 sentinels and
+        enumerated the whole calendar at the finest quantum."""
+        holder, idx, ex = env
+        idx.create_field("td", FieldOptions(type="time", time_quantum="YMDH"))
+        q(ex, "Set(1, td=1, 2020-01-02T03:00) Set(2, td=1, 2020-06-01T00:00)")
+        (r,) = q(ex, "Row(td=1, from=2020-01-01T00:00)")
+        np.testing.assert_array_equal(r.columns, [1, 2])
+        (r,) = q(ex, "Row(td=1, to=2020-05-01T00:00)")
+        np.testing.assert_array_equal(r.columns, [1])
+
+    def test_range_on_field_without_views(self, env):
+        holder, idx, ex = env
+        idx.create_field("t2", FieldOptions(type="time", time_quantum="D"))
+        (r,) = q(ex, "Row(t2=1, from=2020-01-01T00:00, to=2021-01-01T00:00)")
+        assert len(r.columns) == 0

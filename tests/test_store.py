@@ -289,3 +289,55 @@ class TestTimeQuantum:
     def test_invalid_quantum(self):
         with pytest.raises(ValueError):
             timeq.validate_quantum("YD")
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_unsorted_set_bits(self, tmp_path):
+        from pilosa_tpu.store import Fragment
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        assert f.set_bits(np.array([2, 1], np.uint64),
+                          np.array([5, 6], np.uint64)) == 2
+        np.testing.assert_array_equal(f.row(1).columns(), [6])
+        np.testing.assert_array_equal(f.row(2).columns(), [5])
+        # replay must agree with memory
+        g = Fragment(str(tmp_path / "0"), 0).open()
+        np.testing.assert_array_equal(g.row(1).columns(), [6])
+        np.testing.assert_array_equal(g.row(2).columns(), [5])
+
+    def test_bsi_overwrite_reports_changed(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("n", FieldOptions(type="int", min=0, max=100))
+        assert f.set_value(7, 5)
+        assert f.set_value(7, 9)      # overwrite: different value → changed
+        assert not f.set_value(7, 9)  # same value → unchanged
+        assert f.value(7) == (9, True)
+
+    def test_empty_store_on_empty_row_is_noop(self, tmp_path):
+        from pilosa_tpu.store import Fragment
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        assert not f.set_row(1, np.empty(0, np.uint32))
+        assert f.op_n == 0
+
+    def test_schema_preserves_timestamp_options(self, tmp_path):
+        h = Holder(str(tmp_path / "a")).open()
+        idx = h.create_index("i")
+        idx.create_field("ts", FieldOptions(type="timestamp", time_unit="ms",
+                                            epoch="2020-01-01T00:00:00"))
+        h2 = Holder(str(tmp_path / "b")).open()
+        h2.apply_schema(h.schema())
+        o = h2.index("i").field("ts").options
+        assert o.time_unit == "ms" and o.epoch == "2020-01-01T00:00:00"
+
+    def test_mutex_bulk_import(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("m", FieldOptions(type="mutex"))
+        cols = np.arange(500, dtype=np.uint64)
+        f.import_bits(np.ones(500, np.uint64), cols)          # all row 1
+        f.import_bits(np.full(250, 2, np.uint64), cols[:250])  # move half
+        frag = f.standard_view().fragment(0)
+        assert frag.row(1).cardinality == 250
+        assert frag.row(2).cardinality == 250
