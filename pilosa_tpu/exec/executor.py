@@ -64,9 +64,16 @@ class _Ctx:
 
 class Executor:
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
-                 place=None, plane_budget: int | None = None):
+                 place=None, plane_budget: int | None = None, placement=None):
+        """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
+        shards every plane's leading axis over the device mesh and pads
+        shard lists to the mesh size; without it, planes live on the
+        default device."""
         self.holder = holder
         self.translate = translate or TranslateStore(holder.path)
+        self.placement = placement
+        if placement is not None and place is None:
+            place = placement.place
         kw = {"budget_bytes": plane_budget} if plane_budget else {}
         self.planes = PlaneCache(place, **kw)
 
@@ -90,11 +97,15 @@ class Executor:
     def _shards_for(self, index: Index, shards, call: Call) -> tuple[int, ...]:
         opts = call.args.get("shards") if call.name == "Options" else None
         if opts is not None:
-            return tuple(int(s) for s in opts)
-        if shards is not None:
-            return tuple(shards)
-        avail = index.available_shards()
-        return tuple(avail) if avail else (0,)
+            out = tuple(int(s) for s in opts)
+        elif shards is not None:
+            out = tuple(shards)
+        else:
+            avail = index.available_shards()
+            out = tuple(avail) if avail else (0,)
+        if self.placement is not None:
+            out = self.placement.pad_shards(out)
+        return out
 
     # ------------------------------------------------------------- dispatch
 
@@ -288,7 +299,8 @@ class Executor:
                                      ctx.shards)
 
     def _zeros(self, ctx: _Ctx) -> jax.Array:
-        return jnp.zeros((len(ctx.shards), WORDS_PER_SHARD), dtype=jnp.uint32)
+        zeros = np.zeros((len(ctx.shards), WORDS_PER_SHARD), dtype=np.uint32)
+        return self.planes.place(zeros)
 
     def _to_row_result(self, ctx: _Ctx, words: jax.Array) -> RowResult:
         host = np.asarray(words)

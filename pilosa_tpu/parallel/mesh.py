@@ -1,0 +1,58 @@
+"""Device mesh placement: the shard axis over TPU chips.
+
+Reference: the reference distributes shards to nodes and merges partial
+results over HTTP (``executor.go#mapReduce``, ``cluster.go#shardNodes``;
+SURVEY.md §3.5).  The TPU rebuild replaces that with data placement: the
+shard axis of every plane is sharded over a ``jax.sharding.Mesh``, the
+same jitted query program runs on every chip against its resident
+shards, and cross-shard reductions (``sum`` for counts, ``top_k`` after
+a shard-axis sum) compile to XLA collectives over ICI — no host merge.
+
+``MeshPlacement`` is the pluggable placement for
+:class:`pilosa_tpu.exec.planes.PlaneCache`: it pads shard lists to the
+mesh size and device_puts host arrays with a shard-axis
+``NamedSharding``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.exec.planes import PAD_SHARD
+
+SHARD_AXIS = "shard"
+
+
+class MeshPlacement:
+    """Places plane arrays (axis 0 = shards) across a device mesh."""
+
+    def __init__(self, devices: list | None = None, axis: str = SHARD_AXIS):
+        if devices is None:
+            devices = jax.devices()
+        self.axis = axis
+        self.mesh = Mesh(np.array(devices), (axis,))
+        self.n_devices = len(devices)
+
+    def pad_shards(self, shards: tuple[int, ...]) -> tuple[int, ...]:
+        """Pad a shard list to a multiple of the device count with
+        PAD_SHARD sentinels (all-zero planes) so the shard axis divides
+        evenly across the mesh."""
+        rem = len(shards) % self.n_devices
+        if rem:
+            shards = shards + (PAD_SHARD,) * (self.n_devices - rem)
+        return shards
+
+    def sharding(self, ndim: int) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis, *([None] * (ndim - 1))))
+
+    def place(self, host_array: np.ndarray) -> jax.Array:
+        return jax.device_put(host_array, self.sharding(host_array.ndim))
+
+
+def local_placement() -> MeshPlacement | None:
+    """Mesh over all local devices, or None for a single device (plain
+    ``device_put`` placement is then used)."""
+    devs = jax.devices()
+    return MeshPlacement(devs) if len(devs) > 1 else None

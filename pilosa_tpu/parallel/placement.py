@@ -1,0 +1,56 @@
+"""Shard → partition → node placement (host-level distribution).
+
+Reference: ``cluster.go`` (SURVEY.md §3.3) — shards hash to one of 256
+partitions via jump-consistent-hash of (index, shard); a partition maps
+to ``replicaN`` nodes.  The TPU rebuild keeps this exact scheme for the
+*host* layer (which host owns a shard's fragment files and feeds it to
+its chips); within one host, shards map onto the chip mesh by position
+(``MeshPlacement``).
+
+Jump hash per Lamping & Veach, "A Fast, Minimal Memory, Consistent Hash
+Algorithm" — the algorithm upstream uses.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.store.translate import PARTITION_N, fnv1a64
+
+
+def jump_hash(key: int, n_buckets: int) -> int:
+    """Jump consistent hash: uint64 key -> bucket in [0, n_buckets)."""
+    if n_buckets <= 0:
+        raise ValueError("n_buckets must be positive")
+    b, j = -1, 0
+    key &= 0xFFFFFFFFFFFFFFFF
+    while j < n_buckets:
+        b = j
+        key = (key * 2862933555777941757 + 1) & 0xFFFFFFFFFFFFFFFF
+        j = int((b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+def shard_partition(index: str, shard: int, n_partitions: int = PARTITION_N) -> int:
+    """Partition of (index, shard) — reference: ``Cluster.partition``:
+    fnv hash of index name + big-endian shard, jump-hashed."""
+    h = fnv1a64(index.encode() + shard.to_bytes(8, "big"))
+    return jump_hash(h, n_partitions)
+
+
+def partition_nodes(partition: int, node_ids: list[str],
+                    replica_n: int = 1) -> list[str]:
+    """The replica_n nodes owning a partition: jump-hash picks the
+    primary among sorted node IDs; replicas follow in ring order
+    (reference: ``Cluster.partitionNodes``)."""
+    if not node_ids:
+        return []
+    nodes = sorted(node_ids)
+    k = min(replica_n, len(nodes))
+    start = jump_hash(partition, len(nodes))
+    return [nodes[(start + i) % len(nodes)] for i in range(k)]
+
+
+def shard_nodes(index: str, shard: int, node_ids: list[str],
+                replica_n: int = 1) -> list[str]:
+    """Owning nodes of a shard (primary first) — reference:
+    ``Cluster.shardNodes``."""
+    return partition_nodes(shard_partition(index, shard), node_ids, replica_n)

@@ -1,0 +1,175 @@
+"""Mesh distribution tests on the simulated 8-device CPU mesh — the
+rebuild's in-process multi-node cluster harness (SURVEY.md §5): every
+query must produce identical results on a meshed executor and a plain
+single-device executor over the same holder."""
+
+import jax
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH, WORDS_PER_SHARD, pack_columns
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.parallel import (MeshPlacement, jump_hash, partition_nodes,
+                                 shard_nodes, shard_partition)
+from pilosa_tpu.parallel import spmd
+from pilosa_tpu.store import FieldOptions, Holder
+
+
+@pytest.fixture(scope="module")
+def mesh_placement():
+    assert jax.device_count() == 8, "conftest must force 8 CPU devices"
+    return MeshPlacement(jax.devices())
+
+
+@pytest.fixture
+def holder12(tmp_path, rng):
+    """Holder with data spread over 12 shards (not a multiple of 8 —
+    exercises pad shards)."""
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("amount", FieldOptions(type="int", min=-500, max=500))
+    n = 5000
+    cols = rng.choice(12 * SHARD_WIDTH, size=n, replace=False).astype(np.uint64)
+    rows = rng.integers(0, 8, size=n).astype(np.uint64)
+    idx.field("f").import_bits(rows, cols)
+    half = cols[: n // 2]
+    idx.field("g").import_bits(np.ones(len(half), np.uint64), half)
+    vcols = cols[:1000]
+    vals = rng.integers(-500, 500, size=1000)
+    idx.field("amount").import_values(vcols, vals)
+    idx.note_columns(cols)
+    return h
+
+
+QUERIES = [
+    "Count(Row(f=1))",
+    "Count(Intersect(Row(f=1), Row(g=1)))",
+    "Count(Union(Row(f=1), Row(f=2), Row(g=1)))",
+    "Count(Xor(Row(f=1), Row(g=1)))",
+    "Count(Not(Row(g=1)))",
+    "Count(Row(amount > 100))",
+    "Count(Row(-100 <= amount <= 100))",
+]
+
+
+class TestMeshedExecutorEquivalence:
+    def test_counts_match(self, holder12, mesh_placement):
+        plain = Executor(holder12)
+        meshed = Executor(holder12, placement=mesh_placement)
+        for pql in QUERIES:
+            assert plain.execute("i", pql) == meshed.execute("i", pql), pql
+
+    def test_row_columns_match(self, holder12, mesh_placement):
+        plain = Executor(holder12)
+        meshed = Executor(holder12, placement=mesh_placement)
+        for pql in ["Row(f=3)", "Intersect(Row(f=1), Row(g=1))",
+                    "Row(amount > 0)"]:
+            (a,) = plain.execute("i", pql)
+            (b,) = meshed.execute("i", pql)
+            np.testing.assert_array_equal(a.columns, b.columns, err_msg=pql)
+
+    def test_topn_matches(self, holder12, mesh_placement):
+        plain = Executor(holder12)
+        meshed = Executor(holder12, placement=mesh_placement)
+        (a,) = plain.execute("i", "TopN(f)")
+        (b,) = meshed.execute("i", "TopN(f)")
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+
+    def test_aggregates_match(self, holder12, mesh_placement):
+        plain = Executor(holder12)
+        meshed = Executor(holder12, placement=mesh_placement)
+        for pql in ["Sum(field=amount)", "Min(field=amount)",
+                    "Max(field=amount)"]:
+            (a,) = plain.execute("i", pql)
+            (b,) = meshed.execute("i", pql)
+            assert (a.value, a.count) == (b.value, b.count), pql
+
+    def test_writes_through_meshed_executor(self, holder12, mesh_placement):
+        meshed = Executor(holder12, placement=mesh_placement)
+        assert meshed.execute("i", f"Set({13 * SHARD_WIDTH}, f=1)") == [True]
+        plain = Executor(holder12)
+        assert plain.execute("i", "Count(Row(f=1))") == \
+            meshed.execute("i", "Count(Row(f=1))")
+
+
+class TestSpmdPrograms:
+    def test_explicit_psum_intersect_count(self, mesh_placement, rng):
+        n_shards = 8
+        a_cols = [rng.choice(SHARD_WIDTH, 1000, replace=False) for _ in range(n_shards)]
+        b_cols = [rng.choice(SHARD_WIDTH, 1000, replace=False) for _ in range(n_shards)]
+        a = np.stack([pack_columns(c) for c in a_cols])
+        b = np.stack([pack_columns(c) for c in b_cols])
+        expect = sum(len(np.intersect1d(x, y)) for x, y in zip(a_cols, b_cols))
+        fn = spmd.make_intersect_count_psum(mesh_placement.mesh)
+        got = int(fn(mesh_placement.place(a), mesh_placement.place(b)))
+        assert got == expect
+        # implicit-collective variant agrees
+        assert int(spmd.intersect_count(mesh_placement.place(a),
+                                        mesh_placement.place(b))) == expect
+
+    def test_explicit_psum_topn(self, mesh_placement, rng):
+        n_shards, n_rows = 8, 16
+        plane = np.zeros((n_shards, n_rows, WORDS_PER_SHARD), np.uint32)
+        counts = np.zeros(n_rows, np.int64)
+        for s in range(n_shards):
+            for r in range(n_rows):
+                k = int(rng.integers(0, 500))
+                cols = rng.choice(SHARD_WIDTH, k, replace=False)
+                plane[s, r] = pack_columns(cols)
+                counts[r] += k
+        fn = spmd.make_topn_psum(mesh_placement.mesh, n=4)
+        filt = np.full((n_shards, WORDS_PER_SHARD), 0xFFFFFFFF, np.uint32)
+        vals, slots = fn(mesh_placement.place(plane), mesh_placement.place(filt))
+        order = np.argsort(-counts, kind="stable")[:4]
+        np.testing.assert_array_equal(np.asarray(vals), counts[order])
+
+    def test_ingest_step(self, mesh_placement, rng):
+        from pilosa_tpu.engine.words import coalesce_updates
+        n_shards = 8
+        words = np.zeros((n_shards, WORDS_PER_SHARD), np.uint32)
+        k = 64
+        idx = np.zeros((n_shards, k), np.int64)
+        mask = np.zeros((n_shards, k), np.uint32)
+        expect = []
+        for s in range(n_shards):
+            pos = rng.choice(SHARD_WIDTH, 50, replace=False)
+            ui, um = coalesce_updates(pos)
+            idx[s, :len(ui)] = ui
+            idx[s, len(ui):] = WORDS_PER_SHARD  # pad = out-of-range drop
+            mask[s, :len(um)] = um
+            expect.append(np.sort(pos))
+        fn = spmd.make_ingest_step(mesh_placement.mesh)
+        out = np.asarray(fn(mesh_placement.place(words),
+                            mesh_placement.place(idx),
+                            mesh_placement.place(mask)))
+        from pilosa_tpu.engine.words import unpack_columns
+        for s in range(n_shards):
+            np.testing.assert_array_equal(unpack_columns(out[s]), expect[s])
+
+
+class TestJumpHashPlacement:
+    def test_jump_hash_stability(self):
+        # moving 4→5 buckets relocates only ~1/5 of keys
+        moved = sum(jump_hash(k, 4) != jump_hash(k, 5) for k in range(10000))
+        assert 1500 < moved < 2500
+
+    def test_partition_determinism(self):
+        assert shard_partition("i", 0) == shard_partition("i", 0)
+        assert 0 <= shard_partition("i", 123) < 256
+
+    def test_partition_nodes_replication(self):
+        nodes = [f"node{i}" for i in range(5)]
+        owners = partition_nodes(7, nodes, replica_n=3)
+        assert len(owners) == 3 and len(set(owners)) == 3
+        # stable under node-list order permutation
+        assert owners == partition_nodes(7, list(reversed(nodes)), replica_n=3)
+
+    def test_shard_nodes_balance(self):
+        nodes = [f"n{i}" for i in range(4)]
+        counts = {n: 0 for n in nodes}
+        for s in range(256):
+            counts[shard_nodes("idx", s, nodes)[0]] += 1
+        assert max(counts.values()) < 2.5 * min(counts.values())
