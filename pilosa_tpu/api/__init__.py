@@ -1,0 +1,9 @@
+"""API surface (L5 of SURVEY.md §2): programmatic façade, REST server,
+HTTP client."""
+
+from pilosa_tpu.api.api import API, ApiError, field_options_from_json
+from pilosa_tpu.api.client import Client, ClientError
+from pilosa_tpu.api.server import Server
+
+__all__ = ["API", "ApiError", "Server", "Client", "ClientError",
+           "field_options_from_json"]

@@ -1,0 +1,322 @@
+"""Programmatic API façade.
+
+Reference: ``api.go`` (SURVEY.md §3.3) — the validation + orchestration
+layer used by both the HTTP handler and (upstream v2) gRPC: index/field
+CRUD, query execution, bulk import routing, schema and status
+introspection.  Both the REST server (:mod:`pilosa_tpu.api.server`) and
+the CLI drive this class; it owns nothing itself — holder for storage,
+executor for queries.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from datetime import datetime
+
+import numpy as np
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor, result_to_json
+from pilosa_tpu.store import FieldOptions, Holder
+from pilosa_tpu.store.field import BSI_TYPES
+from pilosa_tpu.store.view import VIEW_STANDARD
+
+
+class ApiError(Exception):
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+def field_options_from_json(o: dict) -> FieldOptions:
+    """REST field-options body -> FieldOptions (reference:
+    ``http/handler.go`` postFieldRequest decoding)."""
+    return FieldOptions(
+        type=o.get("type", "set"), keys=o.get("keys", False),
+        cache_type=o.get("cacheType", "ranked"),
+        cache_size=o.get("cacheSize", 50000),
+        time_quantum=o.get("timeQuantum", ""),
+        min=o.get("min"), max=o.get("max"), base=o.get("base", 0),
+        bit_depth=o.get("bitDepth", 0), scale=o.get("scale", 0),
+        epoch=o.get("epoch", ""), time_unit=o.get("timeUnit", "s"),
+    )
+
+
+class API:
+    def __init__(self, holder: Holder, executor: Executor | None = None,
+                 cluster=None):
+        self.holder = holder
+        self.executor = executor or Executor(holder)
+        self.cluster = cluster  # set by the cluster layer when distributed
+
+    # -- schema -------------------------------------------------------------
+
+    def create_index(self, name: str, options: dict | None = None):
+        options = options or {}
+        try:
+            return self.holder.create_index(
+                name, keys=options.get("keys", False),
+                track_existence=options.get("trackExistence", True))
+        except ValueError as e:
+            raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+
+    def delete_index(self, name: str) -> None:
+        try:
+            self.holder.delete_index(name)
+        except KeyError:
+            raise ApiError(f"index {name!r} not found", 404)
+        self.executor.planes.invalidate(name)
+
+    def create_field(self, index: str, name: str, options: dict | None = None):
+        idx = self._index(index)
+        try:
+            return idx.create_field(
+                name, field_options_from_json(options or {}))
+        except ValueError as e:
+            raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+
+    def delete_field(self, index: str, name: str) -> None:
+        idx = self._index(index)
+        try:
+            idx.delete_field(name)
+        except KeyError:
+            raise ApiError(f"field {name!r} not found", 404)
+        self.executor.planes.invalidate(index)
+
+    def schema(self) -> list[dict]:
+        return self.holder.schema()
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        self.holder.apply_schema(schema)
+
+    # -- query --------------------------------------------------------------
+
+    def query(self, index: str, pql: str,
+              shards: list[int] | None = None) -> dict:
+        from pilosa_tpu.exec.executor import ExecutionError
+        from pilosa_tpu.pql.parser import ParseError
+        self._index(index)
+        try:
+            results = self.executor.execute(index, pql, shards=shards)
+        except (ParseError, ExecutionError) as e:
+            raise ApiError(str(e), 400)
+        return {"results": [result_to_json(r) for r in results]}
+
+    # -- imports ------------------------------------------------------------
+
+    def import_bits(self, index: str, field: str, *,
+                    row_ids=None, col_ids=None, row_keys=None, col_keys=None,
+                    timestamps=None, clear: bool = False) -> int:
+        """Bulk bit import (reference: ``API.Import``): ID or key form;
+        timestamps are epoch-seconds or ISO strings."""
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field {field!r} not found", 404)
+        rows = self._translate_rows(idx, f, row_ids, row_keys)
+        cols = self._translate_cols(idx, col_ids, col_keys)
+        if len(rows) != len(cols):
+            raise ApiError("rows and columns length mismatch")
+        ts = self._parse_timestamps(timestamps, len(cols))
+        if clear:
+            changed = 0
+            for r, c in zip(rows, cols):
+                changed += f.clear_bit(int(r), int(c))
+            return changed
+        changed = f.import_bits(rows, cols, ts)
+        idx.note_columns(cols)
+        return changed
+
+    def import_values(self, index: str, field: str, *,
+                      col_ids=None, col_keys=None, values=None) -> int:
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field {field!r} not found", 404)
+        if f.options.type not in BSI_TYPES:
+            raise ApiError(f"field {field!r} is not an int field")
+        cols = self._translate_cols(idx, col_ids, col_keys)
+        if values is None or len(values) != len(cols):
+            raise ApiError("columns and values length mismatch")
+        try:
+            changed = f.import_values(cols, values)
+        except ValueError as e:
+            raise ApiError(str(e))
+        idx.note_columns(cols)
+        return changed
+
+    def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
+                       view: str = VIEW_STANDARD, clear: bool = False) -> int:
+        """Pre-encoded roaring import — the bulk-loader fast path
+        (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field {field!r} not found", 404)
+        frag = f.view(view, create=True).fragment(shard, create=True)
+        try:
+            changed = f_changed = frag.import_roaring(blob, clear=clear)
+        except ValueError as e:
+            raise ApiError(f"bad roaring payload: {e}")
+        if f_changed and idx.track_existence and not clear:
+            from pilosa_tpu.store import roaring as rc
+            positions = rc.deserialize(blob)
+            cols = (np.unique(positions % np.uint64(SHARD_WIDTH))
+                    + np.uint64(shard * SHARD_WIDTH))
+            idx.note_columns(cols)
+        return changed
+
+    # -- export -------------------------------------------------------------
+
+    def export_csv(self, index: str, field: str) -> str:
+        """CSV of (row,col) pairs (reference: ``API.ExportCSV``), keys
+        translated when the index/field is keyed."""
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field {field!r} not found", 404)
+        out = io.StringIO()
+        col_log = (self.executor.translate.columns(index)
+                   if idx.keys else None)
+        row_log = (self.executor.translate.rows(index, field)
+                   if f.options.keys else None)
+        view = f.standard_view()
+        if view is not None:
+            for shard in sorted(view.fragments):
+                frag = view.fragment(shard)
+                for r in frag.row_ids():
+                    cols = frag.row(r).columns().astype(np.uint64) + \
+                        np.uint64(shard * SHARD_WIDTH)
+                    rkey = row_log.key_of(r) if row_log else r
+                    for c in cols:
+                        ckey = col_log.key_of(int(c)) if col_log else int(c)
+                        out.write(f"{rkey},{ckey}\n")
+        return out.getvalue()
+
+    # -- backup / restore ---------------------------------------------------
+
+    def backup_tar(self) -> bytes:
+        """Consistent tar of the data dir (reference: ``ctl/backup``):
+        snapshot every open fragment so snapshots subsume op-logs, then
+        tar snapshot + meta + key files."""
+        import tarfile
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        if frag.op_n > 0:
+                            frag.snapshot()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self.holder.path, arcname="data",
+                    filter=lambda ti: None if ti.name.endswith(".oplog")
+                    else ti)
+        return buf.getvalue()
+
+    def restore_tar(self, blob: bytes) -> None:
+        """Restore a backup tar into the data dir and reopen the holder.
+        Refuses when indexes already exist (as upstream restore does)."""
+        import tarfile
+        if self.holder.indexes:
+            raise ApiError("restore requires an empty holder", 409)
+        buf = io.BytesIO(blob)
+        with tarfile.open(fileobj=buf) as tar:
+            for member in tar.getmembers():
+                name = member.name
+                if not name.startswith("data/") and name != "data":
+                    raise ApiError(f"unexpected tar entry {name!r}")
+            import tempfile
+            with tempfile.TemporaryDirectory() as tmp:
+                tar.extractall(tmp, filter="data")
+                import shutil
+                src = f"{tmp}/data"
+                for entry in sorted(os.listdir(src)):
+                    shutil.move(f"{src}/{entry}",
+                                f"{self.holder.path}/{entry}")
+        self.holder.close()
+        self.holder.open()
+        self.executor.planes.invalidate()
+        self.executor.translate.close()
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self) -> dict:
+        import jax
+        devices = [{"id": d.id, "platform": d.platform, "kind": d.device_kind}
+                   for d in jax.devices()]
+        state = "NORMAL"
+        nodes = [{"id": "local", "uri": "", "state": state, "isPrimary": True}]
+        if self.cluster is not None:
+            nodes = self.cluster.nodes_status()
+            state = self.cluster.state()
+        return {"state": state, "nodes": nodes,
+                "localShardCount": sum(len(i.available_shards())
+                                       for i in self.holder.indexes.values()),
+                "devices": devices}
+
+    def info(self) -> dict:
+        import os
+        return {"shardWidth": SHARD_WIDTH,
+                "cpuPhysicalCores": os.cpu_count(),
+                "memory": _total_memory_bytes()}
+
+    # -- internal -----------------------------------------------------------
+
+    def _index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise ApiError(f"index {name!r} not found", 404)
+        return idx
+
+    def _translate_rows(self, idx, f, row_ids, row_keys) -> np.ndarray:
+        if row_keys is not None:
+            if not f.options.keys:
+                raise ApiError(f"field {f.name!r} is not keyed")
+            log = self.executor.translate.rows(idx.name, f.name)
+            return np.array(log.translate(list(row_keys), create=True),
+                            dtype=np.uint64)
+        if row_ids is None:
+            raise ApiError("missing rowIDs/rowKeys")
+        if f.options.keys:
+            raise ApiError(f"field {f.name!r} is keyed; use rowKeys")
+        return np.asarray(row_ids, dtype=np.uint64)
+
+    def _translate_cols(self, idx, col_ids, col_keys) -> np.ndarray:
+        if col_keys is not None:
+            if not idx.keys:
+                raise ApiError(f"index {idx.name!r} is not keyed")
+            log = self.executor.translate.columns(idx.name)
+            return np.array(log.translate(list(col_keys), create=True),
+                            dtype=np.uint64)
+        if col_ids is None:
+            raise ApiError("missing columnIDs/columnKeys")
+        if idx.keys:
+            raise ApiError(f"index {idx.name!r} is keyed; use columnKeys")
+        return np.asarray(col_ids, dtype=np.uint64)
+
+    @staticmethod
+    def _parse_timestamps(timestamps, n: int):
+        if timestamps is None:
+            return None
+        out = []
+        for t in timestamps:
+            if t in (None, 0, ""):
+                out.append(None)
+            elif isinstance(t, str):
+                from pilosa_tpu.store.timeq import parse_pql_time
+                out.append(parse_pql_time(t))
+            else:
+                out.append(datetime.utcfromtimestamp(int(t)))
+        return out
+
+
+def _total_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0

@@ -1,0 +1,112 @@
+"""HTTP client for the REST surface.
+
+Reference: ``http/client.go`` (SURVEY.md §3.3) — the same client serves
+external callers (CLI import/export/backup) and, in the cluster layer,
+node-to-node calls (``InternalClient``).  stdlib urllib; no external
+deps.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+class ClientError(Exception):
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = status
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10101,
+                 timeout: float = 60.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    # -- transport ----------------------------------------------------------
+
+    def _do(self, method: str, path: str, body: bytes | None = None,
+            content_type: str = "application/json"):
+        req = urllib.request.Request(
+            self.base + path, data=body, method=method,
+            headers={"Content-Type": content_type} if body else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except json.JSONDecodeError:
+                pass
+            raise ClientError(detail, e.code) from e
+        except urllib.error.URLError as e:
+            raise ClientError(f"cannot reach {self.base}: {e.reason}") from e
+        if ctype.startswith("application/json"):
+            return json.loads(data)
+        return data
+
+    def _json(self, method: str, path: str, obj=None):
+        body = json.dumps(obj).encode() if obj is not None else None
+        return self._do(method, path, body)
+
+    # -- api ----------------------------------------------------------------
+
+    def query(self, index: str, pql: str, shards: list[int] | None = None):
+        path = f"/index/{index}/query"
+        if shards:
+            path += "?shards=" + ",".join(str(s) for s in shards)
+        return self._do("POST", path, pql.encode())["results"]
+
+    def create_index(self, name: str, options: dict | None = None):
+        return self._json("POST", f"/index/{name}",
+                          {"options": options or {}})
+
+    def delete_index(self, name: str):
+        return self._json("DELETE", f"/index/{name}")
+
+    def create_field(self, index: str, name: str,
+                     options: dict | None = None):
+        return self._json("POST", f"/index/{index}/field/{name}",
+                          {"options": options or {}})
+
+    def delete_field(self, index: str, name: str):
+        return self._json("DELETE", f"/index/{index}/field/{name}")
+
+    def import_bits(self, index: str, field: str, **body):
+        return self._json("POST", f"/index/{index}/field/{field}/import",
+                          body)["changed"]
+
+    def import_values(self, index: str, field: str, **body):
+        return self._json("POST", f"/index/{index}/field/{field}/importValue",
+                          body)["changed"]
+
+    def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
+                       view: str = "standard"):
+        path = (f"/index/{index}/field/{field}/import-roaring/{shard}"
+                f"?view={urllib.parse.quote(view)}")
+        return self._do("POST", path, blob,
+                        content_type="application/octet-stream")["changed"]
+
+    def export_csv(self, index: str, field: str) -> str:
+        return self._do(
+            "GET", f"/export?index={index}&field={field}").decode()
+
+    def schema(self) -> list[dict]:
+        return self._json("GET", "/schema")["indexes"]
+
+    def status(self) -> dict:
+        return self._json("GET", "/status")
+
+    def info(self) -> dict:
+        return self._json("GET", "/info")
+
+    def version(self) -> str:
+        return self._json("GET", "/version")["version"]
+
+    def metrics_text(self) -> str:
+        return self._do("GET", "/metrics").decode()

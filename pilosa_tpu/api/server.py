@@ -1,0 +1,288 @@
+"""REST server over the API façade.
+
+Reference: ``http/handler.go`` (SURVEY.md §3.3).  Routes (same surface,
+JSON bodies instead of protobuf — content negotiation is a deliberate
+simplification):
+
+    POST   /index/{i}/query                     PQL body -> {"results": [...]}
+    POST   /index/{i}                           create index
+    DELETE /index/{i}
+    POST   /index/{i}/field/{f}                 create field
+    DELETE /index/{i}/field/{f}
+    POST   /index/{i}/field/{f}/import          bulk bits (JSON)
+    POST   /index/{i}/field/{f}/importValue     bulk BSI values (JSON)
+    POST   /index/{i}/field/{f}/import-roaring/{shard}   binary roaring
+    GET    /export?index=i&field=f              CSV
+    GET    /schema | /status | /info | /version | /metrics
+    POST   /internal/*                          node-to-node (cluster layer)
+
+Implementation is stdlib ``ThreadingHTTPServer`` — the control plane is
+host-side Python; all data-plane math stays on device.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pilosa_tpu import __version__
+from pilosa_tpu.api.api import API, ApiError
+
+
+class Router:
+    def __init__(self):
+        self.routes: list[tuple[str, re.Pattern, object]] = []
+
+    def add(self, method: str, pattern: str, fn) -> None:
+        # '{name}' segments -> named groups
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self.routes.append((method, re.compile("^" + regex + "$"), fn))
+
+    def match(self, method: str, path: str):
+        for m, rx, fn in self.routes:
+            if m != method:
+                continue
+            hit = rx.match(path)
+            if hit:
+                return fn, hit.groupdict()
+        return None, None
+
+
+class Handler(BaseHTTPRequestHandler):
+    """One instance per request; server state lives on ``self.server``."""
+
+    protocol_version = "HTTP/1.1"
+    server_version = "pilosa-tpu/" + __version__
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through our logger
+        logger = getattr(self.server, "logger", None)
+        if logger is not None:
+            logger.debug("http: " + fmt % args)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _json_body(self) -> dict:
+        raw = self._body()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}")
+
+    def _reply(self, obj, status: int = 200,
+               content_type: str = "application/json") -> None:
+        data = (obj if isinstance(obj, bytes)
+                else json.dumps(obj).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        self.query = urllib.parse.parse_qs(parsed.query)
+        fn, params = self.server.router.match(method, parsed.path)
+        srv = self.server
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            if fn is None:
+                code = 404
+                self._reply({"error": f"no route {method} {parsed.path}"}, 404)
+                return
+            fn(self, **params)
+        except ApiError as e:
+            code = e.status
+            self._reply({"error": str(e)}, e.status)
+        except BrokenPipeError:
+            code = 499
+        except Exception as e:  # noqa: BLE001 — server must not die
+            code = 500
+            if getattr(srv, "logger", None):
+                srv.logger.exception("http 500: %s %s", method, parsed.path)
+            try:
+                self._reply({"error": f"internal error: {e}"}, 500)
+            except BrokenPipeError:
+                pass
+        finally:
+            stats = getattr(srv, "stats", None)
+            if stats is not None:
+                stats.count("http_requests_total", 1,
+                            method=method, status=str(code))
+                stats.observe("http_request_seconds",
+                              time.perf_counter() - t0, method=method)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- handlers -------------------------------------------------------------
+
+    def h_query(self, index: str) -> None:
+        pql = self._body().decode()
+        shards = None
+        if "shards" in self.query:
+            try:
+                shards = [int(s) for s in
+                          self.query["shards"][0].split(",") if s]
+            except ValueError:
+                raise ApiError(f"bad shards param "
+                               f"{self.query['shards'][0]!r}")
+        self._reply(self.server.api.query(index, pql, shards=shards))
+
+    def h_create_index(self, index: str) -> None:
+        body = self._json_body()
+        self.server.api.create_index(index, body.get("options"))
+        self._reply({"success": True})
+
+    def h_delete_index(self, index: str) -> None:
+        self.server.api.delete_index(index)
+        self._reply({"success": True})
+
+    def h_create_field(self, index: str, field: str) -> None:
+        body = self._json_body()
+        self.server.api.create_field(index, field, body.get("options"))
+        self._reply({"success": True})
+
+    def h_delete_field(self, index: str, field: str) -> None:
+        self.server.api.delete_field(index, field)
+        self._reply({"success": True})
+
+    def h_import(self, index: str, field: str) -> None:
+        b = self._json_body()
+        changed = self.server.api.import_bits(
+            index, field,
+            row_ids=b.get("rowIDs"), col_ids=b.get("columnIDs"),
+            row_keys=b.get("rowKeys"), col_keys=b.get("columnKeys"),
+            timestamps=b.get("timestamps"),
+            clear=b.get("clear", False) or "clear" in self.query)
+        self._reply({"changed": changed})
+
+    def h_import_value(self, index: str, field: str) -> None:
+        b = self._json_body()
+        changed = self.server.api.import_values(
+            index, field,
+            col_ids=b.get("columnIDs"), col_keys=b.get("columnKeys"),
+            values=b.get("values"))
+        self._reply({"changed": changed})
+
+    def h_import_roaring(self, index: str, field: str, shard: str) -> None:
+        view = self.query.get("view", ["standard"])[0]
+        clear = "clear" in self.query
+        changed = self.server.api.import_roaring(
+            index, field, int(shard), self._body(), view=view, clear=clear)
+        self._reply({"changed": changed})
+
+    def h_export(self) -> None:
+        index = self.query.get("index", [None])[0]
+        field = self.query.get("field", [None])[0]
+        if not index or not field:
+            raise ApiError("export requires ?index= and ?field=")
+        csv = self.server.api.export_csv(index, field)
+        self._reply(csv.encode(), content_type="text/csv")
+
+    def h_schema(self) -> None:
+        self._reply({"indexes": self.server.api.schema()})
+
+    def h_status(self) -> None:
+        self._reply(self.server.api.status())
+
+    def h_info(self) -> None:
+        self._reply(self.server.api.info())
+
+    def h_version(self) -> None:
+        self._reply({"version": __version__})
+
+    def h_metrics(self) -> None:
+        stats = getattr(self.server, "stats", None)
+        text = stats.prometheus_text() if stats is not None else ""
+        self._reply(text.encode(),
+                    content_type="text/plain; version=0.0.4")
+
+    def h_backup(self) -> None:
+        """Tar the whole data dir (reference: ``pilosa backup`` tars over
+        HTTP; SURVEY.md §6 checkpoint/resume).  Fragments snapshot first
+        so the tar is self-consistent."""
+        self._reply(self.server.api.backup_tar(),
+                    content_type="application/x-tar")
+
+    def h_restore(self) -> None:
+        self.server.api.restore_tar(self._body())
+        self._reply({"success": True})
+
+    def h_traces(self) -> None:
+        from pilosa_tpu.obs import GLOBAL_TRACER
+        self._reply({"traces": [s.to_json()
+                                for s in GLOBAL_TRACER.finished()]})
+
+
+def build_router() -> Router:
+    r = Router()
+    r.add("POST", "/index/{index}/query", Handler.h_query)
+    r.add("POST", "/index/{index}/field/{field}/import", Handler.h_import)
+    r.add("POST", "/index/{index}/field/{field}/importValue",
+          Handler.h_import_value)
+    r.add("POST", "/index/{index}/field/{field}/import-roaring/{shard}",
+          Handler.h_import_roaring)
+    r.add("POST", "/index/{index}/field/{field}", Handler.h_create_field)
+    r.add("DELETE", "/index/{index}/field/{field}", Handler.h_delete_field)
+    r.add("POST", "/index/{index}", Handler.h_create_index)
+    r.add("DELETE", "/index/{index}", Handler.h_delete_index)
+    r.add("GET", "/export", Handler.h_export)
+    r.add("GET", "/schema", Handler.h_schema)
+    r.add("GET", "/status", Handler.h_status)
+    r.add("GET", "/info", Handler.h_info)
+    r.add("GET", "/version", Handler.h_version)
+    r.add("GET", "/metrics", Handler.h_metrics)
+    r.add("GET", "/internal/backup", Handler.h_backup)
+    r.add("POST", "/internal/restore", Handler.h_restore)
+    r.add("GET", "/internal/traces", Handler.h_traces)
+    return r
+
+
+class Server:
+    """HTTP server wrapper: ``serve_forever`` on a background thread
+    (reference: ``server.go#Server.Open`` / handler listen-serve)."""
+
+    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101,
+                 stats=None, logger=None):
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.api = api
+        self.httpd.router = build_router()
+        self.httpd.stats = stats
+        self.httpd.logger = logger
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "Server":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="pilosa-tpu-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

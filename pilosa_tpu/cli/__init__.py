@@ -1,0 +1,5 @@
+"""Command line + config (L6 of SURVEY.md §2)."""
+
+from pilosa_tpu.cli.config import Config, load
+
+__all__ = ["Config", "load"]

@@ -1,0 +1,103 @@
+"""Layered configuration: TOML file ⊕ ``PILOSA_*`` env vars ⊕ CLI flags.
+
+Reference: ``server/config.go`` with cobra+viper layering (SURVEY.md
+§3.3, §6): flags override env, env overrides file, file overrides
+defaults.  One typed dataclass; ``effective()`` dumps the resolved
+config the way the reference's startup log does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field as dc_field
+
+ENV_PREFIX = "PILOSA_"
+
+
+@dataclass
+class Config:
+    bind: str = "127.0.0.1:10101"
+    data_dir: str = "~/.pilosa_tpu"
+    verbose: bool = False
+    fsync: bool = False
+    # cluster
+    name: str = ""                      # node id; default derived from bind
+    seeds: list[str] = dc_field(default_factory=list)  # host:port of peers
+    replicas: int = 1
+    anti_entropy_interval: float = 600.0  # seconds; 0 disables
+    heartbeat_interval: float = 2.0
+    # device
+    plane_budget_bytes: int = 4 << 30
+    mesh: bool = True                   # shard planes over all local devices
+
+    @property
+    def host(self) -> str:
+        return self.bind.rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.bind.rsplit(":", 1)[1])
+
+    def effective(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _coerce(value: str, typ):
+    if typ is bool:
+        return value.lower() in _BOOL_TRUE
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ == list[str]:
+        return [s.strip() for s in value.split(",") if s.strip()]
+    return value
+
+
+def load(path: str | None = None, env: dict | None = None,
+         overrides: dict | None = None) -> Config:
+    """defaults ← TOML file ← PILOSA_* env ← explicit overrides."""
+    cfg = Config()
+    fields = {f.name: f.type for f in dataclasses.fields(Config)}
+
+    if path:
+        import tomllib
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for k, v in data.items():
+            k = k.replace("-", "_")
+            if k not in fields:
+                raise ValueError(f"unknown config key {k!r} in {path}")
+            setattr(cfg, k, v)
+
+    env = env if env is not None else os.environ
+    for k in fields:
+        ev = env.get(ENV_PREFIX + k.upper())
+        if ev is not None:
+            setattr(cfg, k, _coerce(ev, _resolve_type(fields[k])))
+
+    for k, v in (overrides or {}).items():
+        if v is not None:
+            setattr(cfg, k, v)
+
+    cfg.data_dir = os.path.expanduser(cfg.data_dir)
+    if not cfg.name:
+        cfg.name = cfg.bind
+    return cfg
+
+
+def _resolve_type(t):
+    # dataclass field types may be strings under future annotations
+    if t in ("bool", bool):
+        return bool
+    if t in ("int", int):
+        return int
+    if t in ("float", float):
+        return float
+    if t in ("list[str]",) or t == list[str]:
+        return list[str]
+    return str

@@ -1,0 +1,280 @@
+"""Command line: ``python -m pilosa_tpu.cli <command>``.
+
+Reference: ``cmd/`` cobra commands → ``ctl/`` implementations
+(SURVEY.md §3.3): server, import, export, backup, restore, check,
+config, generate-config, version.  argparse subcommands; client-side
+commands talk HTTP to a running server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+from pilosa_tpu import __version__
+from pilosa_tpu.cli import config as cfgmod
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="TOML config file")
+    p.add_argument("--bind", help="host:port to serve on / connect to")
+    p.add_argument("--data-dir", dest="data_dir", help="storage directory")
+    p.add_argument("--verbose", action="store_true", default=None)
+
+
+def _load_cfg(args) -> cfgmod.Config:
+    overrides = {k: getattr(args, k, None)
+                 for k in ("bind", "data_dir", "verbose")}
+    return cfgmod.load(args.config, overrides=overrides)
+
+
+def _client(cfg: cfgmod.Config):
+    from pilosa_tpu.api.client import Client
+    return Client(cfg.host, cfg.port)
+
+
+# -- commands ---------------------------------------------------------------
+
+
+def cmd_server(args) -> int:
+    cfg = _load_cfg(args)
+    from pilosa_tpu.obs import get_logger
+    log = get_logger(verbose=cfg.verbose)
+    log.info("effective config: %s", json.dumps(cfg.effective()))
+
+    from pilosa_tpu.server import PilosaTPUServer
+    srv = PilosaTPUServer(cfg)
+    srv.open()
+    log.info("listening on http://%s:%d data=%s", cfg.host, cfg.port,
+             cfg.data_dir)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        log.info("shutting down")
+        srv.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """CSV import: ``row,col`` lines (or ``col,value`` with --value-field,
+    keys auto-detected by the target field/index schema).  Reference:
+    ``ctl/import.go`` batching."""
+    cfg = _load_cfg(args)
+    client = _client(cfg)
+    if args.create:
+        try:
+            client.create_index(args.index, {"keys": args.keys})
+        except Exception:
+            pass
+        try:
+            opts = ({"type": "int"} if args.value else
+                    {"keys": args.keys and not args.id_rows})
+            client.create_field(args.index, args.field, opts)
+        except Exception:
+            pass
+
+    schema = {i["name"]: i for i in client.schema()}
+    if args.index not in schema:
+        print(f"index {args.index!r} not found (use --create)",
+              file=sys.stderr)
+        return 1
+    idx_keyed = schema[args.index]["options"]["keys"]
+    fld = next((f for f in schema[args.index]["fields"]
+                if f["name"] == args.field), None)
+    if fld is None:
+        print(f"field {args.field!r} not found (use --create)",
+              file=sys.stderr)
+        return 1
+    fld_keyed = fld["options"]["keys"]
+
+    src = open(args.file) if args.file != "-" else sys.stdin
+    batch_rows, batch_cols, batch_vals, total = [], [], [], 0
+
+    def flush():
+        nonlocal total
+        if not batch_cols:
+            return
+        ckey = "columnKeys" if idx_keyed else "columnIDs"
+        if args.value:
+            total += client.import_values(
+                args.index, args.field,
+                **{ckey: batch_cols, "values": batch_vals})
+        else:
+            rkey = "rowKeys" if fld_keyed else "rowIDs"
+            total += client.import_bits(
+                args.index, args.field,
+                **{rkey: batch_rows, ckey: batch_cols})
+        batch_rows.clear(), batch_cols.clear(), batch_vals.clear()
+
+    for line in src:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        a, b = line.split(",", 1)
+        if args.value:
+            batch_cols.append(a if idx_keyed else int(a))
+            batch_vals.append(int(b))
+        else:
+            batch_rows.append(a if fld_keyed else int(a))
+            batch_cols.append(b if idx_keyed else int(b))
+        if len(batch_cols) >= args.batch_size:
+            flush()
+    flush()
+    print(f"imported (changed {total} bits/values)", file=sys.stderr)
+    return 0
+
+
+def cmd_export(args) -> int:
+    cfg = _load_cfg(args)
+    out = _client(cfg).export_csv(args.index, args.field)
+    (open(args.output, "w") if args.output else sys.stdout).write(out)
+    return 0
+
+
+def cmd_backup(args) -> int:
+    cfg = _load_cfg(args)
+    client = _client(cfg)
+    blob = client._do("GET", "/internal/backup")
+    with open(args.output, "wb") as f:
+        f.write(blob)
+    print(f"wrote {len(blob)} bytes to {args.output}", file=sys.stderr)
+    return 0
+
+
+def cmd_restore(args) -> int:
+    cfg = _load_cfg(args)
+    client = _client(cfg)
+    with open(args.input, "rb") as f:
+        blob = f.read()
+    client._do("POST", "/internal/restore", blob,
+               content_type="application/x-tar")
+    print("restored", file=sys.stderr)
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline integrity check of a data dir (reference: ``pilosa
+    check``/``inspect``): every fragment file parses, op-logs replay,
+    BSI invariants hold."""
+    cfg = _load_cfg(args)
+    from pilosa_tpu.store import Holder
+    problems = 0
+    h = Holder(cfg.data_dir)
+    try:
+        h.open()
+    except Exception as e:  # noqa: BLE001 — report, not crash
+        print(f"FATAL: holder open failed: {e}")
+        return 1
+    for iname, idx in h.indexes.items():
+        for fname, f in idx.fields.items():
+            for vname, v in f.views.items():
+                for shard, frag in v.fragments.items():
+                    try:
+                        n = frag.cardinality()
+                        print(f"ok {iname}/{fname}/{vname}/{shard}: "
+                              f"{n} bits, {len(frag.rows)} rows, "
+                              f"op_n={frag.op_n}")
+                    except Exception as e:  # noqa: BLE001
+                        problems += 1
+                        print(f"BAD {iname}/{fname}/{vname}/{shard}: {e}")
+    h.close()
+    print(f"{problems} problems" if problems else "all fragments ok")
+    return 1 if problems else 0
+
+
+def cmd_config(args) -> int:
+    print(json.dumps(_load_cfg(args).effective(), indent=2))
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    cfg = cfgmod.Config()
+    for f, v in cfg.effective().items():
+        key = f.replace("_", "-")
+        if isinstance(v, str):
+            print(f'{key} = "{v}"')
+        elif isinstance(v, bool):
+            print(f"{key} = {str(v).lower()}")
+        elif isinstance(v, list):
+            print(f"{key} = {v!r}")
+        else:
+            print(f"{key} = {v}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pilosa-tpu",
+                                description="TPU-native bitmap index")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("server", help="run a node")
+    _add_common(sp)
+    sp.set_defaults(fn=cmd_server)
+
+    sp = sub.add_parser("import", help="bulk import CSV")
+    _add_common(sp)
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("file", help="CSV path or - for stdin")
+    sp.add_argument("--create", action="store_true",
+                    help="create index/field if missing")
+    sp.add_argument("--keys", action="store_true",
+                    help="with --create: keyed index/field")
+    sp.add_argument("--id-rows", action="store_true",
+                    help="with --create --keys: rows stay integer ids")
+    sp.add_argument("--value", action="store_true",
+                    help="CSV is col,value for an int field")
+    sp.add_argument("--batch-size", type=int, default=100_000)
+    sp.set_defaults(fn=cmd_import)
+
+    sp = sub.add_parser("export", help="export field as CSV")
+    _add_common(sp)
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("-o", "--output")
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("backup", help="tar the server's data")
+    _add_common(sp)
+    sp.add_argument("-o", "--output", required=True)
+    sp.set_defaults(fn=cmd_backup)
+
+    sp = sub.add_parser("restore", help="restore a backup tar")
+    _add_common(sp)
+    sp.add_argument("input")
+    sp.set_defaults(fn=cmd_restore)
+
+    sp = sub.add_parser("check", help="offline data-dir integrity check")
+    _add_common(sp)
+    sp.set_defaults(fn=cmd_check)
+
+    sp = sub.add_parser("config", help="print effective config")
+    _add_common(sp)
+    sp.set_defaults(fn=cmd_config)
+
+    sp = sub.add_parser("generate-config", help="print default TOML")
+    sp.set_defaults(fn=cmd_generate_config)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ the reference (``executor.Execute`` translate steps).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
@@ -64,7 +65,8 @@ class _Ctx:
 
 class Executor:
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
-                 place=None, plane_budget: int | None = None, placement=None):
+                 place=None, plane_budget: int | None = None, placement=None,
+                 stats=None, tracer=None):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -76,6 +78,9 @@ class Executor:
             place = placement.place
         kw = {"budget_bytes": plane_budget} if plane_budget else {}
         self.planes = PlaneCache(place, **kw)
+        from pilosa_tpu.obs import GLOBAL_TRACER, NopStats
+        self.stats = stats or NopStats()
+        self.tracer = tracer or GLOBAL_TRACER
 
     # ------------------------------------------------------------------ api
 
@@ -89,9 +94,17 @@ class Executor:
         if isinstance(query, str):
             query = parse(query)
         results = []
+        # spans per call + per-call-type latency counters (reference:
+        # executor span/stats emission, SURVEY.md §3.3 / §6)
         for call in query.calls:
             ctx = _Ctx(index, self._shards_for(index, shards, call))
-            results.append(self._call(ctx, call))
+            with self.tracer.span("executor." + call.name,
+                                  index=index_name,
+                                  shards=len(ctx.shards)):
+                t0 = time.perf_counter()
+                results.append(self._call(ctx, call))
+                self.stats.timing("query_seconds",
+                                  time.perf_counter() - t0, call=call.name)
         return results
 
     def _shards_for(self, index: Index, shards, call: Call) -> tuple[int, ...]:
@@ -299,8 +312,7 @@ class Executor:
                                      ctx.shards)
 
     def _zeros(self, ctx: _Ctx) -> jax.Array:
-        zeros = np.zeros((len(ctx.shards), WORDS_PER_SHARD), dtype=np.uint32)
-        return self.planes.place(zeros)
+        return self.planes.zeros(len(ctx.shards))
 
     def _to_row_result(self, ctx: _Ctx, words: jax.Array) -> RowResult:
         host = np.asarray(words)

@@ -60,6 +60,7 @@ class PlaneCache:
         self.place = place or jax.device_put
         self.budget = budget_bytes
         self._entries: OrderedDict[tuple, tuple[tuple, object, int]] = OrderedDict()
+        self._zeros: dict[int, jax.Array] = {}
         self._bytes = 0
         self._lock = threading.RLock()
 
@@ -87,6 +88,21 @@ class PlaneCache:
         ps = self._get(key, field, view_name, shards,
                        lambda f, v, s: self._build_row(f, v, s, row_id))
         return ps.plane
+
+    def zeros(self, n_shards: int) -> jax.Array:
+        """Cached all-zero bitmap uint32[n_shards, W] (empty Row / empty
+        Union results) — built and transferred once per shard count, not
+        per query."""
+        key = n_shards
+        with self._lock:
+            hit = self._zeros.get(key)
+        if hit is not None:
+            return hit
+        placed = self.place(np.zeros((n_shards, WORDS_PER_SHARD),
+                                     dtype=np.uint32))
+        with self._lock:
+            self._zeros[key] = placed
+        return placed
 
     def invalidate(self, index: str | None = None) -> None:
         with self._lock:

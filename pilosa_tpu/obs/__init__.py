@@ -1,0 +1,8 @@
+"""Cross-cutting observability (LX of SURVEY.md §2): metrics, tracing,
+logging."""
+
+from pilosa_tpu.obs.logging import get_logger
+from pilosa_tpu.obs.metrics import NopStats, Stats
+from pilosa_tpu.obs.tracing import GLOBAL_TRACER, Tracer
+
+__all__ = ["Stats", "NopStats", "get_logger", "Tracer", "GLOBAL_TRACER"]

@@ -1,0 +1,27 @@
+"""Logging: the injected logger interface.
+
+Reference: ``logger.go`` — a tiny ``Logger`` interface with std/verbose
+implementations, injected through every constructor (SURVEY.md §3.3).
+The rebuild rides Python's stdlib logging with the same shape: one
+``get_logger`` used by server/executor/cluster, verbosity switch, and a
+structured (key=value) formatter for operational greppability.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
+
+
+def get_logger(name: str = "pilosa_tpu", verbose: bool = False,
+               stream=None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(h)
+        logger.propagate = False
+    logger.setLevel(logging.DEBUG if verbose else logging.INFO)
+    return logger

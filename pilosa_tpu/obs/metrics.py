@@ -1,0 +1,127 @@
+"""Metrics: counters, gauges, histograms with Prometheus text export.
+
+Reference: ``stats.go#StatsClient`` (Count/Gauge/Timing/Histogram/
+WithTags; SURVEY.md §3.3) with statsd/expvar/prometheus backends.  The
+rebuild keeps one in-process registry exporting the Prometheus text
+format at ``/metrics`` (the v2-era surface); a ``NopStats`` mirrors the
+reference's nop client for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _labels_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Stats:
+    """In-process metrics registry.  Thread-safe; cheap enough for the
+    query path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[tuple, float]] = defaultdict(dict)
+        self._gauges: dict[str, dict[tuple, float]] = defaultdict(dict)
+        self._hists: dict[str, dict[tuple, list]] = defaultdict(dict)
+
+    # -- StatsClient surface (reference parity) -----------------------------
+
+    def count(self, name: str, value: float = 1, **labels) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            m = self._counters[name]
+            m[key] = m.get(key, 0) + value
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[name][_labels_key(labels)] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Histogram observation (reference: Timing/Histogram)."""
+        key = _labels_key(labels)
+        with self._lock:
+            h = self._hists[name].get(key)
+            if h is None:
+                # [bucket counts..., +inf count, sum, total]
+                h = self._hists[name][key] = [0] * (len(_BUCKETS) + 1) + [0.0, 0]
+            for i, ub in enumerate(_BUCKETS):
+                if value <= ub:
+                    h[i] += 1
+                    break
+            else:
+                h[len(_BUCKETS)] += 1
+            h[-2] += value
+            h[-1] += 1
+
+    def timing(self, name: str, seconds: float, **labels) -> None:
+        self.observe(name, seconds, **labels)
+
+    # -- export -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {n: dict(m) for n, m in self._counters.items()},
+                "gauges": {n: dict(m) for n, m in self._gauges.items()},
+            }
+
+    def prometheus_text(self) -> str:
+        out = []
+        with self._lock:
+            for name, m in sorted(self._counters.items()):
+                out.append(f"# TYPE {name} counter")
+                for key, v in sorted(m.items()):
+                    out.append(f"{name}{_fmt_labels(key)} {v}")
+            for name, m in sorted(self._gauges.items()):
+                out.append(f"# TYPE {name} gauge")
+                for key, v in sorted(m.items()):
+                    out.append(f"{name}{_fmt_labels(key)} {v}")
+            for name, m in sorted(self._hists.items()):
+                out.append(f"# TYPE {name} histogram")
+                for key, h in sorted(m.items()):
+                    cum = 0
+                    for i, ub in enumerate(_BUCKETS):
+                        cum += h[i]
+                        lk = key + (("le", repr(ub)),)
+                        out.append(f"{name}_bucket{_fmt_labels(lk)} {cum}")
+                    cum += h[len(_BUCKETS)]
+                    lk = key + (("le", "+Inf"),)
+                    out.append(f"{name}_bucket{_fmt_labels(lk)} {cum}")
+                    out.append(f"{name}_sum{_fmt_labels(key)} {h[-2]}")
+                    out.append(f"{name}_count{_fmt_labels(key)} {h[-1]}")
+        return "\n".join(out) + "\n"
+
+
+class NopStats:
+    """No-op client (reference: ``nopStatsClient``)."""
+
+    def count(self, *a, **k):
+        pass
+
+    def gauge(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
+        pass
+
+    def timing(self, *a, **k):
+        pass
+
+    def snapshot(self):
+        return {"counters": {}, "gauges": {}}
+
+    def prometheus_text(self):
+        return ""

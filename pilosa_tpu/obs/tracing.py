@@ -1,0 +1,119 @@
+"""Tracing: spans over the query pipeline with cross-node propagation.
+
+Reference: ``tracing/`` wrapping opentracing — spans per executor call
+and per shard, HTTP header inject/extract for cross-node traces
+(SURVEY.md §3.3, §6).  The rebuild is self-contained (no opentracing in
+the image): explicit span tree, W3C-style ``traceparent`` header
+propagation, and an in-memory ring of finished traces exposed for
+``profile=true`` query responses and debugging.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field as dc_field
+
+TRACEPARENT = "Traceparent"  # traceparent: 00-<trace_id>-<span_id>-01
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start: float = 0.0
+    duration: float = 0.0
+    tags: dict = dc_field(default_factory=dict)
+    children: list["Span"] = dc_field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "durationUs": round(self.duration * 1e6),
+            "tags": self.tags,
+            "children": [c.to_json() for c in self.children],
+        }
+
+
+class Tracer:
+    """Per-process tracer.  ``span()`` nests via a thread-local stack;
+    ``extract``/``inject`` carry the active trace across nodes."""
+
+    def __init__(self, keep: int = 128):
+        self._local = threading.local()
+        self._finished: deque[Span] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+
+    def _stack(self) -> list[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        s = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else secrets.token_hex(8),
+            span_id=secrets.token_hex(4),
+            parent_id=parent.span_id if parent else None,
+            start=time.perf_counter(),
+            tags=tags,
+        )
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.duration = time.perf_counter() - s.start
+            stack.pop()
+            if parent is not None:
+                parent.children.append(s)
+            else:
+                with self._lock:
+                    self._finished.append(s)
+
+    # -- cross-node propagation (reference: handler extract / client inject)
+
+    def inject(self, headers: dict) -> None:
+        stack = self._stack()
+        if stack:
+            s = stack[-1]
+            headers[TRACEPARENT] = f"00-{s.trace_id}-{s.span_id}-01"
+
+    @contextmanager
+    def extract(self, headers, name: str):
+        """Open a span continuing the trace in ``headers`` (if any)."""
+        tp = headers.get(TRACEPARENT) or headers.get(TRACEPARENT.lower())
+        if tp:
+            try:
+                _, trace_id, parent_id, _ = tp.split("-")
+            except ValueError:
+                trace_id = None
+            if trace_id is not None:
+                remote = Span(name="remote-parent", trace_id=trace_id,
+                              span_id=parent_id, parent_id=None)
+                self._stack().append(remote)
+                try:
+                    with self.span(name) as s:
+                        yield s
+                finally:
+                    self._stack().pop()
+                    # the synthetic parent is discarded; its real children
+                    # are this node's roots for the propagated trace
+                    with self._lock:
+                        self._finished.extend(remote.children)
+                return
+        with self.span(name) as s:
+            yield s
+
+    def finished(self) -> list[Span]:
+        with self._lock:
+            return list(self._finished)
+
+
+GLOBAL_TRACER = Tracer()

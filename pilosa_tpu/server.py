@@ -1,0 +1,69 @@
+"""Composition root: wire holder + executor + API + HTTP + observability.
+
+Reference: ``server.go`` (SURVEY.md §3.3) — functional options
+assembling holder/cluster/executor/handlers/stats/tracing, lifecycle
+``Open``/``Close``, and background loops.  Here the wiring input is the
+:class:`pilosa_tpu.cli.config.Config` dataclass.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.api import API, Server as HttpServer
+from pilosa_tpu.cli.config import Config
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.obs import Stats, get_logger
+from pilosa_tpu.store import Holder
+
+
+class PilosaTPUServer:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.logger = get_logger(verbose=cfg.verbose)
+        self.stats = Stats()
+        self.holder = Holder(cfg.data_dir, fsync=cfg.fsync)
+        self.executor: Executor | None = None
+        self.api: API | None = None
+        self.http: HttpServer | None = None
+        self.cluster = None
+
+    def open(self) -> "PilosaTPUServer":
+        self.holder.open()
+        placement = None
+        if self.cfg.mesh:
+            from pilosa_tpu.parallel import local_placement
+            placement = local_placement()
+            if placement is not None:
+                self.logger.info("mesh: sharding over %d devices",
+                                 placement.n_devices)
+        self.executor = Executor(
+            self.holder, placement=placement, stats=self.stats,
+            plane_budget=self.cfg.plane_budget_bytes)
+        self.api = API(self.holder, self.executor)
+        if self.cfg.seeds or self.cfg.replicas > 1:
+            try:
+                from pilosa_tpu.cluster import Cluster
+            except ImportError as e:
+                raise RuntimeError(
+                    "config sets seeds/replicas but cluster support is "
+                    "not available in this build") from e
+            self.cluster = Cluster(self.cfg, self.api, stats=self.stats,
+                                   logger=self.logger)
+            self.api.cluster = self.cluster
+        self.http = HttpServer(self.api, self.cfg.host, self.cfg.port,
+                               stats=self.stats, logger=self.logger).start()
+        if self.cluster is not None:
+            self.cluster.open()
+        return self
+
+    def close(self) -> None:
+        if self.cluster is not None:
+            self.cluster.close()
+        if self.http is not None:
+            self.http.close()
+        if self.executor is not None:
+            self.executor.translate.close()
+        self.holder.close()
+
+    @property
+    def port(self) -> int:
+        return self.http.address[1]

@@ -1,0 +1,108 @@
+"""CLI + config tests (reference: ``server/config.go`` layering and
+``ctl/`` command behaviors, SURVEY.md §3.3)."""
+
+import json
+
+import pytest
+
+from pilosa_tpu.api import API, Server
+from pilosa_tpu.cli import config as cfgmod
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.store import Holder
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = cfgmod.load(env={})
+        assert cfg.port == 10101 and cfg.replicas == 1
+
+    def test_layering_file_env_flags(self, tmp_path):
+        toml = tmp_path / "c.toml"
+        toml.write_text('bind = "0.0.0.0:7777"\nreplicas = 2\n'
+                        'seeds = ["a:1", "b:2"]\n')
+        cfg = cfgmod.load(str(toml),
+                          env={"PILOSA_REPLICAS": "3",
+                               "PILOSA_VERBOSE": "true"},
+                          overrides={"bind": "1.2.3.4:9999"})
+        assert cfg.bind == "1.2.3.4:9999"   # flag beats env beats file
+        assert cfg.replicas == 3            # env beats file
+        assert cfg.seeds == ["a:1", "b:2"]  # file beats default
+        assert cfg.verbose is True
+
+    def test_unknown_key_rejected(self, tmp_path):
+        toml = tmp_path / "c.toml"
+        toml.write_text('no-such-key = 1\n')
+        with pytest.raises(ValueError):
+            cfgmod.load(str(toml), env={})
+
+    def test_name_defaults_to_bind(self):
+        assert cfgmod.load(env={}).name == "127.0.0.1:10101"
+
+
+@pytest.fixture
+def running(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    api = API(holder)
+    server = Server(api, "127.0.0.1", 0).start()
+    yield holder, server, f"127.0.0.1:{server.address[1]}"
+    server.close()
+    holder.close()
+
+
+class TestCommands:
+    def test_version_and_generate_config(self, capsys):
+        assert main(["version"]) == 0
+        assert main(["generate-config"]) == 0
+        out = capsys.readouterr().out
+        assert 'data-dir' in out
+
+    def test_config_print(self, capsys, monkeypatch):
+        monkeypatch.setenv("PILOSA_BIND", "9.9.9.9:1")
+        assert main(["config"]) == 0
+        assert json.loads(capsys.readouterr().out)["bind"] == "9.9.9.9:1"
+
+    def test_import_export(self, running, tmp_path, capsys):
+        _, _, bind = running
+        csv = tmp_path / "in.csv"
+        csv.write_text("1,10\n1,11\n2,20\n")
+        assert main(["import", "--bind", bind, "-i", "i", "-f", "f",
+                     "--create", str(csv)]) == 0
+        assert main(["export", "--bind", bind, "-i", "i", "-f", "f"]) == 0
+        out = capsys.readouterr().out
+        assert out == "1,10\n1,11\n2,20\n"
+
+    def test_import_values(self, running, tmp_path):
+        _, _, bind = running
+        csv = tmp_path / "vals.csv"
+        csv.write_text("1,100\n2,-5\n")
+        assert main(["import", "--bind", bind, "-i", "i", "-f", "n",
+                     "--create", "--value", str(csv)]) == 0
+        from pilosa_tpu.api.client import Client
+        host, port = bind.rsplit(":", 1)
+        (r,) = Client(host, int(port)).query("i", "Sum(field=n)")
+        assert r == {"value": 95, "count": 2}
+
+    def test_backup_restore_check(self, running, tmp_path, capsys):
+        holder, _, bind = running
+        csv = tmp_path / "in.csv"
+        csv.write_text("1,10\n")
+        main(["import", "--bind", bind, "-i", "i", "-f", "f", "--create",
+              str(csv)])
+        tarball = tmp_path / "b.tar"
+        assert main(["backup", "--bind", bind, "-o", str(tarball)]) == 0
+        assert tarball.stat().st_size > 0
+
+        data2 = tmp_path / "data2"
+        h2 = Holder(str(data2)).open()
+        api2 = API(h2)
+        s2 = Server(api2, "127.0.0.1", 0).start()
+        bind2 = f"127.0.0.1:{s2.address[1]}"
+        assert main(["restore", "--bind", bind2, str(tarball)]) == 0
+        from pilosa_tpu.api.client import Client
+        (r,) = Client("127.0.0.1", s2.address[1]).query("i", "Row(f=1)")
+        assert r == {"columns": [10]}
+        s2.close()
+        h2.close()
+
+        assert main(["check", "--data-dir", str(data2)]) == 0
+        assert "all fragments ok" in capsys.readouterr().out

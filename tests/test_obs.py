@@ -1,0 +1,82 @@
+"""Observability tests: metrics registry + prometheus text, span tree +
+cross-node propagation (SURVEY.md §6)."""
+
+from pilosa_tpu.obs import Stats, Tracer
+
+
+class TestStats:
+    def test_counters_and_labels(self):
+        s = Stats()
+        s.count("reqs", 1, method="GET")
+        s.count("reqs", 2, method="GET")
+        s.count("reqs", 1, method="POST")
+        snap = s.snapshot()["counters"]["reqs"]
+        assert snap[(("method", "GET"),)] == 3
+        assert snap[(("method", "POST"),)] == 1
+
+    def test_gauge_overwrites(self):
+        s = Stats()
+        s.gauge("hbm_bytes", 10)
+        s.gauge("hbm_bytes", 20)
+        assert s.snapshot()["gauges"]["hbm_bytes"][()] == 20
+
+    def test_prometheus_text(self):
+        s = Stats()
+        s.count("reqs", 5, method="GET")
+        s.gauge("up", 1)
+        s.observe("lat", 0.003)
+        text = s.prometheus_text()
+        assert '# TYPE reqs counter' in text
+        assert 'reqs{method="GET"} 5' in text
+        assert "lat_count 1" in text
+        assert "lat_sum 0.003" in text
+        # cumulative buckets
+        assert 'lat_bucket{le="+Inf"} 1' in text
+
+    def test_histogram_bucketing(self):
+        s = Stats()
+        for v in (0.0001, 0.5, 100.0):
+            s.observe("lat", v)
+        text = s.prometheus_text()
+        assert 'lat_bucket{le="+Inf"} 3' in text
+        assert "lat_count 3" in text
+
+
+class TestTracer:
+    def test_span_nesting(self):
+        t = Tracer()
+        with t.span("outer"):
+            with t.span("inner", shard=3):
+                pass
+        (root,) = t.finished()
+        assert root.name == "outer"
+        assert root.children[0].name == "inner"
+        assert root.children[0].tags == {"shard": 3}
+        assert root.duration >= root.children[0].duration
+
+    def test_inject_extract(self):
+        t = Tracer()
+        headers = {}
+        with t.span("client-side"):
+            t.inject(headers)
+            trace_id = t._stack()[-1].trace_id
+        assert headers["Traceparent"].split("-")[1] == trace_id
+
+        t2 = Tracer()
+        with t2.extract(headers, "server-side") as s:
+            assert s.trace_id == trace_id  # trace continues across nodes
+
+    def test_extract_without_header(self):
+        t = Tracer()
+        with t.extract({}, "root") as s:
+            assert s.parent_id is None
+
+    def test_extracted_trace_recorded(self):
+        """Regression: propagated traces must land in finished()."""
+        t = Tracer()
+        headers = {"Traceparent": "00-aaaa-bbbb-01"}
+        with t.extract(headers, "server-side"):
+            pass
+        (s,) = t.finished()
+        assert s.name == "server-side" and s.trace_id == "aaaa"
+        assert s.parent_id == "bbbb"
