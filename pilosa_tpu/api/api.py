@@ -55,11 +55,14 @@ class API:
     def create_index(self, name: str, options: dict | None = None):
         options = options or {}
         try:
-            return self.holder.create_index(
+            idx = self.holder.create_index(
                 name, keys=options.get("keys", False),
                 track_existence=options.get("trackExistence", True))
         except ValueError as e:
             raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+        return idx
 
     def delete_index(self, name: str) -> None:
         try:
@@ -71,10 +74,13 @@ class API:
     def create_field(self, index: str, name: str, options: dict | None = None):
         idx = self._index(index)
         try:
-            return idx.create_field(
+            f = idx.create_field(
                 name, field_options_from_json(options or {}))
         except ValueError as e:
             raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        if self.cluster is not None:
+            self.cluster.broadcast_schema()
+        return f
 
     def delete_field(self, index: str, name: str) -> None:
         idx = self._index(index)
@@ -98,6 +104,9 @@ class API:
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
         try:
+            if self.cluster is not None:
+                return {"results": self.cluster.dist.execute_json(
+                    index, pql, shards=shards)}
             results = self.executor.execute(index, pql, shards=shards)
         except (ParseError, ExecutionError) as e:
             raise ApiError(str(e), 400)
@@ -107,17 +116,24 @@ class API:
 
     def import_bits(self, index: str, field: str, *,
                     row_ids=None, col_ids=None, row_keys=None, col_keys=None,
-                    timestamps=None, clear: bool = False) -> int:
+                    timestamps=None, clear: bool = False,
+                    direct: bool = False) -> int:
         """Bulk bit import (reference: ``API.Import``): ID or key form;
-        timestamps are epoch-seconds or ISO strings."""
+        timestamps are epoch-seconds or ISO strings.  In cluster mode
+        batches are routed to the shard-owning nodes (reference:
+        ``api.go`` import orchestration); ``direct`` marks an
+        already-routed forwarded batch."""
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
             raise ApiError(f"field {field!r} not found", 404)
-        rows = self._translate_rows(idx, f, row_ids, row_keys)
-        cols = self._translate_cols(idx, col_ids, col_keys)
+        rows = self._translate_rows(idx, f, row_ids, row_keys, direct)
+        cols = self._translate_cols(idx, col_ids, col_keys, direct)
         if len(rows) != len(cols):
             raise ApiError("rows and columns length mismatch")
+        if self.cluster is not None and not direct:
+            return self._route_import_bits(index, field, rows, cols,
+                                           timestamps, clear)
         ts = self._parse_timestamps(timestamps, len(cols))
         if clear:
             changed = 0
@@ -129,16 +145,19 @@ class API:
         return changed
 
     def import_values(self, index: str, field: str, *,
-                      col_ids=None, col_keys=None, values=None) -> int:
+                      col_ids=None, col_keys=None, values=None,
+                      direct: bool = False) -> int:
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
             raise ApiError(f"field {field!r} not found", 404)
         if f.options.type not in BSI_TYPES:
             raise ApiError(f"field {field!r} is not an int field")
-        cols = self._translate_cols(idx, col_ids, col_keys)
+        cols = self._translate_cols(idx, col_ids, col_keys, direct)
         if values is None or len(values) != len(cols):
             raise ApiError("columns and values length mismatch")
+        if self.cluster is not None and not direct:
+            return self._route_import_values(index, field, cols, values)
         try:
             changed = f.import_values(cols, values)
         except ValueError as e:
@@ -146,14 +165,85 @@ class API:
         idx.note_columns(cols)
         return changed
 
+    def _route_to_owners(self, index: str, shard: int, local_fn,
+                         remote_fn) -> int:
+        """Apply a write on every replica owner of a shard; returns the
+        primary's changed count (reference: ``API.Import`` routing to
+        shard-owning nodes, SURVEY.md §4.5).  ``local_fn()`` applies
+        locally; ``remote_fn(client)`` forwards with the direct flag."""
+        primary_changed = None
+        for owner in self.cluster.shard_owners(index, shard):
+            if owner == self.cluster.node_id:
+                got = local_fn()
+            else:
+                got = remote_fn(self.cluster._client(owner))
+            if primary_changed is None:
+                primary_changed = got
+        return primary_changed or 0
+
+    def _route_import_bits(self, index: str, field: str, rows, cols,
+                           timestamps, clear: bool) -> int:
+        shards = cols // np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            m = shards == shard
+            sub_rows = [int(r) for r in rows[m]]
+            sub_cols = [int(c) for c in cols[m]]
+            sub_ts = ([timestamps[i] for i in np.nonzero(m)[0]]
+                      if timestamps is not None else None)
+            changed += self._route_to_owners(
+                index, int(shard),
+                lambda: self.import_bits(
+                    index, field, row_ids=sub_rows, col_ids=sub_cols,
+                    timestamps=sub_ts, clear=clear, direct=True),
+                lambda client: client._json(
+                    "POST", f"/index/{index}/field/{field}/import",
+                    {"rowIDs": sub_rows, "columnIDs": sub_cols,
+                     "timestamps": sub_ts, "clear": clear},
+                    headers={"X-Pilosa-Direct": "1"})["changed"])
+        return changed
+
+    def _route_import_values(self, index: str, field: str, cols,
+                             values) -> int:
+        shards = cols // np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            m = shards == shard
+            sub_cols = [int(c) for c in cols[m]]
+            sub_vals = [values[i] for i in np.nonzero(m)[0]]
+            changed += self._route_to_owners(
+                index, int(shard),
+                lambda: self.import_values(
+                    index, field, col_ids=sub_cols, values=sub_vals,
+                    direct=True),
+                lambda client: client._json(
+                    "POST", f"/index/{index}/field/{field}/importValue",
+                    {"columnIDs": sub_cols, "values": sub_vals},
+                    headers={"X-Pilosa-Direct": "1"})["changed"])
+        return changed
+
     def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
-                       view: str = VIEW_STANDARD, clear: bool = False) -> int:
+                       view: str = VIEW_STANDARD, clear: bool = False,
+                       direct: bool = False) -> int:
         """Pre-encoded roaring import — the bulk-loader fast path
         (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
             raise ApiError(f"field {field!r} not found", 404)
+        if self.cluster is not None and not direct:
+            qs = f"?view={view}" + ("&clear=1" if clear else "")
+            return self._route_to_owners(
+                index, shard,
+                lambda: self.import_roaring(index, field, shard, blob,
+                                            view=view, clear=clear,
+                                            direct=True),
+                lambda client: client._do(
+                    "POST",
+                    f"/index/{index}/field/{field}/import-roaring/"
+                    f"{shard}{qs}", blob,
+                    content_type="application/octet-stream",
+                    headers={"X-Pilosa-Direct": "1"})["changed"])
         frag = f.view(view, create=True).fragment(shard, create=True)
         try:
             changed = f_changed = frag.import_roaring(blob, clear=clear)
@@ -249,7 +339,7 @@ class API:
         nodes = [{"id": "local", "uri": "", "state": state, "isPrimary": True}]
         if self.cluster is not None:
             nodes = self.cluster.nodes_status()
-            state = self.cluster.state()
+            state = self.cluster.state
         return {"state": state, "nodes": nodes,
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
@@ -269,29 +359,40 @@ class API:
             raise ApiError(f"index {name!r} not found", 404)
         return idx
 
-    def _translate_rows(self, idx, f, row_ids, row_keys) -> np.ndarray:
+    def _translate_rows(self, idx, f, row_ids, row_keys,
+                        direct: bool = False) -> np.ndarray:
         if row_keys is not None:
             if not f.options.keys:
                 raise ApiError(f"field {f.name!r} is not keyed")
+            if self.cluster is not None:
+                ids = self.cluster.translate_keys(idx.name, f.name,
+                                                  list(row_keys), create=True)
+                return np.array(ids, dtype=np.uint64)
             log = self.executor.translate.rows(idx.name, f.name)
             return np.array(log.translate(list(row_keys), create=True),
                             dtype=np.uint64)
         if row_ids is None:
             raise ApiError("missing rowIDs/rowKeys")
-        if f.options.keys:
+        if f.options.keys and not direct:
+            # forwarded cluster batches (direct) are pre-translated IDs
             raise ApiError(f"field {f.name!r} is keyed; use rowKeys")
         return np.asarray(row_ids, dtype=np.uint64)
 
-    def _translate_cols(self, idx, col_ids, col_keys) -> np.ndarray:
+    def _translate_cols(self, idx, col_ids, col_keys,
+                        direct: bool = False) -> np.ndarray:
         if col_keys is not None:
             if not idx.keys:
                 raise ApiError(f"index {idx.name!r} is not keyed")
+            if self.cluster is not None:
+                ids = self.cluster.translate_keys(idx.name, None,
+                                                  list(col_keys), create=True)
+                return np.array(ids, dtype=np.uint64)
             log = self.executor.translate.columns(idx.name)
             return np.array(log.translate(list(col_keys), create=True),
                             dtype=np.uint64)
         if col_ids is None:
             raise ApiError("missing columnIDs/columnKeys")
-        if idx.keys:
+        if idx.keys and not direct:
             raise ApiError(f"index {idx.name!r} is keyed; use columnKeys")
         return np.asarray(col_ids, dtype=np.uint64)
 

@@ -29,10 +29,13 @@ class Client:
     # -- transport ----------------------------------------------------------
 
     def _do(self, method: str, path: str, body: bytes | None = None,
-            content_type: str = "application/json"):
+            content_type: str = "application/json",
+            headers: dict | None = None):
+        hdrs = dict(headers or {})
+        if body:
+            hdrs["Content-Type"] = content_type
         req = urllib.request.Request(
-            self.base + path, data=body, method=method,
-            headers={"Content-Type": content_type} if body else {})
+            self.base + path, data=body, method=method, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 data = resp.read()
@@ -50,9 +53,10 @@ class Client:
             return json.loads(data)
         return data
 
-    def _json(self, method: str, path: str, obj=None):
+    def _json(self, method: str, path: str, obj=None,
+              headers: dict | None = None):
         body = json.dumps(obj).encode() if obj is not None else None
-        return self._do(method, path, body)
+        return self._do(method, path, body, headers=headers)
 
     # -- api ----------------------------------------------------------------
 

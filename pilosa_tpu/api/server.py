@@ -163,6 +163,11 @@ class Handler(BaseHTTPRequestHandler):
         self.server.api.delete_field(index, field)
         self._reply({"success": True})
 
+    @property
+    def _direct(self) -> bool:
+        """Forwarded-batch marker: skip cluster re-routing."""
+        return self.headers.get("X-Pilosa-Direct") == "1"
+
     def h_import(self, index: str, field: str) -> None:
         b = self._json_body()
         changed = self.server.api.import_bits(
@@ -170,7 +175,8 @@ class Handler(BaseHTTPRequestHandler):
             row_ids=b.get("rowIDs"), col_ids=b.get("columnIDs"),
             row_keys=b.get("rowKeys"), col_keys=b.get("columnKeys"),
             timestamps=b.get("timestamps"),
-            clear=b.get("clear", False) or "clear" in self.query)
+            clear=b.get("clear", False) or "clear" in self.query,
+            direct=self._direct)
         self._reply({"changed": changed})
 
     def h_import_value(self, index: str, field: str) -> None:
@@ -178,14 +184,15 @@ class Handler(BaseHTTPRequestHandler):
         changed = self.server.api.import_values(
             index, field,
             col_ids=b.get("columnIDs"), col_keys=b.get("columnKeys"),
-            values=b.get("values"))
+            values=b.get("values"), direct=self._direct)
         self._reply({"changed": changed})
 
     def h_import_roaring(self, index: str, field: str, shard: str) -> None:
         view = self.query.get("view", ["standard"])[0]
         clear = "clear" in self.query
         changed = self.server.api.import_roaring(
-            index, field, int(shard), self._body(), view=view, clear=clear)
+            index, field, int(shard), self._body(), view=view, clear=clear,
+            direct=self._direct)
         self._reply({"changed": changed})
 
     def h_export(self) -> None:
@@ -252,6 +259,15 @@ def build_router() -> Router:
     r.add("GET", "/internal/backup", Handler.h_backup)
     r.add("POST", "/internal/restore", Handler.h_restore)
     r.add("GET", "/internal/traces", Handler.h_traces)
+    # node-to-node surface (deferred import: cluster depends on this
+    # module for Handler/Router; a build without the cluster package
+    # still serves single-node)
+    try:
+        from pilosa_tpu.cluster.internal import register_internal_routes
+    except ImportError:
+        pass
+    else:
+        register_internal_routes(r)
     return r
 
 

@@ -25,6 +25,8 @@ class Config:
     name: str = ""                      # node id; default derived from bind
     seeds: list[str] = dc_field(default_factory=list)  # host:port of peers
     replicas: int = 1
+    cluster_enabled: bool = False       # force cluster mode without seeds
+                                        # (single seed node of a new cluster)
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
     heartbeat_interval: float = 2.0
     # device

@@ -1,0 +1,12 @@
+"""Cluster layer (L3 of SURVEY.md §2): membership, distribution,
+anti-entropy, resize."""
+
+from pilosa_tpu.cluster.cluster import (STATE_DEGRADED, STATE_NORMAL,
+                                        STATE_RESIZING, STATE_STARTING,
+                                        Cluster)
+from pilosa_tpu.cluster.dist import DistributedExecutor, merge_results
+
+__all__ = [
+    "Cluster", "DistributedExecutor", "merge_results",
+    "STATE_STARTING", "STATE_NORMAL", "STATE_RESIZING", "STATE_DEGRADED",
+]

@@ -1,0 +1,566 @@
+"""Cluster: membership, placement, translation replication, AAE, resize.
+
+Reference: ``cluster.go`` + ``gossip/`` + ``broadcast.go`` (SURVEY.md
+§3.3, §3.6).  The reference uses memberlist gossip for liveness and a
+coordinator-driven state machine; this rebuild keeps the shape with a
+boring HTTP control plane (no gossip lib in the image, and TPU-pod
+deployments want deterministic membership anyway):
+
+- membership: explicit join to seed nodes + periodic heartbeats; a node
+  is suspect after 3 missed heartbeat intervals;
+- coordinator: lowest node id (reference: v1 coordinator) — drives
+  resize jobs and owns key-translation assignment, replicating the
+  append-only key logs to every node (v1 translate-log streaming);
+- placement: jump-hash shard→partition→node with ``replicas`` copies
+  (:mod:`pilosa_tpu.parallel.placement`);
+- anti-entropy: periodic block-checksum diff + bidirectional union
+  merge between replicas (reference: holder syncer, SURVEY.md §4.6);
+- resize: on membership change the coordinator computes fragment
+  transfers from a cluster-wide inventory and instructs holders to push
+  roaring blobs to the new owners (reference: ``ResizeJob``/
+  ``ResizeInstruction``).
+
+Queries fan out via :class:`pilosa_tpu.cluster.dist.DistributedExecutor`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.cluster.dist import DistributedExecutor
+from pilosa_tpu.obs import NopStats, get_logger
+from pilosa_tpu.parallel.placement import shard_nodes
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+STATE_DEGRADED = "DEGRADED"
+
+SUSPECT_AFTER = 3  # missed heartbeat intervals
+
+_SHARD_CACHE_TTL = 2.0
+
+
+class Cluster:
+    def __init__(self, cfg, api, stats=None, logger=None, port: int | None = None):
+        self.cfg = cfg
+        self.api = api
+        self.stats = stats or NopStats()
+        self.logger = logger or get_logger("pilosa_tpu.cluster")
+        host = cfg.host
+        self.port = port if port is not None else cfg.port
+        self.node_id = f"{host}:{self.port}"
+        self.nodes: dict[str, dict] = {
+            self.node_id: {"id": self.node_id, "uri": self.node_id,
+                           "state": STATE_STARTING}}
+        self._last_seen: dict[str, float] = {}
+        self.state = STATE_STARTING
+        self.dist = DistributedExecutor(self)
+        self._clients: dict[str, object] = {}
+        self._shard_cache: dict[str, tuple[float, tuple[int, ...]]] = {}
+        self._lock = threading.RLock()
+        self._status_ts = 0.0
+        self._resize_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Cluster":
+        joined = False
+        for seed in self.cfg.seeds:
+            if seed == self.node_id:
+                continue
+            try:
+                resp = self._client(seed)._json(
+                    "POST", "/internal/join",
+                    {"id": self.node_id, "uri": self.node_id})
+                now = time.monotonic()
+                with self._lock:
+                    self.nodes = {n["id"]: n for n in resp["nodes"]}
+                    for nid in self.nodes:
+                        self._last_seen.setdefault(nid, now)
+                    self.state = resp.get("state", STATE_NORMAL)
+                self.api.apply_schema(resp.get("schema", []))
+                self._pull_translate_tails(seed)
+                joined = True
+                self.logger.info("joined cluster via %s (%d nodes)", seed,
+                                 len(self.nodes))
+                # ask the coordinator to rebalance onto the new membership
+                # (the seed we joined through may not be the coordinator —
+                # and WE might be it, if our id sorts lowest)
+                coord = self.coordinator_id()
+                if coord == self.node_id:
+                    self.trigger_resize()
+                else:
+                    try:
+                        self._client(coord)._json(
+                            "POST", "/internal/resize/trigger", {})
+                    except Exception as e:  # noqa: BLE001
+                        self.logger.warning("resize trigger failed: %s", e)
+                break
+            except Exception as e:  # noqa: BLE001 — try next seed
+                self.logger.warning("join via %s failed: %s", seed, e)
+        if not joined:
+            self.logger.info("no seeds joinable; starting as single node")
+        with self._lock:
+            self.nodes.setdefault(
+                self.node_id, {"id": self.node_id, "uri": self.node_id})
+            self.nodes[self.node_id]["state"] = STATE_NORMAL
+            if self.state == STATE_STARTING:
+                self.state = STATE_NORMAL
+        self._spawn(self._heartbeat_loop, "heartbeat")
+        if self.cfg.anti_entropy_interval > 0:
+            self._spawn(self._aae_loop, "anti-entropy")
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=f"pilosa-{name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- membership ---------------------------------------------------------
+
+    def _client(self, node_id: str):
+        from pilosa_tpu.api.client import Client
+        with self._lock:
+            c = self._clients.get(node_id)
+            if c is None:
+                host, port = node_id.rsplit(":", 1)
+                c = self._clients[node_id] = Client(host, int(port))
+            return c
+
+    def member_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self.nodes)
+
+    def alive_ids(self) -> list[str]:
+        now = time.monotonic()
+        horizon = SUSPECT_AFTER * self.cfg.heartbeat_interval
+        with self._lock:
+            return sorted(
+                nid for nid in self.nodes
+                if nid == self.node_id
+                or now - self._last_seen.get(nid, now) < horizon)
+
+    def coordinator_id(self) -> str:
+        """Lowest alive node id (reference: v1 coordinator election by
+        ordering)."""
+        return self.alive_ids()[0]
+
+    def is_coordinator(self) -> bool:
+        return self.coordinator_id() == self.node_id
+
+    def handle_join(self, node: dict) -> dict:
+        with self._lock:
+            is_new = node["id"] not in self.nodes
+            self.nodes[node["id"]] = {**node, "state": STATE_NORMAL}
+            self._last_seen[node["id"]] = time.monotonic()
+        if is_new:
+            self._broadcast_status()
+            if self.is_coordinator():
+                self.trigger_resize()
+        return {"nodes": list(self.nodes.values()), "state": self.state,
+                "schema": self.api.schema()}
+
+    def handle_heartbeat(self, node_id: str, state: str) -> dict:
+        with self._lock:
+            self._last_seen[node_id] = time.monotonic()
+            if node_id not in self.nodes:
+                # node knows us but we lost it (e.g. restarted): re-add
+                self.nodes[node_id] = {"id": node_id, "uri": node_id,
+                                       "state": state}
+        return {"id": self.node_id, "state": self.state}
+
+    def handle_status(self, payload: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            # out-of-order guard: RESIZING->NORMAL broadcasts may race
+            if payload.get("ts", float("inf")) < self._status_ts:
+                return
+            self._status_ts = payload.get("ts", self._status_ts)
+            # MERGE membership: a broadcast snapshotted before a
+            # concurrent join must not evict the newer node (nodes are
+            # only removed explicitly, never by omission)
+            for n in payload["nodes"]:
+                self.nodes[n["id"]] = n
+                self._last_seen.setdefault(n["id"], now)
+            self.state = payload["state"]
+
+    def _broadcast_status(self) -> None:
+        payload = {"nodes": list(self.nodes.values()), "state": self.state,
+                   "ts": time.time()}
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                self._client(nid)._json("POST", "/internal/cluster/status",
+                                        payload)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("status broadcast to %s failed: %s",
+                                    nid, e)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.cfg.heartbeat_interval):
+            for nid in self.member_ids():
+                if nid == self.node_id:
+                    continue
+                try:
+                    self._client(nid)._json(
+                        "POST", "/internal/heartbeat",
+                        {"id": self.node_id, "state": self.state})
+                    with self._lock:
+                        self._last_seen[nid] = time.monotonic()
+                except Exception:  # noqa: BLE001 — peer down
+                    pass
+            alive = set(self.alive_ids())
+            with self._lock:
+                dead = set(self.nodes) - alive
+                new_state = (STATE_DEGRADED if dead and
+                             self.state == STATE_NORMAL else self.state)
+                if new_state != self.state:
+                    self.logger.warning("nodes suspect: %s", sorted(dead))
+                    self.state = new_state
+                if not dead and self.state == STATE_DEGRADED:
+                    self.state = STATE_NORMAL
+
+    # -- schema broadcast ---------------------------------------------------
+
+    def broadcast_schema(self) -> None:
+        """Push the full schema to every peer (reference: CreateIndex/
+        Field broadcast messages)."""
+        schema = self.api.schema()
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                self._client(nid)._json("POST", "/internal/schema",
+                                        {"schema": schema})
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("schema broadcast to %s failed: %s",
+                                    nid, e)
+
+    # -- placement / routing -------------------------------------------------
+
+    def shard_owners(self, index: str, shard: int) -> list[str]:
+        """Replica owner node ids, primary first.  Placement uses the
+        full member list (stability); callers fail over with
+        ``alive_ids``."""
+        return shard_nodes(index, shard, self.member_ids(),
+                           self.cfg.replicas)
+
+    def group_shards_by_node(self, index: str,
+                             shards: tuple[int, ...]) -> dict[str, tuple]:
+        alive = set(self.alive_ids())
+        groups: dict[str, list[int]] = {}
+        for s in shards:
+            owners = self.shard_owners(index, s)
+            target = next((o for o in owners if o in alive), None)
+            if target is None:
+                raise RuntimeError(
+                    f"no alive replica for shard {s} of {index!r} "
+                    f"(owners {owners})")
+            groups.setdefault(target, []).append(s)
+        return {k: tuple(v) for k, v in groups.items()}
+
+    def index_shards(self, index: str) -> tuple[int, ...]:
+        """Cluster-wide shard universe for an index (short-TTL cache)."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._shard_cache.get(index)
+            if hit is not None and now - hit[0] < _SHARD_CACHE_TTL:
+                return hit[1]
+        shards: set[int] = set()
+        idx = self.api.holder.index(index)
+        if idx is not None:
+            shards.update(idx.available_shards())
+        for nid in self.alive_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                resp = self._client(nid)._json(
+                    "GET", f"/internal/shards?index={index}")
+                shards.update(resp["shards"])
+            except Exception:  # noqa: BLE001 — degraded view is fine
+                pass
+        out = tuple(sorted(shards)) if shards else (0,)
+        with self._lock:
+            self._shard_cache[index] = (now, out)
+        return out
+
+    def internal_query(self, node_id: str, index: str, pql: str,
+                       shards) -> list:
+        path = f"/internal/query?index={index}"
+        if shards:
+            path += "&shards=" + ",".join(str(s) for s in shards)
+        return self._client(node_id)._do(
+            "POST", path, pql.encode())["results"]
+
+    # -- key translation (coordinator-assigned, replicated logs) ------------
+
+    def translate_keys(self, index: str, field: str | None,
+                       keys: list[str], create: bool) -> list[int | None]:
+        log = (self.api.executor.translate.columns(index) if field is None
+               else self.api.executor.translate.rows(index, field))
+        ids = log.translate(keys, create=False)
+        if all(i is not None for i in ids) or not create:
+            return ids
+        if self.is_coordinator():
+            ids = log.translate(keys, create=True)
+            self._replicate_keys(index, field, log)
+            return ids
+        resp = self._client(self.coordinator_id())._json(
+            "POST", "/internal/translate",
+            {"index": index, "field": field, "keys": keys, "create": True})
+        # the coordinator replicated synchronously; but don't rely on it
+        self._sync_log_from_coordinator(index, field, log)
+        return resp["ids"]
+
+    def handle_translate(self, index: str, field: str | None,
+                         keys: list[str], create: bool) -> list[int | None]:
+        if not self.is_coordinator() and create:
+            raise PermissionError("not the coordinator")
+        log = (self.api.executor.translate.columns(index) if field is None
+               else self.api.executor.translate.rows(index, field))
+        ids = log.translate(keys, create=create)
+        if create:
+            self._replicate_keys(index, field, log)
+        return ids
+
+    def _replicate_keys(self, index: str, field: str | None, log) -> None:
+        """Best-effort synchronous replication of the full tail each
+        batch (logs are append-only; peers dedupe)."""
+        f = field or ""
+        for nid in self.alive_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                peer_len = self._client(nid)._json(
+                    "GET", f"/internal/translate/len?index={index}"
+                    f"&field={f}")["len"]
+                tail = log.tail(peer_len)
+                if tail:
+                    self._client(nid)._json(
+                        "POST", "/internal/translate/replicate",
+                        {"index": index, "field": field,
+                         "start_id": peer_len + 1, "keys": tail})
+            except Exception as e:  # noqa: BLE001 — repaired by pull later
+                self.logger.warning("translate replicate to %s failed: %s",
+                                    nid, e)
+
+    @staticmethod
+    def _tail_path(index: str, field: str | None, after: int) -> str:
+        f = field or ""
+        return f"/internal/translate/tail?index={index}&field={f}&after={after}"
+
+    def _sync_log_from_coordinator(self, index: str, field: str | None,
+                                   log) -> None:
+        coord = self.coordinator_id()
+        if coord == self.node_id:
+            return
+        try:
+            resp = self._client(coord)._json(
+                "GET", self._tail_path(index, field, len(log)))
+            if resp["keys"]:
+                log.append_replicated(len(log) + 1, resp["keys"])
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning("translate tail pull failed: %s", e)
+
+    def _pull_translate_tails(self, seed: str) -> None:
+        """On join: pull every key log the seed has."""
+        try:
+            listing = self._client(seed)._json("GET", "/internal/translate/logs")
+        except Exception:  # noqa: BLE001
+            return
+        for entry in listing.get("logs", []):
+            index, field = entry["index"], entry["field"]
+            log = (self.api.executor.translate.columns(index)
+                   if field is None
+                   else self.api.executor.translate.rows(index, field))
+            try:
+                resp = self._client(seed)._json(
+                    "GET", self._tail_path(index, field, len(log)))
+                if resp["keys"]:
+                    log.append_replicated(len(log) + 1, resp["keys"])
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("translate pull %s/%s failed: %s",
+                                    index, field, e)
+
+    def keys_of(self, index: str, field: str | None, ids) -> list[str]:
+        log = (self.api.executor.translate.columns(index) if field is None
+               else self.api.executor.translate.rows(index, field))
+        out, missing = [], False
+        for i in ids:
+            k = log.key_of(int(i))
+            if k is None:
+                missing = True
+                break
+            out.append(k)
+        if not missing:
+            return out
+        self._sync_log_from_coordinator(index, field, log)
+        return [log.key_of(int(i)) or f"<unknown:{i}>" for i in ids]
+
+    # -- anti-entropy (reference: holder syncer, SURVEY.md §4.6) ------------
+
+    def _aae_loop(self) -> None:
+        while not self._stop.wait(self.cfg.anti_entropy_interval):
+            try:
+                self.sync_once()
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("anti-entropy round failed: %s", e)
+
+    def sync_once(self) -> int:
+        """One AAE round: for every local fragment replicated elsewhere,
+        diff block checksums with each replica and union-merge
+        differences both ways.  Returns blocks repaired."""
+        repaired = 0
+        holder = self.api.holder
+        for iname, idx in list(holder.indexes.items()):
+            for fname, f in list(idx.fields.items()):
+                for vname, v in list(f.views.items()):
+                    for shard, frag in list(v.fragments.items()):
+                        owners = self.shard_owners(iname, shard)
+                        if self.node_id not in owners:
+                            continue
+                        for peer in owners:
+                            if peer == self.node_id:
+                                continue
+                            repaired += self._sync_fragment(
+                                peer, iname, fname, vname, shard, frag)
+        if repaired:
+            self.logger.info("anti-entropy repaired %d blocks", repaired)
+            self.stats.count("aae_blocks_repaired", repaired)
+        return repaired
+
+    def _sync_fragment(self, peer: str, index: str, field: str, view: str,
+                       shard: int, frag) -> int:
+        from pilosa_tpu.store import roaring
+        qs = f"index={index}&field={field}&view={view}&shard={shard}"
+        try:
+            theirs = self._client(peer)._json(
+                "GET", f"/internal/fragment/blocks?{qs}")["blocks"]
+        except Exception:  # noqa: BLE001 — peer down; next round
+            return 0
+        theirs = {int(k): v for k, v in theirs.items()}
+        ours = frag.blocks()
+        diff = [b for b in set(ours) | set(theirs)
+                if ours.get(b) != theirs.get(b)]
+        repaired = 0
+        for block in sorted(diff):
+            try:
+                blob = self._client(peer)._do(
+                    "GET", f"/internal/fragment/data?{qs}&block={block}")
+                frag.merge_positions(roaring.deserialize(blob))
+                mine = roaring.serialize(frag.block_positions(block))
+                self._client(peer)._do(
+                    "POST", f"/internal/fragment/merge?{qs}", mine,
+                    content_type="application/octet-stream")
+                repaired += 1
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("aae %s/%s/%s/%d block %d: %s",
+                                    index, field, view, shard, block, e)
+        return repaired
+
+    # -- resize (reference: ResizeJob, SURVEY.md §3.3) ----------------------
+
+    def trigger_resize(self) -> None:
+        """Spawn a background rebalance (coordinator only)."""
+        self._spawn(self._resize_job, "resize")
+
+    def _resize_job(self) -> None:
+        """Coordinator: rebalance fragments onto the current membership.
+        Gather inventories, compute transfers, instruct sources to push.
+        Jobs serialize on ``_resize_lock``; the cluster always lands on
+        NORMAL afterwards."""
+        with self._resize_lock:
+            self._resize_once()
+
+    def _resize_once(self) -> None:
+        with self._lock:
+            self.state = STATE_RESIZING
+        self._broadcast_status()
+        try:
+            inventory: dict[tuple, list[str]] = {}
+            for nid in self.alive_ids():
+                try:
+                    frags = (self._local_inventory()
+                             if nid == self.node_id else
+                             self._client(nid)._json(
+                                 "GET", "/internal/fragments")["fragments"])
+                except Exception as e:  # noqa: BLE001
+                    self.logger.warning("inventory from %s failed: %s",
+                                        nid, e)
+                    continue
+                for fr in frags:
+                    key = (fr["index"], fr["field"], fr["view"], fr["shard"])
+                    inventory.setdefault(key, []).append(nid)
+            moved = 0
+            for (index, field, view, shard), holders in inventory.items():
+                owners = self.shard_owners(index, shard)
+                for dest in owners:
+                    if dest in holders:
+                        continue
+                    src = holders[0]
+                    try:
+                        if src == self.node_id:
+                            self.push_fragment(index, field, view, shard,
+                                               dest)
+                        else:
+                            self._client(src)._json(
+                                "POST", "/internal/resize/push",
+                                {"index": index, "field": field,
+                                 "view": view, "shard": shard,
+                                 "dest": dest})
+                        moved += 1
+                    except Exception as e:  # noqa: BLE001
+                        self.logger.warning("resize push %s -> %s: %s",
+                                            (index, field, view, shard),
+                                            dest, e)
+            self.logger.info("resize complete: %d fragment copies moved",
+                             moved)
+        finally:
+            with self._lock:
+                self.state = STATE_NORMAL
+            self._broadcast_status()
+
+    def _local_inventory(self) -> list[dict]:
+        out = []
+        for iname, idx in self.api.holder.indexes.items():
+            for fname, f in idx.fields.items():
+                for vname, v in f.views.items():
+                    for shard, frag in v.fragments.items():
+                        if frag.rows:
+                            out.append({"index": iname, "field": fname,
+                                        "view": vname, "shard": shard})
+        return out
+
+    def push_fragment(self, index: str, field: str, view: str, shard: int,
+                      dest: str) -> None:
+        """Send one local fragment's bits to ``dest`` (union-merge
+        there)."""
+        from pilosa_tpu.store import roaring
+        idx = self.api.holder.index(index)
+        frag = idx.field(field).view(view).fragment(shard)
+        blob = roaring.serialize(frag.positions())
+        qs = f"index={index}&field={field}&view={view}&shard={shard}"
+        self._client(dest)._do(
+            "POST", f"/internal/fragment/merge?{qs}", blob,
+            content_type="application/octet-stream")
+
+    # -- introspection -------------------------------------------------------
+
+    def nodes_status(self) -> list[dict]:
+        alive = set(self.alive_ids())
+        coord = self.coordinator_id()
+        return [{"id": nid, "uri": n["uri"],
+                 "state": (n.get("state", STATE_NORMAL)
+                           if nid in alive else "DOWN"),
+                 "isPrimary": nid == coord}
+                for nid, n in sorted(self.nodes.items())]

@@ -1,0 +1,282 @@
+"""Distributed query execution: fan-out over nodes, merge partials.
+
+Reference: ``executor.go#mapReduce`` (SURVEY.md §4.2) — shards are
+grouped by owning node; local shards execute on this node's TPU mesh as
+one batched program, remote groups ship the sub-query as PQL text to
+``POST /internal/query`` on the peer (the rebuild of
+``InternalClient.QueryNode``), and partial results merge host-side.
+Intra-node merging stays on-device; only the per-node partials (already
+tiny: counts, id lists, pairs) merge here.
+
+Key translation in cluster mode happens at the edge (this module):
+inputs are translated before routing (so shard targets are known) via
+the partition-owner nodes, outputs after merging — local executors run
+with ``translate_output=False``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.exec import result_to_json
+from pilosa_tpu.exec.executor import ExecutionError
+from pilosa_tpu.pql import parse
+from pilosa_tpu.pql.ast import Call, Condition, Query
+
+WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store"})
+
+_MAX_U64 = (1 << 64) - 1
+
+
+def _call_of(call: Call) -> Call:
+    """Unwrap Options() to the effective call."""
+    return call.children[0] if call.name == "Options" and call.children else call
+
+
+def _strip_truncation(call: Call) -> Call:
+    """Remove per-node truncation args (TopN n, Rows/GroupBy limit) from
+    the fan-out sub-query — each node must return full partials or the
+    merge is inexact (the reference needs a second query phase for the
+    same reason, ``executeTopN`` SURVEY.md §4.3; here nodes return full
+    count vectors instead)."""
+    eff = _call_of(call)
+    strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",)}
+    keys = strip.get(eff.name)
+    if not keys or not any(k in eff.args for k in keys):
+        return call
+    new_eff = Call(eff.name,
+                   {k: v for k, v in eff.args.items() if k not in keys},
+                   eff.children)
+    if call is eff:
+        return new_eff
+    return Call(call.name, dict(call.args), [new_eff])
+
+
+class DistributedExecutor:
+    """Same surface as :class:`pilosa_tpu.exec.Executor`.execute but
+    JSON-valued, routing shards across the cluster."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # Cluster (membership + clients + api)
+
+    # -- public -------------------------------------------------------------
+
+    def execute_json(self, index: str, pql: str,
+                     shards: list[int] | None = None) -> list:
+        query = parse(pql)
+        out = []
+        for call in query.calls:
+            if _call_of(call).name in WRITE_CALLS:
+                out.append(self._write(index, call))
+            else:
+                out.append(self._read(index, call, shards))
+        return out
+
+    # -- reads --------------------------------------------------------------
+
+    def _read(self, index: str, call: Call, shards: list[int] | None):
+        call = self._translate_input(index, call)
+        all_shards = (tuple(shards) if shards is not None
+                      else self.cluster.index_shards(index))
+        groups = self.cluster.group_shards_by_node(index, all_shards)
+        sub_call = _strip_truncation(call)
+        partials = []
+        local_api = self.cluster.api
+        for node_id, node_shards in groups.items():
+            if node_id == self.cluster.node_id:
+                rs = local_api.executor.execute(
+                    index, Query([sub_call]), shards=list(node_shards),
+                    translate_output=False)
+                partials.append(result_to_json(rs[0]))
+            else:
+                rs = self.cluster.internal_query(
+                    node_id, index, str(sub_call), node_shards)
+                partials.append(rs[0])
+        merged = merge_results(_call_of(call), partials)
+        return self._translate_output(index, _call_of(call), merged)
+
+    # -- writes -------------------------------------------------------------
+
+    def _write(self, index: str, call: Call):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        # Set/Store create missing keys; Clear/ClearRow must not
+        create = _call_of(call).name in ("Set", "Store")
+        call = self._translate_input(index, call, create=create)
+        eff = _call_of(call)
+        if eff.name in ("Set", "Clear"):
+            col = int(eff.args["_col"])
+            owners = self.cluster.shard_owners(index, col // SHARD_WIDTH)
+            results = self._run_on(index, call, owners, shards=None)
+            return bool(results[0])
+        # ClearRow / Store touch every shard: run on every node for its
+        # owned shards
+        all_shards = self.cluster.index_shards(index)
+        groups = self.cluster.group_shards_by_node(index, all_shards)
+        changed = False
+        for node_id, node_shards in groups.items():
+            r = self._run_on(index, call, [node_id], shards=node_shards)
+            changed = changed or bool(r[0])
+        return changed
+
+    def _run_on(self, index: str, call: Call, node_ids, shards):
+        """Execute one call on each named node (replica-synchronous for
+        writes); returns the primary's (first) result."""
+        results = []
+        for node_id in node_ids:
+            if node_id == self.cluster.node_id:
+                rs = self.cluster.api.executor.execute(
+                    index, Query([call]),
+                    shards=list(shards) if shards else None,
+                    translate_output=False)
+                results.append(result_to_json(rs[0]))
+            else:
+                results.append(self.cluster.internal_query(
+                    node_id, index, str(call), shards)[0])
+        return results
+
+    # -- key translation at the edge ---------------------------------------
+
+    def _translate_input(self, index: str, call: Call,
+                         create: bool = False) -> Call:
+        """Replace string row/column keys with IDs (on a copy).  An
+        unknown key on a read becomes ID 0 — key IDs start at 1, so the
+        sub-row/column is empty, matching single-node semantics exactly
+        (a missing key must not veto Not/Difference/Union siblings)."""
+        idx = self.cluster.api.holder.index(index)
+        if idx is None:
+            raise ExecutionError(f"index {index!r} not found")
+
+        def resolve(field: str | None, key: str) -> int:
+            kid = self.cluster.translate_keys(index, field, [key],
+                                              create=create)[0]
+            return 0 if kid is None else kid
+
+        def walk(c: Call) -> Call:
+            new = Call(c.name, dict(c.args), [walk(ch) for ch in c.children])
+            for k, v in list(new.args.items()):
+                if isinstance(v, Call):
+                    new.args[k] = walk(v)
+            if isinstance(new.args.get("_col"), str):
+                new.args["_col"] = resolve(None, new.args["_col"])
+            if isinstance(new.args.get("column"), str):
+                cid = self.cluster.translate_keys(
+                    index, None, [new.args["column"]], create=False)[0]
+                new.args["column"] = 0 if cid is None else cid
+            # row key: the single non-reserved field arg
+            from pilosa_tpu.exec.executor import RESERVED_KEYS
+            for k, v in list(new.args.items()):
+                if (k in RESERVED_KEYS or k.startswith("_")
+                        or isinstance(v, (Condition, Call))):
+                    continue
+                field = idx.field(k)
+                if field is not None and field.options.keys \
+                        and isinstance(v, str):
+                    new.args[k] = resolve(k, v)
+            prev = new.args.get("previous")
+            if isinstance(prev, str):
+                fname = new.args.get("_field") or new.args.get("field")
+                rid = self.cluster.translate_keys(
+                    index, str(fname), [prev], create=False)[0]
+                new.args["previous"] = rid if rid is not None else _MAX_U64
+            return new
+
+        return walk(call)
+
+    def _translate_output(self, index: str, call: Call, merged):
+        idx = self.cluster.api.holder.index(index)
+        if merged is None or idx is None:
+            return merged
+        if isinstance(merged, dict) and "columns" in merged and idx.keys:
+            keys = self.cluster.keys_of(index, None, merged["columns"])
+            return {"keys": keys}
+        fname = call.args.get("_field") or call.args.get("field")
+        field = idx.field(str(fname)) if fname else None
+        keyed_field = field is not None and field.options.keys
+        if isinstance(merged, list) and keyed_field:  # TopN pairs
+            ids = [p["id"] for p in merged]
+            keys = self.cluster.keys_of(index, str(fname), ids)
+            return [{"key": k, "count": p["count"]}
+                    for k, p in zip(keys, merged)]
+        if isinstance(merged, dict) and "rows" in merged and keyed_field:
+            keys = self.cluster.keys_of(index, str(fname), merged["rows"])
+            return {"keys": keys}
+        if isinstance(merged, list) and call.name == "GroupBy":
+            for g in merged:
+                for fr in g["group"]:
+                    f = idx.field(fr["field"])
+                    if f is not None and f.options.keys and "rowID" in fr:
+                        fr["rowKey"] = self.cluster.keys_of(
+                            index, fr["field"], [fr.pop("rowID")])[0]
+        return merged
+
+
+
+# ---------------------------------------------------------------------------
+# partial-result merging (reference: the reduce fns in executor.go)
+# ---------------------------------------------------------------------------
+
+
+def merge_results(call: Call, partials: list):
+    if not partials:
+        return None
+    name = call.name
+    if name == "Count":
+        return sum(partials)
+    if name in WRITE_CALLS:
+        return any(partials)
+    if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
+                "Not", "All"):
+        cols = np.sort(np.concatenate(
+            [np.asarray(p.get("columns", []), dtype=np.uint64)
+             for p in partials]))
+        return {"columns": [int(c) for c in np.unique(cols)]}
+    if name == "TopN":
+        counts: dict[int, int] = {}
+        for p in partials:
+            for pair in p:
+                counts[pair["id"]] = counts.get(pair["id"], 0) + pair["count"]
+        pairs = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        n = call.args.get("n")
+        if n is not None:
+            pairs = pairs[: int(n)]
+        return [{"id": i, "count": c} for i, c in pairs]
+    if name == "Sum":
+        return {"value": sum(p["value"] for p in partials),
+                "count": sum(p["count"] for p in partials)}
+    if name in ("Min", "Max"):
+        live = [p for p in partials if p["count"] > 0]
+        if not live:
+            return {"value": 0, "count": 0}
+        best = (min if name == "Min" else max)(p["value"] for p in live)
+        return {"value": best,
+                "count": sum(p["count"] for p in live
+                             if p["value"] == best)}
+    if name == "Rows":
+        rows = np.unique(np.concatenate(
+            [np.asarray(p.get("rows", []), dtype=np.uint64)
+             for p in partials]))
+        limit = call.args.get("limit")
+        if limit is not None:
+            rows = rows[: int(limit)]
+        return {"rows": [int(r) for r in rows]}
+    if name == "GroupBy":
+        merged: dict[tuple, dict] = {}
+        for p in partials:
+            for g in p:
+                key = tuple((fr["field"], fr.get("rowID", fr.get("rowKey")))
+                            for fr in g["group"])
+                hit = merged.get(key)
+                if hit is None:
+                    merged[key] = dict(g)
+                else:
+                    hit["count"] += g["count"]
+                    if g.get("agg") is not None:
+                        hit["agg"] = (hit.get("agg") or 0) + g["agg"]
+        groups = sorted(merged.values(),
+                        key=lambda g: [fr.get("rowID", 0)
+                                       for fr in g["group"]])
+        limit = call.args.get("limit")
+        if limit is not None:
+            groups = groups[: int(limit)]
+        return groups
+    raise ExecutionError(f"cannot merge results for call {name!r}")

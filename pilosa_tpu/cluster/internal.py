@@ -1,0 +1,197 @@
+"""Node-to-node internal HTTP handlers.
+
+Reference: the ``/internal/*`` surface of ``http/handler.go`` —
+query fan-out, fragment block/data exchange for AAE + resize, translate
+streaming, cluster messages (SURVEY.md §3.3).  Registered into the main
+router; every handler 503s when the node is not clustered.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.api.api import ApiError
+from pilosa_tpu.api.server import Handler, Router
+from pilosa_tpu.store import roaring
+
+
+def _cluster(handler: Handler):
+    cluster = handler.server.api.cluster
+    if cluster is None:
+        raise ApiError("node is not clustered", 503)
+    return cluster
+
+
+def _qs(handler: Handler, name: str) -> str:
+    vals = handler.query.get(name)
+    if not vals:
+        raise ApiError(f"missing query param {name!r}")
+    return vals[0]
+
+
+def _fragment(handler: Handler, create: bool = False):
+    api = handler.server.api
+    idx = api.holder.index(_qs(handler, "index"))
+    if idx is None:
+        raise ApiError("index not found", 404)
+    field = idx.field(_qs(handler, "field"))
+    if field is None:
+        raise ApiError("field not found", 404)
+    view = field.view(_qs(handler, "view"), create=create)
+    if view is None:
+        raise ApiError("view not found", 404)
+    frag = view.fragment(int(_qs(handler, "shard")), create=create)
+    if frag is None:
+        raise ApiError("fragment not found", 404)
+    return frag
+
+
+# -- handlers ----------------------------------------------------------------
+
+
+def h_join(self: Handler) -> None:
+    self._reply(_cluster(self).handle_join(self._json_body()))
+
+
+def h_heartbeat(self: Handler) -> None:
+    b = self._json_body()
+    self._reply(_cluster(self).handle_heartbeat(b["id"],
+                                                b.get("state", "NORMAL")))
+
+
+def h_cluster_status(self: Handler) -> None:
+    _cluster(self).handle_status(self._json_body())
+    self._reply({"success": True})
+
+
+def h_internal_query(self: Handler) -> None:
+    """Execute locally only (no re-fan-out) with raw-ID results —
+    reference: ``/internal/query`` remote execution."""
+    from pilosa_tpu.exec import result_to_json
+    api = self.server.api
+    index = _qs(self, "index")
+    shards = None
+    if "shards" in self.query:
+        shards = [int(s) for s in self.query["shards"][0].split(",") if s]
+    pql = self._body().decode()
+    results = api.executor.execute(index, pql, shards=shards,
+                                   translate_output=False)
+    self._reply({"results": [result_to_json(r) for r in results]})
+
+
+def h_shards(self: Handler) -> None:
+    idx = self.server.api.holder.index(_qs(self, "index"))
+    self._reply({"shards": idx.available_shards() if idx else []})
+
+
+def h_fragments(self: Handler) -> None:
+    self._reply({"fragments": _cluster(self)._local_inventory()})
+
+
+def h_schema_apply(self: Handler) -> None:
+    self.server.api.apply_schema(self._json_body()["schema"])
+    self._reply({"success": True})
+
+
+def h_translate(self: Handler) -> None:
+    b = self._json_body()
+    try:
+        ids = _cluster(self).handle_translate(
+            b["index"], b.get("field"), b["keys"], b.get("create", False))
+    except PermissionError as e:
+        raise ApiError(str(e), 409)
+    self._reply({"ids": ids})
+
+
+def h_translate_replicate(self: Handler) -> None:
+    b = self._json_body()
+    cluster = _cluster(self)
+    log = (cluster.api.executor.translate.columns(b["index"])
+           if b.get("field") is None
+           else cluster.api.executor.translate.rows(b["index"], b["field"]))
+    try:
+        log.append_replicated(b["start_id"], b["keys"])
+    except KeyError as e:
+        raise ApiError(str(e), 409)
+    self._reply({"len": len(log)})
+
+
+def _translate_log(self: Handler):
+    api = self.server.api
+    index = _qs(self, "index")
+    field = self.query.get("field", [""])[0] or None
+    return (api.executor.translate.columns(index) if field is None
+            else api.executor.translate.rows(index, field))
+
+
+def h_translate_tail(self: Handler) -> None:
+    log = _translate_log(self)
+    after = int(self.query.get("after", ["0"])[0])
+    self._reply({"keys": log.tail(after), "len": len(log)})
+
+
+def h_translate_len(self: Handler) -> None:
+    self._reply({"len": len(_translate_log(self))})
+
+
+def h_translate_logs(self: Handler) -> None:
+    store = self.server.api.executor.translate
+    logs = []
+    with store._lock:
+        for (index, field) in store._logs:
+            logs.append({"index": index, "field": field})
+    self._reply({"logs": logs})
+
+
+def h_fragment_blocks(self: Handler) -> None:
+    frag = _fragment(self)
+    self._reply({"blocks": {str(k): v for k, v in frag.blocks().items()}})
+
+
+def h_fragment_data(self: Handler) -> None:
+    frag = _fragment(self)
+    if "block" in self.query:
+        positions = frag.block_positions(int(_qs(self, "block")))
+    else:
+        positions = frag.positions()
+    self._reply(roaring.serialize(positions),
+                content_type="application/octet-stream")
+
+
+def h_fragment_merge(self: Handler) -> None:
+    frag = _fragment(self, create=True)
+    changed = frag.merge_positions(roaring.deserialize(self._body()))
+    self._reply({"changed": changed})
+
+
+def h_resize_push(self: Handler) -> None:
+    b = self._json_body()
+    _cluster(self).push_fragment(b["index"], b["field"], b["view"],
+                                 b["shard"], b["dest"])
+    self._reply({"success": True})
+
+
+def h_resize_trigger(self: Handler) -> None:
+    cluster = _cluster(self)
+    if not cluster.is_coordinator():
+        raise ApiError("not the coordinator", 409)
+    cluster.trigger_resize()
+    self._reply({"success": True})
+
+
+def register_internal_routes(router: Router) -> None:
+    router.add("POST", "/internal/join", h_join)
+    router.add("POST", "/internal/heartbeat", h_heartbeat)
+    router.add("POST", "/internal/cluster/status", h_cluster_status)
+    router.add("POST", "/internal/query", h_internal_query)
+    router.add("GET", "/internal/shards", h_shards)
+    router.add("GET", "/internal/fragments", h_fragments)
+    router.add("POST", "/internal/schema", h_schema_apply)
+    router.add("POST", "/internal/translate", h_translate)
+    router.add("POST", "/internal/translate/replicate", h_translate_replicate)
+    router.add("GET", "/internal/translate/tail", h_translate_tail)
+    router.add("GET", "/internal/translate/len", h_translate_len)
+    router.add("GET", "/internal/translate/logs", h_translate_logs)
+    router.add("GET", "/internal/fragment/blocks", h_fragment_blocks)
+    router.add("GET", "/internal/fragment/data", h_fragment_data)
+    router.add("POST", "/internal/fragment/merge", h_fragment_merge)
+    router.add("POST", "/internal/resize/push", h_resize_push)
+    router.add("POST", "/internal/resize/trigger", h_resize_trigger)

@@ -61,6 +61,7 @@ class ExecutionError(Exception):
 class _Ctx:
     index: Index
     shards: tuple[int, ...]
+    translate_output: bool = True
 
 
 class Executor:
@@ -85,9 +86,14 @@ class Executor:
     # ------------------------------------------------------------------ api
 
     def execute(self, index_name: str, query: str | Query,
-                shards: list[int] | None = None) -> list:
+                shards: list[int] | None = None,
+                translate_output: bool = True) -> list:
         """Run every top-level call; returns one result per call
-        (reference: ``Executor.Execute`` → ``QueryResponse.Results``)."""
+        (reference: ``Executor.Execute`` → ``QueryResponse.Results``).
+
+        ``translate_output=False`` leaves raw IDs in results — used by
+        the cluster layer, which merges partials from many nodes first
+        and key-translates once at the edge."""
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index {index_name!r} not found")
@@ -97,7 +103,8 @@ class Executor:
         # spans per call + per-call-type latency counters (reference:
         # executor span/stats emission, SURVEY.md §3.3 / §6)
         for call in query.calls:
-            ctx = _Ctx(index, self._shards_for(index, shards, call))
+            ctx = _Ctx(index, self._shards_for(index, shards, call),
+                       translate_output)
             with self.tracer.span("executor." + call.name,
                                   index=index_name,
                                   shards=len(ctx.shards)):
@@ -191,7 +198,7 @@ class Executor:
         if field.options.type in BSI_TYPES:
             # Row(amount=5) on BSI ≡ amount == 5
             return self._bsi_condition(ctx, field, Condition("==", value))
-        row_id = self._row_id(field, value, create=False)
+        row_id = self._row_id(ctx, field, value, create=False)
         if row_id is None:
             return self._zeros(ctx)
         if "from" in call.args or "to" in call.args:
@@ -276,7 +283,8 @@ class Executor:
                 f"field {name!r} not found in index {ctx.index.name!r}")
         return field
 
-    def _row_id(self, field: Field, value, create: bool) -> int | None:
+    def _row_id(self, ctx: _Ctx, field: Field, value,
+                create: bool) -> int | None:
         if isinstance(value, bool):
             return int(value)
         if isinstance(value, str):
@@ -285,7 +293,9 @@ class Executor:
                     f"field {field.name!r}: string row on unkeyed field")
             log = self.translate.rows(field.index_name, field.name)
             return log.translate([value], create=create)[0]
-        if field.options.keys:
+        # raw mode (translate_output=False): the cluster layer pre-
+        # translated keys to IDs at the edge; integer rows are expected
+        if field.options.keys and ctx.translate_output:
             raise ExecutionError(
                 f"field {field.name!r}: integer row on keyed field")
         return int(value)
@@ -297,7 +307,7 @@ class Executor:
                     f"index {ctx.index.name!r}: string column on unkeyed index")
             log = self.translate.columns(ctx.index.name)
             return log.translate([value], create=create)[0]
-        if ctx.index.keys:
+        if ctx.index.keys and ctx.translate_output:
             raise ExecutionError(
                 f"index {ctx.index.name!r}: integer column on keyed index")
         return int(value)
@@ -325,7 +335,7 @@ class Executor:
                 parts.append(cols + np.uint64(s * SHARD_WIDTH))
         columns = (np.concatenate(parts) if parts
                    else np.empty(0, np.uint64))
-        if ctx.index.keys:
+        if ctx.index.keys and ctx.translate_output:
             log = self.translate.columns(ctx.index.name)
             return RowResult(keys=log.keys_of(columns))
         return RowResult(columns=columns)
@@ -418,7 +428,7 @@ class Executor:
         live = (vals > 0) & (slots < ps.n_rows)
         row_ids = ps.row_ids[slots[live]]
         vals = vals[live]
-        if field.options.keys:
+        if field.options.keys and ctx.translate_output:
             log = self.translate.rows(ctx.index.name, field.name)
             return PairsResult([Pair(key=log.key_of(int(r)), count=int(c))
                                 for r, c in zip(row_ids, vals)])
@@ -433,7 +443,7 @@ class Executor:
             raise ExecutionError("Rows: missing field argument")
         field = self._field(ctx, str(fname))
         rows = self._rows_of(ctx, field, call)
-        if field.options.keys:
+        if field.options.keys and ctx.translate_output:
             log = self.translate.rows(ctx.index.name, field.name)
             return RowIdsResult(keys=[log.key_of(int(r)) for r in rows])
         return RowIdsResult(rows=rows)
@@ -458,7 +468,7 @@ class Executor:
         rows = ps.row_ids[live]
         prev = call.args.get("previous")
         if prev is not None:
-            prev_id = self._row_id(field, prev, create=False)
+            prev_id = self._row_id(ctx, field, prev, create=False)
             if prev_id is not None:
                 rows = rows[rows > prev_id]
         limit = call.args.get("limit")
@@ -537,7 +547,7 @@ class Executor:
         return GroupCountsResult(groups)
 
     def _field_row(self, ctx: _Ctx, field: Field, row_id: int) -> FieldRow:
-        if field.options.keys:
+        if field.options.keys and ctx.translate_output:
             log = self.translate.rows(ctx.index.name, field.name)
             return FieldRow(field.name, row_key=log.key_of(row_id))
         return FieldRow(field.name, row_id=row_id)
@@ -557,7 +567,7 @@ class Executor:
         if field.options.type in BSI_TYPES:
             changed = field.set_value(col_id, value)
         else:
-            row_id = self._row_id(field, value, create=True)
+            row_id = self._row_id(ctx, field, value, create=True)
             ts = call.args.get("_timestamp")
             changed = field.set_bit(
                 row_id, col_id,
@@ -579,7 +589,7 @@ class Executor:
         field = self._field(ctx, fname)
         if field.options.type in BSI_TYPES:
             return field.clear_value(col_id)
-        row_id = self._row_id(field, value, create=False)
+        row_id = self._row_id(ctx, field, value, create=False)
         if row_id is None:
             return False
         return field.clear_bit(row_id, col_id)
@@ -590,7 +600,7 @@ class Executor:
             raise ExecutionError("ClearRow: missing field=row argument")
         fname, value = hit
         field = self._field(ctx, fname)
-        row_id = self._row_id(field, value, create=False)
+        row_id = self._row_id(ctx, field, value, create=False)
         if row_id is None:
             return False
         view = field.standard_view()
@@ -612,7 +622,7 @@ class Executor:
             raise ExecutionError("Store: missing field=row argument")
         fname, value = hit
         field = self._field(ctx, fname)
-        row_id = self._row_id(field, value, create=True)
+        row_id = self._row_id(ctx, field, value, create=True)
         words = np.asarray(self._bitmap(ctx, call.children[0]))
         view = field.standard_view(create=True)
         changed = False

@@ -65,15 +65,52 @@ class Call:
         return hits[0]
 
     def __str__(self) -> str:
-        parts = [str(c) for c in self.children]
+        """Valid, re-parseable PQL (used to ship sub-queries to peer
+        nodes — reference: ``InternalClient.QueryNode`` carrying the
+        sub-AST, SURVEY.md §4.2).  ``parse(str(call))`` must equal
+        ``call``."""
+        parts: list[str] = []
+        if "_field" in self.args:
+            parts.append(str(self.args["_field"]))  # bareword field
+        for slot in ("_col", "_row"):
+            if slot in self.args:
+                parts.append(_literal(self.args[slot]))
+        parts += [str(c) for c in self.children]
         for k, v in self.args.items():
+            if k in ("_field", "_col", "_row", "_timestamp"):
+                continue
             if isinstance(v, Condition):
-                parts.append(f"{k} {v.op} {v.value}")
-            elif isinstance(v, str):
-                parts.append(f'{k}="{v}"')
+                parts.append(_condition_pql(k, v))
             else:
-                parts.append(f"{k}={v}")
+                parts.append(f"{k}={_literal(v)}")
+        if "_timestamp" in self.args:
+            parts.append(str(self.args["_timestamp"]))  # bare timestamp
         return f"{self.name}({', '.join(parts)})"
+
+
+def _literal(v) -> str:
+    """One PQL literal, re-parseable."""
+    if isinstance(v, Call):
+        return str(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, str):
+        escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_literal(x) for x in v) + "]"
+    return str(v)
+
+
+def _condition_pql(field: str, c: Condition) -> str:
+    if c.op in BETWEEN_OPS:
+        lo_op = "<" if c.op.startswith("<>") else "<="
+        hi_op = "<" if c.op.endswith("><") else "<="
+        return (f"{_literal(c.value[0])} {lo_op} {field} "
+                f"{hi_op} {_literal(c.value[1])}")
+    return f"{field} {c.op} {_literal(c.value)}"
 
 
 @dataclass

@@ -39,18 +39,17 @@ class PilosaTPUServer:
             self.holder, placement=placement, stats=self.stats,
             plane_budget=self.cfg.plane_budget_bytes)
         self.api = API(self.holder, self.executor)
-        if self.cfg.seeds or self.cfg.replicas > 1:
-            try:
-                from pilosa_tpu.cluster import Cluster
-            except ImportError as e:
-                raise RuntimeError(
-                    "config sets seeds/replicas but cluster support is "
-                    "not available in this build") from e
-            self.cluster = Cluster(self.cfg, self.api, stats=self.stats,
-                                   logger=self.logger)
-            self.api.cluster = self.cluster
+        # construct (binds the socket; resolves port 0) before the
+        # cluster needs the advertised address, then serve
         self.http = HttpServer(self.api, self.cfg.host, self.cfg.port,
-                               stats=self.stats, logger=self.logger).start()
+                               stats=self.stats, logger=self.logger)
+        if self.cfg.seeds or self.cfg.replicas > 1 or self.cfg.cluster_enabled:
+            from pilosa_tpu.cluster import Cluster
+            self.cluster = Cluster(self.cfg, self.api, stats=self.stats,
+                                   logger=self.logger,
+                                   port=self.http.address[1])
+            self.api.cluster = self.cluster
+        self.http.start()
         if self.cluster is not None:
             self.cluster.open()
         return self

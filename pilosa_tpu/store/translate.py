@@ -95,6 +95,28 @@ class KeyLog:
                 out.append(kid)
         return out
 
+    def append_replicated(self, start_id: int, keys: list[str]) -> None:
+        """Apply a replicated batch assigned by the coordinator
+        (reference: v1 translate-log streaming, SURVEY.md §3.3).  Batches
+        may overlap what we have (idempotent); a gap means we missed a
+        batch and must pull the tail first."""
+        with self._lock:
+            have = len(self._keys)
+            if start_id > have + 1:
+                raise KeyError(
+                    f"translate gap: have {have} keys, batch starts at "
+                    f"{start_id}")
+            skip = have + 1 - start_id
+            for k in keys[skip:]:
+                self._append(k)
+                self._ids[k] = len(self._keys) + 1
+                self._keys.append(k)
+
+    def tail(self, after_id: int) -> list[str]:
+        """Keys with IDs > after_id, in ID order."""
+        with self._lock:
+            return list(self._keys[after_id:])
+
     def key_of(self, kid: int) -> str | None:
         with self._lock:
             if 1 <= kid <= len(self._keys):

@@ -1,0 +1,97 @@
+"""In-process multi-node cluster harness for tests.
+
+Reference: ``test/cluster.go#MustRunCluster`` (SURVEY.md §5) — the most
+load-bearing fixture upstream: n real servers in one process, real
+executors/holders, loopback HTTP between them.  Heartbeat intervals are
+cranked down so liveness converges inside test timeouts.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from pilosa_tpu.api.client import Client
+from pilosa_tpu.cli.config import Config
+from pilosa_tpu.server import PilosaTPUServer
+
+
+class TestCluster:
+    __test__ = False  # not a pytest collectable
+
+    def __init__(self, servers: list[PilosaTPUServer]):
+        self.servers = servers
+
+    @property
+    def clients(self) -> list[Client]:
+        return [Client("127.0.0.1", s.http.address[1]) for s in self.servers]
+
+    def client(self, i: int = 0) -> Client:
+        return self.clients[i]
+
+    def node_ids(self) -> list[str]:
+        return [s.cluster.node_id for s in self.servers]
+
+    def server_for(self, node_id: str) -> PilosaTPUServer:
+        for s in self.servers:
+            if s.cluster.node_id == node_id:
+                return s
+        raise KeyError(node_id)
+
+    def await_membership(self, n: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(len(s.cluster.alive_ids()) == n for s in self.servers):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"cluster did not reach {n} members: "
+            f"{[s.cluster.alive_ids() for s in self.servers]}")
+
+    def await_state(self, state: str, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(s.cluster.state == state for s in self.servers):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"cluster states {[s.cluster.state for s in self.servers]}")
+
+    def close(self) -> None:
+        for s in self.servers:
+            s.close()
+
+
+@contextmanager
+def run_cluster(n: int, base_dir: str, replicas: int = 1,
+                heartbeat: float = 0.2, anti_entropy: float = 0.0,
+                mesh: bool = False):
+    """Boot an n-node in-process cluster; yields a :class:`TestCluster`."""
+    servers: list[PilosaTPUServer] = []
+    try:
+        seed_bind = None
+        for i in range(n):
+            cfg = Config(
+                bind="127.0.0.1:0",
+                data_dir=f"{base_dir}/node{i}",
+                seeds=[seed_bind] if seed_bind else [],
+                replicas=replicas,
+                cluster_enabled=True,
+                heartbeat_interval=heartbeat,
+                anti_entropy_interval=anti_entropy,
+                mesh=mesh,
+            )
+            srv = PilosaTPUServer(cfg).open()
+            servers.append(srv)
+            if seed_bind is None:
+                seed_bind = srv.cluster.node_id
+        cluster = TestCluster(servers)
+        cluster.await_membership(n)
+        cluster.await_state("NORMAL")  # join-triggered resizes settled
+        yield cluster
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

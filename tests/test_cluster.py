@@ -1,0 +1,308 @@
+"""Multi-node cluster tests over the in-process harness — the rebuild of
+the reference's ``test.MustRunCluster``-based executor/cluster tests
+(SURVEY.md §5): distributed queries, schema broadcast, key translation
+replication, replica failover, AAE repair, resize migration."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.testing import run_cluster
+
+
+@pytest.fixture
+def three_nodes(tmp_path):
+    with run_cluster(3, str(tmp_path)) as c:
+        yield c
+
+
+def spread_bits(client, n_shards=6, per_shard=50, seed=7):
+    """Import bits across n_shards shards; returns oracle (row -> col set)."""
+    rng = np.random.default_rng(seed)
+    oracle: dict[int, set[int]] = {}
+    rows, cols = [], []
+    for s in range(n_shards):
+        base = s * SHARD_WIDTH
+        cs = rng.choice(SHARD_WIDTH, size=per_shard, replace=False)
+        rs = rng.integers(1, 4, size=per_shard)
+        for r, cc in zip(rs, cs):
+            oracle.setdefault(int(r), set()).add(base + int(cc))
+            rows.append(int(r))
+            cols.append(base + int(cc))
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.import_bits("i", "f", rowIDs=rows, columnIDs=cols)
+    return oracle
+
+
+class TestMembership:
+    def test_three_nodes_form(self, three_nodes):
+        c = three_nodes
+        st = c.client(0).status()
+        assert st["state"] == "NORMAL"
+        assert len(st["nodes"]) == 3
+        assert sum(n["isPrimary"] for n in st["nodes"]) == 1
+
+    def test_consistent_coordinator(self, three_nodes):
+        coords = {s.cluster.coordinator_id() for s in three_nodes.servers}
+        assert len(coords) == 1
+
+
+class TestDistributedQueries:
+    def test_schema_broadcast(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f", {"type": "int", "min": 0,
+                                            "max": 100})
+        for cl in c.clients:
+            schema = cl.schema()
+            assert schema[0]["name"] == "i"
+            assert schema[0]["fields"][0]["options"]["type"] == "int"
+
+    def test_counts_from_every_node(self, three_nodes):
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        total = sum(len(v) for v in oracle.values())
+        for cl in c.clients:
+            (got,) = cl.query("i", "Count(All())")
+            assert got == total
+            for r, cols in oracle.items():
+                (cnt,) = cl.query("i", f"Count(Row(f={r}))")
+                assert cnt == len(cols), f"row {r}"
+
+    def test_row_columns_and_algebra(self, three_nodes):
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        (r1,) = c.client(1).query("i", "Row(f=1)")
+        assert r1["columns"] == sorted(oracle[1])
+        (ri,) = c.client(2).query("i", "Intersect(Row(f=1), Row(f=2))")
+        assert ri["columns"] == sorted(oracle[1] & oracle[2])
+        (ru,) = c.client(0).query("i", "Union(Row(f=1), Row(f=2))")
+        assert ru["columns"] == sorted(oracle[1] | oracle[2])
+
+    def test_topn_merged(self, three_nodes):
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        expect = sorted(((r, len(cols)) for r, cols in oracle.items()),
+                        key=lambda kv: (-kv[1], kv[0]))[:2]
+        (top,) = c.client(1).query("i", "TopN(f, n=2)")
+        assert [(p["id"], p["count"]) for p in top] == expect
+
+    def test_bsi_distributed(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "amount", {"type": "int",
+                                                 "min": -1000, "max": 1000})
+        cols = [0, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 2, 3 * SHARD_WIDTH + 3]
+        vals = [10, -20, 30, 40]
+        c.client(0).import_values("i", "amount", columnIDs=cols, values=vals)
+        for cl in c.clients:
+            (s,) = cl.query("i", "Sum(field=amount)")
+            assert s == {"value": 60, "count": 4}
+            (mn,) = cl.query("i", "Min(field=amount)")
+            assert mn == {"value": -20, "count": 1}
+            (r,) = cl.query("i", "Row(amount > 15)")
+            assert r["columns"] == [2 * SHARD_WIDTH + 2, 3 * SHARD_WIDTH + 3]
+
+    def test_writes_via_pql_from_any_node(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        far = 5 * SHARD_WIDTH + 123
+        assert c.client(2).query("i", f"Set({far}, f=9)") == [True]
+        assert c.client(1).query("i", f"Count(Row(f=9))") == [1]
+        assert c.client(0).query("i", f"Clear({far}, f=9)") == [True]
+        assert c.client(1).query("i", "Count(Row(f=9))") == [0]
+
+    def test_groupby_distributed(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "a")
+        c.client(0).create_field("i", "b")
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "a", rowIDs=[1, 1], columnIDs=[5, far])
+        c.client(0).import_bits("i", "b", rowIDs=[2, 3], columnIDs=[5, far])
+        (g,) = c.client(1).query("i", "GroupBy(Rows(a), Rows(b))")
+        got = sorted((tuple(fr["rowID"] for fr in grp["group"]),
+                      grp["count"]) for grp in g)
+        assert got == [((1, 2), 1), ((1, 3), 1)]
+
+
+class TestKeyedCluster:
+    def test_key_translation_replicated(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        # writes via different nodes: coordinator assigns, replicates
+        assert c.client(1).query("k", 'Set("alice", f="admin")') == [True]
+        assert c.client(2).query("k", 'Set("bob", f="admin")') == [True]
+        for cl in c.clients:
+            (r,) = cl.query("k", 'Row(f="admin")')
+            assert sorted(r["keys"]) == ["alice", "bob"]
+        (top,) = c.client(2).query("k", "TopN(f)")
+        assert top == [{"key": "admin", "count": 2}]
+
+    def test_unknown_key_reads_empty(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        c.client(0).query("k", 'Set("alice", f="admin")')
+        (r,) = c.client(1).query("k", 'Row(f="nosuch")')
+        assert r == {"keys": []}
+
+
+class TestReplicationAndFailover:
+    def test_replicated_write_lands_on_replicas(self, tmp_path):
+        with run_cluster(3, str(tmp_path), replicas=2) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).import_bits("i", "f", rowIDs=[1], columnIDs=[42])
+            owners = c.servers[0].cluster.shard_owners("i", 0)
+            assert len(owners) == 2
+            holders = 0
+            for s in c.servers:
+                idx = s.holder.index("i")
+                f = idx.field("f") if idx else None
+                v = f.standard_view() if f else None
+                frag = v.fragment(0) if v else None
+                if frag is not None and frag.row(1).contains(42):
+                    holders += 1
+            assert holders == 2
+
+    def test_failover_query_after_node_loss(self, tmp_path):
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=0.1) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6,
+                                    columnIDs=cols)
+            (before,) = c.client(0).query("i", "Count(Row(f=1))")
+            assert before == 6
+            # kill a non-coordinator node
+            coord = c.servers[0].cluster.coordinator_id()
+            victim = next(s for s in c.servers
+                          if s.cluster.node_id != coord)
+            survivor = next(s for s in c.servers if s is not victim)
+            victim.close()
+            # wait for liveness to notice
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(survivor.cluster.alive_ids()) == 2:
+                    break
+                time.sleep(0.05)
+            assert len(survivor.cluster.alive_ids()) == 2
+            from pilosa_tpu.api.client import Client
+            cl = Client("127.0.0.1", survivor.http.address[1])
+            (after,) = cl.query("i", "Count(Row(f=1))")
+            assert after == 6
+
+
+class TestAntiEntropy:
+    def test_repair_diverged_replica(self, tmp_path):
+        with run_cluster(2, str(tmp_path), replicas=2) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).import_bits("i", "f", rowIDs=[1, 2],
+                                    columnIDs=[10, 20])
+            # fabricate divergence: drop a row on node 1's replica only
+            frag_b = (c.servers[1].holder.index("i").field("f")
+                      .standard_view().fragment(0))
+            frag_b.clear_row(2)
+            assert not frag_b.row(2).any()
+            repaired = c.servers[0].cluster.sync_once()
+            assert repaired > 0
+            assert frag_b.row(2).contains(20)
+
+
+class TestResize:
+    def test_join_triggers_rebalance(self, tmp_path):
+        with run_cluster(1, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 1 for s in range(8)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 8,
+                                    columnIDs=cols)
+            # join a second node
+            from pilosa_tpu.cli.config import Config
+            from pilosa_tpu.server import PilosaTPUServer
+            cfg = Config(bind="127.0.0.1:0",
+                         data_dir=str(tmp_path / "late"),
+                         seeds=[c.servers[0].cluster.node_id],
+                         cluster_enabled=True,
+                         heartbeat_interval=0.2,
+                         anti_entropy_interval=0.0,
+                         mesh=False)
+            late = PilosaTPUServer(cfg).open()
+            try:
+                c.servers.append(late)
+                c.await_membership(2)
+                moved = []
+                for s in range(8):
+                    owners = late.cluster.shard_owners("i", s)
+                    if late.cluster.node_id in owners:
+                        moved.append(s)
+                assert moved, "placement should assign some shards to node 2"
+
+                def migrated() -> bool:
+                    idx = late.holder.index("i")
+                    f = idx.field("f") if idx else None
+                    v = f.standard_view() if f else None
+                    if v is None:
+                        return False
+                    return all(
+                        v.fragment(s) is not None and v.fragment(s).row(1).any()
+                        for s in moved)
+
+                import time
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and not migrated():
+                    time.sleep(0.05)
+                assert migrated(), f"shards {moved} not migrated"
+                # queries correct from both nodes
+                from pilosa_tpu.api.client import Client
+                cl = Client("127.0.0.1", late.http.address[1])
+                assert cl.query("i", "Count(Row(f=1))") == [8]
+                assert c.client(0).query("i", "Count(Row(f=1))") == [8]
+            finally:
+                if late in c.servers:
+                    c.servers.remove(late)
+                late.close()
+
+
+class TestClusterReviewRegressions:
+    def test_keyed_import_routed(self, three_nodes):
+        """Regression: forwarded keyed batches carry pre-translated IDs
+        and must bypass the keyed-input guard."""
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        changed = c.client(1).import_bits(
+            "k", "f", rowKeys=["admin", "user"],
+            columnKeys=["alice", "bob"])
+        assert changed == 2
+        for cl in c.clients:
+            (r,) = cl.query("k", 'Row(f="admin")')
+            assert r["keys"] == ["alice"]
+
+    def test_unknown_key_does_not_veto_siblings(self, three_nodes):
+        """Regression: a missing key is an empty row, not a query veto —
+        cluster must match single-node semantics."""
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        c.client(0).query("k", 'Set("alice", f="admin")')
+        (d,) = c.client(1).query(
+            "k", 'Difference(Row(f="admin"), Row(f="nosuch"))')
+        assert d["keys"] == ["alice"]
+        (n,) = c.client(2).query("k", 'Not(Row(f="nosuch"))')
+        assert n["keys"] == ["alice"]
+
+    def test_clear_does_not_create_keys(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        assert c.client(0).query("k", 'Clear("ghost", f="nothing")') == [False]
+        log = c.servers[0].executor.translate.columns("k")
+        assert log.translate(["ghost"], create=False) == [None]

@@ -189,3 +189,36 @@ class TestErrors:
         src = "Count(Intersect(Row(a=1), Row(b=2)))"
         c = parse1(src)
         assert pql.parse(str(c)).calls[0] == c
+
+
+class TestPqlRoundTrip:
+    """str(parse(s)) must re-parse to an identical AST — sub-queries are
+    shipped to peer nodes as PQL text."""
+
+    CASES = [
+        "Row(f=1)",
+        'Row(f="key with \\"quotes\\"")',
+        "Count(Intersect(Row(f=1), Row(g=2)))",
+        "Set(10, f=1, 2017-01-02T03:04)",
+        "Clear(10, f=1)",
+        "TopN(f, n=5, filter=Row(g=1))",
+        "TopN(f, ids=[1, 2, 3])",
+        "Row(amount > 5)",
+        "Row(amount <= -3)",
+        "Row(0 < amount < 100)",
+        "Row(5 <= amount <= 10)",
+        "Row(t=1, from=2017-01-01T00:00, to=2018-01-01T00:00)",
+        "Rows(f, limit=10, previous=3)",
+        "GroupBy(Rows(a), Rows(b), filter=Row(x=1), limit=7)",
+        "Store(Row(f=1), g=7)",
+        "Sum(Row(f=1), field=amount)",
+        "Options(Row(f=1), shards=[0, 2])",
+        "Row(b=true) Row(c=false)",
+    ]
+
+    def test_round_trip(self):
+        from pilosa_tpu.pql import parse
+        for src in self.CASES:
+            q1 = parse(src)
+            q2 = parse(str(q1))
+            assert q1 == q2, f"{src!r} -> {str(q1)!r}"
