@@ -1,0 +1,67 @@
+// ASAN-built round-trip test for the native codec (run via `make check`).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+int64_t rc_cardinality(const uint8_t*, size_t);
+int64_t rc_deserialize(const uint8_t*, size_t, uint64_t*, size_t);
+int64_t rc_serialize(const uint64_t*, size_t, uint8_t*, size_t);
+int64_t rc_serialized_bound(const uint64_t*, size_t);
+int64_t rc_expand_plane(const uint8_t*, size_t, uint64_t, const uint64_t*,
+                        size_t, uint32_t*, size_t);
+int64_t rc_pack_columns(const uint32_t*, size_t, uint32_t*, size_t);
+int64_t rc_popcount(const uint32_t*, size_t);
+}
+
+static void round_trip(const std::vector<uint64_t>& positions) {
+  int64_t bound = rc_serialized_bound(positions.data(), positions.size());
+  std::vector<uint8_t> blob(bound);
+  int64_t len =
+      rc_serialize(positions.data(), positions.size(), blob.data(), bound);
+  assert(len > 0);
+  assert(rc_cardinality(blob.data(), len) == (int64_t)positions.size());
+  std::vector<uint64_t> out(positions.size());
+  int64_t m = rc_deserialize(blob.data(), len, out.data(), out.size());
+  assert(m == (int64_t)positions.size());
+  for (size_t i = 0; i < positions.size(); i++) assert(out[i] == positions[i]);
+}
+
+int main() {
+  // array, run, bitmap, 64-bit keys, container boundaries
+  round_trip({0, 1, 5, 100, 65535});
+  round_trip({0, 65535, 65536, 65537, 1ull << 20, (1ull << 20) + 3});
+  round_trip({1ull << 32, (1ull << 40) + 7, 1ull << 45});
+  std::vector<uint64_t> run;
+  for (uint64_t v = 10; v < 50000; v++) run.push_back(v);
+  round_trip(run);
+  std::vector<uint64_t> dense;
+  for (uint64_t v = 0; v < 65536; v += 2) dense.push_back(v | (7ull << 16));
+  round_trip(dense);
+  round_trip({});
+
+  // expand_plane: rows 3 and 9 of a 64-bit-wide row space
+  std::vector<uint64_t> pos = {3 * 64 + 1, 3 * 64 + 33, 9 * 64 + 0};
+  std::vector<uint8_t> blob(rc_serialized_bound(pos.data(), pos.size()));
+  int64_t len = rc_serialize(pos.data(), pos.size(), blob.data(), blob.size());
+  assert(len > 0);
+  uint64_t slots[2] = {3, 9};
+  uint32_t plane[2 * 2] = {0, 0, 0, 0};  // 2 rows x 2 words (64 bits)
+  int64_t set = rc_expand_plane(blob.data(), len, 64, slots, 2, plane, 2);
+  assert(set == 3);
+  assert(plane[0] == (1u << 1));
+  assert(plane[1] == (1u << 1));  // bit 33 -> word 1 bit 1
+  assert(plane[2] == 1u);
+  assert(rc_popcount(plane, 4) == 3);
+
+  uint32_t words[4] = {0, 0, 0, 0};
+  uint32_t cols[3] = {0, 33, 127};
+  assert(rc_pack_columns(cols, 3, words, 4) == 3);
+  assert(rc_popcount(words, 4) == 3);
+
+  printf("native codec: all checks passed\n");
+  return 0;
+}

@@ -1,0 +1,111 @@
+"""ctypes loader for the native roaring codec (native/roaring_codec.cpp).
+
+The native slot of SURVEY.md §3.4: fragment blob parse/serialize and
+dense-plane expansion in C++ at memory bandwidth.  Byte-compatible with
+the pure-Python codec in :mod:`pilosa_tpu.store.roaring`, which remains
+the always-available fallback (``PILOSA_NO_NATIVE=1`` forces it).
+
+Build: ``make -C native`` → ``native/libroaring_codec.so``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "libroaring_codec.so")
+
+_ERRORS = {-1: "truncated buffer", -2: "bad magic/version",
+           -3: "bad container type", -4: "output buffer too small",
+           -5: "positions not sorted/unique"}
+
+
+def _load():
+    if os.environ.get("PILOSA_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rc_cardinality.restype = ctypes.c_int64
+    lib.rc_cardinality.argtypes = [u8p, ctypes.c_size_t]
+    lib.rc_deserialize.restype = ctypes.c_int64
+    lib.rc_deserialize.argtypes = [u8p, ctypes.c_size_t, u64p,
+                                   ctypes.c_size_t]
+    lib.rc_serialize.restype = ctypes.c_int64
+    lib.rc_serialize.argtypes = [u64p, ctypes.c_size_t, u8p,
+                                 ctypes.c_size_t]
+    lib.rc_serialized_bound.restype = ctypes.c_int64
+    lib.rc_serialized_bound.argtypes = [u64p, ctypes.c_size_t]
+    lib.rc_expand_plane.restype = ctypes.c_int64
+    lib.rc_expand_plane.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64,
+                                    u64p, ctypes.c_size_t, u32p,
+                                    ctypes.c_size_t]
+    return lib
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def _check(rc: int, what: str) -> int:
+    if rc < 0:
+        raise ValueError(
+            f"native codec {what}: {_ERRORS.get(rc, f'error {rc}')}")
+    return rc
+
+
+def _u8(buf) -> ctypes.POINTER(ctypes.c_uint8):
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr
+
+
+def deserialize(buf: bytes) -> np.ndarray:
+    ptr, keep = _u8(buf)
+    card = _check(_lib.rc_cardinality(ptr, len(buf)), "cardinality")
+    out = np.empty(card, dtype=np.uint64)
+    got = _check(_lib.rc_deserialize(
+        ptr, len(buf), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        card), "deserialize")
+    return out[:got]
+
+
+def serialize(positions: np.ndarray) -> bytes:
+    positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    p64 = positions.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+    bound = _check(_lib.rc_serialized_bound(p64, len(positions)), "bound")
+    out = np.empty(bound, dtype=np.uint8)
+    n = _check(_lib.rc_serialize(
+        p64, len(positions),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), bound),
+        "serialize")
+    return out[:n].tobytes()
+
+
+def expand_plane(buf: bytes, row_width: int, row_slots: np.ndarray,
+                 plane: np.ndarray) -> int:
+    """Expand a fragment blob directly into a zeroed dense plane
+    ``uint32[n_rows, words_per_row]``; ``row_slots`` = sorted row ids of
+    the plane's rows.  Returns bits set."""
+    ptr, keep = _u8(buf)
+    row_slots = np.ascontiguousarray(row_slots, dtype=np.uint64)
+    if plane.dtype != np.uint32 or not plane.flags.c_contiguous:
+        raise ValueError("plane must be C-contiguous uint32")
+    return _check(_lib.rc_expand_plane(
+        ptr, len(buf), row_width,
+        row_slots.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(row_slots),
+        plane.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        plane.shape[-1]), "expand_plane")

@@ -122,8 +122,12 @@ def _group_by_high(positions: np.ndarray, shift: int) -> tuple[np.ndarray, list[
 
 
 def serialize(positions: np.ndarray) -> bytes:
-    """Sorted-or-not uint64 bit positions -> pilosa-format bytes."""
+    """Sorted-or-not uint64 bit positions -> pilosa-format bytes.
+    Dispatches to the C++ codec when built (byte-identical output)."""
     positions = np.unique(np.asarray(positions, dtype=np.uint64))
+    from pilosa_tpu.store import native
+    if native.available():
+        return native.serialize(positions)
     keys, lows_per = _group_by_high(positions, 16)
     n = len(keys)
     out = bytearray()
@@ -162,6 +166,9 @@ def deserialize(buf: bytes | memoryview) -> np.ndarray:
         raise ValueError("roaring: buffer too short")
     magic, = struct.unpack_from("<H", buf, 0)
     if magic == MAGIC:
+        from pilosa_tpu.store import native
+        if native.available():
+            return native.deserialize(bytes(buf))
         return _deserialize_pilosa(buf)
     cookie, = struct.unpack_from("<I", buf, 0)
     if cookie == COOKIE_NO_RUN or (cookie & 0xFFFF) == COOKIE_RUN:

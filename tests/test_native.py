@@ -1,0 +1,114 @@
+"""Native C++ codec tests: byte-compatibility with the Python codec and
+the dense-plane expansion path.  Skipped when the .so is not built
+(build with ``make -C native``)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import WORDS_PER_SHARD, pack_columns
+from pilosa_tpu.store import native, roaring
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native codec not built")
+
+
+def _py_serialize(positions):
+    """Force the pure-Python encoder regardless of native presence."""
+    positions = np.unique(np.asarray(positions, dtype=np.uint64))
+    keys, lows_per = roaring._group_by_high(positions, 16)
+    import struct
+    out = bytearray()
+    out += struct.pack("<HHI", roaring.MAGIC, roaring.VERSION, len(keys))
+    payloads, meta = [], []
+    for key, lows in zip(keys, lows_per):
+        ctype, payload = roaring._best_container(lows)
+        if ctype == roaring.TYPE_ARRAY:
+            data = payload.astype("<u2").tobytes()
+        elif ctype == roaring.TYPE_BITMAP:
+            data = payload.astype("<u8").tobytes()
+        else:
+            starts, lasts = payload
+            data = struct.pack("<H", len(starts)) + np.column_stack(
+                (starts, lasts)).astype("<u2").tobytes()
+        payloads.append(data)
+        meta.append((int(key), ctype, len(lows)))
+    for key, ctype, card in meta:
+        out += struct.pack("<QHH", key, ctype, card - 1)
+    off = len(out) + 4 * len(keys)
+    for data in payloads:
+        out += struct.pack("<I", off)
+        off += len(data)
+    for data in payloads:
+        out += data
+    return bytes(out)
+
+
+CASES = [
+    np.array([], np.uint64),
+    np.array([0, 1, 5, 100, 65535], np.uint64),
+    np.array([0, 65535, 65536, 65537, 1 << 20, (1 << 20) + 3], np.uint64),
+    np.array([1 << 32, (1 << 40) + 7, 1 << 45], np.uint64),
+    np.arange(10, 50000, dtype=np.uint64),                 # run
+    np.arange(0, 8194, 2, dtype=np.uint64),                # bitmap boundary
+]
+
+
+class TestByteCompatibility:
+    @pytest.mark.parametrize("positions", CASES, ids=range(len(CASES)))
+    def test_identical_bytes(self, positions):
+        assert native.serialize(positions) == _py_serialize(positions)
+
+    def test_cross_decode(self, rng):
+        mixed = np.unique(np.concatenate([
+            rng.choice(1 << 22, size=5000, replace=False),
+            np.arange(200000, 270000),
+        ]).astype(np.uint64))
+        # python encodes -> native decodes
+        np.testing.assert_array_equal(
+            native.deserialize(_py_serialize(mixed)), mixed)
+        # native encodes -> python decodes
+        np.testing.assert_array_equal(
+            roaring._deserialize_pilosa(memoryview(native.serialize(mixed))),
+            mixed)
+
+    def test_random_round_trips(self, rng):
+        for _ in range(5):
+            n = int(rng.integers(1, 100000))
+            positions = np.unique(
+                rng.integers(0, 1 << 44, size=n, dtype=np.uint64))
+            np.testing.assert_array_equal(
+                native.deserialize(native.serialize(positions)), positions)
+
+    def test_error_on_garbage(self):
+        with pytest.raises(ValueError):
+            native.deserialize(b"\x00\x01\x02\x03\x04\x05\x06\x07")
+
+
+class TestExpandPlane:
+    def test_matches_row_materialization(self, rng):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        rows = np.array([3, 9, 77], np.uint64)
+        positions = []
+        expect = {}
+        for r in rows:
+            cols = np.sort(rng.choice(SHARD_WIDTH, 500, replace=False))
+            expect[int(r)] = cols
+            positions.append(r * np.uint64(SHARD_WIDTH) +
+                             cols.astype(np.uint64))
+        blob = roaring.serialize(np.concatenate(positions))
+        plane = np.zeros((3, WORDS_PER_SHARD), np.uint32)
+        set_bits = native.expand_plane(blob, SHARD_WIDTH, rows, plane)
+        assert set_bits == 1500
+        for i, r in enumerate(rows):
+            np.testing.assert_array_equal(plane[i],
+                                          pack_columns(expect[int(r)]))
+
+    def test_skips_unmapped_rows(self):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        positions = np.array([5, SHARD_WIDTH + 5], np.uint64)  # rows 0, 1
+        blob = roaring.serialize(positions)
+        plane = np.zeros((1, WORDS_PER_SHARD), np.uint32)
+        got = native.expand_plane(blob, SHARD_WIDTH,
+                                  np.array([1], np.uint64), plane)
+        assert got == 1
+        assert plane[0, 0] == 1 << 5
