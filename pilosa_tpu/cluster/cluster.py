@@ -434,9 +434,58 @@ class Cluster:
                                 continue
                             repaired += self._sync_fragment(
                                 peer, iname, fname, vname, shard, frag)
+        repaired += self._sync_attrs()
         if repaired:
             self.logger.info("anti-entropy repaired %d blocks", repaired)
             self.stats.count("aae_blocks_repaired", repaired)
+        return repaired
+
+    def _sync_attrs(self) -> int:
+        """AAE for attribute stores (reference: AttrStore block sync,
+        SURVEY.md §4.6).  Attr stores are fully replicated: diff with
+        every alive peer, merge differing blocks both ways."""
+        import os
+        repaired = 0
+        holder = self.api.holder
+        targets: list[tuple[str, str]] = []  # (index, field-or-"")
+        for iname, idx in list(holder.indexes.items()):
+            if os.path.exists(os.path.join(idx.path, "_attrs.db")):
+                targets.append((iname, ""))
+            for fname, f in list(idx.fields.items()):
+                if os.path.exists(os.path.join(f.path, "_attrs.db")):
+                    targets.append((iname, fname))
+        for iname, fname in targets:
+            idx = holder.index(iname)
+            store = (idx.field(fname).row_attrs if fname
+                     else idx.column_attrs)
+            qs = f"index={iname}&field={fname}"
+            for peer in self.alive_ids():
+                if peer == self.node_id:
+                    continue
+                try:
+                    theirs = self._client(peer)._json(
+                        "GET", f"/internal/attrs/blocks?{qs}")["blocks"]
+                except Exception:  # noqa: BLE001 — peer down
+                    continue
+                theirs = {int(k): v for k, v in theirs.items()}
+                ours = store.blocks()
+                for block in sorted(b for b in set(ours) | set(theirs)
+                                    if ours.get(b) != theirs.get(b)):
+                    try:
+                        items = self._client(peer)._json(
+                            "GET", f"/internal/attrs/block?{qs}"
+                            f"&block={block}")["items"]
+                        store.merge_items({int(k): v
+                                           for k, v in items.items()})
+                        mine = store.block_items(block)
+                        self._client(peer)._json(
+                            "POST", f"/internal/attrs/merge?{qs}",
+                            {"items": {str(k): v
+                                       for k, v in mine.items()}})
+                        repaired += 1
+                    except Exception as e:  # noqa: BLE001
+                        self.logger.warning("attr aae %s/%s block %d: %s",
+                                            iname, fname, block, e)
         return repaired
 
     def _sync_fragment(self, peer: str, index: str, field: str, view: str,

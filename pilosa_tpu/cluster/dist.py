@@ -24,6 +24,8 @@ from pilosa_tpu.pql import parse
 from pilosa_tpu.pql.ast import Call, Condition, Query
 
 WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store"})
+# attrs are replicated everywhere (not sharded): broadcast writes
+ATTR_CALLS = frozenset({"SetRowAttrs", "SetColumnAttrs"})
 
 _MAX_U64 = (1 << 64) - 1
 
@@ -66,7 +68,10 @@ class DistributedExecutor:
         query = parse(pql)
         out = []
         for call in query.calls:
-            if _call_of(call).name in WRITE_CALLS:
+            name = _call_of(call).name
+            if name in ATTR_CALLS:
+                out.append(self._attr_write(index, call))
+            elif name in WRITE_CALLS:
                 out.append(self._write(index, call))
             else:
                 out.append(self._read(index, call, shards))
@@ -118,6 +123,13 @@ class DistributedExecutor:
             changed = changed or bool(r[0])
         return changed
 
+    def _attr_write(self, index: str, call: Call):
+        """SetRowAttrs/SetColumnAttrs apply on every alive node — attr
+        stores are fully replicated, AAE repairs missed nodes."""
+        call = self._translate_input(index, call, create=True)
+        self._run_on(index, call, self.cluster.alive_ids(), shards=None)
+        return None
+
     def _run_on(self, index: str, call: Call, node_ids, shards):
         """Execute one call on each named node (replica-synchronous for
         writes); returns the primary's (first) result."""
@@ -158,6 +170,11 @@ class DistributedExecutor:
                     new.args[k] = walk(v)
             if isinstance(new.args.get("_col"), str):
                 new.args["_col"] = resolve(None, new.args["_col"])
+            if isinstance(new.args.get("_row"), str):
+                fname = new.args.get("_field")
+                f = idx.field(str(fname)) if fname else None
+                if f is not None and f.options.keys:
+                    new.args["_row"] = resolve(str(fname), new.args["_row"])
             if isinstance(new.args.get("column"), str):
                 cid = self.cluster.translate_keys(
                     index, None, [new.args["column"]], create=False)[0]

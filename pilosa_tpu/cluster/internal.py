@@ -162,6 +162,37 @@ def h_fragment_merge(self: Handler) -> None:
     self._reply({"changed": changed})
 
 
+def _attr_store(self: Handler):
+    api = self.server.api
+    idx = api.holder.index(_qs(self, "index"))
+    if idx is None:
+        raise ApiError("index not found", 404)
+    field = self.query.get("field", [""])[0]
+    if field:
+        f = idx.field(field)
+        if f is None:
+            raise ApiError("field not found", 404)
+        return f.row_attrs
+    return idx.column_attrs
+
+
+def h_attr_blocks(self: Handler) -> None:
+    store = _attr_store(self)
+    self._reply({"blocks": {str(k): v for k, v in store.blocks().items()}})
+
+
+def h_attr_block(self: Handler) -> None:
+    store = _attr_store(self)
+    items = store.block_items(int(_qs(self, "block")))
+    self._reply({"items": {str(k): v for k, v in items.items()}})
+
+
+def h_attr_merge(self: Handler) -> None:
+    store = _attr_store(self)
+    items = {int(k): v for k, v in self._json_body()["items"].items()}
+    self._reply({"changed": store.merge_items(items)})
+
+
 def h_resize_push(self: Handler) -> None:
     b = self._json_body()
     _cluster(self).push_fragment(b["index"], b["field"], b["view"],
@@ -195,3 +226,6 @@ def register_internal_routes(router: Router) -> None:
     router.add("POST", "/internal/fragment/merge", h_fragment_merge)
     router.add("POST", "/internal/resize/push", h_resize_push)
     router.add("POST", "/internal/resize/trigger", h_resize_trigger)
+    router.add("GET", "/internal/attrs/blocks", h_attr_blocks)
+    router.add("GET", "/internal/attrs/block", h_attr_block)
+    router.add("POST", "/internal/attrs/merge", h_attr_merge)

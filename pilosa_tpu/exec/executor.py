@@ -43,6 +43,7 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 RESERVED_KEYS = frozenset({
     "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
     "like", "previous", "aggregate", "sort", "shards", "index",
+    "attrName", "attrValue", "columnAttrs",
 })
 
 _BITMAP_CALLS = frozenset({
@@ -133,7 +134,16 @@ class Executor:
         if call.name == "Options":
             if len(call.children) != 1:
                 raise ExecutionError("Options: exactly one child required")
-            return self._call(ctx, call.children[0])
+            result = self._call(ctx, call.children[0])
+            # columnAttrs=true attaches column attribute maps to a row
+            # result (reference: QueryRequest.ColumnAttrs)
+            if call.args.get("columnAttrs") and isinstance(result, RowResult):
+                store = ctx.index.column_attrs
+                result.attrs = {int(c): a for c, a in
+                                zip(result.columns,
+                                    store.attrs_many(result.columns))
+                                if a}
+            return result
         if call.name in _BITMAP_CALLS:
             words = self._bitmap(ctx, call)
             return self._to_row_result(ctx, words)
@@ -415,6 +425,14 @@ class Executor:
         counts = kernels.row_counts(ps.plane, filter_words)  # [S, R_pad]
         totals = jnp.sum(counts, axis=0)                     # [R_pad]
         ids_arg = call.args.get("ids")
+        attr_name = call.args.get("attrName")
+        if attr_name is not None:
+            # restrict to rows whose attr matches (reference:
+            # ``fragment.top`` attrName/attrValue filtering)
+            ids_arg = list(ids_arg or []) + field.row_attrs.find_ids(
+                str(attr_name), call.args.get("attrValue"))
+            if not ids_arg:
+                return PairsResult([])
         if ids_arg is not None:
             keep = np.zeros(totals.shape[0], dtype=bool)
             for rid in ids_arg:
@@ -613,6 +631,32 @@ class Executor:
                 if frag is not None:
                     changed += frag.clear_row(row_id)
         return changed > 0
+
+    def _execute_setrowattrs(self, ctx: _Ctx, call: Call):
+        """SetRowAttrs(f, row, k=v, ...) — reference: row AttrStore write
+        (``executor.go#executeSetRowAttrs``)."""
+        fname = call.args.get("_field")
+        if fname is None:
+            raise ExecutionError("SetRowAttrs: missing field")
+        field = self._field(ctx, str(fname))
+        row = call.args.get("_row")
+        if row is None:
+            raise ExecutionError("SetRowAttrs: missing row")
+        row_id = self._row_id(ctx, field, row, create=True)
+        attrs = {k: v for k, v in call.args.items()
+                 if not k.startswith("_") and k not in RESERVED_KEYS}
+        field.row_attrs.set_attrs(int(row_id), attrs)
+        return None
+
+    def _execute_setcolumnattrs(self, ctx: _Ctx, call: Call):
+        col = call.args.get("_col")
+        if col is None:
+            raise ExecutionError("SetColumnAttrs: missing column")
+        col_id = self._col_id(ctx, col, create=True)
+        attrs = {k: v for k, v in call.args.items()
+                 if not k.startswith("_") and k not in RESERVED_KEYS}
+        ctx.index.column_attrs.set_attrs(int(col_id), attrs)
+        return None
 
     def _execute_store(self, ctx: _Ctx, call: Call) -> bool:
         if len(call.children) != 1:

@@ -22,11 +22,14 @@ class RowResult:
     columns: np.ndarray = dc_field(
         default_factory=lambda: np.empty(0, np.uint64))
     keys: list[str] | None = None
+    attrs: dict | None = None  # column attrs (Options columnAttrs=true)
 
     def to_json(self):
-        if self.keys is not None:
-            return {"keys": self.keys}
-        return {"columns": [int(c) for c in self.columns]}
+        out = ({"keys": self.keys} if self.keys is not None
+               else {"columns": [int(c) for c in self.columns]})
+        if self.attrs is not None:
+            out["attrs"] = {str(k): v for k, v in self.attrs.items()}
+        return out
 
 
 @dataclass

@@ -1,0 +1,130 @@
+"""AttrStore: arbitrary key/value attributes per row or column.
+
+Reference: ``attrstore.go`` (SURVEY.md §3.1) — BoltDB-backed KV with
+block checksums for anti-entropy.  The rebuild uses stdlib sqlite3 (no
+BoltDB in Python; sqlite is the boring durable KV at hand): one store
+per index (column attrs) and per field (row attrs), attrs stored as a
+JSON object per id, merged on write like upstream (``SetAttrs`` updates
+keys, ``null`` deletes a key).
+
+Block checksums (``HASH_BLOCK_SIZE`` ids per block) support the same
+AAE diff protocol as fragments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import zlib
+
+HASH_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        with self._conn() as c:
+            c.execute("CREATE TABLE IF NOT EXISTS attrs ("
+                      "id INTEGER PRIMARY KEY, data TEXT NOT NULL)")
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path)
+            conn.isolation_level = None  # autocommit; writes are atomic
+            self._local.conn = conn
+        return conn
+
+    # -- api ----------------------------------------------------------------
+
+    def set_attrs(self, item_id: int, attrs: dict) -> dict:
+        """Merge attrs into the item's map (``None`` value deletes the
+        key, as upstream); returns the resulting map."""
+        with self._lock:
+            conn = self._conn()
+            cur = conn.execute("SELECT data FROM attrs WHERE id=?",
+                               (item_id,))
+            row = cur.fetchone()
+            current = json.loads(row[0]) if row else {}
+            for k, v in attrs.items():
+                if v is None:
+                    current.pop(k, None)
+                else:
+                    current[k] = v
+            if current:
+                conn.execute(
+                    "INSERT INTO attrs(id, data) VALUES(?, ?) "
+                    "ON CONFLICT(id) DO UPDATE SET data=excluded.data",
+                    (item_id, json.dumps(current, sort_keys=True)))
+            else:
+                conn.execute("DELETE FROM attrs WHERE id=?", (item_id,))
+            return current
+
+    def attrs(self, item_id: int) -> dict:
+        cur = self._conn().execute("SELECT data FROM attrs WHERE id=?",
+                                   (item_id,))
+        row = cur.fetchone()
+        return json.loads(row[0]) if row else {}
+
+    def attrs_many(self, ids) -> list[dict]:
+        return [self.attrs(int(i)) for i in ids]
+
+    def find_ids(self, name: str, value) -> list[int]:
+        """IDs whose attr ``name`` equals ``value`` (TopN attrName/
+        attrValue filter, reference: ``fragment.top`` attr filtering)."""
+        out = []
+        cur = self._conn().execute("SELECT id, data FROM attrs")
+        for item_id, data in cur.fetchall():
+            if json.loads(data).get(name) == value:
+                out.append(int(item_id))
+        return out
+
+    # -- anti-entropy -------------------------------------------------------
+
+    def blocks(self) -> dict[int, int]:
+        """Per-block CRC of (id, canonical-json) pairs."""
+        out: dict[int, int] = {}
+        cur = self._conn().execute("SELECT id, data FROM attrs ORDER BY id")
+        for item_id, data in cur.fetchall():
+            blk = int(item_id) // HASH_BLOCK_SIZE
+            crc = out.get(blk, 0)
+            crc = zlib.crc32(f"{item_id}:{data}".encode(), crc)
+            out[blk] = crc
+        return out
+
+    def block_items(self, block: int) -> dict[int, dict]:
+        lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
+        cur = self._conn().execute(
+            "SELECT id, data FROM attrs WHERE id>=? AND id<?", (lo, hi))
+        return {int(i): json.loads(d) for i, d in cur.fetchall()}
+
+    def merge_items(self, items: dict[int, dict]) -> int:
+        """Union-merge attr maps (peer's keys fill in missing; local keys
+        win conflicts — deterministic for AAE convergence both ways)."""
+        changed = 0
+        for item_id, attrs in items.items():
+            with self._lock:
+                conn = self._conn()
+                cur = conn.execute("SELECT data FROM attrs WHERE id=?",
+                                   (item_id,))
+                row = cur.fetchone()
+                current = json.loads(row[0]) if row else {}
+                merged = {**attrs, **current}
+                if merged != current:
+                    conn.execute(
+                        "INSERT INTO attrs(id, data) VALUES(?, ?) "
+                        "ON CONFLICT(id) DO UPDATE SET data=excluded.data",
+                        (item_id, json.dumps(merged, sort_keys=True)))
+                    changed += 1
+        return changed
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None

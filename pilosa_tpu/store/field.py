@@ -91,6 +91,7 @@ class Field:
         self.options = options or FieldOptions()
         self.fsync = fsync
         self.views: dict[str, View] = {}
+        self._row_attrs = None
         self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -117,6 +118,20 @@ class Field:
     def close(self) -> None:
         for v in self.views.values():
             v.close()
+        if self._row_attrs is not None:
+            self._row_attrs.close()
+            self._row_attrs = None
+
+    @property
+    def row_attrs(self):
+        """Row attribute store (reference: field-level AttrStore,
+        ``field.go``), created on first use."""
+        with self._lock:
+            if self._row_attrs is None:
+                from pilosa_tpu.store.attrs import AttrStore
+                self._row_attrs = AttrStore(
+                    os.path.join(self.path, "_attrs.db"))
+            return self._row_attrs
 
     # -- views --------------------------------------------------------------
 

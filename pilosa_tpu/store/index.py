@@ -29,6 +29,7 @@ class Index:
         self.track_existence = track_existence
         self.fsync = fsync
         self.fields: dict[str, Field] = {}
+        self._column_attrs = None
         self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -60,6 +61,9 @@ class Index:
     def close(self) -> None:
         for f in self.fields.values():
             f.close()
+        if self._column_attrs is not None:
+            self._column_attrs.close()
+            self._column_attrs = None
 
     # -- fields -------------------------------------------------------------
 
@@ -96,6 +100,17 @@ class Index:
     @property
     def existence_field(self) -> Field | None:
         return self.fields.get(EXISTENCE_FIELD)
+
+    @property
+    def column_attrs(self):
+        """Column attribute store (reference: index-level AttrStore,
+        ``index.go``/``attrstore.go``), created on first use."""
+        with self._lock:
+            if self._column_attrs is None:
+                from pilosa_tpu.store.attrs import AttrStore
+                self._column_attrs = AttrStore(
+                    os.path.join(self.path, "_attrs.db"))
+            return self._column_attrs
 
     # -- column tracking ----------------------------------------------------
 

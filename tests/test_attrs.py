@@ -1,0 +1,109 @@
+"""AttrStore + attr PQL call tests (reference: ``attrstore.go`` and
+``executor.go#executeSetRowAttrs``; SURVEY.md §3.1)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.store import FieldOptions, Holder
+from pilosa_tpu.store.attrs import AttrStore
+
+
+class TestAttrStore:
+    def test_merge_and_delete_semantics(self, tmp_path):
+        s = AttrStore(str(tmp_path / "a.db"))
+        assert s.set_attrs(1, {"name": "x", "rank": 5}) == \
+            {"name": "x", "rank": 5}
+        assert s.set_attrs(1, {"rank": 9}) == {"name": "x", "rank": 9}
+        assert s.set_attrs(1, {"name": None}) == {"rank": 9}
+        assert s.attrs(1) == {"rank": 9}
+        assert s.attrs(99) == {}
+
+    def test_persistence(self, tmp_path):
+        path = str(tmp_path / "a.db")
+        AttrStore(path).set_attrs(7, {"k": "v"})
+        assert AttrStore(path).attrs(7) == {"k": "v"}
+
+    def test_find_ids(self, tmp_path):
+        s = AttrStore(str(tmp_path / "a.db"))
+        s.set_attrs(1, {"color": "red"})
+        s.set_attrs(2, {"color": "blue"})
+        s.set_attrs(3, {"color": "red"})
+        assert s.find_ids("color", "red") == [1, 3]
+
+    def test_blocks_and_merge(self, tmp_path):
+        a = AttrStore(str(tmp_path / "a.db"))
+        b = AttrStore(str(tmp_path / "b.db"))
+        a.set_attrs(1, {"x": 1})
+        b.set_attrs(1, {"x": 1})
+        assert a.blocks() == b.blocks()
+        a.set_attrs(250, {"y": 2})  # block 2 differs
+        diff = [blk for blk in set(a.blocks()) | set(b.blocks())
+                if a.blocks().get(blk) != b.blocks().get(blk)]
+        assert diff == [2]
+        b.merge_items(a.block_items(2))
+        assert a.blocks() == b.blocks()
+
+    def test_merge_local_wins_conflicts(self, tmp_path):
+        s = AttrStore(str(tmp_path / "a.db"))
+        s.set_attrs(1, {"k": "local"})
+        s.merge_items({1: {"k": "remote", "extra": 1}})
+        assert s.attrs(1) == {"k": "local", "extra": 1}
+
+
+class TestAttrCalls:
+    @pytest.fixture
+    def env(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        return holder, idx, Executor(holder)
+
+    def test_set_row_attrs(self, env):
+        holder, idx, ex = env
+        ex.execute("i", 'SetRowAttrs(f, 10, team="red", rank=5)')
+        assert idx.field("f").row_attrs.attrs(10) == \
+            {"team": "red", "rank": 5}
+
+    def test_set_column_attrs(self, env):
+        holder, idx, ex = env
+        ex.execute("i", 'SetColumnAttrs(3, plan="pro")')
+        assert idx.column_attrs.attrs(3) == {"plan": "pro"}
+
+    def test_column_attrs_in_row_result(self, env):
+        holder, idx, ex = env
+        ex.execute("i", 'Set(1, f=10) Set(2, f=10) '
+                        'SetColumnAttrs(1, plan="pro")')
+        (r,) = ex.execute("i", "Options(Row(f=10), columnAttrs=true)")
+        assert r.attrs == {1: {"plan": "pro"}}
+        assert r.to_json() == {"columns": [1, 2],
+                               "attrs": {"1": {"plan": "pro"}}}
+
+    def test_topn_attr_filter(self, env):
+        holder, idx, ex = env
+        ex.execute("i", "Set(1, f=10) Set(2, f=10) Set(3, f=20)"
+                        'SetRowAttrs(f, 10, cat="a")'
+                        'SetRowAttrs(f, 20, cat="b")')
+        (p,) = ex.execute("i", 'TopN(f, attrName="cat", attrValue="a")')
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 2)]
+        (p2,) = ex.execute("i", 'TopN(f, attrName="cat", attrValue="zzz")')
+        assert p2.pairs == []
+
+
+class TestClusterAttrs:
+    def test_attrs_broadcast_and_aae(self, tmp_path):
+        from pilosa_tpu.testing import run_cluster
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(1).query("i", 'SetRowAttrs(f, 10, team="red")')
+            # broadcast applied on both nodes
+            for s in c.servers:
+                assert s.holder.index("i").field("f").row_attrs.attrs(10) \
+                    == {"team": "red"}
+            # diverge one node, AAE repairs
+            c.servers[1].holder.index("i").field("f").row_attrs.set_attrs(
+                20, {"team": "blue"})
+            assert c.servers[0].cluster.sync_once() > 0
+            assert c.servers[0].holder.index("i").field("f") \
+                .row_attrs.attrs(20) == {"team": "blue"}
