@@ -2,11 +2,12 @@
 placement, SPMD query programs with ICI collectives, and host-level
 jump-hash shard placement."""
 
-from pilosa_tpu.parallel.mesh import MeshPlacement, local_placement
+from pilosa_tpu.parallel.mesh import (MeshPlacement, MeshPlacement2D,
+                                      local_placement)
 from pilosa_tpu.parallel.placement import (jump_hash, partition_nodes,
                                            shard_nodes, shard_partition)
 
 __all__ = [
-    "MeshPlacement", "local_placement", "jump_hash", "shard_partition",
-    "partition_nodes", "shard_nodes",
+    "MeshPlacement", "MeshPlacement2D", "local_placement", "jump_hash",
+    "shard_partition", "partition_nodes", "shard_nodes",
 ]

@@ -51,6 +51,55 @@ class MeshPlacement:
         return jax.device_put(host_array, self.sharding(host_array.ndim))
 
 
+WORDS_AXIS = "words"
+
+
+class MeshPlacement2D:
+    """2D mesh ``(shard, words)``: shards across one axis AND each
+    shard's packed-word axis split across the other — the rebuild's
+    context-parallel analogue (SURVEY.md §3.5/§6: "split one shard's
+    word axis across chips with partial popcounts psum-reduced").  Used
+    when row-count × shard-width exceeds per-chip HBM: a row's 32768
+    words live on ``words_size`` chips, counts reduce over both axes.
+
+    Drop-in for :class:`MeshPlacement` in the executor/PlaneCache: the
+    same eager kernels run under GSPMD with reductions compiling to
+    collectives over both mesh axes.
+    """
+
+    def __init__(self, devices: list | None = None, shard_size: int = 1,
+                 words_size: int = 2, shard_axis: str = SHARD_AXIS,
+                 words_axis: str = WORDS_AXIS):
+        if devices is None:
+            devices = jax.devices()
+        if shard_size * words_size != len(devices):
+            raise ValueError(
+                f"mesh {shard_size}x{words_size} needs "
+                f"{shard_size * words_size} devices, have {len(devices)}")
+        self.shard_axis, self.words_axis = shard_axis, words_axis
+        self.mesh = Mesh(
+            np.array(devices).reshape(shard_size, words_size),
+            (shard_axis, words_axis))
+        self.n_devices = shard_size  # shard-axis width (for pad_shards)
+        self.words_size = words_size
+
+    def pad_shards(self, shards: tuple[int, ...]) -> tuple[int, ...]:
+        rem = len(shards) % self.n_devices
+        if rem:
+            shards = shards + (PAD_SHARD,) * (self.n_devices - rem)
+        return shards
+
+    def sharding(self, ndim: int) -> NamedSharding:
+        if ndim == 1:
+            return NamedSharding(self.mesh, P(self.words_axis))
+        return NamedSharding(
+            self.mesh,
+            P(self.shard_axis, *([None] * (ndim - 2)), self.words_axis))
+
+    def place(self, host_array: np.ndarray) -> jax.Array:
+        return jax.device_put(host_array, self.sharding(host_array.ndim))
+
+
 def local_placement() -> MeshPlacement | None:
     """Mesh over all local devices, or None for a single device (plain
     ``device_put`` placement is then used)."""

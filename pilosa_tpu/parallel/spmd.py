@@ -99,6 +99,40 @@ def make_bsi_sum_psum(mesh: Mesh, axis: str = "shard"):
         out_specs=(P(), P())))
 
 
+def make_intersect_count_psum2d(mesh: Mesh, shard_axis: str = "shard",
+                                words_axis: str = "words"):
+    """Explicit 2D-SPMD Count(Intersect) over a (shard × words) mesh:
+    each chip holds a block of shards × a slice of each row's words;
+    partial popcounts psum over BOTH axes (SURVEY.md §6 long-context
+    analogue — the word axis is the 'sequence' being split)."""
+
+    def per_chip(a, b):
+        partial = jnp.sum(kernels.intersection_count(a, b))
+        return jax.lax.psum(partial, axis_name=(shard_axis, words_axis))
+
+    return jax.jit(shard_map(
+        per_chip, mesh=mesh,
+        in_specs=(P(shard_axis, words_axis), P(shard_axis, words_axis)),
+        out_specs=P()))
+
+
+def make_topn_psum2d(mesh: Mesh, n: int, shard_axis: str = "shard",
+                     words_axis: str = "words"):
+    """2D TopN: per-chip partial row counts, psum over shards + word
+    slices, replicated top_k."""
+
+    def per_chip(plane, filter_words):
+        counts = jnp.sum(kernels.row_counts(plane, filter_words), axis=0)
+        counts = jax.lax.psum(counts, axis_name=(shard_axis, words_axis))
+        return kernels.top_n(counts, n)
+
+    return jax.jit(shard_map(
+        per_chip, mesh=mesh,
+        in_specs=(P(shard_axis, None, words_axis),
+                  P(shard_axis, words_axis)),
+        out_specs=(P(), P())))
+
+
 def make_ingest_step(mesh: Mesh, axis: str = "shard"):
     """Sharded device-side mutation: apply coalesced (word_idx, mask)
     updates to each chip's resident rows (SURVEY.md §4.5 device half).

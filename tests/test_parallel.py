@@ -173,3 +173,60 @@ class TestJumpHashPlacement:
         for s in range(256):
             counts[shard_nodes("idx", s, nodes)[0]] += 1
         assert max(counts.values()) < 2.5 * min(counts.values())
+
+
+class TestWordsAxis2D:
+    """The context-parallel analogue (SURVEY.md §6): one shard's word
+    axis split across chips, partial popcounts psum-reduced."""
+
+    @pytest.fixture(scope="class")
+    def placement2d(self):
+        from pilosa_tpu.parallel import MeshPlacement2D
+        return MeshPlacement2D(jax.devices(), shard_size=2, words_size=4)
+
+    def test_executor_equivalence_on_2d_mesh(self, holder12, placement2d):
+        plain = Executor(holder12)
+        meshed = Executor(holder12, placement=placement2d)
+        for pql in QUERIES:
+            assert plain.execute("i", pql) == meshed.execute("i", pql), pql
+        (a,) = plain.execute("i", "TopN(f)")
+        (b,) = meshed.execute("i", "TopN(f)")
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+        for pql in ["Row(f=3)", "Row(amount > 0)"]:
+            (ra,) = plain.execute("i", pql)
+            (rb,) = meshed.execute("i", pql)
+            np.testing.assert_array_equal(ra.columns, rb.columns, err_msg=pql)
+
+    def test_explicit_2d_psum_programs(self, placement2d, rng):
+        from pilosa_tpu.parallel import spmd
+        n_shards = 4
+        a_cols = [rng.choice(SHARD_WIDTH, 2000, replace=False)
+                  for _ in range(n_shards)]
+        b_cols = [rng.choice(SHARD_WIDTH, 2000, replace=False)
+                  for _ in range(n_shards)]
+        a = np.stack([pack_columns(c) for c in a_cols])
+        b = np.stack([pack_columns(c) for c in b_cols])
+        expect = sum(len(np.intersect1d(x, y))
+                     for x, y in zip(a_cols, b_cols))
+        fn = spmd.make_intersect_count_psum2d(placement2d.mesh)
+        got = int(fn(placement2d.place(a), placement2d.place(b)))
+        assert got == expect
+
+    def test_2d_topn(self, placement2d, rng):
+        from pilosa_tpu.parallel import spmd
+        n_shards, n_rows = 4, 8
+        plane = np.zeros((n_shards, n_rows, WORDS_PER_SHARD), np.uint32)
+        counts = np.zeros(n_rows, np.int64)
+        for s in range(n_shards):
+            for r in range(n_rows):
+                k = int(rng.integers(1, 300))
+                plane[s, r] = pack_columns(
+                    rng.choice(SHARD_WIDTH, k, replace=False))
+                counts[r] += k
+        filt = np.full((n_shards, WORDS_PER_SHARD), 0xFFFFFFFF, np.uint32)
+        fn = spmd.make_topn_psum2d(placement2d.mesh, n=3)
+        vals, slots = fn(placement2d.place(plane), placement2d.place(filt))
+        order = np.argsort(-counts, kind="stable")[:3]
+        np.testing.assert_array_equal(np.asarray(vals), counts[order])
+        np.testing.assert_array_equal(np.asarray(slots), order)
