@@ -83,6 +83,8 @@ class Executor:
         from pilosa_tpu.obs import GLOBAL_TRACER, NopStats
         self.stats = stats or NopStats()
         self.tracer = tracer or GLOBAL_TRACER
+        from pilosa_tpu.exec.fused import FusedCache
+        self.fused = FusedCache()
 
     # ------------------------------------------------------------------ api
 
@@ -145,7 +147,7 @@ class Executor:
                                 if a}
             return result
         if call.name in _BITMAP_CALLS:
-            words = self._bitmap(ctx, call)
+            words = self._fused_bitmap(ctx, call)
             return self._to_row_result(ctx, words)
         handler = getattr(self, "_execute_" + call.name.lower(), None)
         if handler is None:
@@ -153,6 +155,109 @@ class Executor:
         return handler(ctx, call)
 
     # -- bitmap calls -------------------------------------------------------
+
+    def _fused_bitmap(self, ctx: _Ctx, call: Call, want: str = "words"):
+        """Evaluate a bitmap call tree as ONE compiled program (SURVEY.md
+        §8 "one compiled function per call-shape"); falls back to the
+        eager per-op path for shapes the planner doesn't cover."""
+        from pilosa_tpu.exec.fused import Unfusable
+        try:
+            leaves: list = []
+            node = self._plan(ctx, call, leaves)
+        except Unfusable:
+            words = self._bitmap(ctx, call)
+            if want == "count":
+                return jnp.sum(kernels.count(words))
+            return words
+        return self.fused.run(node, tuple(leaves), want)
+
+    def _plan(self, ctx: _Ctx, call: Call, leaves: list):
+        """Mirror of :meth:`_bitmap` that collects leaf arrays and
+        returns a hashable structure tree for the fused compiler."""
+        name = call.name
+
+        def leaf(arr) -> tuple:
+            leaves.append(arr)
+            return ("leaf", len(leaves) - 1)
+
+        if name in ("Row", "Range"):
+            return self._plan_row(ctx, call, leaves, leaf)
+        if name == "All":
+            return leaf(self._exists(ctx))
+        if name == "Not":
+            if len(call.children) != 1:
+                raise ExecutionError("Not: exactly one child required")
+            child = self._plan(ctx, call.children[0], leaves)
+            leaves.append(self._exists(ctx))
+            return ("not", child, len(leaves) - 1)
+        kids = call.children
+        if name == "Union" and not kids:
+            return leaf(self._zeros(ctx))
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            if not kids:
+                raise ExecutionError(f"{name}: at least one child required")
+            op = {"Union": "or", "Intersect": "and",
+                  "Difference": "andnot", "Xor": "xor"}[name]
+            return (op, tuple(self._plan(ctx, k, leaves) for k in kids))
+        raise ExecutionError(f"not a bitmap call: {name}")
+
+    def _plan_row(self, ctx: _Ctx, call: Call, leaves: list, leaf):
+        hit = call.field_arg(RESERVED_KEYS)
+        if hit is None:
+            raise ExecutionError(f"{call.name}: missing field argument")
+        fname, value = hit
+        field = self._field(ctx, fname)
+        if isinstance(value, Condition) or field.options.type in BSI_TYPES:
+            cond = (value if isinstance(value, Condition)
+                    else Condition("==", value))
+            return self._plan_bsi(ctx, field, cond, leaves, leaf)
+        row_id = self._row_id(ctx, field, value, create=False)
+        if row_id is None:
+            return leaf(self._zeros(ctx))
+        if "from" in call.args or "to" in call.args:
+            # time-range rows stay eager (variable view counts would
+            # explode the program cache); wrap the result as one leaf
+            return leaf(self._time_row(ctx, field, row_id, call))
+        return leaf(self.planes.row_words(ctx.index.name, field,
+                                          VIEW_STANDARD, row_id, ctx.shards))
+
+    def _plan_bsi(self, ctx: _Ctx, field: Field, cond: Condition,
+                  leaves: list, leaf):
+        if field.options.type not in BSI_TYPES:
+            raise ExecutionError(
+                f"field {field.name!r}: condition on non-BSI field")
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        if cond.op in BETWEEN_OPS:
+            lo_op = "gt" if cond.op.startswith("<>") else "ge"
+            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo = self._plan_bsi_cmp(ctx, field, ps, lo_op, cond.value[0],
+                                    leaves, leaf)
+            hi = self._plan_bsi_cmp(ctx, field, ps, hi_op, cond.value[1],
+                                    leaves, leaf)
+            return ("and", (lo, hi))
+        return self._plan_bsi_cmp(ctx, field, ps,
+                                  _SCALAR_TO_KEY[cond.op], cond.value,
+                                  leaves, leaf)
+
+    def _plan_bsi_cmp(self, ctx: _Ctx, field: Field, ps, op_key: str,
+                      value, leaves: list, leaf):
+        opts = field.options
+        depth = opts.bit_depth
+        offset = field.to_stored(value) - opts.base
+        bound = (1 << depth) - 1
+        if offset > bound or offset < -bound:
+            # saturated: trivially everything-not-null or nothing
+            exists = ps.plane[..., bsik.EXISTS_ROW, :]
+            all_hit = (op_key in ("lt", "le", "ne")) if offset > bound \
+                else (op_key in ("gt", "ge", "ne"))
+            return leaf(exists if all_hit else jnp.zeros_like(exists))
+        leaves.append(ps.plane)
+        i_plane = len(leaves) - 1
+        leaves.append(jnp.asarray(bsik.predicate_masks(abs(offset), depth)))
+        i_masks = len(leaves) - 1
+        leaves.append(jnp.asarray(offset < 0))
+        i_neg = len(leaves) - 1
+        return ("bsi", i_plane, i_masks, i_neg, op_key)
 
     def _bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
         """Evaluate a bitmap-valued call to uint32[n_shards, W]."""
@@ -359,15 +464,15 @@ class Executor:
             return None
         if not isinstance(flt, Call):
             raise ExecutionError("filter must be a bitmap call")
-        return self._bitmap(ctx, flt)
+        return self._fused_bitmap(ctx, flt)
 
     # -- scalar / aggregate calls ------------------------------------------
 
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
-        words = self._bitmap(ctx, call.children[0])
-        return int(jnp.sum(kernels.count(words)))
+        # fused: bitwise tree + popcount + reduce in one XLA program
+        return int(self._fused_bitmap(ctx, call.children[0], want="count"))
 
     def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)

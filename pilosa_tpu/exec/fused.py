@@ -1,0 +1,95 @@
+"""Whole-query fusion: one compiled XLA program per call-tree shape.
+
+SURVEY.md §8: "One compiled function per (call-shape, row-bucket)".
+Eager per-op dispatch costs one device round trip per AST node; here the
+bitmap-call tree is planned into (structure key, leaf arrays), the
+structure is compiled once into a single jitted program (bitwise tree +
+optional popcount-reduce fused end-to-end by XLA), and subsequent
+queries with the same shape — any row IDs, any predicate values — reuse
+it with zero retracing.
+
+Predicate values enter as *traced* leaves (lane-broadcast masks and a
+sign scalar, see ``engine.bsi.predicate_masks``), so ``amount > 5`` and
+``amount > 99`` hit the same executable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.engine import bsi as bsik
+from pilosa_tpu.engine import kernels
+
+# node encodings (hashable nested tuples):
+#   ("leaf", i)                      leaf i is uint32[..., W] words
+#   ("zeros",)                       all-empty bitmap
+#   ("or-leaves", (i, j, ...))       union of row leaves (time ranges)
+#   ("and"|"or"|"andnot"|"xor", (child, child, ...))   fold left
+#   ("not", child, i_exists)
+#   ("bsi", i_plane, i_masks, i_neg, op_key)
+#   ("bsi-between", i_plane, i_lo_masks, i_lo_neg, lo_op,
+#                   i_hi_masks, i_hi_neg, hi_op)
+
+
+class Unfusable(Exception):
+    """Raised by planners for shapes the fused path doesn't cover."""
+
+
+def _build(node, leaves):
+    kind = node[0]
+    if kind == "leaf":
+        return leaves[node[1]]
+    if kind == "zeros":
+        return jnp.zeros_like(leaves[0])
+    if kind == "or-leaves":
+        acc = leaves[node[1][0]]
+        for i in node[1][1:]:
+            acc = jnp.bitwise_or(acc, leaves[i])
+        return acc
+    if kind in ("and", "or", "andnot", "xor"):
+        op = {"and": jnp.bitwise_and, "or": jnp.bitwise_or,
+              "xor": jnp.bitwise_xor,
+              "andnot": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+              }[kind]
+        acc = _build(node[1][0], leaves)
+        for child in node[1][1:]:
+            acc = op(acc, _build(child, leaves))
+        return acc
+    if kind == "not":
+        return kernels.complement(_build(node[1], leaves), leaves[node[2]])
+    if kind == "bsi":
+        _, i_plane, i_masks, i_neg, op_key = node
+        cmp = bsik.range_cmp(leaves[i_plane], leaves[i_masks],
+                             leaves[i_neg])
+        return cmp[op_key]
+    if kind == "bsi-between":
+        (_, i_plane, i_lo, i_lo_neg, lo_op, i_hi, i_hi_neg, hi_op) = node
+        lo = bsik.range_cmp(leaves[i_plane], leaves[i_lo],
+                            leaves[i_lo_neg])[lo_op]
+        hi = bsik.range_cmp(leaves[i_plane], leaves[i_hi],
+                            leaves[i_hi_neg])[hi_op]
+        return jnp.bitwise_and(lo, hi)
+    raise AssertionError(f"bad node {node!r}")
+
+
+class FusedCache:
+    """structure key -> jitted program.  One instance per executor."""
+
+    def __init__(self):
+        self._programs: dict = {}
+
+    def run(self, node, leaves, want: str):
+        """Execute a planned tree: ``want`` is "words" (bitmap) or
+        "count" (fused popcount-reduce scalar)."""
+        key = (node, want)
+        fn = self._programs.get(key)
+        if fn is None:
+            if want == "count":
+                def program(*ls):
+                    return jnp.sum(kernels.count(_build(node, ls)))
+            else:
+                def program(*ls):
+                    return _build(node, ls)
+            fn = self._programs[key] = jax.jit(program)
+        return fn(*leaves)
