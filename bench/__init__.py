@@ -1,0 +1,1 @@
+"""Per-config benchmark scripts (BASELINE.md rows 1-5)."""

@@ -1,0 +1,54 @@
+"""Config #1 (BASELINE.md): single-shard Intersect(Row,Row)+Count on a
+1M-column index — END-TO-END through PQL parse + executor + fused
+program, not just the kernel.  Baseline column: the same query answered
+by numpy set algebra on host."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from bench._util import emit, log, time_wall
+
+
+def main():
+    import tempfile
+
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    rng = np.random.default_rng(1)
+    a = rng.choice(1 << 20, 300_000, replace=False)
+    b = rng.choice(1 << 20, 300_000, replace=False)
+
+    h = Holder(tempfile.mkdtemp()).open()
+    idx = h.create_index("bench")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.field("f").import_bits(np.ones(len(a), np.uint64), a.astype(np.uint64))
+    idx.field("g").import_bits(np.ones(len(b), np.uint64), b.astype(np.uint64))
+    ex = Executor(h)
+
+    expect = len(np.intersect1d(a, b))
+    pql = "Count(Intersect(Row(f=1), Row(g=1)))"
+    assert ex.execute("bench", pql) == [expect]
+
+    # cpu baseline: numpy sorted-array intersection (the closest honest
+    # stand-in for the reference's Go roaring intersectionCount)
+    sa, sb = np.sort(a), np.sort(b)
+    t_cpu = time_wall(lambda: len(np.intersect1d(sa, sb,
+                                                 assume_unique=True)), 50)
+    log(f"cpu numpy baseline: {1 / t_cpu:,.0f} qps")
+
+    ex.execute("bench", pql)  # warm compile
+    t = time_wall(lambda: ex.execute("bench", pql), 500)
+    import jax
+    platform = jax.devices()[0].platform
+    log(f"executor end-to-end ({platform}): {1 / t:,.0f} qps")
+    emit(f"e2e_intersect_count_qps_1m_cols_{platform}", 1 / t, "qps",
+         (1 / t) / (1 / t_cpu))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Config #2 (BASELINE.md): Union/Xor/Difference over 64 rows at 100M
+columns (96 shards), single device.  Measures the 64-way row fold as one
+fused program vs numpy reduce on host."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import cpu_popcount, emit, log, random_shard_rows, time_p50
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.engine import kernels
+
+    rng = np.random.default_rng(2)
+    n_shards = 96  # ~100.7M columns
+    plane = random_shard_rows(rng, n_shards, 64)
+    log(f"plane: {plane.nbytes / 1e9:.2f} GB host")
+
+    @jax.jit
+    def union64(p):
+        return jnp.sum(kernels.count(kernels.union_rows(
+            p, jnp.ones(p.shape[-2], bool))))
+
+    @jax.jit
+    def xor64(p):
+        acc = p[:, 0, :]
+        for r in range(1, p.shape[1]):
+            acc = jnp.bitwise_xor(acc, p[:, r, :])
+        return jnp.sum(kernels.count(acc))
+
+    d = jax.device_put(plane)
+    results = {}
+    for name, fn in (("union", union64), ("xor", xor64)):
+        out = fn(d)
+        jax.block_until_ready(out)
+        p50 = time_p50(lambda fn=fn: fn(d), 30)
+        results[name] = p50
+        log(f"{name} 64 rows x 100M cols: {p50 * 1e3:.2f} ms (count "
+            f"{int(out)})")
+
+    # cpu baseline for union: numpy bitwise_or.reduce + popcount
+    t0 = __import__("time").perf_counter()
+    cpu = cpu_popcount(np.bitwise_or.reduce(plane, axis=1))
+    t_cpu = __import__("time").perf_counter() - t0
+    log(f"cpu union baseline: {t_cpu * 1e3:.1f} ms")
+
+    platform = jax.devices()[0].platform
+    emit(f"union64_100m_cols_ms_{platform}", results["union"] * 1e3, "ms",
+         t_cpu / results["union"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Config #3 (BASELINE.md, north-star latency): TopN(field, n) on a
+1B-column index.  954 shards x 32 rows resident in HBM (~3.9GB); TopN =
+per-row popcount matrix + top_k, exact by construction — no per-shard
+cache or two-phase threshold protocol (SURVEY.md §4.3)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import emit, log, random_shard_rows, time_p50
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.engine import kernels
+
+    rng = np.random.default_rng(3)
+    n_shards, n_rows = 954, 32
+    plane = random_shard_rows(rng, n_shards, n_rows)
+    log(f"plane: {plane.nbytes / 1e9:.2f} GB")
+
+    @jax.jit
+    def topn10(p):
+        counts = jnp.sum(kernels.row_counts(p), axis=0, dtype=jnp.int32)
+        vals, slots = kernels.top_n(counts, 10)
+        return jnp.stack([vals, slots])  # one output = one host read
+
+    d = jax.device_put(plane)
+    out = np.asarray(topn10(d))
+    vals, slots = out[0], out[1]
+
+    # oracle on a subsample of rows to keep cpu time sane
+    import time
+    t0 = time.perf_counter()
+    if hasattr(np, "bitwise_count"):
+        counts = np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+    else:
+        counts = np.array([
+            int(np.unpackbits(plane[:, r].reshape(-1).view(np.uint8)).sum())
+            for r in range(n_rows)], np.int64)
+    t_cpu = time.perf_counter() - t0
+    order = np.argsort(-counts, kind="stable")[:10]
+    assert list(slots) == list(order), "TopN mismatch vs oracle"
+    assert list(vals) == list(counts[order])
+    log(f"cpu oracle: {t_cpu * 1e3:.0f} ms")
+
+    p50 = time_p50(lambda: topn10(d), 30)
+    platform = jax.devices()[0].platform
+    log(f"TopN p50 ({platform}): {p50 * 1e3:.2f} ms @ 1B cols x {n_rows} rows")
+    emit(f"topn_p50_ms_1b_cols_{platform}", p50 * 1e3, "ms", t_cpu / p50)
+
+
+if __name__ == "__main__":
+    main()

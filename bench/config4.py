@@ -1,0 +1,59 @@
+"""Config #4 (BASELINE.md): BSI int field — Range + Sum/Min/Max over
+10M columns (10 shards, 20-bit depth) end-to-end through the executor,
+vs numpy int64 array operations as the CPU stand-in."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import emit, log, time_wall
+
+
+def main():
+    import tempfile
+
+    import jax
+
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import FieldOptions, Holder
+
+    rng = np.random.default_rng(4)
+    n_cols = 10_000_000
+    cols = np.arange(n_cols, dtype=np.uint64)
+    vals = rng.integers(-500_000, 500_000, size=n_cols, dtype=np.int64)
+
+    h = Holder(tempfile.mkdtemp()).open()
+    idx = h.create_index("bench", track_existence=False)
+    f = idx.create_field("amount", FieldOptions(
+        type="int", min=-500_000, max=500_000))
+    import time
+    t0 = time.perf_counter()
+    f.import_values(cols, vals)
+    log(f"import of {n_cols / 1e6:.0f}M values: "
+        f"{time.perf_counter() - t0:.1f}s")
+    ex = Executor(h)
+
+    (s,) = ex.execute("bench", "Sum(field=amount)")
+    assert (s.value, s.count) == (int(vals.sum()), n_cols)
+    (r,) = ex.execute("bench", "Count(Row(amount > 250000))")
+    assert r == int((vals > 250_000).sum())
+
+    t_cpu_sum = time_wall(lambda: vals.sum(), 20)
+    t_cpu_rng = time_wall(lambda: (vals > 250_000).sum(), 20)
+
+    t_sum = time_wall(lambda: ex.execute("bench", "Sum(field=amount)"), 50)
+    t_rng = time_wall(
+        lambda: ex.execute("bench", "Count(Row(amount > 250000))"), 50)
+    t_min = time_wall(lambda: ex.execute("bench", "Min(field=amount)"), 50)
+    platform = jax.devices()[0].platform
+    log(f"Sum {t_sum * 1e3:.2f} ms | Range+Count {t_rng * 1e3:.2f} ms | "
+        f"Min {t_min * 1e3:.2f} ms  (cpu: sum {t_cpu_sum * 1e3:.2f}, "
+        f"range {t_cpu_rng * 1e3:.2f})")
+    emit(f"bsi_range_count_ms_10m_cols_{platform}", t_rng * 1e3, "ms",
+         t_cpu_rng / t_rng)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Config #5 (BASELINE.md): cluster Intersect+Count at 256 shards over
+the device mesh.  Real multi-chip hardware is unavailable in this image
+(one tunneled chip); this measures (a) 256 shards batched on the real
+device and (b) scaling 1→8 simulated CPU devices via the psum program —
+the shape the driver's dry run validates and a pod slice executes.
+Run with JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+for the scaling half."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import emit, log, time_p50
+
+
+def main():
+    import jax
+
+    from pilosa_tpu.parallel import MeshPlacement, spmd
+
+    rng = np.random.default_rng(5)
+    n_shards = 256
+    a = rng.integers(0, 1 << 32, size=(n_shards, 32768), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=(n_shards, 32768), dtype=np.uint32)
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    if len(devs) > 1:
+        results = {}
+        for n_dev in (1, 2, 4, 8):
+            if n_dev > len(devs):
+                break
+            p = MeshPlacement(devs[:n_dev])
+            fn = spmd.make_intersect_count_psum(p.mesh)
+            da, db = p.place(a), p.place(b)
+            jax.block_until_ready(fn(da, db))
+            p50 = time_p50(lambda: fn(da, db), 20)
+            results[n_dev] = p50
+            log(f"{n_dev} devices: {p50 * 1e3:.3f} ms "
+                f"({1 / p50:,.0f} qps)")
+        scale = results[1] / results[max(results)]
+        emit(f"cluster_scaling_{max(results)}dev_speedup_{platform}",
+             scale, "x", scale / max(results))
+    else:
+        da, db = jax.device_put(a), jax.device_put(b)
+        jax.block_until_ready(spmd.intersect_count(da, db))
+        p50 = time_p50(lambda: spmd.intersect_count(da, db), 50)
+        log(f"single device, 256 shards: {p50 * 1e3:.3f} ms")
+        emit(f"intersect_count_qps_256shards_{platform}", 1 / p50, "qps",
+             1.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,17 @@
 """One-time JAX runtime configuration for the compute path.
 
-Imported by every jax-using engine module (kernels, bsi, mesh) and nothing
-else, so ``import pilosa_tpu`` stays side-effect free while any actual
-device compute gets x64 reductions (cluster-wide counts on 1B+ columns
-exceed int32; see engine/__init__ docstring).
+Deliberately does NOT enable global x64: TPUs have no native int64 —
+under ``jax_enable_x64`` every count/reduce lowers to emulated 64-bit
+arithmetic, measured ~1000x slower than int32 on the popcount matrix
+path.  The engine's contract instead is:
+
+- device accumulations are int32, which is always exact per
+  (shard, row): one shard holds 2^20 columns, so any per-shard popcount
+  fits comfortably (2^20 << 2^31);
+- cross-shard totals that could exceed int32 (>2047 full shards ≈ 2.1B
+  columns) are finished on the HOST in int64/python ints — see
+  ``engine.kernels.shard_totals`` and the host combine helpers in
+  ``engine.bsi``.
 """
 
-import jax
-
-jax.config.update("jax_enable_x64", True)
+import jax  # noqa: F401  (kept as the single config hook point)

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (enables x64)
+from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (device int32 policy)
 from pilosa_tpu.engine import kernels
 
 EXISTS_ROW = 0
@@ -136,65 +136,88 @@ def not_null(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.Arr
     return exists
 
 
-def sum_count(
+def bit_counts(
     plane: jax.Array, filter_words: jax.Array | None = None
-) -> tuple[jax.Array, jax.Array]:
-    """(sum of offsets, count of non-null) per batch element -> int64[...].
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-bit positive/negative popcounts + non-null count, all int32.
 
-    Reference: ``fragment.sum`` — per bit b, ``popcount(filter & bitrow_b)
-    << b``, negatives subtracted via the sign row (SURVEY.md §4.4).  The
-    caller adds ``base * count`` to recover absolute values.
+    Reference: ``fragment.sum`` decomposition (SURVEY.md §4.4) — per bit
+    b, ``popcount(filter & bitrow_b)`` split by sign.  The device stays
+    in int32 (each count <= 2^20 per shard); :func:`combine_sum` does the
+    ``<< b`` weighting exactly on the host.
+
+    Returns (pos[..., depth], neg[..., depth], count[...]), jit-safe.
     """
     exists = not_null(plane, filter_words)
     sign = plane[..., SIGN_ROW, :] & exists
     pos = exists & ~sign
-    depth = depth_of(plane)
-    total = jnp.zeros(plane.shape[:-2], dtype=jnp.int64)
-    for b in range(depth):
-        bitplane = plane[..., OFFSET_ROW + b, :]
-        pos_c = kernels.count(bitplane & pos)
-        neg_c = kernels.count(bitplane & sign)
-        total = total + ((pos_c - neg_c) << b)
-    return total, kernels.count(exists)
+    mag = plane[..., OFFSET_ROW:, :]
+    pos_c = kernels.count(mag & pos[..., None, :])
+    neg_c = kernels.count(mag & sign[..., None, :])
+    return pos_c, neg_c, kernels.count(exists)
+
+
+def combine_sum(pos_c, neg_c, cnt) -> tuple[int, int]:
+    """Host combine of :func:`bit_counts` outputs over ALL leading axes:
+    exact python-int (sum_of_offsets, count)."""
+    pos_c = np.asarray(pos_c, dtype=np.int64)
+    neg_c = np.asarray(neg_c, dtype=np.int64)
+    depth = pos_c.shape[-1]
+    flat_p = pos_c.reshape(-1, depth).sum(axis=0)
+    flat_n = neg_c.reshape(-1, depth).sum(axis=0)
+    total = sum((int(flat_p[b]) - int(flat_n[b])) << b
+                for b in range(depth))
+    return total, int(np.asarray(cnt, dtype=np.int64).sum())
+
+
+def sum_count(
+    plane: jax.Array, filter_words: jax.Array | None = None
+) -> tuple[int, int]:
+    """(sum of offsets, count of non-null) over all batch elements —
+    device bit counts + exact host combine.  NOT jit-safe (host
+    finishing); inside compiled programs use :func:`bit_counts`."""
+    return combine_sum(*bit_counts(plane, filter_words))
 
 
 def _mag_max(cand: jax.Array, mag: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Largest magnitude among candidate columns: (value int64[...], final
-    candidate bitmap).  Data-dependent bit descent done branch-free with
-    ``where`` on per-batch "any" scalars (jit/TPU friendly)."""
+    """Largest magnitude among candidate columns: (bits bool[..., depth],
+    final candidate bitmap).  Data-dependent bit descent done branch-free
+    with ``where`` on per-batch "any" scalars (jit/TPU friendly); the
+    value is reconstructed exactly on host from the bit flags (int64-free
+    device path)."""
     depth = mag.shape[-2]
-    val = jnp.zeros(cand.shape[:-1], dtype=jnp.int64)
+    bits = []
     for b in reversed(range(depth)):
         hit = cand & mag[..., b, :]
         has = kernels.any_bit(hit)
         cand = jnp.where(has[..., None], hit, cand)
-        val = val | (has.astype(jnp.int64) << b)
-    return val, cand
+        bits.append(has)
+    return jnp.stack(bits[::-1], axis=-1), cand
 
 
 def _mag_min(cand: jax.Array, mag: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Smallest magnitude among candidate columns."""
+    """Smallest magnitude among candidate columns (bit flags, see
+    :func:`_mag_max`)."""
     depth = mag.shape[-2]
-    val = jnp.zeros(cand.shape[:-1], dtype=jnp.int64)
+    bits = []
     for b in reversed(range(depth)):
         zero_side = cand & ~mag[..., b, :]
         has_zero = kernels.any_bit(zero_side)
         cand = jnp.where(has_zero[..., None], zero_side, cand)
-        val = val | ((~has_zero).astype(jnp.int64) << b)
+        bits.append(~has_zero)
     # columns that survived only because no zero-side existed at some bit
-    # all share the same magnitude, so val is exact
-    return val, cand
+    # all share the same magnitude, so the flags are exact
+    return jnp.stack(bits[::-1], axis=-1), cand
 
 
-def min_max(
+def min_max_bits(
     plane: jax.Array, filter_words: jax.Array | None = None
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Per-batch (min_offset, min_count, max_offset, max_count), int64.
+) -> dict[str, jax.Array]:
+    """Per-batch min/max as device-side bit flags + counts (jit-safe,
+    int64-free).  Host reconstruction in :func:`combine_min_max`.
 
     Reference: ``fragment.min``/``fragment.max`` bit descent (SURVEY.md
-    §3.1).  Offsets are relative to base; counts are 0 when no non-null
-    column exists (caller must check before using the values).
-    """
+    §3.1)."""
     exists = not_null(plane, filter_words)
     sign = plane[..., SIGN_ROW, :] & exists
     pos = exists & ~sign
@@ -204,17 +227,52 @@ def min_max(
     has_pos = kernels.any_bit(pos)
 
     # min: most-negative (largest |.| among negatives) else smallest positive
-    neg_val, neg_cand = _mag_max(sign, mag)
-    posmin_val, posmin_cand = _mag_min(pos, mag)
-    min_val = jnp.where(has_neg, -neg_val, posmin_val)
+    neg_bits, neg_cand = _mag_max(sign, mag)
+    posmin_bits, posmin_cand = _mag_min(pos, mag)
+    min_bits = jnp.where(has_neg[..., None], neg_bits, posmin_bits)
     min_cand = jnp.where(has_neg[..., None], neg_cand, posmin_cand)
     min_cnt = jnp.where(has_neg | has_pos, kernels.count(min_cand), 0)
 
     # max: largest positive else least-negative (smallest |.| among negatives)
-    posmax_val, posmax_cand = _mag_max(pos, mag)
-    negmin_val, negmin_cand = _mag_min(sign, mag)
-    max_val = jnp.where(has_pos, posmax_val, -negmin_val)
+    posmax_bits, posmax_cand = _mag_max(pos, mag)
+    negmin_bits, negmin_cand = _mag_min(sign, mag)
+    max_bits = jnp.where(has_pos[..., None], posmax_bits, negmin_bits)
     max_cand = jnp.where(has_pos[..., None], posmax_cand, negmin_cand)
     max_cnt = jnp.where(has_neg | has_pos, kernels.count(max_cand), 0)
 
-    return min_val, min_cnt, max_val, max_cnt
+    return {"min_bits": min_bits, "min_neg": has_neg, "min_cnt": min_cnt,
+            "max_bits": max_bits, "max_neg": has_neg & ~has_pos,
+            "max_cnt": max_cnt}
+
+
+def combine_min_max(out: dict) -> list[tuple[int, int, int, int]]:
+    """Host reconstruction of :func:`min_max_bits` per batch element:
+    [(min_value, min_count, max_value, max_count), ...] exact python
+    ints (offsets relative to base; counts 0 = no non-null columns)."""
+    min_bits = np.asarray(out["min_bits"]).reshape(-1,
+                                                   out["min_bits"].shape[-1])
+    max_bits = np.asarray(out["max_bits"]).reshape(-1,
+                                                   out["max_bits"].shape[-1])
+    min_neg = np.asarray(out["min_neg"]).reshape(-1)
+    max_neg = np.asarray(out["max_neg"]).reshape(-1)
+    min_cnt = np.asarray(out["min_cnt"]).reshape(-1)
+    max_cnt = np.asarray(out["max_cnt"]).reshape(-1)
+
+    def val(bits) -> int:
+        return sum(1 << b for b, hit in enumerate(bits) if hit)
+
+    res = []
+    for i in range(len(min_neg)):
+        mn = -val(min_bits[i]) if min_neg[i] else val(min_bits[i])
+        mx = -val(max_bits[i]) if max_neg[i] else val(max_bits[i])
+        res.append((mn, int(min_cnt[i]), mx, int(max_cnt[i])))
+    return res
+
+
+def min_max(
+    plane: jax.Array, filter_words: jax.Array | None = None
+) -> list[tuple[int, int, int, int]]:
+    """Per-batch (min_offset, min_count, max_offset, max_count) — device
+    bit descent + exact host reconstruction.  NOT jit-safe; inside
+    compiled programs use :func:`min_max_bits`."""
+    return combine_min_max(min_max_bits(plane, filter_words))

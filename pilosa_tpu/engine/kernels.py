@@ -12,17 +12,21 @@ All kernels are shape-polymorphic over leading batch axes: a "bitmap" is
 batches ``[n_shards, W]`` (one row across resident shards) or
 ``[n_shards, n_rows, W]`` (a whole field plane) and the same kernels apply.
 
-Counts are ``int64`` (JAX x64 is enabled at engine import): a single shard
-row fits int32 but cluster-wide counts on 1B+ columns do not.
+Counts are ``int32`` on device — always exact per (shard, row) since a
+shard is 2^20 columns — and finished in int64 on the host where
+cluster-wide totals could overflow (:func:`shard_totals`).  TPUs have no
+native int64; keeping the device path int32 avoids ~1000x emulation
+overhead on the popcount matrix (see ``engine._jaxcfg``).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (enables x64)
+from pilosa_tpu.engine import _jaxcfg  # noqa: F401  (device int32 policy)
 
 # ---------------------------------------------------------------------------
 # Boolean algebra (reference: roaring.Bitmap Intersect/Union/Difference/Xor)
@@ -61,8 +65,9 @@ def popcount(words: jax.Array) -> jax.Array:
 
 
 def count(words: jax.Array) -> jax.Array:
-    """Total set bits over the trailing word axis -> int64[...]."""
-    return jnp.sum(popcount(words).astype(jnp.int64), axis=-1)
+    """Total set bits over the trailing word axis -> int32[...] (exact:
+    one shard's 2^20 bits << 2^31)."""
+    return jnp.sum(popcount(words), axis=-1, dtype=jnp.int32)
 
 
 def intersection_count(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -101,7 +106,7 @@ def row_counts(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.A
     §3.2/§4.3): recount every row at HBM bandwidth instead of maintaining a
     cache + two-phase threshold protocol.
 
-    plane: uint32[..., R, W]; filter: uint32[..., W] -> int64[..., R].
+    plane: uint32[..., R, W]; filter: uint32[..., W] -> int32[..., R].
     """
     if filter_words is not None:
         plane = jnp.bitwise_and(plane, filter_words[..., None, :])
@@ -112,13 +117,13 @@ def top_n(counts: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
     """(values, row_ids) of the n largest counts (reference: two-phase
     ``executeTopN`` merge, SURVEY.md §4.3 — exact by construction here).
 
-    counts: int64[R] (already reduced across shards) -> (int64[k], int64[k])
+    counts: int32[R] (already reduced across shards) -> (int32[k], int32[k])
     with ``k = min(n, R)`` — an oversized ``n`` returns every row, matching
     the reference's TopN semantics.  Rows with zero count may appear;
     callers filter them.
     """
     vals, idx = lax.top_k(counts, min(n, counts.shape[-1]))
-    return vals, idx.astype(jnp.int64)
+    return vals, idx
 
 
 def union_rows(plane: jax.Array, row_mask: jax.Array) -> jax.Array:
@@ -168,3 +173,30 @@ def apply_word_andnot(words: jax.Array, word_idx: jax.Array, word_mask: jax.Arra
     return words.at[..., word_idx].set(
         jnp.bitwise_and(gathered, jnp.bitwise_not(word_mask)), mode="drop"
     )
+
+
+# ---------------------------------------------------------------------------
+# Host-finished reductions (int64 exactness beyond int32 device range)
+# ---------------------------------------------------------------------------
+
+# Summing int32 per-shard counts over more shards than this could
+# overflow int32 (2047 full shards of 2^20 bits ~ 2^31); beyond it the
+# reduction chunks on device and finishes in int64 on host.
+SAFE_SHARD_SUM = 2047
+
+
+def shard_totals(counts: jax.Array) -> np.ndarray:
+    """Reduce int32 per-shard counts over axis 0 exactly -> np.int64[...].
+
+    Device-sums chunks that cannot overflow; the (tiny) chunk totals are
+    finished in int64 on the host.  This is the cross-shard merge for
+    Count/TopN/Rows at any scale without device int64 emulation.
+    """
+    s = counts.shape[0]
+    if s <= SAFE_SHARD_SUM:
+        return np.asarray(jnp.sum(counts, axis=0, dtype=jnp.int32)
+                          ).astype(np.int64)
+    parts = [np.asarray(jnp.sum(counts[i:i + SAFE_SHARD_SUM], axis=0,
+                                dtype=jnp.int32))
+             for i in range(0, s, SAFE_SHARD_SUM)]
+    return np.stack(parts).astype(np.int64).sum(axis=0)

@@ -167,7 +167,7 @@ class Executor:
         except Unfusable:
             words = self._bitmap(ctx, call)
             if want == "count":
-                return jnp.sum(kernels.count(words))
+                return kernels.count(words)
             return words
         return self.fused.run(node, tuple(leaves), want)
 
@@ -471,14 +471,15 @@ class Executor:
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
-        # fused: bitwise tree + popcount + reduce in one XLA program
-        return int(self._fused_bitmap(ctx, call.children[0], want="count"))
+        # fused: bitwise tree + per-shard popcount in one XLA program;
+        # the tiny cross-shard total finishes in int64 on host
+        per_shard = self._fused_bitmap(ctx, call.children[0], want="count")
+        return int(kernels.shard_totals(per_shard))
 
     def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
         total, cnt = bsik.sum_count(ps.plane, filter_words)
-        total, cnt = int(jnp.sum(total)), int(jnp.sum(cnt))
         value = total + field.options.base * cnt
         return ValCount(value=field.from_stored(value) if cnt else 0,
                         count=cnt)
@@ -492,16 +493,19 @@ class Executor:
     def _min_max(self, ctx: _Ctx, call: Call, want_min: bool) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
-        mn, mn_c, mx, mx_c = bsik.min_max(ps.plane, filter_words)
-        mn, mn_c = np.asarray(mn), np.asarray(mn_c)
-        mx, mx_c = np.asarray(mx), np.asarray(mx_c)
-        # reduce across the shard axis on host (scalar per shard)
-        vals, cnts = (mn, mn_c) if want_min else (mx, mx_c)
-        mask = cnts > 0
-        if not mask.any():
+        per_shard = bsik.min_max(ps.plane, filter_words)
+        # reduce across the shard axis on host (one tuple per shard)
+        live = [(mn, mn_c, mx, mx_c)
+                for mn, mn_c, mx, mx_c in per_shard
+                if (mn_c if want_min else mx_c) > 0]
+        if not live:
             return ValCount(0, 0)
-        best = int(vals[mask].min() if want_min else vals[mask].max())
-        total = int(cnts[mask][vals[mask] == best].sum())
+        if want_min:
+            best = min(mn for mn, *_ in live)
+            total = sum(mn_c for mn, mn_c, *_ in live if mn == best)
+        else:
+            best = max(mx for _, _, mx, _ in live)
+            total = sum(mx_c for _, _, mx, mx_c in live if mx == best)
         value = best + field.options.base
         return ValCount(value=field.from_stored(value), count=total)
 
@@ -528,7 +532,7 @@ class Executor:
         if ps.n_rows == 0:
             return PairsResult([])
         counts = kernels.row_counts(ps.plane, filter_words)  # [S, R_pad]
-        totals = jnp.sum(counts, axis=0)                     # [R_pad]
+        totals = kernels.shard_totals(counts)                # np.int64[R_pad]
         ids_arg = call.args.get("ids")
         attr_name = call.args.get("attrName")
         if attr_name is not None:
@@ -544,10 +548,10 @@ class Executor:
                 slot = ps.slot_of.get(int(rid))
                 if slot is not None:
                     keep[slot] = True
-            totals = jnp.where(jnp.asarray(keep), totals, 0)
+            totals = np.where(keep, totals, 0)
         k = ps.n_rows if n is None else min(int(n), ps.n_rows)
-        vals, slots = kernels.top_n(totals, k)
-        vals, slots = np.asarray(vals), np.asarray(slots)
+        slots = np.argsort(-totals, kind="stable")[:k]
+        vals = totals[slots]
         live = (vals > 0) & (slots < ps.n_rows)
         row_ids = ps.row_ids[slots[live]]
         vals = vals[live]
@@ -583,10 +587,10 @@ class Executor:
             if col_id is None:
                 return np.empty(0, np.uint64)
             filter_words = self._column_bitmap(ctx, col_id)
-            counts = np.asarray(jnp.sum(
-                kernels.row_counts(ps.plane, filter_words), axis=0))
+            counts = kernels.shard_totals(
+                kernels.row_counts(ps.plane, filter_words))
         else:
-            counts = np.asarray(jnp.sum(kernels.row_counts(ps.plane), axis=0))
+            counts = kernels.shard_totals(kernels.row_counts(ps.plane))
         live = counts[:ps.n_rows] > 0
         rows = ps.row_ids[live]
         prev = call.args.get("previous")
@@ -650,7 +654,7 @@ class Executor:
                     if limit is not None and len(groups) >= int(limit):
                         return
                     continue
-                cnt = int(jnp.sum(kernels.count(words)))
+                cnt = int(kernels.shard_totals(kernels.count(words)))
                 if cnt == 0:
                     continue
                 group = [self._field_row(ctx, gf, gr)
@@ -660,8 +664,7 @@ class Executor:
                     aps = self.planes.bsi_plane(ctx.index.name, agg_field,
                                                 ctx.shards)
                     t, c = bsik.sum_count(aps.plane, words)
-                    agg_val = (int(jnp.sum(t))
-                               + agg_field.options.base * int(jnp.sum(c)))
+                    agg_val = t + agg_field.options.base * c
                 groups.append(GroupCount(group, cnt, agg_val))
                 if limit is not None and len(groups) >= int(limit):
                     return

@@ -86,8 +86,10 @@ class FusedCache:
         fn = self._programs.get(key)
         if fn is None:
             if want == "count":
+                # per-shard int32 counts; the caller finishes the tiny
+                # cross-shard sum in int64 on host (engine int32 policy)
                 def program(*ls):
-                    return jnp.sum(kernels.count(_build(node, ls)))
+                    return kernels.count(_build(node, ls))
             else:
                 def program(*ls):
                     return _build(node, ls)

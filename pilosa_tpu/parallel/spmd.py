@@ -49,10 +49,10 @@ def topn(plane: jax.Array, filter_words: jax.Array | None, n: int):
 
 
 @jax.jit
-def bsi_sum(plane: jax.Array, filter_words: jax.Array | None):
-    """(sum_of_offsets, count) over a [n_shards, depth+2, W] BSI plane."""
-    total, cnt = bsik.sum_count(plane, filter_words)
-    return jnp.sum(total), jnp.sum(cnt)
+def bsi_bit_counts(plane: jax.Array, filter_words: jax.Array | None):
+    """Per-shard per-bit BSI counts over a [n_shards, depth+2, W] plane;
+    finish with ``engine.bsi.combine_sum`` on host."""
+    return bsik.bit_counts(plane, filter_words)
 
 
 # -- explicit shard_map programs (collectives spelled out) -------------------
@@ -88,15 +88,22 @@ def make_topn_psum(mesh: Mesh, n: int, axis: str = "shard"):
 
 
 def make_bsi_sum_psum(mesh: Mesh, axis: str = "shard"):
+    """Cluster-wide per-bit count matrices via ICI psum (int32 — exact
+    for <2047 full shards per bit); host combine_sum finishes."""
+
     def per_chip(plane, filter_words):
-        total, cnt = bsik.sum_count(plane, filter_words)
-        return (jax.lax.psum(jnp.sum(total), axis_name=axis),
-                jax.lax.psum(jnp.sum(cnt), axis_name=axis))
+        pos, neg, cnt = bsik.bit_counts(plane, filter_words)
+        return (jax.lax.psum(jnp.sum(pos, axis=0, dtype=jnp.int32),
+                             axis_name=axis),
+                jax.lax.psum(jnp.sum(neg, axis=0, dtype=jnp.int32),
+                             axis_name=axis),
+                jax.lax.psum(jnp.sum(cnt, dtype=jnp.int32),
+                             axis_name=axis))
 
     return jax.jit(shard_map(
         per_chip, mesh=mesh,
         in_specs=(P(axis, None, None), P(axis, None)),
-        out_specs=(P(), P())))
+        out_specs=(P(), P(), P())))
 
 
 def make_intersect_count_psum2d(mesh: Mesh, shard_axis: str = "shard",

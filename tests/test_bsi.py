@@ -63,17 +63,17 @@ def test_sum_count_min_max(pairs):
     vals = [v for _, v in pairs]
     plane = encode(cols, vals)
     total, cnt = bsi.sum_count(plane)
-    assert int(cnt) == len(cols)
-    assert int(total) == sum(vals)
+    assert cnt == len(cols)
+    assert total == sum(vals)
 
-    mn, mn_c, mx, mx_c = bsi.min_max(plane)
+    ((mn, mn_c, mx, mx_c),) = bsi.min_max(plane)
     if cols:
-        assert int(mn) == min(vals)
-        assert int(mn_c) == vals.count(min(vals))
-        assert int(mx) == max(vals)
-        assert int(mx_c) == vals.count(max(vals))
+        assert mn == min(vals)
+        assert mn_c == vals.count(min(vals))
+        assert mx == max(vals)
+        assert mx_c == vals.count(max(vals))
     else:
-        assert int(mn_c) == 0 and int(mx_c) == 0
+        assert mn_c == 0 and mx_c == 0
 
 
 def test_base_offset_encoding():
@@ -83,7 +83,7 @@ def test_base_offset_encoding():
     plane = words.bsi_encode(np.array(cols, np.uint64), np.array(vals, np.int64),
                              base, DEPTH, W)
     total, cnt = bsi.sum_count(plane)
-    assert int(total) + base * int(cnt) == sum(vals)
+    assert total + base * cnt == sum(vals)
     masks = jnp.asarray(bsi.predicate_masks(abs(120 - base), DEPTH))
     out = bsi.range_cmp(plane, masks, jnp.asarray(120 - base < 0))
     assert to_set(out["lt"]) == {1, 3}  # values < 120
@@ -94,9 +94,9 @@ def test_filtered_sum_and_range():
     plane = encode(cols, vals)
     filt = words.pack_columns(np.array([0, 1], np.uint64), W)
     total, cnt = bsi.sum_count(plane, jnp.asarray(filt))
-    assert (int(total), int(cnt)) == (-2, 2)
-    mn, mn_c, mx, mx_c = bsi.min_max(plane, jnp.asarray(filt))
-    assert (int(mn), int(mn_c), int(mx), int(mx_c)) == (-7, 1, 5, 1)
+    assert (total, cnt) == (-2, 2)
+    ((mn, mn_c, mx, mx_c),) = bsi.min_max(plane, jnp.asarray(filt))
+    assert (mn, mn_c, mx, mx_c) == (-7, 1, 5, 1)
 
 
 def test_batched_shard_axis(rng):
@@ -104,9 +104,12 @@ def test_batched_shard_axis(rng):
     p0 = encode([1, 2], [3, -4])
     p1 = encode([5], [7])
     planes = jnp.stack([jnp.asarray(p0), jnp.asarray(p1)])
+    # sum_count combines over ALL leading axes (the executor's use);
+    # per-shard splits come from bit_counts
     total, cnt = bsi.sum_count(planes)
-    assert np.asarray(total).tolist() == [-1, 7]
-    assert np.asarray(cnt).tolist() == [2, 1]
-    mn, mn_c, mx, mx_c = bsi.min_max(planes)
-    assert np.asarray(mn).tolist() == [-4, 7]
-    assert np.asarray(mx).tolist() == [3, 7]
+    assert (total, cnt) == (6, 3)
+    pos, neg, c = bsi.bit_counts(planes)
+    assert np.asarray(c).tolist() == [2, 1]
+    per_shard = bsi.min_max(planes)
+    assert [t[0] for t in per_shard] == [-4, 7]   # per-shard min
+    assert [t[2] for t in per_shard] == [3, 7]    # per-shard max

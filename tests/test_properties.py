@@ -177,7 +177,7 @@ class TestBsiProperties:
         plane = bsi_encode(cs, vs, base=0, depth=depth, n_words=N_WORDS)
         total, cnt = bsik.sum_count(plane)
         assert int(total) == int(vs.sum()) and int(cnt) == len(vs)
-        mn, mn_c, mx, mx_c = bsik.min_max(plane)
+        ((mn, mn_c, mx, mx_c),) = bsik.min_max(plane)
         assert int(mn) == int(vs.min())
         assert int(mn_c) == int((vs == vs.min()).sum())
         assert int(mx) == int(vs.max())
