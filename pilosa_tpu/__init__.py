@@ -10,7 +10,7 @@ TPU-first on JAX/XLA:
 - The per-shard map-reduce executor (reference: ``executor.go#mapReduce``,
   SURVEY.md §4.2) becomes a sharded, jit-compiled program over a
   ``jax.sharding.Mesh`` with ICI collective reductions in place of HTTP
-  merges (:mod:`pilosa_tpu.engine.mesh`, :mod:`pilosa_tpu.executor`).
+  merges (:mod:`pilosa_tpu.parallel`, :mod:`pilosa_tpu.exec`).
 - Host-side storage keeps a roaring-style container format on disk with an
   op-log + snapshot durability model (reference: ``fragment.go``, SURVEY.md
   §3.1/§6) (:mod:`pilosa_tpu.store`).
@@ -22,13 +22,15 @@ Layer map (mirrors SURVEY.md §2):
 
 ====  =====================  ===========================================
 L0    pilosa_tpu.engine      packed-word bitmap kernels (XLA), BSI, TopN
-L1    pilosa_tpu.store       holder/index/field/view/fragment, codec
+L1    pilosa_tpu.store       holder/index/field/view/fragment, codec,
+                             attrs, key translation (+ native/ C++ codec)
 L2    pilosa_tpu.pql         PQL front end
-L2    pilosa_tpu.executor    AST -> jitted kernels over shards
-L3    pilosa_tpu.cluster     placement, mesh distribution, control plane
-L5    pilosa_tpu.api         HTTP surface + client
-L6    pilosa_tpu.cli         command line
-LX    pilosa_tpu.obs         metrics / tracing / logging
+L2    pilosa_tpu.exec        AST -> one fused XLA program per call shape
+L3    pilosa_tpu.parallel    shard/words device mesh, SPMD psum programs
+L3    pilosa_tpu.cluster     membership, fan-out/merge, AAE, resize
+L5    pilosa_tpu.api         REST surface + client
+L6    pilosa_tpu.cli         command line + config
+LX    pilosa_tpu.obs         metrics / tracing / logging / diagnostics
 ====  =====================  ===========================================
 """
 

@@ -29,6 +29,7 @@ class Config:
                                         # (single seed node of a new cluster)
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
     heartbeat_interval: float = 2.0
+    diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
     # device
     plane_budget_bytes: int = 4 << 30
     mesh: bool = True                   # shard planes over all local devices

@@ -1,0 +1,85 @@
+"""Diagnostics: periodic anonymized usage snapshot + version check.
+
+Reference: ``diagnostics.go`` (SURVEY.md §3.3) — an opt-out phone-home
+in upstream.  This rebuild inverts the default (opt-IN, and this image
+has no egress anyway): the reporter builds the same shaped payload and
+hands it to a pluggable ``send`` callable; the default sink writes to
+the logger at debug level.  The payload builder is exercised by tests
+and by ``/status`` consumers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu import __version__
+
+
+def build_payload(holder, cluster=None) -> dict:
+    """Anonymized usage snapshot (counts only, no names/keys)."""
+    n_fields = 0
+    n_shards = 0
+    field_types: dict[str, int] = {}
+    for idx in holder.indexes.values():
+        for fname, f in idx.fields.items():
+            if fname.startswith("_"):
+                continue
+            n_fields += 1
+            field_types[f.options.type] = \
+                field_types.get(f.options.type, 0) + 1
+        n_shards += len(idx.available_shards())
+    payload = {
+        "version": __version__,
+        "numIndexes": len(holder.indexes),
+        "numFields": n_fields,
+        "numShards": n_shards,
+        "fieldTypes": field_types,
+        "numNodes": len(cluster.member_ids()) if cluster else 1,
+    }
+    try:
+        import jax
+        payload["deviceKind"] = jax.devices()[0].device_kind
+        payload["numDevices"] = jax.device_count()
+    except Exception:  # noqa: BLE001 — diagnostics must never break serving
+        pass
+    return payload
+
+
+class Diagnostics:
+    """Periodic reporter; disabled unless an interval > 0 is given
+    (upstream default-on behavior deliberately inverted)."""
+
+    def __init__(self, holder, cluster=None, interval: float = 0.0,
+                 send=None, logger=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.interval = interval
+        self.send = send or self._log_sink
+        self.logger = logger
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _log_sink(self, payload: dict) -> None:
+        if self.logger is not None:
+            self.logger.debug("diagnostics: %s", payload)
+
+    def start(self) -> "Diagnostics":
+        if self.interval > 0:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="pilosa-diagnostics",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.send(build_payload(self.holder, self.cluster))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -25,6 +25,7 @@ class PilosaTPUServer:
         self.api: API | None = None
         self.http: HttpServer | None = None
         self.cluster = None
+        self.diagnostics = None
 
     def open(self) -> "PilosaTPUServer":
         self.holder.open()
@@ -52,9 +53,16 @@ class PilosaTPUServer:
         self.http.start()
         if self.cluster is not None:
             self.cluster.open()
+        from pilosa_tpu.obs.diagnostics import Diagnostics
+        self.diagnostics = Diagnostics(
+            self.holder, self.cluster,
+            interval=self.cfg.diagnostics_interval,
+            logger=self.logger).start()
         return self
 
     def close(self) -> None:
+        if self.diagnostics is not None:
+            self.diagnostics.close()
         if self.cluster is not None:
             self.cluster.close()
         if self.http is not None:

@@ -80,3 +80,36 @@ class TestTracer:
         (s,) = t.finished()
         assert s.name == "server-side" and s.trace_id == "aaaa"
         assert s.parent_id == "bbbb"
+
+
+class TestDiagnostics:
+    def test_payload_shape(self, tmp_path):
+        from pilosa_tpu.obs.diagnostics import build_payload
+        from pilosa_tpu.store import FieldOptions, Holder
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        idx.create_field("n", FieldOptions(type="int"))
+        idx.set_bit("f", 1, 10)
+        p = build_payload(h)
+        assert p["numIndexes"] == 1 and p["numFields"] == 2
+        assert p["fieldTypes"] == {"set": 1, "int": 1}
+        assert p["numShards"] >= 1 and p["version"]
+
+    def test_periodic_reporting(self, tmp_path):
+        import time
+        from pilosa_tpu.obs.diagnostics import Diagnostics
+        from pilosa_tpu.store import Holder
+        h = Holder(str(tmp_path)).open()
+        got = []
+        d = Diagnostics(h, interval=0.05, send=got.append).start()
+        time.sleep(0.2)
+        d.close()
+        assert got and got[0]["numIndexes"] == 0
+
+    def test_disabled_by_default(self, tmp_path):
+        from pilosa_tpu.obs.diagnostics import Diagnostics
+        from pilosa_tpu.store import Holder
+        d = Diagnostics(Holder(str(tmp_path)).open(), interval=0.0).start()
+        assert d._thread is None
+        d.close()
