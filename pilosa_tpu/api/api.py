@@ -99,18 +99,30 @@ class API:
     # -- query --------------------------------------------------------------
 
     def query(self, index: str, pql: str,
-              shards: list[int] | None = None) -> dict:
+              shards: list[int] | None = None,
+              profile: bool = False) -> dict:
+        """``profile=True`` attaches the per-call span tree to the
+        response (reference: query ``profile`` option, SURVEY.md §6)."""
         from pilosa_tpu.exec.executor import ExecutionError
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
+        tracer = None
+        if profile:
+            from pilosa_tpu.obs import Tracer
+            tracer = Tracer()
         try:
             if self.cluster is not None:
-                return {"results": self.cluster.dist.execute_json(
-                    index, pql, shards=shards)}
-            results = self.executor.execute(index, pql, shards=shards)
+                out = {"results": self.cluster.dist.execute_json(
+                    index, pql, shards=shards, tracer=tracer)}
+            else:
+                results = self.executor.execute(index, pql, shards=shards,
+                                                tracer=tracer)
+                out = {"results": [result_to_json(r) for r in results]}
         except (ParseError, ExecutionError) as e:
             raise ApiError(str(e), 400)
-        return {"results": [result_to_json(r) for r in results]}
+        if tracer is not None:
+            out["profile"] = [s.to_json() for s in tracer.finished()]
+        return out
 
     # -- imports ------------------------------------------------------------
 

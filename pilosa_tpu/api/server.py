@@ -143,7 +143,9 @@ class Handler(BaseHTTPRequestHandler):
             except ValueError:
                 raise ApiError(f"bad shards param "
                                f"{self.query['shards'][0]!r}")
-        self._reply(self.server.api.query(index, pql, shards=shards))
+        profile = "profile" in self.query
+        self._reply(self.server.api.query(index, pql, shards=shards,
+                                          profile=profile))
 
     def h_create_index(self, index: str) -> None:
         body = self._json_body()

@@ -61,6 +61,7 @@ class Cluster:
         self._shard_cache: dict[str, tuple[float, tuple[int, ...]]] = {}
         self._lock = threading.RLock()
         self._status_ts = 0.0
+        self._removed: dict[str, float] = {}  # tombstones: explicit removals
         self._resize_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -159,11 +160,14 @@ class Cluster:
 
     def handle_join(self, node: dict) -> dict:
         with self._lock:
+            self._removed.pop(node["id"], None)  # explicit rejoin clears
             is_new = node["id"] not in self.nodes
             self.nodes[node["id"]] = {**node, "state": STATE_NORMAL}
             self._last_seen[node["id"]] = time.monotonic()
         if is_new:
-            self._broadcast_status()
+            # propagate the tombstone clear: every peer must re-admit the
+            # rejoining node or its heartbeats keep getting bounced
+            self._broadcast_status(cleared=[node["id"]])
             if self.is_coordinator():
                 self.trigger_resize()
         return {"nodes": list(self.nodes.values()), "state": self.state,
@@ -171,6 +175,11 @@ class Cluster:
 
     def handle_heartbeat(self, node_id: str, state: str) -> dict:
         with self._lock:
+            if node_id in self._removed:
+                # tombstoned: tell the sender it was removed; it must
+                # rejoin explicitly to come back
+                return {"id": self.node_id, "state": self.state,
+                        "removed": True}
             self._last_seen[node_id] = time.monotonic()
             if node_id not in self.nodes:
                 # node knows us but we lost it (e.g. restarted): re-add
@@ -185,17 +194,24 @@ class Cluster:
             if payload.get("ts", float("inf")) < self._status_ts:
                 return
             self._status_ts = payload.get("ts", self._status_ts)
+            for cleared_id in payload.get("cleared", []):
+                self._removed.pop(cleared_id, None)
             # MERGE membership: a broadcast snapshotted before a
             # concurrent join must not evict the newer node (nodes are
-            # only removed explicitly, never by omission)
+            # only removed explicitly, never by omission); tombstoned
+            # nodes stay out even if a stale snapshot carries them
             for n in payload["nodes"]:
+                if n["id"] in self._removed:
+                    continue
                 self.nodes[n["id"]] = n
                 self._last_seen.setdefault(n["id"], now)
             self.state = payload["state"]
 
-    def _broadcast_status(self) -> None:
+    def _broadcast_status(self, cleared: list[str] | None = None) -> None:
         payload = {"nodes": list(self.nodes.values()), "state": self.state,
                    "ts": time.time()}
+        if cleared:
+            payload["cleared"] = cleared
         for nid in self.member_ids():
             if nid == self.node_id:
                 continue
@@ -212,9 +228,22 @@ class Cluster:
                 if nid == self.node_id:
                     continue
                 try:
-                    self._client(nid)._json(
+                    resp = self._client(nid)._json(
                         "POST", "/internal/heartbeat",
                         {"id": self.node_id, "state": self.state})
+                    if resp.get("removed"):
+                        # we were explicitly removed: drop to single-node
+                        # membership (an operator rejoin brings us back)
+                        self.logger.warning(
+                            "this node was removed from the cluster by %s",
+                            nid)
+                        with self._lock:
+                            self.nodes = {self.node_id:
+                                          self.nodes.get(self.node_id,
+                                                         {"id": self.node_id,
+                                                          "uri": self.node_id,
+                                                          "state": self.state})}
+                        break
                     with self._lock:
                         self._last_seen[nid] = time.monotonic()
                 except Exception:  # noqa: BLE001 — peer down
@@ -522,6 +551,43 @@ class Cluster:
     def trigger_resize(self) -> None:
         """Spawn a background rebalance (coordinator only)."""
         self._spawn(self._resize_job, "resize")
+
+    # -- explicit removal (reference: remove-node resize, SURVEY.md §6) -----
+
+    def remove_node(self, node_id: str) -> None:
+        """Coordinator: remove a node from membership (dead or retiring),
+        tombstone it, broadcast the removal, and rebalance so remaining
+        replicas restore the replication factor."""
+        if not self.is_coordinator():
+            raise PermissionError(
+                f"not the coordinator (coordinator is "
+                f"{self.coordinator_id()})")
+        if node_id == self.node_id:
+            raise ValueError("coordinator cannot remove itself")
+        with self._lock:
+            if node_id not in self.nodes:
+                raise KeyError(node_id)
+            del self.nodes[node_id]
+            self._last_seen.pop(node_id, None)
+            self._removed[node_id] = time.time()
+        payload = {"id": node_id, "ts": time.time()}
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                self._client(nid)._json("POST", "/internal/node/remove",
+                                        payload)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("remove broadcast to %s failed: %s",
+                                    nid, e)
+        self.logger.info("removed node %s; rebalancing", node_id)
+        self.trigger_resize()
+
+    def handle_node_remove(self, payload: dict) -> None:
+        with self._lock:
+            self.nodes.pop(payload["id"], None)
+            self._last_seen.pop(payload["id"], None)
+            self._removed[payload["id"]] = payload.get("ts", time.time())
 
     def _resize_job(self) -> None:
         """Coordinator: rebalance fragments onto the current membership.

@@ -42,7 +42,8 @@ def _strip_truncation(call: Call) -> Call:
     same reason, ``executeTopN`` SURVEY.md §4.3; here nodes return full
     count vectors instead)."""
     eff = _call_of(call)
-    strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",)}
+    strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",),
+             "All": ("limit", "offset")}
     keys = strip.get(eff.name)
     if not keys or not any(k in eff.args for k in keys):
         return call
@@ -64,17 +65,21 @@ class DistributedExecutor:
     # -- public -------------------------------------------------------------
 
     def execute_json(self, index: str, pql: str,
-                     shards: list[int] | None = None) -> list:
+                     shards: list[int] | None = None, tracer=None) -> list:
+        from contextlib import nullcontext
         query = parse(pql)
         out = []
         for call in query.calls:
             name = _call_of(call).name
-            if name in ATTR_CALLS:
-                out.append(self._attr_write(index, call))
-            elif name in WRITE_CALLS:
-                out.append(self._write(index, call))
-            else:
-                out.append(self._read(index, call, shards))
+            span = (tracer.span("cluster." + name, index=index)
+                    if tracer is not None else nullcontext())
+            with span:
+                if name in ATTR_CALLS:
+                    out.append(self._attr_write(index, call))
+                elif name in WRITE_CALLS:
+                    out.append(self._write(index, call))
+                else:
+                    out.append(self._read(index, call, shards))
         return out
 
     # -- reads --------------------------------------------------------------
@@ -242,11 +247,18 @@ def merge_results(call: Call, partials: list):
     if name in WRITE_CALLS:
         return any(partials)
     if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
-                "Not", "All"):
-        cols = np.sort(np.concatenate(
+                "Not", "All", "Shift", "UnionRows"):
+        cols = np.unique(np.concatenate(
             [np.asarray(p.get("columns", []), dtype=np.uint64)
              for p in partials]))
-        return {"columns": [int(c) for c in np.unique(cols)]}
+        if name == "All":
+            # paging applies to the MERGED list (per-node paging was
+            # stripped from the fan-out)
+            offset = int(call.args.get("offset", 0))
+            limit = call.args.get("limit")
+            end = None if limit is None else offset + int(limit)
+            cols = cols[offset:end]
+        return {"columns": [int(c) for c in cols]}
     if name == "TopN":
         counts: dict[int, int] = {}
         for p in partials:

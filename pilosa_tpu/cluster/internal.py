@@ -208,6 +208,27 @@ def h_resize_trigger(self: Handler) -> None:
     self._reply({"success": True})
 
 
+def h_node_remove_internal(self: Handler) -> None:
+    _cluster(self).handle_node_remove(self._json_body())
+    self._reply({"success": True})
+
+
+def h_node_remove(self: Handler, node: str) -> None:
+    """Operator surface: remove a (dead or retiring) node.  Must be sent
+    to the coordinator (reference: coordinator-driven remove-node
+    resize)."""
+    cluster = _cluster(self)
+    try:
+        cluster.remove_node(node)
+    except PermissionError as e:
+        raise ApiError(str(e), 409)
+    except KeyError:
+        raise ApiError(f"node {node!r} not in cluster", 404)
+    except ValueError as e:
+        raise ApiError(str(e), 400)
+    self._reply({"success": True})
+
+
 def register_internal_routes(router: Router) -> None:
     router.add("POST", "/internal/join", h_join)
     router.add("POST", "/internal/heartbeat", h_heartbeat)
@@ -229,3 +250,5 @@ def register_internal_routes(router: Router) -> None:
     router.add("GET", "/internal/attrs/blocks", h_attr_blocks)
     router.add("GET", "/internal/attrs/block", h_attr_block)
     router.add("POST", "/internal/attrs/merge", h_attr_merge)
+    router.add("POST", "/internal/node/remove", h_node_remove_internal)
+    router.add("DELETE", "/cluster/node/{node}", h_node_remove)

@@ -142,6 +142,30 @@ def union_rows(plane: jax.Array, row_mask: jax.Array) -> jax.Array:
     )
 
 
+def shift(words: jax.Array, n: int = 1) -> jax.Array:
+    """Shift every bit's column position up by ``n`` within its shard
+    (reference: v2 ``Shift(row, n)`` — bits crossing the shard boundary
+    drop, matching upstream's per-fragment shift).
+
+    words: uint32[..., W]; bit order is LSB-first within a word, so a
+    +1 column shift is a logical LEFT shift with carry between words.
+    """
+    if n < 0:
+        raise ValueError("shift n must be non-negative")
+    word_n, bit_n = divmod(n, 32)
+    w = words.shape[-1]
+    if word_n:
+        # move whole words towards higher indices, zero-fill the bottom
+        pad = jnp.zeros(words.shape[:-1] + (word_n,), dtype=words.dtype)
+        words = jnp.concatenate([pad, words[..., :w - word_n]], axis=-1)
+    if bit_n:
+        carry_in = jnp.concatenate(
+            [jnp.zeros(words.shape[:-1] + (1,), dtype=words.dtype),
+             words[..., :-1]], axis=-1) >> (32 - bit_n)
+        words = (words << bit_n) | carry_in
+    return words
+
+
 # ---------------------------------------------------------------------------
 # Mutation kernels (device-side scatter of bit updates)
 # ---------------------------------------------------------------------------

@@ -48,6 +48,7 @@ RESERVED_KEYS = frozenset({
 
 _BITMAP_CALLS = frozenset({
     "Row", "Intersect", "Union", "Difference", "Xor", "Not", "All", "Range",
+    "Shift", "UnionRows",
 })
 
 _SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
@@ -90,27 +91,30 @@ class Executor:
 
     def execute(self, index_name: str, query: str | Query,
                 shards: list[int] | None = None,
-                translate_output: bool = True) -> list:
+                translate_output: bool = True, tracer=None) -> list:
         """Run every top-level call; returns one result per call
         (reference: ``Executor.Execute`` → ``QueryResponse.Results``).
 
         ``translate_output=False`` leaves raw IDs in results — used by
         the cluster layer, which merges partials from many nodes first
-        and key-translates once at the edge."""
+        and key-translates once at the edge.  ``tracer`` overrides the
+        shared tracer (the ``profile=true`` path uses a per-request one
+        so concurrent queries' spans don't interleave)."""
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index {index_name!r} not found")
         if isinstance(query, str):
             query = parse(query)
+        tracer = tracer or self.tracer
         results = []
         # spans per call + per-call-type latency counters (reference:
         # executor span/stats emission, SURVEY.md §3.3 / §6)
         for call in query.calls:
             ctx = _Ctx(index, self._shards_for(index, shards, call),
                        translate_output)
-            with self.tracer.span("executor." + call.name,
-                                  index=index_name,
-                                  shards=len(ctx.shards)):
+            with tracer.span("executor." + call.name,
+                             index=index_name,
+                             shards=len(ctx.shards)):
                 t0 = time.perf_counter()
                 results.append(self._call(ctx, call))
                 self.stats.timing("query_seconds",
@@ -148,7 +152,17 @@ class Executor:
             return result
         if call.name in _BITMAP_CALLS:
             words = self._fused_bitmap(ctx, call)
-            return self._to_row_result(ctx, words)
+            result = self._to_row_result(ctx, words)
+            if call.name == "All":
+                # All(limit=, offset=) pages the column list (v2 parity)
+                offset = int(call.args.get("offset", 0))
+                limit = call.args.get("limit")
+                if offset or limit is not None:
+                    end = None if limit is None else offset + int(limit)
+                    result.columns = result.columns[offset:end]
+                    if result.keys is not None:
+                        result.keys = result.keys[offset:end]
+            return result
         handler = getattr(self, "_execute_" + call.name.lower(), None)
         if handler is None:
             raise ExecutionError(f"unknown call {call.name!r}")
@@ -199,7 +213,50 @@ class Executor:
             op = {"Union": "or", "Intersect": "and",
                   "Difference": "andnot", "Xor": "xor"}[name]
             return (op, tuple(self._plan(ctx, k, leaves) for k in kids))
+        if name == "Shift":
+            if len(call.children) != 1:
+                raise ExecutionError("Shift: exactly one child required")
+            n = self._shift_n(call)
+            return ("shift", self._plan(ctx, call.children[0], leaves), n)
+        if name == "UnionRows":
+            # UnionRows(Rows(f)): OR of every row the Rows call selects
+            # (reference: v2 executeUnionRows)
+            return leaf(self._union_rows(ctx, call))
         raise ExecutionError(f"not a bitmap call: {name}")
+
+    @staticmethod
+    def _shift_n(call: Call) -> int:
+        try:
+            n = int(call.args.get("n", 1))
+        except (TypeError, ValueError):
+            raise ExecutionError(f"Shift: bad n {call.args.get('n')!r}")
+        if not 0 <= n < SHARD_WIDTH:
+            raise ExecutionError(f"Shift: n must be in [0, 2^20), got {n}")
+        return n
+
+    def _union_rows(self, ctx: _Ctx, call: Call) -> jax.Array:
+        bad = [c.name for c in call.children if c.name != "Rows"]
+        if bad:
+            raise ExecutionError(
+                f"UnionRows: children must be Rows calls, got {bad}")
+        rows_calls = call.children
+        if not rows_calls:
+            raise ExecutionError("UnionRows: Rows children required")
+        acc = self._zeros(ctx)
+        for rc in rows_calls:
+            fname = rc.args.get("_field") or rc.args.get("field")
+            field = self._field(ctx, str(fname))
+            rows = self._rows_of(ctx, field, rc)
+            ps = self.planes.field_plane(ctx.index.name, field,
+                                         VIEW_STANDARD, ctx.shards)
+            if ps.n_rows == 0 or len(rows) == 0:
+                continue
+            mask = np.zeros(ps.plane.shape[-2], dtype=bool)
+            for r in rows:
+                mask[ps.slot_of[int(r)]] = True
+            acc = kernels.union(acc, kernels.union_rows(
+                ps.plane, jnp.asarray(mask)))
+        return acc
 
     def _plan_row(self, ctx: _Ctx, call: Call, leaves: list, leaf):
         hit = call.field_arg(RESERVED_KEYS)
@@ -300,6 +357,13 @@ class Executor:
             for k in kids[1:]:
                 acc = kernels.xor(acc, self._bitmap(ctx, k))
             return acc
+        if name == "Shift":
+            if len(kids) != 1:
+                raise ExecutionError("Shift: exactly one child required")
+            return kernels.shift(self._bitmap(ctx, kids[0]),
+                                 self._shift_n(call))
+        if name == "UnionRows":
+            return self._union_rows(ctx, call)
         raise ExecutionError(f"not a bitmap call: {name}")
 
     def _row_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:

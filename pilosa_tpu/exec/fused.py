@@ -27,6 +27,7 @@ from pilosa_tpu.engine import kernels
 #   ("or-leaves", (i, j, ...))       union of row leaves (time ranges)
 #   ("and"|"or"|"andnot"|"xor", (child, child, ...))   fold left
 #   ("not", child, i_exists)
+#   ("shift", child, n)
 #   ("bsi", i_plane, i_masks, i_neg, op_key)
 #   ("bsi-between", i_plane, i_lo_masks, i_lo_neg, lo_op,
 #                   i_hi_masks, i_hi_neg, hi_op)
@@ -58,6 +59,8 @@ def _build(node, leaves):
         return acc
     if kind == "not":
         return kernels.complement(_build(node[1], leaves), leaves[node[2]])
+    if kind == "shift":
+        return kernels.shift(_build(node[1], leaves), node[2])
     if kind == "bsi":
         _, i_plane, i_masks, i_neg, op_key = node
         cmp = bsik.range_cmp(leaves[i_plane], leaves[i_masks],
@@ -74,16 +77,23 @@ def _build(node, leaves):
 
 
 class FusedCache:
-    """structure key -> jitted program.  One instance per executor."""
+    """structure key -> jitted program, LRU-bounded: structure keys can
+    embed user-controlled constants (e.g. Shift n), so the program set
+    must not grow without bound.  One instance per executor."""
+
+    MAX_PROGRAMS = 256
 
     def __init__(self):
-        self._programs: dict = {}
+        from collections import OrderedDict
+        self._programs: "OrderedDict" = OrderedDict()
 
     def run(self, node, leaves, want: str):
         """Execute a planned tree: ``want`` is "words" (bitmap) or
         "count" (fused popcount-reduce scalar)."""
         key = (node, want)
         fn = self._programs.get(key)
+        if fn is not None:
+            self._programs.move_to_end(key)
         if fn is None:
             if want == "count":
                 # per-shard int32 counts; the caller finishes the tiny
@@ -94,4 +104,6 @@ class FusedCache:
                 def program(*ls):
                     return _build(node, ls)
             fn = self._programs[key] = jax.jit(program)
+            while len(self._programs) > self.MAX_PROGRAMS:
+                self._programs.popitem(last=False)
         return fn(*leaves)

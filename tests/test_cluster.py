@@ -306,3 +306,150 @@ class TestClusterReviewRegressions:
         assert c.client(0).query("k", 'Clear("ghost", f="nothing")') == [False]
         log = c.servers[0].executor.translate.columns("k")
         assert log.translate(["ghost"], create=False) == [None]
+
+
+class TestNodeRemoval:
+    def test_remove_rebalances_and_tombstones(self, tmp_path):
+        with run_cluster(3, str(tmp_path), replicas=2, heartbeat=0.1) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6, columnIDs=cols)
+
+            coord_id = c.servers[0].cluster.coordinator_id()
+            coord = c.server_for(coord_id)
+            victim = next(s for s in c.servers
+                          if s.cluster.node_id != coord_id)
+            victim_id = victim.cluster.node_id
+            victim.close()
+
+            from pilosa_tpu.api.client import Client
+            host, port = coord_id.rsplit(":", 1)
+            cl = Client(host, int(port))
+            cl._json("DELETE", f"/cluster/node/{victim_id}")
+
+            import time
+            deadline = time.monotonic() + 10
+            survivors = [s for s in c.servers if s is not victim]
+            while time.monotonic() < deadline:
+                if all(victim_id not in s.cluster.nodes for s in survivors) \
+                        and all(s.cluster.state == "NORMAL"
+                                for s in survivors):
+                    break
+                time.sleep(0.05)
+            for s in survivors:
+                assert victim_id not in s.cluster.nodes
+            # replication factor restored: every shard has 2 live holders
+            deadline = time.monotonic() + 10
+            def fully_replicated():
+                for shard in range(6):
+                    holders = 0
+                    for s in survivors:
+                        idx = s.holder.index("i")
+                        f = idx.field("f") if idx else None
+                        v = f.standard_view() if f else None
+                        frag = v.fragment(shard) if v else None
+                        if frag is not None and frag.row(1).any():
+                            holders += 1
+                    if holders < 2:
+                        return False
+                return True
+            while time.monotonic() < deadline and not fully_replicated():
+                time.sleep(0.05)
+            assert fully_replicated()
+            (cnt,) = cl.query("i", "Count(Row(f=1))")
+            assert cnt == 6
+
+    def test_non_coordinator_remove_is_409(self, three_nodes):
+        c = three_nodes
+        coord = c.servers[0].cluster.coordinator_id()
+        non = next(s for s in c.servers if s.cluster.node_id != coord)
+        from pilosa_tpu.api.client import Client, ClientError
+        host, port = non.cluster.node_id.rsplit(":", 1)
+        cl = Client(host, int(port))
+        other = next(i for i in c.node_ids()
+                     if i not in (coord, non.cluster.node_id))
+        with pytest.raises(ClientError) as e:
+            cl._json("DELETE", f"/cluster/node/{other}")
+        assert e.value.status == 409
+
+
+class TestParityBatchCluster:
+    def test_shift_and_unionrows_merge(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "f", rowIDs=[1, 2],
+                                columnIDs=[5, far + 7])
+        (r,) = c.client(1).query("i", "Shift(Row(f=1), n=1)")
+        assert r["columns"] == [6]
+        (u,) = c.client(2).query("i", "UnionRows(Rows(f))")
+        assert u["columns"] == [5, far + 7]
+
+    def test_all_paging_merged(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        cols = [1, 2, SHARD_WIDTH + 1, SHARD_WIDTH + 2, 3 * SHARD_WIDTH + 5]
+        c.client(0).import_bits("i", "f", rowIDs=[1] * 5, columnIDs=cols)
+        (r,) = c.client(1).query("i", "All(limit=3)")
+        assert r["columns"] == sorted(cols)[:3]
+        (r2,) = c.client(2).query("i", "All(limit=2, offset=2)")
+        assert r2["columns"] == sorted(cols)[2:4]
+
+    def test_shift_bad_n_is_400(self, three_nodes):
+        from pilosa_tpu.api.client import ClientError
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        with pytest.raises(ClientError) as e:
+            c.client(0).query("i", "Shift(Row(f=1), n=-1)")
+        assert e.value.status == 400
+
+
+class TestRejoinAfterRemoval:
+    def test_removed_node_can_rejoin(self, tmp_path):
+        with run_cluster(3, str(tmp_path), heartbeat=0.1) as c:
+            coord_id = c.servers[0].cluster.coordinator_id()
+            coord = c.server_for(coord_id)
+            victim = next(s for s in c.servers
+                          if s.cluster.node_id != coord_id)
+            victim_id = victim.cluster.node_id
+            victim_dir = victim.cfg.data_dir
+            victim.close()
+            coord.cluster.remove_node(victim_id)
+            import time
+            survivors = [s for s in c.servers if s is not victim]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if all(victim_id not in s.cluster.nodes for s in survivors):
+                    break
+                time.sleep(0.05)
+            # rejoin: a fresh server at a new port, seeded via NON-coord
+            # peer (exercises tombstone-clear propagation)
+            from pilosa_tpu.cli.config import Config
+            from pilosa_tpu.server import PilosaTPUServer
+            non_coord = next(s for s in survivors
+                             if s.cluster.node_id != coord_id)
+            cfg = Config(bind="127.0.0.1:0", data_dir=victim_dir + "b",
+                         seeds=[non_coord.cluster.node_id],
+                         cluster_enabled=True, heartbeat_interval=0.1,
+                         anti_entropy_interval=0.0, mesh=False)
+            back = PilosaTPUServer(cfg).open()
+            try:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if all(back.cluster.node_id in s.cluster.nodes
+                           for s in survivors) \
+                            and len(back.cluster.alive_ids()) == 3:
+                        break
+                    time.sleep(0.05)
+                for s in survivors:
+                    assert back.cluster.node_id in s.cluster.nodes
+                    assert back.cluster.node_id not in s.cluster._removed
+                # must stay in (heartbeats not bounced)
+                time.sleep(0.5)
+                assert len(back.cluster.nodes) == 3
+            finally:
+                back.close()

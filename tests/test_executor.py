@@ -299,3 +299,51 @@ class TestTimeRangeClamping:
         idx.create_field("t2", FieldOptions(type="time", time_quantum="D"))
         (r,) = q(ex, "Row(t2=1, from=2020-01-01T00:00, to=2021-01-01T00:00)")
         assert len(r.columns) == 0
+
+
+class TestParityBatch:
+    def test_shift(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(40, f=1)")
+        (r,) = q(ex, "Shift(Row(f=1), n=1)")
+        np.testing.assert_array_equal(r.columns, [2, 41])
+        (r2,) = q(ex, "Shift(Row(f=1), n=40)")  # crosses word boundary
+        np.testing.assert_array_equal(r2.columns, [41, 80])
+        assert q(ex, "Count(Shift(Row(f=1), n=1))") == [2]
+
+    def test_shift_drops_at_shard_boundary(self, env):
+        _, _, ex = env
+        last = SHARD_WIDTH - 1
+        q(ex, f"Set({last}, f=1) Set(0, f=1)")
+        (r,) = q(ex, "Shift(Row(f=1), n=1)")
+        np.testing.assert_array_equal(r.columns, [1])
+
+    def test_union_rows(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=20) Set(3, f=30) Set(2, g=1)")
+        (r,) = q(ex, "UnionRows(Rows(f))")
+        np.testing.assert_array_equal(r.columns, [1, 2, 3])
+        (r2,) = q(ex, "UnionRows(Rows(f, limit=2))")
+        np.testing.assert_array_equal(r2.columns, [1, 2])
+        assert q(ex, "Count(Intersect(UnionRows(Rows(f)), Row(g=1)))") == [1]
+
+    def test_all_limit_offset(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(3, f=1) Set(4, f=1)")
+        (r,) = q(ex, "All(limit=2)")
+        np.testing.assert_array_equal(r.columns, [1, 2])
+        (r2,) = q(ex, "All(limit=2, offset=1)")
+        np.testing.assert_array_equal(r2.columns, [2, 3])
+
+    def test_profile_spans(self, tmp_path):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.store import Holder
+        holder = Holder(str(tmp_path)).open()
+        holder.create_index("i").create_field("f")
+        api = API(holder)
+        api.query("i", "Set(1, f=1)")
+        out = api.query("i", "Count(Row(f=1)) Row(f=1)", profile=True)
+        assert out["results"][0] == 1
+        names = [s["name"] for s in out["profile"]]
+        assert names == ["executor.Count", "executor.Row"]
+        assert all(s["durationUs"] >= 0 for s in out["profile"])
