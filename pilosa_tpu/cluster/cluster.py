@@ -325,11 +325,20 @@ class Cluster:
 
     def internal_query(self, node_id: str, index: str, pql: str,
                        shards) -> list:
+        from pilosa_tpu.api.client import ClientError
+        from pilosa_tpu.exec.executor import ExecutionError
         path = f"/internal/query?index={index}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
-        return self._client(node_id)._do(
-            "POST", path, pql.encode())["results"]
+        try:
+            return self._client(node_id)._do(
+                "POST", path, pql.encode())["results"]
+        except ClientError as e:
+            if e.status == 400:
+                # peer rejected the query itself: surface as a query
+                # error (HTTP 400 at the public edge), not a node fault
+                raise ExecutionError(str(e)) from e
+            raise
 
     # -- key translation (coordinator-assigned, replicated logs) ------------
 

@@ -66,14 +66,19 @@ def h_internal_query(self: Handler) -> None:
     """Execute locally only (no re-fan-out) with raw-ID results —
     reference: ``/internal/query`` remote execution."""
     from pilosa_tpu.exec import result_to_json
+    from pilosa_tpu.exec.executor import ExecutionError
+    from pilosa_tpu.pql.parser import ParseError
     api = self.server.api
     index = _qs(self, "index")
     shards = None
     if "shards" in self.query:
         shards = [int(s) for s in self.query["shards"][0].split(",") if s]
     pql = self._body().decode()
-    results = api.executor.execute(index, pql, shards=shards,
-                                   translate_output=False)
+    try:
+        results = api.executor.execute(index, pql, shards=shards,
+                                       translate_output=False)
+    except (ParseError, ExecutionError) as e:
+        raise ApiError(str(e), 400)
     self._reply({"results": [result_to_json(r) for r in results]})
 
 
