@@ -29,12 +29,26 @@ class Holder:
         self._lock = threading.RLock()
 
     def open(self) -> "Holder":
+        """Scan and open the whole tree; indexes open concurrently
+        (reference: ``Holder.Open`` fragment worker pool — startup is
+        dominated by snapshot reads + op-log replays)."""
         os.makedirs(self.path, exist_ok=True)
-        for entry in sorted(os.listdir(self.path)):
-            ipath = os.path.join(self.path, entry)
-            if os.path.isdir(ipath) and not entry.startswith("."):
-                self.indexes[entry] = Index(ipath, entry,
-                                            fsync=self.fsync).open()
+        entries = [e for e in sorted(os.listdir(self.path))
+                   if os.path.isdir(os.path.join(self.path, e))
+                   and not e.startswith(".")]
+        if len(entries) <= 1:
+            for entry in entries:
+                self.indexes[entry] = Index(
+                    os.path.join(self.path, entry), entry,
+                    fsync=self.fsync).open()
+            return self
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(8, len(entries))) as pool:
+            opened = pool.map(
+                lambda e: (e, Index(os.path.join(self.path, e), e,
+                                    fsync=self.fsync).open()), entries)
+            for entry, idx in opened:
+                self.indexes[entry] = idx
         return self
 
     def close(self) -> None:

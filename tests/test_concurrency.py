@@ -1,0 +1,107 @@
+"""Concurrency stress: writers + readers racing on one holder/executor.
+
+The reference runs its whole suite under ``go test -race`` (SURVEY.md
+§5/§6); Python has no TSAN, so the mitigation is lock discipline
+(per-fragment RLock, plane-cache generation invalidation) exercised
+here under real thread contention: no exceptions, no torn reads, exact
+final counts."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.store import FieldOptions, Holder
+
+
+@pytest.mark.parametrize("n_writers,n_readers", [(4, 4)])
+def test_concurrent_writes_and_queries(tmp_path, n_writers, n_readers):
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("amount", FieldOptions(type="int", min=0, max=10**6))
+    ex = Executor(holder)
+
+    per_writer = 300
+    errors: list[Exception] = []
+    start = threading.Barrier(n_writers + n_readers)
+
+    def writer(wid: int):
+        try:
+            start.wait()
+            rng = np.random.default_rng(wid)
+            for i in range(per_writer):
+                col = wid * per_writer + i
+                ex.execute("i", f"Set({col}, f={wid})")
+                if i % 7 == 0:
+                    ex.execute("i", f"Set({col}, amount={int(rng.integers(1000))})")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            start.wait()
+            for _ in range(50):
+                (n,) = ex.execute("i", "Count(All())")
+                assert 0 <= n <= n_writers * per_writer
+                ex.execute("i", "TopN(f, n=3)")
+                ex.execute("i", "Sum(field=amount)")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_writers)]
+    threads += [threading.Thread(target=reader) for _ in range(n_readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+
+    # exact final state
+    for w in range(n_writers):
+        (cnt,) = ex.execute("i", f"Count(Row(f={w}))")
+        assert cnt == per_writer, f"writer {w}"
+    (total,) = ex.execute("i", "Count(All())")
+    assert total == n_writers * per_writer
+
+
+def test_concurrent_fragment_mutation(tmp_path):
+    """Many threads hammering one fragment: bits must be a clean union."""
+    from pilosa_tpu.store.fragment import Fragment
+    frag = Fragment(str(tmp_path / "0"), 0, max_op_n=50).open()
+    errors = []
+
+    def worker(wid: int):
+        try:
+            cols = np.arange(wid * 1000, (wid + 1) * 1000, dtype=np.uint64)
+            for chunk in np.array_split(cols, 10):
+                frag.set_bits(np.full(len(chunk), 1, np.uint64), chunk)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert frag.row(1).cardinality == 8000
+    # crash-replay under the concurrent op-log interleaving
+    g = Fragment(str(tmp_path / "0"), 0).open()
+    assert g.row(1).cardinality == 8000
+
+
+def test_parallel_holder_open(tmp_path):
+    h = Holder(str(tmp_path)).open()
+    for i in range(5):
+        idx = h.create_index(f"idx{i}")
+        idx.create_field("f")
+        idx.set_bit("f", 1, i * 10)
+    h.close()
+    h2 = Holder(str(tmp_path)).open()  # concurrent index opens
+    assert sorted(h2.indexes) == [f"idx{i}" for i in range(5)]
+    ex = Executor(h2)
+    for i in range(5):
+        assert ex.execute(f"idx{i}", "Count(Row(f=1))") == [1]
