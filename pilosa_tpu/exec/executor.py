@@ -108,8 +108,33 @@ class Executor:
         tracer = tracer or self.tracer
         results = []
         # spans per call + per-call-type latency counters (reference:
-        # executor span/stats emission, SURVEY.md §3.3 / §6)
-        for call in query.calls:
+        # executor span/stats emission, SURVEY.md §3.3 / §6).
+        # Runs of consecutive Count calls execute as ONE fused program
+        # with one result read (consecutive only: a write between counts
+        # must stay ordered).
+        i = 0
+        calls = query.calls
+        while i < len(calls):
+            run_end = i
+            while (run_end < len(calls) and calls[run_end].name == "Count"
+                   and len(calls[run_end].children) == 1):
+                run_end += 1
+            if run_end - i > 1:
+                ctx = _Ctx(index, self._shards_for(index, shards, calls[i]),
+                           translate_output)
+                with tracer.span("executor.CountBatch",
+                                 index=index_name, calls=run_end - i,
+                                 shards=len(ctx.shards)):
+                    t0 = time.perf_counter()
+                    batched = self._count_batch(ctx, calls[i:run_end])
+                    self.stats.timing("query_seconds",
+                                      time.perf_counter() - t0,
+                                      call="CountBatch")
+                if batched is not None:
+                    results.extend(batched)
+                    i = run_end
+                    continue
+            call = calls[i]
             ctx = _Ctx(index, self._shards_for(index, shards, call),
                        translate_output)
             with tracer.span("executor." + call.name,
@@ -119,7 +144,27 @@ class Executor:
                 results.append(self._call(ctx, call))
                 self.stats.timing("query_seconds",
                                   time.perf_counter() - t0, call=call.name)
+            i += 1
         return results
+
+    def _count_batch(self, ctx: _Ctx, calls: list[Call]) -> list[int] | None:
+        """Plan every Count child, concatenate leaf lists, run one
+        program -> int32[K, S], host-finish each row.  Returns None if
+        any child is unfusable (caller falls back to per-call)."""
+        from pilosa_tpu.exec.fused import Unfusable, shift_leaves
+        nodes, all_leaves = [], []
+        try:
+            for call in calls:
+                leaves: list = []
+                node = self._plan(ctx, call.children[0], leaves)
+                nodes.append(shift_leaves(node, len(all_leaves)))
+                all_leaves.extend(leaves)
+        except Unfusable:
+            return None
+        per_shard = self.fused.run_count_batch(tuple(nodes),
+                                               tuple(all_leaves))
+        host = np.asarray(per_shard).astype(np.int64)  # one read
+        return [int(row.sum()) for row in host]
 
     def _shards_for(self, index: Index, shards, call: Call) -> tuple[int, ...]:
         opts = call.args.get("shards") if call.name == "Options" else None

@@ -76,6 +76,32 @@ def _build(node, leaves):
     raise AssertionError(f"bad node {node!r}")
 
 
+def shift_leaves(node, offset: int):
+    """Re-index a plan tree's leaf references by ``offset`` — used to
+    concatenate several plans' leaf lists into one batched program."""
+    kind = node[0]
+    if kind == "leaf":
+        return ("leaf", node[1] + offset)
+    if kind == "zeros":
+        return node
+    if kind == "or-leaves":
+        return ("or-leaves", tuple(i + offset for i in node[1]))
+    if kind in ("and", "or", "andnot", "xor"):
+        return (kind, tuple(shift_leaves(c, offset) for c in node[1]))
+    if kind == "not":
+        return ("not", shift_leaves(node[1], offset), node[2] + offset)
+    if kind == "shift":
+        return ("shift", shift_leaves(node[1], offset), node[2])
+    if kind == "bsi":
+        return ("bsi", node[1] + offset, node[2] + offset,
+                node[3] + offset, node[4])
+    if kind == "bsi-between":
+        return ("bsi-between", node[1] + offset, node[2] + offset,
+                node[3] + offset, node[4], node[5] + offset,
+                node[6] + offset, node[7])
+    raise AssertionError(f"bad node {node!r}")
+
+
 class FusedCache:
     """structure key -> jitted program, LRU-bounded: structure keys can
     embed user-controlled constants (e.g. Shift n), so the program set
@@ -103,6 +129,24 @@ class FusedCache:
             else:
                 def program(*ls):
                     return _build(node, ls)
+            fn = self._programs[key] = jax.jit(program)
+            while len(self._programs) > self.MAX_PROGRAMS:
+                self._programs.popitem(last=False)
+        return fn(*leaves)
+
+    def run_count_batch(self, nodes: tuple, leaves):
+        """K Count trees in ONE program: returns int32[K, n_shards] —
+        one dispatch and one host read amortize fixed per-read costs
+        across every Count in the request (critical on transports with
+        a per-read floor; see BASELINE.md)."""
+        key = (nodes, "count-batch")
+        fn = self._programs.get(key)
+        if fn is not None:
+            self._programs.move_to_end(key)
+        if fn is None:
+            def program(*ls):
+                return jnp.stack([kernels.count(_build(n, ls))
+                                  for n in nodes])
             fn = self._programs[key] = jax.jit(program)
             while len(self._programs) > self.MAX_PROGRAMS:
                 self._programs.popitem(last=False)

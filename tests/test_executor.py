@@ -347,3 +347,34 @@ class TestParityBatch:
         names = [s["name"] for s in out["profile"]]
         assert names == ["executor.Count", "executor.Row"]
         assert all(s["durationUs"] >= 0 for s in out["profile"])
+
+
+class TestCountBatching:
+    def test_batched_counts_match_individual(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(2, g=1) Set(3, g=1)"
+              "Set(1, amount=5) Set(2, amount=-3)")
+        batch = q(ex, "Count(Row(f=1)) Count(Row(g=1)) "
+                      "Count(Intersect(Row(f=1), Row(g=1))) "
+                      "Count(Row(amount > 0))")
+        assert batch == [2, 2, 1, 1]
+        # individually identical
+        for pql, expect in [("Count(Row(f=1))", 2), ("Count(Row(g=1))", 2)]:
+            assert q(ex, pql) == [expect]
+
+    def test_writes_between_counts_stay_ordered(self, env):
+        _, _, ex = env
+        out = q(ex, "Set(1, f=1) Count(Row(f=1)) Set(2, f=1) Count(Row(f=1))")
+        assert out == [True, 1, True, 2]
+
+    def test_one_program_for_the_batch(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(1, g=1)")
+        before = len(ex.fused._programs)
+        q(ex, "Count(Row(f=1)) Count(Row(g=1))")
+        after = len(ex.fused._programs)
+        assert after == before + 1  # one count-batch program, not two
+        # repeat hits the cache
+        q(ex, "Count(Row(g=1)) Count(Row(f=1))")
+        q(ex, "Count(Row(f=1)) Count(Row(g=1))")
+        assert len(ex.fused._programs) <= after + 1
