@@ -1,0 +1,127 @@
+"""Pallas/Mosaic TPU kernels for the popcount hot loop.
+
+The XLA-fused kernels in :mod:`pilosa_tpu.engine.kernels` are the
+default compute path; these Pallas variants give explicit control of
+the HBM→VMEM streaming and accumulation for the two hottest shapes
+(reference hot loops: container-pairwise intersect kernels and the
+popcount matrix behind TopN, ``roaring/roaring.go`` /
+``fragment.top``; SURVEY.md §4.2–4.3):
+
+- :func:`intersect_count`: ``uint32[S, W] × uint32[S, W] → int32[S]``
+  (and + popcount + per-shard reduce, one VMEM pass);
+- :func:`row_counts`: ``uint32[S, R, W] (× filter) → int32[S, R]``
+  (the TopN matrix), gridded over shards × row blocks so each block
+  streams ~1MB through VMEM.
+
+Popcount uses the SWAR bit-twiddling reduction (shift/mask adds) —
+portable across Mosaic versions regardless of ``population_count``
+support.  Tests run the same kernels in interpreter mode on CPU
+against the numpy oracle; on TPU they compile to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def _popcount_u32(x: jax.Array) -> jax.Array:
+    """SWAR popcount per uint32 lane -> int32.  Masks are weak python
+    ints (pallas kernels must not close over concrete arrays)."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    # sum the 4 bytes via shifts (byte values <= 8, no overflow)
+    x = x + (x >> 8)
+    x = x + (x >> 16)
+    return (x & 0x3F).astype(jnp.int32)
+
+
+def _intersect_count_kernel(a_ref, b_ref, out_ref):
+    words = a_ref[...] & b_ref[...]
+    out_ref[...] = jnp.sum(_popcount_u32(words), axis=-1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def intersect_count(a: jax.Array, b: jax.Array,
+                    interpret: bool = False) -> jax.Array:
+    """Count(Intersect) per shard: uint32[S, W] x2 -> int32[S].
+
+    Shards stream in blocks of 8 (Mosaic requires the sublane block dim
+    divisible by 8); each grid step moves 2x8x4W bytes through VMEM.
+    """
+    s, w = a.shape
+    sb = 8
+    pad = (-s) % sb
+    if pad:
+        a = jnp.pad(a, ((0, pad), (0, 0)))
+        b = jnp.pad(b, ((0, pad), (0, 0)))
+    s_pad = s + pad
+    out = pl.pallas_call(
+        _intersect_count_kernel,
+        grid=(s_pad // sb,),
+        in_specs=[pl.BlockSpec((sb, w), lambda i: (i, 0)),
+                  pl.BlockSpec((sb, w), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((sb, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((s_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(a, b)
+    return out[:s, 0]
+
+
+_SB = 8      # shard block (Mosaic sublane granule)
+_RB = 128    # row block (int32 lane granule)
+_WB = 1024   # word block: 8 x 128 x 1024 x 4B = 4MB tile through VMEM
+
+
+def _row_counts_kernel(plane_ref, filter_ref, out_ref):
+    k = pl.program_id(2)
+    # plane (SB, rb, wb) & filter (SB, 1, wb) -> broadcast over rows
+    words = plane_ref[...] & filter_ref[...]
+    counts = jnp.sum(_popcount_u32(words), axis=-1)  # (SB, rb)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = counts
+
+    @pl.when(k != 0)
+    def _acc():
+        out_ref[...] += counts
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def row_counts(plane: jax.Array, filter_words: jax.Array | None = None,
+               interpret: bool = False) -> jax.Array:
+    """Per-row popcounts (the TopN matrix): uint32[S, R, W] -> int32[S, R].
+
+    Grid (shard blocks, row blocks, word blocks): each step streams an
+    8-shard x <=128-row x 1K-word tile (4MB) through VMEM; the output
+    tile is indexed (i, j) only, so it persists across the innermost
+    word-block axis and accumulates partial counts.
+    """
+    s, r, w = plane.shape
+    if filter_words is None:
+        filter_words = jnp.full((s, w), 0xFFFFFFFF, dtype=jnp.uint32)
+    # rows pad to one full block (<=128 rows) or to 128-row blocks
+    rb = r if r <= _RB else _RB
+    s_pad, r_pad = (-s) % _SB, (-r) % rb
+    wb = _WB if w % _WB == 0 else w
+    if s_pad or r_pad:
+        plane = jnp.pad(plane, ((0, s_pad), (0, r_pad), (0, 0)))
+        filter_words = jnp.pad(filter_words, ((0, s_pad), (0, 0)))
+    sp, rp = s + s_pad, r + r_pad
+    filt3 = filter_words.reshape(sp, 1, w)
+    out = pl.pallas_call(
+        _row_counts_kernel,
+        grid=(sp // _SB, rp // rb, w // wb),
+        in_specs=[
+            pl.BlockSpec((_SB, rb, wb), lambda i, j, k: (i, j, k)),
+            pl.BlockSpec((_SB, 1, wb), lambda i, j, k: (i, 0, k)),
+        ],
+        out_specs=pl.BlockSpec((_SB, rb), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((sp, rp), jnp.int32),
+        interpret=interpret,
+    )(plane, filt3)
+    return out[:s, :r]

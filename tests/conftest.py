@@ -25,8 +25,11 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")  # sitecustomize imported jax with
 # JAX_PLATFORMS=axon already read; override the live config too.
+# Drop only the axon tunnel plugin: jax_platforms=cpu already prevents
+# other backends from initializing, and the 'tpu' platform NAME must
+# stay registered or pallas lowering registration fails at import.
 for _name in list(getattr(_xb, "_backend_factories", {})):
-    if _name != "cpu":
+    if _name not in ("cpu", "tpu"):
         _xb._backend_factories.pop(_name, None)
 
 import numpy as np  # noqa: E402
