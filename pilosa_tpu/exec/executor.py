@@ -754,27 +754,36 @@ class Executor:
             if limit is not None and len(groups) >= int(limit):
                 return
             f, rows, ps = specs[level]
+            if level == len(specs) - 1:
+                # innermost field vectorizes: ONE popcount-matrix program
+                # computes every row's count against the prefix instead
+                # of a dispatch per (prefix, row) combination
+                totals = kernels.shard_totals(
+                    kernels.row_counts(ps.plane, prefix_words))
+                for rid in rows:
+                    cnt = int(totals[ps.slot_of[int(rid)]])
+                    if cnt == 0:
+                        continue
+                    group = [self._field_row(ctx, gf, gr)
+                             for gf, gr in prefix_rows + [(f, int(rid))]]
+                    agg_val = None
+                    if agg_field is not None:
+                        row_w = ps.plane[:, ps.slot_of[int(rid)], :]
+                        words = (row_w if prefix_words is None
+                                 else kernels.intersect(prefix_words, row_w))
+                        aps = self.planes.bsi_plane(
+                            ctx.index.name, agg_field, ctx.shards)
+                        t, c = bsik.sum_count(aps.plane, words)
+                        agg_val = t + agg_field.options.base * c
+                    groups.append(GroupCount(group, cnt, agg_val))
+                    if limit is not None and len(groups) >= int(limit):
+                        return
+                return
             for rid in rows:
                 row_w = ps.plane[:, ps.slot_of[int(rid)], :]
                 words = (row_w if prefix_words is None
                          else kernels.intersect(prefix_words, row_w))
-                if level + 1 < len(specs):
-                    recurse(level + 1, words, prefix_rows + [(f, int(rid))])
-                    if limit is not None and len(groups) >= int(limit):
-                        return
-                    continue
-                cnt = int(kernels.shard_totals(kernels.count(words)))
-                if cnt == 0:
-                    continue
-                group = [self._field_row(ctx, gf, gr)
-                         for gf, gr in prefix_rows + [(f, int(rid))]]
-                agg_val = None
-                if agg_field is not None:
-                    aps = self.planes.bsi_plane(ctx.index.name, agg_field,
-                                                ctx.shards)
-                    t, c = bsik.sum_count(aps.plane, words)
-                    agg_val = t + agg_field.options.base * c
-                groups.append(GroupCount(group, cnt, agg_val))
+                recurse(level + 1, words, prefix_rows + [(f, int(rid))])
                 if limit is not None and len(groups) >= int(limit):
                     return
 
