@@ -743,9 +743,14 @@ class Executor:
             f = self._field(ctx, str(rc.args.get("_field") or
                                      rc.args.get("field")))
             rows = self._rows_of(ctx, f, rc)
+            if len(rows) == 0:
+                return GroupCountsResult([])  # no combinations possible
             ps = self.planes.field_plane(ctx.index.name, f, VIEW_STANDARD,
                                          ctx.shards)
             specs.append((f, rows, ps))
+        agg_plane = (self.planes.bsi_plane(ctx.index.name, agg_field,
+                                           ctx.shards)
+                     if agg_field is not None else None)
 
         limit = call.args.get("limit")
         groups: list[GroupCount] = []
@@ -767,13 +772,11 @@ class Executor:
                     group = [self._field_row(ctx, gf, gr)
                              for gf, gr in prefix_rows + [(f, int(rid))]]
                     agg_val = None
-                    if agg_field is not None:
+                    if agg_plane is not None:
                         row_w = ps.plane[:, ps.slot_of[int(rid)], :]
                         words = (row_w if prefix_words is None
                                  else kernels.intersect(prefix_words, row_w))
-                        aps = self.planes.bsi_plane(
-                            ctx.index.name, agg_field, ctx.shards)
-                        t, c = bsik.sum_count(aps.plane, words)
+                        t, c = bsik.sum_count(agg_plane.plane, words)
                         agg_val = t + agg_field.options.base * c
                     groups.append(GroupCount(group, cnt, agg_val))
                     if limit is not None and len(groups) >= int(limit):
