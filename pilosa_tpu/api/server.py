@@ -208,6 +208,23 @@ class Handler(BaseHTTPRequestHandler):
     def h_schema(self) -> None:
         self._reply({"indexes": self.server.api.schema()})
 
+    def h_get_index(self, index: str) -> None:
+        for spec in self.server.api.schema():
+            if spec["name"] == index:
+                self._reply(spec)
+                return
+        raise ApiError(f"index {index!r} not found", 404)
+
+    def h_get_field(self, index: str, field: str) -> None:
+        for spec in self.server.api.schema():
+            if spec["name"] == index:
+                for f in spec["fields"]:
+                    if f["name"] == field:
+                        self._reply(f)
+                        return
+                raise ApiError(f"field {field!r} not found", 404)
+        raise ApiError(f"index {index!r} not found", 404)
+
     def h_status(self) -> None:
         self._reply(self.server.api.status())
 
@@ -252,6 +269,8 @@ def build_router() -> Router:
     r.add("DELETE", "/index/{index}/field/{field}", Handler.h_delete_field)
     r.add("POST", "/index/{index}", Handler.h_create_index)
     r.add("DELETE", "/index/{index}", Handler.h_delete_index)
+    r.add("GET", "/index/{index}/field/{field}", Handler.h_get_field)
+    r.add("GET", "/index/{index}", Handler.h_get_index)
     r.add("GET", "/export", Handler.h_export)
     r.add("GET", "/schema", Handler.h_schema)
     r.add("GET", "/status", Handler.h_status)

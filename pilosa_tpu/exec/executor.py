@@ -43,7 +43,7 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 RESERVED_KEYS = frozenset({
     "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
     "like", "previous", "aggregate", "sort", "shards", "index",
-    "attrName", "attrValue", "columnAttrs",
+    "attrName", "attrValue", "columnAttrs", "excludeColumns",
 })
 
 _BITMAP_CALLS = frozenset({
@@ -194,6 +194,13 @@ class Executor:
                                 zip(result.columns,
                                     store.attrs_many(result.columns))
                                 if a}
+            if call.args.get("excludeColumns") and isinstance(result,
+                                                             RowResult):
+                # reference: QueryRequest.ExcludeColumns — materialize
+                # nothing columnar in the response
+                result.columns = np.empty(0, np.uint64)
+                if result.keys is not None:
+                    result.keys = []
             return result
         if call.name in _BITMAP_CALLS:
             words = self._fused_bitmap(ctx, call)
@@ -702,6 +709,20 @@ class Executor:
             counts = kernels.shard_totals(kernels.row_counts(ps.plane))
         live = counts[:ps.n_rows] > 0
         rows = ps.row_ids[live]
+        like = call.args.get("like")
+        if like is not None:
+            # SQL-style pattern over row KEYS (reference: Rows like=,
+            # FeatureBase era): % = any run, _ = one char
+            if not field.options.keys:
+                raise ExecutionError("Rows: like= requires a keyed field")
+            import fnmatch
+            pattern = (str(like).replace("*", "[*]").replace("?", "[?]")
+                       .replace("%", "*").replace("_", "?"))
+            log = self.translate.rows(ctx.index.name, field.name)
+            rows = np.array([r for r in rows
+                             if fnmatch.fnmatchcase(
+                                 log.key_of(int(r)) or "", pattern)],
+                            dtype=np.uint64)
         prev = call.args.get("previous")
         if prev is not None:
             prev_id = self._row_id(ctx, field, prev, create=False)
@@ -753,6 +774,15 @@ class Executor:
                      if agg_field is not None else None)
 
         limit = call.args.get("limit")
+        # previous=[rowID, ...] pages past an exact combination
+        # (reference: GroupBy previous= paging); groups generate in
+        # lexicographic row-id order, so skip while combo <= previous
+        prev = call.args.get("previous")
+        prev_tuple = (tuple(int(r) for r in prev)
+                      if isinstance(prev, list) else None)
+        if prev_tuple is not None and len(prev_tuple) != len(specs):
+            raise ExecutionError(
+                "GroupBy: previous= must list one row per Rows call")
         groups: list[GroupCount] = []
 
         def recurse(level: int, prefix_words, prefix_rows: list[tuple[Field, int]]):
@@ -766,6 +796,10 @@ class Executor:
                 totals = kernels.shard_totals(
                     kernels.row_counts(ps.plane, prefix_words))
                 for rid in rows:
+                    if prev_tuple is not None:
+                        combo = tuple(gr for _, gr in prefix_rows) + (int(rid),)
+                        if combo <= prev_tuple:
+                            continue
                     cnt = int(totals[ps.slot_of[int(rid)]])
                     if cnt == 0:
                         continue

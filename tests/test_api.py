@@ -188,3 +188,20 @@ class TestRawHttp:
             data=b"Count(Row(f=1))", method="POST")
         with urllib.request.urlopen(req) as resp:
             assert json.loads(resp.read()) == {"results": [1]}
+
+
+class TestInfoEndpoints:
+    def test_get_index_and_field(self, srv):
+        _, _, _, c = srv
+        c.create_index("i")
+        c.create_field("i", "amount", {"type": "int", "min": 0, "max": 9})
+        spec = c._json("GET", "/index/i")
+        assert spec["name"] == "i"
+        f = c._json("GET", "/index/i/field/amount")
+        assert f["options"]["type"] == "int"
+        with pytest.raises(ClientError) as e:
+            c._json("GET", "/index/i/field/nope")
+        assert e.value.status == 404
+        with pytest.raises(ClientError) as e:
+            c._json("GET", "/index/nope")
+        assert e.value.status == 404

@@ -378,3 +378,39 @@ class TestCountBatching:
         q(ex, "Count(Row(g=1)) Count(Row(f=1))")
         q(ex, "Count(Row(f=1)) Count(Row(g=1))")
         assert len(ex.fused._programs) <= after + 1
+
+
+class TestParityBatch2:
+    def test_groupby_previous_paging(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(1, f=20) Set(1, g=5) Set(1, g=6)")
+        (all_g,) = q(ex, "GroupBy(Rows(f), Rows(g))")
+        combos = [tuple(fr.row_id for fr in gc.group) for gc in all_g.groups]
+        assert combos == [(10, 5), (10, 6), (20, 5), (20, 6)]
+        (page,) = q(ex, "GroupBy(Rows(f), Rows(g), previous=[10, 6], limit=1)")
+        assert [tuple(fr.row_id for fr in gc.group)
+                for gc in page.groups] == [(20, 5)]
+
+    def test_rows_like(self, tmp_path):
+        from pilosa_tpu.store import FieldOptions, Holder
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f", FieldOptions(keys=True))
+        ex = Executor(holder)
+        ex.execute("i", 'Set(1, f="apple") Set(2, f="apricot") Set(3, f="banana")')
+        (r,) = ex.execute("i", 'Rows(f, like="ap%")')
+        assert sorted(r.keys) == ["apple", "apricot"]
+        (r2,) = ex.execute("i", 'Rows(f, like="_anana")')
+        assert r2.keys == ["banana"]
+
+    def test_rows_like_requires_keys(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1)")
+        with pytest.raises(ExecutionError):
+            q(ex, 'Rows(f, like="x%")')
+
+    def test_exclude_columns(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+        (r,) = q(ex, "Options(Row(f=1), excludeColumns=true)")
+        assert len(r.columns) == 0
