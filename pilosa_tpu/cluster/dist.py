@@ -280,6 +280,9 @@ def merge_results(call: Call, partials: list):
         return {"value": best,
                 "count": sum(p["count"] for p in live
                              if p["value"] == best)}
+    if name == "Distinct":
+        vals = sorted({v for p in partials for v in p.get("values", [])})
+        return {"values": vals}
     if name == "Rows":
         rows = np.unique(np.concatenate(
             [np.asarray(p.get("rows", []), dtype=np.uint64)

@@ -269,6 +269,44 @@ def combine_min_max(out: dict) -> list[tuple[int, int, int, int]]:
     return res
 
 
+def distinct_presence(
+    plane: jax.Array, filter_words: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """Presence bitmaps over the value space: which offsets occur among
+    the (filtered) columns — the device core of ``Distinct`` (v2 PQL).
+
+    Expands each column's magnitude from the bit planes, then scatters
+    into boolean presence arrays of size ``2^depth`` (positive and
+    negative offsets separately).  Requires ``depth <= 24`` (a 16M-entry
+    presence array); the executor enforces the cap.
+
+    plane: uint32[S, depth+2, W] -> (pos bool[2^depth], neg bool[2^depth]).
+    """
+    depth = depth_of(plane)
+    exists = not_null(plane, filter_words)
+    sign = plane[..., SIGN_ROW, :] & exists
+    mag = plane[..., OFFSET_ROW:, :]
+
+    def expand(words: jax.Array) -> jax.Array:
+        # uint32[S, W] -> bool[S, W*32] (column-major LSB-first bits)
+        bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        return bits.reshape(*words.shape[:-1], -1).astype(jnp.uint32)
+
+    values = jnp.zeros(exists.shape[:-1] + (exists.shape[-1] * 32,),
+                       dtype=jnp.uint32)
+    for b in range(depth):
+        values = values | (expand(mag[..., b, :]) << b)
+    exists_b = expand(exists).astype(bool)
+    sign_b = expand(sign).astype(bool)
+    size = 1 << depth
+    # out-of-range sentinel drops non-participating columns
+    pos_idx = jnp.where(exists_b & ~sign_b, values, size)
+    neg_idx = jnp.where(exists_b & sign_b, values, size)
+    pos = jnp.zeros(size, bool).at[pos_idx.reshape(-1)].set(True, mode="drop")
+    neg = jnp.zeros(size, bool).at[neg_idx.reshape(-1)].set(True, mode="drop")
+    return pos, neg
+
+
 def min_max(
     plane: jax.Array, filter_words: jax.Array | None = None
 ) -> list[tuple[int, int, int, int]]:

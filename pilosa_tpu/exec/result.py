@@ -80,6 +80,17 @@ class RowIdsResult:
 
 
 @dataclass
+class DistinctResult:
+    """``Distinct()`` result: sorted distinct BSI field values
+    (reference: v2 SignedRow-valued Distinct)."""
+
+    values: list
+
+    def to_json(self):
+        return {"values": self.values}
+
+
+@dataclass
 class FieldRow:
     field: str
     row_id: int = 0

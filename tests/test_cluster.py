@@ -453,3 +453,17 @@ class TestRejoinAfterRemoval:
                 assert len(back.cluster.nodes) == 3
             finally:
                 back.close()
+
+
+class TestDistinctCluster:
+    def test_distinct_merged(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "amount",
+                                 {"type": "int", "min": -100, "max": 100})
+        cols = [1, SHARD_WIDTH + 1, 3 * SHARD_WIDTH + 1, 5 * SHARD_WIDTH]
+        c.client(0).import_values("i", "amount", columnIDs=cols,
+                                  values=[5, -3, 5, 42])
+        for cl in c.clients:
+            (d,) = cl.query("i", "Distinct(field=amount)")
+            assert d == {"values": [-3, 5, 42]}

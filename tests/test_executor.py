@@ -414,3 +414,35 @@ class TestParityBatch2:
         q(ex, "Set(1, f=1) Set(2, f=1)")
         (r,) = q(ex, "Options(Row(f=1), excludeColumns=true)")
         assert len(r.columns) == 0
+
+
+class TestDistinct:
+    def test_distinct_values(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=5) Set(2, amount=-3) Set(3, amount=5)"
+              "Set(4, amount=0) Set(5, amount=977)")
+        (d,) = q(ex, "Distinct(field=amount)")
+        assert d.values == [-3, 0, 5, 977]
+
+    def test_distinct_with_filter(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=5) Set(2, amount=9) Set(1, f=1)")
+        (d,) = q(ex, "Distinct(Row(f=1), field=amount)")
+        assert d.values == [5]
+
+    def test_distinct_cross_shard(self, env):
+        _, _, ex = env
+        c2 = SHARD_WIDTH + 1
+        q(ex, f"Set(1, amount=7) Set({c2}, amount=7) Set({c2 + 1}, amount=9)")
+        (d,) = q(ex, "Distinct(field=amount)")
+        assert d.values == [7, 9]
+
+    def test_distinct_decimal(self, tmp_path):
+        from pilosa_tpu.store import FieldOptions, Holder
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("d", FieldOptions(type="decimal", scale=2))
+        ex = Executor(holder)
+        ex.execute("i", "Set(1, d=1.25) Set(2, d=-0.5)")
+        (r,) = ex.execute("i", "Distinct(field=d)")
+        assert r.values == [-0.5, 1.25]
