@@ -256,6 +256,37 @@ class Handler(BaseHTTPRequestHandler):
         self._reply({"traces": [s.to_json()
                                 for s in GLOBAL_TRACER.finished()]})
 
+    def h_debug_threads(self) -> None:
+        """Python stack dump of every thread — the rebuild's
+        /debug/pprof (reference mounts net/http/pprof; SURVEY.md §6)."""
+        import sys
+        import threading
+        import traceback
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append(f"Thread {names.get(ident, '?')} ({ident}):")
+            out.extend(line.rstrip()
+                       for line in traceback.format_stack(frame))
+            out.append("")
+        self._reply("\n".join(out).encode(), content_type="text/plain")
+
+    def h_debug_profile(self) -> None:
+        """Capture a jax device profile for ?seconds= (default 3) into
+        ?dir= (default under the data dir) — TensorBoard-readable
+        (SURVEY.md §6: expose jax.profiler traces)."""
+        import time as _time
+
+        import jax
+        seconds = float(self.query.get("seconds", ["3"])[0])
+        seconds = min(max(seconds, 0.1), 60.0)
+        out_dir = self.query.get("dir", [None])[0] or \
+            self.server.api.holder.path + "/_profiles"
+        jax.profiler.start_trace(out_dir)
+        _time.sleep(seconds)
+        jax.profiler.stop_trace()
+        self._reply({"traceDir": out_dir, "seconds": seconds})
+
 
 def build_router() -> Router:
     r = Router()
@@ -280,6 +311,8 @@ def build_router() -> Router:
     r.add("GET", "/internal/backup", Handler.h_backup)
     r.add("POST", "/internal/restore", Handler.h_restore)
     r.add("GET", "/internal/traces", Handler.h_traces)
+    r.add("GET", "/debug/threads", Handler.h_debug_threads)
+    r.add("POST", "/debug/profile", Handler.h_debug_profile)
     # node-to-node surface (deferred import: cluster depends on this
     # module for Handler/Router; a build without the cluster package
     # still serves single-node)

@@ -205,3 +205,15 @@ class TestInfoEndpoints:
         with pytest.raises(ClientError) as e:
             c._json("GET", "/index/nope")
         assert e.value.status == 404
+
+    def test_debug_threads(self, srv):
+        _, _, _, c = srv
+        dump = c._do("GET", "/debug/threads").decode()
+        assert "Thread" in dump or "Current thread" in dump
+
+    def test_debug_profile(self, srv, tmp_path):
+        _, _, _, c = srv
+        out = c._json("POST", f"/debug/profile?seconds=0.2")
+        assert out["seconds"] == 0.2
+        import os
+        assert os.path.isdir(out["traceDir"])
