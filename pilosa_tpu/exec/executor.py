@@ -323,7 +323,8 @@ class Executor:
         row_id = self._row_id(ctx, field, value, create=False)
         if row_id is None:
             return leaf(self._zeros(ctx))
-        if "from" in call.args or "to" in call.args:
+        if ("from" in call.args or "to" in call.args
+                or "_timestamp" in call.args):
             # time-range rows stay eager (variable view counts would
             # explode the program cache); wrap the result as one leaf
             return leaf(self._time_row(ctx, field, row_id, call))
@@ -432,7 +433,8 @@ class Executor:
         row_id = self._row_id(ctx, field, value, create=False)
         if row_id is None:
             return self._zeros(ctx)
-        if "from" in call.args or "to" in call.args:
+        if ("from" in call.args or "to" in call.args
+                or "_timestamp" in call.args):
             return self._time_row(ctx, field, row_id, call)
         return self.planes.row_words(ctx.index.name, field, VIEW_STANDARD,
                                      row_id, ctx.shards)
@@ -457,8 +459,9 @@ class Executor:
             return self._zeros(ctx)
         vmin = min(s for s, _ in spans)
         vmax = max(e for _, e in spans)
-        frm = call.args.get("from")
-        to = call.args.get("to")
+        # legacy positional form: Range(f=1, <from-ts>, <to-ts>)
+        frm = call.args.get("from", call.args.get("_timestamp"))
+        to = call.args.get("to", call.args.get("_timestamp2"))
         start = max(parse_pql_time(str(frm)) if frm is not None else vmin, vmin)
         end = min(parse_pql_time(str(to)) if to is not None else vmax, vmax)
         acc = self._zeros(ctx)

@@ -77,7 +77,7 @@ class Call:
                 parts.append(_literal(self.args[slot]))
         parts += [str(c) for c in self.children]
         for k, v in self.args.items():
-            if k in ("_field", "_col", "_row", "_timestamp"):
+            if k in ("_field", "_col", "_row", "_timestamp", "_timestamp2"):
                 continue
             if isinstance(v, Condition):
                 parts.append(_condition_pql(k, v))
@@ -85,6 +85,8 @@ class Call:
                 parts.append(f"{k}={_literal(v)}")
         if "_timestamp" in self.args:
             parts.append(str(self.args["_timestamp"]))  # bare timestamp
+        if "_timestamp2" in self.args:
+            parts.append(str(self.args["_timestamp2"]))
         return f"{self.name}({', '.join(parts)})"
 
 

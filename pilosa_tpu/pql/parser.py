@@ -156,10 +156,14 @@ class _Parser:
 
         if t.kind == lx.TIMESTAMP:
             self.next()
-            if "_timestamp" in call.args:
-                raise ParseError("duplicate timestamp arg", t.pos)
-            call.args["_timestamp"] = str(t.value)
-            return
+            if "_timestamp" not in call.args:
+                call.args["_timestamp"] = str(t.value)
+                return
+            # second bare timestamp: legacy Range(f=1, from, to) form
+            if "_timestamp2" not in call.args:
+                call.args["_timestamp2"] = str(t.value)
+                return
+            raise ParseError("too many timestamp args", t.pos)
 
         # positional scalar → per-call slot (_col / _row)
         val = self.value()

@@ -446,3 +446,17 @@ class TestDistinct:
         ex.execute("i", "Set(1, d=1.25) Set(2, d=-0.5)")
         (r,) = ex.execute("i", "Distinct(field=d)")
         assert r.values == [-0.5, 1.25]
+
+
+class TestLegacyRangeSyntax:
+    def test_positional_time_range(self, env):
+        holder, idx, ex = env
+        idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+        q(ex, "Set(1, t=1, 2017-01-02T00:00) Set(2, t=1, 2017-05-01T00:00)"
+              "Set(3, t=1, 2018-06-01T00:00)")
+        (r,) = q(ex, "Range(t=1, 2017-01-01T00:00, 2017-12-31T00:00)")
+        np.testing.assert_array_equal(r.columns, [1, 2])
+        # round-trips through the printer too
+        from pilosa_tpu.pql import parse
+        src = "Range(t=1, 2017-01-01T00:00, 2017-12-31T00:00)"
+        assert parse(str(parse(src))) == parse(src)
