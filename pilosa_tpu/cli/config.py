@@ -33,6 +33,11 @@ class Config:
     # device
     plane_budget_bytes: int = 4 << 30
     mesh: bool = True                   # shard planes over all local devices
+    # multi-host jax (one process per host of a pod slice; the host-level
+    # cluster layer above is independent of this)
+    jax_coordinator: str = ""           # host:port of process 0; "" = single
+    jax_num_processes: int = 0
+    jax_process_id: int = -1
 
     @property
     def host(self) -> str:

@@ -28,6 +28,19 @@ class PilosaTPUServer:
         self.diagnostics = None
 
     def open(self) -> "PilosaTPUServer":
+        if self.cfg.jax_coordinator:
+            # multi-host pod slice: one process per host joins the jax
+            # runtime before any device use; jax.devices() then spans
+            # every chip and the mesh placement shards across the full
+            # slice with collectives over ICI/DCN (SURVEY.md §3.6)
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=self.cfg.jax_coordinator,
+                num_processes=self.cfg.jax_num_processes or None,
+                process_id=(self.cfg.jax_process_id
+                            if self.cfg.jax_process_id >= 0 else None))
+            self.logger.info("jax.distributed: process %d of %d",
+                             jax.process_index(), jax.process_count())
         self.holder.open()
         placement = None
         if self.cfg.mesh:
