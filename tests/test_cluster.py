@@ -467,3 +467,28 @@ class TestDistinctCluster:
         for cl in c.clients:
             (d,) = cl.query("i", "Distinct(field=amount)")
             assert d == {"values": [-3, 5, 42]}
+
+
+class TestClusterWithDeviceMesh:
+    """Cluster fan-out AND per-node device-mesh sharding together: each
+    node's executor shards its resident planes over the 8 simulated
+    devices while queries also fan out across nodes."""
+
+    def test_meshed_nodes_agree(self, tmp_path):
+        with run_cluster(2, str(tmp_path), mesh=True) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).create_field("i", "amount",
+                                     {"type": "int", "min": -100, "max": 100})
+            cols = [s * SHARD_WIDTH + 3 for s in range(5)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 5, columnIDs=cols)
+            c.client(1).import_values("i", "amount", columnIDs=cols[:3],
+                                      values=[10, -20, 30])
+            for cl in c.clients:
+                assert cl.query("i", "Count(Row(f=1))") == [5]
+                (r,) = cl.query("i", "Row(f=1)")
+                assert r["columns"] == cols
+                (s,) = cl.query("i", "Sum(field=amount)")
+                assert s == {"value": 20, "count": 3}
+                (t,) = cl.query("i", "TopN(f)")
+                assert t == [{"id": 1, "count": 5}]
