@@ -666,12 +666,34 @@ class Executor:
         field = self._field(ctx, str(fname))
         n = call.args.get("n")
         filter_words = self._filter_words(ctx, call)
-        ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
-                                     ctx.shards)
-        if ps.n_rows == 0:
-            return PairsResult([])
-        counts = kernels.row_counts(ps.plane, filter_words)  # [S, R_pad]
-        totals = kernels.shard_totals(counts)                # np.int64[R_pad]
+        # resident path: the whole plane fits the device budget;
+        # otherwise stream fixed-shape row blocks (one compile) and
+        # accumulate totals on host — the "dense blowup" escape hatch
+        # for fields with huge row sets (SURVEY.md §8)
+        est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
+        if est <= self.planes.budget:
+            ps = self.planes.field_plane(ctx.index.name, field,
+                                         VIEW_STANDARD, ctx.shards)
+            if ps.n_rows == 0:
+                return PairsResult([])
+            counts = kernels.row_counts(ps.plane, filter_words)
+            totals = kernels.shard_totals(counts)[:ps.n_rows]
+            all_rows = ps.row_ids
+        else:
+            block = max(64, int(self.planes.budget
+                                // (len(ctx.shards) * WORDS_PER_SHARD * 4
+                                    * 4)))  # /4: chunk + staging headroom
+            parts_rows, parts_totals = [], []
+            for chunk_rows, chunk_plane in self.planes.iter_row_blocks(
+                    field, VIEW_STANDARD, ctx.shards, block):
+                counts = kernels.row_counts(chunk_plane, filter_words)
+                parts_totals.append(
+                    kernels.shard_totals(counts)[:len(chunk_rows)])
+                parts_rows.append(chunk_rows)
+            if not parts_rows:
+                return PairsResult([])
+            all_rows = np.concatenate(parts_rows)
+            totals = np.concatenate(parts_totals)
         ids_arg = call.args.get("ids")
         attr_name = call.args.get("attrName")
         if attr_name is not None:
@@ -682,17 +704,14 @@ class Executor:
             if not ids_arg:
                 return PairsResult([])
         if ids_arg is not None:
-            keep = np.zeros(totals.shape[0], dtype=bool)
-            for rid in ids_arg:
-                slot = ps.slot_of.get(int(rid))
-                if slot is not None:
-                    keep[slot] = True
+            wanted = {int(r) for r in ids_arg}
+            keep = np.array([int(r) in wanted for r in all_rows])
             totals = np.where(keep, totals, 0)
-        k = ps.n_rows if n is None else min(int(n), ps.n_rows)
+        k = len(all_rows) if n is None else min(int(n), len(all_rows))
         slots = np.argsort(-totals, kind="stable")[:k]
         vals = totals[slots]
-        live = (vals > 0) & (slots < ps.n_rows)
-        row_ids = ps.row_ids[slots[live]]
+        live = vals > 0
+        row_ids = all_rows[slots[live]]
         vals = vals[live]
         if field.options.keys and ctx.translate_output:
             log = self.translate.rows(ctx.index.name, field.name)
@@ -716,22 +735,35 @@ class Executor:
 
     def _rows_of(self, ctx: _Ctx, field: Field, call: Call) -> np.ndarray:
         """Row IDs with ≥1 bit, honoring column=, previous=, limit=."""
-        ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
-                                     ctx.shards)
-        if ps.n_rows == 0:
-            return np.empty(0, np.uint64)
         column = call.args.get("column")
         if column is not None:
+            # column filter needs the bits: check membership per shard
+            # on host (one column touches at most one shard)
             col_id = self._col_id(ctx, column, create=False)
             if col_id is None:
                 return np.empty(0, np.uint64)
-            filter_words = self._column_bitmap(ctx, col_id)
-            counts = kernels.shard_totals(
-                kernels.row_counts(ps.plane, filter_words))
+            shard, off = col_id // SHARD_WIDTH, col_id % SHARD_WIDTH
+            view = field.standard_view()
+            frag = view.fragment(shard) if view is not None else None
+            if frag is None or shard not in ctx.shards:
+                return np.empty(0, np.uint64)
+            with frag.lock:
+                rows = np.array([r for r in frag.row_ids()
+                                 if frag.rows[r].contains(off)],
+                                dtype=np.uint64)
         else:
-            counts = kernels.shard_totals(kernels.row_counts(ps.plane))
-        live = counts[:ps.n_rows] > 0
-        rows = ps.row_ids[live]
+            # live rows come straight from the fragment indexes — no
+            # plane materialization or device round trip needed
+            view = field.standard_view()
+            row_set: set[int] = set()
+            if view is not None:
+                for s in ctx.shards:
+                    if s == PAD_SHARD:
+                        continue
+                    frag = view.fragment(s)
+                    if frag is not None:
+                        row_set.update(frag.row_ids())
+            rows = np.array(sorted(row_set), dtype=np.uint64)
         like = call.args.get("like")
         if like is not None:
             # SQL-style pattern over row KEYS (reference: Rows like=,

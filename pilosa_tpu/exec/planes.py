@@ -89,6 +89,58 @@ class PlaneCache:
                        lambda f, v, s: self._build_row(f, v, s, row_id))
         return ps.plane
 
+    def plane_bytes(self, field: Field, view_name: str,
+                    shards: tuple[int, ...]) -> int:
+        """Estimated dense-plane footprint (for budget decisions)."""
+        view = field.view(view_name)
+        rows: set[int] = set()
+        if view is not None:
+            for s in shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    rows.update(frag.row_ids())
+        return len(shards) * _pow2(max(1, len(rows))) * WORDS_PER_SHARD * 4
+
+    def iter_row_blocks(self, field: Field, view_name: str,
+                        shards: tuple[int, ...], block_rows: int):
+        """Stream a view's rows through the device in fixed-size blocks:
+        yields (row_ids[block], device uint32[n_shards, block, W]).
+
+        The working-set half of SURVEY.md §8's "dense blowup" hard part:
+        fields whose full plane exceeds the HBM budget never materialize
+        it — each block reuses one compiled shape.  The final block is
+        zero-padded (padded rows yield zero counts; callers slice)."""
+        view = field.view(view_name)
+        row_set: set[int] = set()
+        if view is not None:
+            for s in shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    row_set.update(frag.row_ids())
+        row_ids = np.array(sorted(row_set), dtype=np.uint64)
+        for start in range(0, len(row_ids), block_rows):
+            chunk = row_ids[start:start + block_rows]
+            host = np.zeros((len(shards), block_rows, WORDS_PER_SHARD),
+                            dtype=np.uint32)
+            slot_of = {int(r): i for i, r in enumerate(chunk)}
+            if view is not None:
+                for si, s in enumerate(shards):
+                    if s == PAD_SHARD:
+                        continue
+                    frag = view.fragment(s)
+                    if frag is None:
+                        continue
+                    with frag.lock:
+                        for r, slot in slot_of.items():
+                            bits = frag.rows.get(r)
+                            if bits is not None:
+                                host[si, slot] = bits.words()
+            yield chunk, self.place(host)
+
     def zeros(self, n_shards: int) -> jax.Array:
         """Cached all-zero bitmap uint32[n_shards, W] (empty Row / empty
         Union results) — built and transferred once per shard count, not

@@ -460,3 +460,43 @@ class TestLegacyRangeSyntax:
         from pilosa_tpu.pql import parse
         src = "Range(t=1, 2017-01-01T00:00, 2017-12-31T00:00)"
         assert parse(str(parse(src))) == parse(src)
+
+
+class TestStreamingTopN:
+    def test_streamed_matches_resident(self, tmp_path, rng):
+        """Force the streaming path with a tiny plane budget; results
+        must match a resident-plane executor exactly."""
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        n = 4000
+        rows = rng.integers(0, 500, size=n).astype(np.uint64)
+        cols = rng.choice(2 * SHARD_WIDTH, size=n, replace=False).astype(np.uint64)
+        idx.field("f").import_bits(rows, cols)
+        idx.note_columns(cols)
+
+        resident = Executor(holder)
+        # budget too small for the ~500-row plane -> streaming path
+        streaming = Executor(holder, plane_budget=8 << 20)
+        for pql in ["TopN(f, n=10)", "TopN(f)", "TopN(f, ids=[3, 7, 9])"]:
+            (a,) = resident.execute("i", pql)
+            (b,) = streaming.execute("i", pql)
+            assert [(p.id, p.count) for p in a.pairs] == \
+                   [(p.id, p.count) for p in b.pairs], pql
+
+    def test_streamed_with_filter(self, tmp_path, rng):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        rows = rng.integers(0, 300, size=2000).astype(np.uint64)
+        cols = rng.choice(SHARD_WIDTH, size=2000, replace=False).astype(np.uint64)
+        idx.field("f").import_bits(rows, cols)
+        idx.field("g").import_bits(np.ones(1000, np.uint64), cols[:1000])
+        idx.note_columns(cols)
+        resident = Executor(holder)
+        streaming = Executor(holder, plane_budget=4 << 20)
+        (a,) = resident.execute("i", "TopN(f, filter=Row(g=1), n=5)")
+        (b,) = streaming.execute("i", "TopN(f, filter=Row(g=1), n=5)")
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
