@@ -137,6 +137,41 @@ class Fragment:
                 self._log(OP_CLEAR_BITS, 0, positions)
             return changed
 
+    def set_bits_grouped(self, groups: list[tuple[int, np.ndarray]]) -> int:
+        """Bulk set with pre-grouped (row_id, cols) — skips the global
+        position sort/segmentation when the caller already has per-row
+        columns (BSI imports build exactly this shape)."""
+        return self._apply_grouped(groups, clear=False)
+
+    def clear_bits_grouped(self, groups: list[tuple[int, np.ndarray]]) -> int:
+        return self._apply_grouped(groups, clear=True)
+
+    def _apply_grouped(self, groups, clear: bool) -> int:
+        op = OP_CLEAR_BITS if clear else OP_SET_BITS
+        with self.lock:
+            changed = 0
+            parts = []
+            for row_id, cols in groups:
+                cols = np.asarray(cols, dtype=np.uint32)
+                if len(cols) == 0:
+                    continue
+                if clear:
+                    row = self.rows.get(int(row_id))
+                    if row is not None:
+                        changed += row.remove(cols)
+                        if not row.any():
+                            del self.rows[int(row_id)]
+                else:
+                    row = self.rows.get(int(row_id))
+                    if row is None:
+                        row = self.rows[int(row_id)] = RowBits()
+                    changed += row.add(cols)
+                parts.append(np.uint64(row_id) * _SW + cols.astype(np.uint64))
+            if changed:
+                self.generation += 1
+                self._log(op, 0, np.concatenate(parts))
+            return changed
+
     def clear_row(self, row_id: int) -> int:
         """Clear every bit of a row (reference: ``fragment.clearRow``)."""
         with self.lock:

@@ -28,12 +28,19 @@ class View:
     def open(self) -> "View":
         frag_dir = os.path.join(self.path, "fragments")
         if os.path.isdir(frag_dir):
+            # a fragment exists if EITHER its snapshot or its op-log does
+            # (a crash before the first snapshot leaves only the op-log —
+            # it must still be discovered or replay never runs)
+            shards: set[int] = set()
             for entry in os.listdir(frag_dir):
                 if entry.isdigit():
-                    shard = int(entry)
-                    frag = Fragment(os.path.join(frag_dir, entry), shard,
-                                    fsync=self.fsync)
-                    self.fragments[shard] = frag.open()
+                    shards.add(int(entry))
+                elif entry.endswith(".oplog") and entry[:-6].isdigit():
+                    shards.add(int(entry[:-6]))
+            for shard in shards:
+                frag = Fragment(os.path.join(frag_dir, str(shard)), shard,
+                                fsync=self.fsync)
+                self.fragments[shard] = frag.open()
         return self
 
     def fragment(self, shard: int, create: bool = False) -> Fragment | None:

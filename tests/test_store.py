@@ -341,3 +341,25 @@ class TestReviewRegressions:
         frag = f.standard_view().fragment(0)
         assert frag.row(1).cardinality == 250
         assert frag.row(2).cardinality == 250
+
+    def test_crash_before_first_snapshot_is_durable(self, tmp_path):
+        """Regression: a fragment whose only on-disk state is the op-log
+        (crash before any snapshot) must be discovered on reopen."""
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        idx.set_bit("f", 1, 10)   # 1 op; far below MAX_OP_N, no snapshot
+        # no h.close() — simulate crash
+        h2 = Holder(str(tmp_path)).open()
+        frag = h2.index("i").field("f").standard_view().fragment(0)
+        assert frag is not None and frag.row(1).contains(10)
+
+    def test_crash_replay_bsi_grouped(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i", track_existence=False)
+        f = idx.create_field("n", FieldOptions(type="int", min=-10, max=10))
+        f.import_values(np.array([1, 2], np.uint64), [5, -3])
+        h2 = Holder(str(tmp_path)).open()
+        f2 = h2.index("i").field("n")
+        assert f2.value(1) == (5, True)
+        assert f2.value(2) == (-3, True)
