@@ -184,10 +184,12 @@ class DistributedExecutor:
                 cid = self.cluster.translate_keys(
                     index, None, [new.args["column"]], create=False)[0]
                 new.args["column"] = 0 if cid is None else cid
-            # row key: the single non-reserved field arg
-            from pilosa_tpu.exec.executor import RESERVED_KEYS
+            # row key: the single non-reserved field arg (reservation
+            # is per call — see executor.reserved_for)
+            from pilosa_tpu.exec.executor import reserved_for
+            rk = reserved_for(c.name)
             for k, v in list(new.args.items()):
-                if (k in RESERVED_KEYS or k.startswith("_")
+                if (k in rk or k.startswith("_")
                         or isinstance(v, (Condition, Call))):
                     continue
                 field = idx.field(k)

@@ -39,12 +39,28 @@ from pilosa_tpu.store.timeq import (parse_pql_time, view_span,
 from pilosa_tpu.store.translate import TranslateStore
 from pilosa_tpu.store.view import VIEW_STANDARD
 
-# option keys that are never field names in call args
+# option keys that are never field names in call args.  Reservation is
+# PER CALL: a field named "n" must still work in Set(5, n=777) even
+# though TopN reserves n= (the upstream grammar scopes options the same
+# way).  RESERVED_KEYS is the superset default for option-heavy calls.
 RESERVED_KEYS = frozenset({
     "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
     "like", "previous", "aggregate", "sort", "shards", "index",
     "attrName", "attrValue", "columnAttrs", "excludeColumns",
 })
+
+_CALL_RESERVED = {
+    "Row": frozenset({"from", "to"}),
+    "Range": frozenset({"from", "to"}),
+    "Set": frozenset(),
+    "Clear": frozenset(),
+    "ClearRow": frozenset(),
+    "Store": frozenset(),
+}
+
+
+def reserved_for(call_name: str) -> frozenset:
+    return _CALL_RESERVED.get(call_name, RESERVED_KEYS)
 
 _BITMAP_CALLS = frozenset({
     "Row", "Intersect", "Union", "Difference", "Xor", "Not", "All", "Range",
@@ -311,7 +327,7 @@ class Executor:
         return acc
 
     def _plan_row(self, ctx: _Ctx, call: Call, leaves: list, leaf):
-        hit = call.field_arg(RESERVED_KEYS)
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError(f"{call.name}: missing field argument")
         fname, value = hit
@@ -420,7 +436,7 @@ class Executor:
         raise ExecutionError(f"not a bitmap call: {name}")
 
     def _row_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
-        hit = call.field_arg(RESERVED_KEYS)
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError(f"{call.name}: missing field argument")
         fname, value = hit
@@ -895,7 +911,7 @@ class Executor:
         if col is None:
             raise ExecutionError("Set: missing column argument")
         col_id = self._col_id(ctx, col, create=True)
-        hit = call.field_arg(RESERVED_KEYS | {"_col", "_timestamp"})
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError("Set: missing field=value argument")
         fname, value = hit
@@ -918,7 +934,7 @@ class Executor:
         col_id = self._col_id(ctx, col, create=False)
         if col_id is None:
             return False
-        hit = call.field_arg(RESERVED_KEYS | {"_col", "_timestamp"})
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError("Clear: missing field argument")
         fname, value = hit
@@ -931,7 +947,7 @@ class Executor:
         return field.clear_bit(row_id, col_id)
 
     def _execute_clearrow(self, ctx: _Ctx, call: Call) -> bool:
-        hit = call.field_arg(RESERVED_KEYS)
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError("ClearRow: missing field=row argument")
         fname, value = hit
@@ -962,7 +978,7 @@ class Executor:
             raise ExecutionError("SetRowAttrs: missing row")
         row_id = self._row_id(ctx, field, row, create=True)
         attrs = {k: v for k, v in call.args.items()
-                 if not k.startswith("_") and k not in RESERVED_KEYS}
+                 if not k.startswith("_")}
         field.row_attrs.set_attrs(int(row_id), attrs)
         return None
 
@@ -972,14 +988,14 @@ class Executor:
             raise ExecutionError("SetColumnAttrs: missing column")
         col_id = self._col_id(ctx, col, create=True)
         attrs = {k: v for k, v in call.args.items()
-                 if not k.startswith("_") and k not in RESERVED_KEYS}
+                 if not k.startswith("_")}
         ctx.index.column_attrs.set_attrs(int(col_id), attrs)
         return None
 
     def _execute_store(self, ctx: _Ctx, call: Call) -> bool:
         if len(call.children) != 1:
             raise ExecutionError("Store: exactly one bitmap child required")
-        hit = call.field_arg(RESERVED_KEYS)
+        hit = call.field_arg(reserved_for(call.name))
         if hit is None:
             raise ExecutionError("Store: missing field=row argument")
         fname, value = hit
