@@ -185,7 +185,11 @@ class DistributedExecutor:
                     index, None, [new.args["column"]], create=False)[0]
                 new.args["column"] = 0 if cid is None else cid
             # row key: the single non-reserved field arg (reservation
-            # is per call — see executor.reserved_for)
+            # is per call — see executor.reserved_for).  Attr calls
+            # never carry row keys in their kv args: an attr VALUE that
+            # happens to share a keyed field's name must stay verbatim.
+            if c.name in ("SetRowAttrs", "SetColumnAttrs"):
+                return new
             from pilosa_tpu.exec.executor import reserved_for
             rk = reserved_for(c.name)
             for k, v in list(new.args.items()):

@@ -62,6 +62,14 @@ _CALL_RESERVED = {
 def reserved_for(call_name: str) -> frozenset:
     return _CALL_RESERVED.get(call_name, RESERVED_KEYS)
 
+
+def _field_arg(call: Call):
+    """Per-call-scoped field_arg with query-error (not 500) semantics."""
+    try:
+        return call.field_arg(reserved_for(call.name))
+    except ValueError as e:
+        raise ExecutionError(str(e))
+
 _BITMAP_CALLS = frozenset({
     "Row", "Intersect", "Union", "Difference", "Xor", "Not", "All", "Range",
     "Shift", "UnionRows",
@@ -327,7 +335,7 @@ class Executor:
         return acc
 
     def _plan_row(self, ctx: _Ctx, call: Call, leaves: list, leaf):
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError(f"{call.name}: missing field argument")
         fname, value = hit
@@ -436,7 +444,7 @@ class Executor:
         raise ExecutionError(f"not a bitmap call: {name}")
 
     def _row_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError(f"{call.name}: missing field argument")
         fname, value = hit
@@ -911,7 +919,7 @@ class Executor:
         if col is None:
             raise ExecutionError("Set: missing column argument")
         col_id = self._col_id(ctx, col, create=True)
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError("Set: missing field=value argument")
         fname, value = hit
@@ -934,7 +942,7 @@ class Executor:
         col_id = self._col_id(ctx, col, create=False)
         if col_id is None:
             return False
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError("Clear: missing field argument")
         fname, value = hit
@@ -947,7 +955,7 @@ class Executor:
         return field.clear_bit(row_id, col_id)
 
     def _execute_clearrow(self, ctx: _Ctx, call: Call) -> bool:
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError("ClearRow: missing field=row argument")
         fname, value = hit
@@ -995,7 +1003,7 @@ class Executor:
     def _execute_store(self, ctx: _Ctx, call: Call) -> bool:
         if len(call.children) != 1:
             raise ExecutionError("Store: exactly one bitmap child required")
-        hit = call.field_arg(reserved_for(call.name))
+        hit = _field_arg(call)
         if hit is None:
             raise ExecutionError("Store: missing field=row argument")
         fname, value = hit

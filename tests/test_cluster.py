@@ -492,3 +492,20 @@ class TestClusterWithDeviceMesh:
                 assert s == {"value": 20, "count": 3}
                 (t,) = cl.query("i", "TopN(f)")
                 assert t == [{"id": 1, "count": 5}]
+
+
+class TestAttrValueNotTranslated:
+    def test_attr_value_matching_keyed_field_name(self, tmp_path):
+        """Regression: an attr VALUE that collides with a keyed field's
+        name must be stored verbatim, not key-translated."""
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).create_field("i", "city", {"keys": True})
+            c.client(1).query("i", 'SetRowAttrs(f, 1, city="NYC")')
+            for s in c.servers:
+                assert s.holder.index("i").field("f").row_attrs.attrs(1) \
+                    == {"city": "NYC"}
+            # and no bogus key was created in the city field's log
+            log = c.servers[0].executor.translate.rows("i", "city")
+            assert log.translate(["NYC"], create=False) == [None]

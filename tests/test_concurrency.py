@@ -121,8 +121,9 @@ def test_kill9_server_durability(tmp_path):
     from pilosa_tpu.api.client import Client
 
     data = str(tmp_path / "data")
-    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
-               PILOSA_BIND="127.0.0.1:0")
+    # blank PALLAS_AXON_POOL_IPS makes the image's sitecustomize skip
+    # axon TPU registration (see .claude/skills/verify/SKILL.md)
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
     # ask the OS for a free port first
     import socket
     s = socket.socket()

@@ -500,3 +500,23 @@ class TestStreamingTopN:
         (b,) = streaming.execute("i", "TopN(f, filter=Row(g=1), n=5)")
         assert [(p.id, p.count) for p in a.pairs] == \
                [(p.id, p.count) for p in b.pairs]
+
+
+class TestReservedKeyScoping:
+    def test_field_named_like_option(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("n", FieldOptions(type="int", min=0, max=1000))
+        idx.create_field("limit")
+        ex = Executor(holder)
+        assert ex.execute("i", "Set(5, n=777)") == [True]
+        (s,) = ex.execute("i", "Sum(field=n)")
+        assert (s.value, s.count) == (777, 1)
+        assert ex.execute("i", "Set(5, limit=3)") == [True]
+        (r,) = ex.execute("i", "Row(limit=3)")
+        np.testing.assert_array_equal(r.columns, [5])
+
+    def test_ambiguous_args_is_query_error(self, env):
+        _, _, ex = env
+        with pytest.raises(ExecutionError):
+            q(ex, "Set(5, f=1, g=2)")
