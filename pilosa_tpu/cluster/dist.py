@@ -78,9 +78,64 @@ class DistributedExecutor:
                     out.append(self._attr_write(index, call))
                 elif name in WRITE_CALLS:
                     out.append(self._write(index, call))
+                elif name == "Percentile":
+                    out.append(self._percentile(index, call, shards))
                 else:
                     out.append(self._read(index, call, shards))
         return out
+
+    def _percentile(self, index: str, call: Call, shards):
+        """Percentile cannot merge from per-node partials (a median of
+        medians is not a median): run the binary search HERE with
+        cluster-wide counts — each step is one distributed
+        Count(Row(field <= v)) reusing the normal fan-out."""
+        import math
+        eff = _call_of(call)
+        fname = eff.args.get("field") or eff.args.get("_field")
+        nth = eff.args.get("nth")
+        if fname is None or nth is None:
+            raise ExecutionError("Percentile: field= and nth= required")
+        nth = float(nth)
+        if not 0 <= nth <= 100:
+            raise ExecutionError("Percentile: nth must be in [0, 100]")
+        idx = self.cluster.api.holder.index(index)
+        field = idx.field(str(fname)) if idx else None
+        if field is None:
+            raise ExecutionError(f"field {fname!r} not found")
+        base = field.options.base
+        bound = (1 << field.options.bit_depth) - 1
+        flt = eff.args.get("filter")
+        children = [c for c in eff.children]
+
+        def dist_count(cond: Condition) -> int:
+            row = Call("Row", {str(fname): cond})
+            tree = (Call("Intersect", {}, [row] + children +
+                         ([flt] if isinstance(flt, Call) else []))
+                    if (children or isinstance(flt, Call)) else row)
+            return self._read(index, Call("Count", {}, [tree]), shards)
+
+        def from_stored_pred(offset: int):
+            # predicate in API space for the stored offset
+            v = offset + base
+            if field.options.type == "decimal":
+                return v / 10**field.options.scale
+            return v
+
+        total = dist_count(Condition("<=", from_stored_pred(bound)))
+        if total == 0:
+            return {"value": 0, "count": 0}
+        target = max(1, math.ceil(nth / 100.0 * total))
+        lo, hi = -bound, bound
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if dist_count(Condition("<=", from_stored_pred(mid))) >= target:
+                hi = mid
+            else:
+                lo = mid + 1
+        below = (dist_count(Condition("<=", from_stored_pred(lo - 1)))
+                 if lo > -bound else 0)
+        cnt = dist_count(Condition("<=", from_stored_pred(lo))) - below
+        return {"value": field.from_stored(lo + base), "count": cnt}
 
     # -- reads --------------------------------------------------------------
 

@@ -514,9 +514,16 @@ class Executor:
         """One signed comparison with out-of-depth predicate saturation
         (everything/nothing cases need no kernel; see
         ``engine.bsi.predicate_masks``)."""
+        return self._bsi_cmp_offset(
+            field, ps, op_key,
+            field.to_stored(value) - field.options.base)
+
+    def _bsi_cmp_offset(self, field: Field, ps, op_key: str,
+                        offset: int) -> jax.Array:
+        """Comparison against a base-relative stored offset (used by
+        Percentile's binary search, which walks stored space directly)."""
         opts = field.options
         depth = opts.bit_depth
-        offset = field.to_stored(value) - opts.base
         exists = ps.plane[..., bsik.EXISTS_ROW, :]
         bound = (1 << depth) - 1
         if offset > bound:
@@ -638,6 +645,46 @@ class Executor:
         stored = sorted({int(v) + base for v in pos}
                         | {-int(v) + base for v in neg})
         return DistinctResult([field.from_stored(v) for v in stored])
+
+    def _execute_percentile(self, ctx: _Ctx, call: Call) -> ValCount:
+        """Percentile(field=f, nth=99.9, filter?): the smallest stored
+        value v with count(values <= v) >= nth% of non-null columns —
+        binary search over the value space, one fused compare+count per
+        step (FeatureBase-era Percentile parity)."""
+        field, filter_words = self._agg_args(ctx, call)
+        nth = call.args.get("nth")
+        if nth is None:
+            raise ExecutionError("Percentile: missing nth argument")
+        nth = float(nth)
+        if not 0 <= nth <= 100:
+            raise ExecutionError("Percentile: nth must be in [0, 100]")
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        exists = bsik.not_null(ps.plane, filter_words)
+        total = int(kernels.shard_totals(kernels.count(exists)))
+        if total == 0:
+            return ValCount(0, 0)
+        import math
+        target = max(1, math.ceil(nth / 100.0 * total))
+
+        depth = field.options.bit_depth
+        bound = (1 << depth) - 1
+
+        def count_le(offset: int) -> int:
+            words = self._bsi_cmp_offset(field, ps, "le", offset)
+            if filter_words is not None:
+                words = kernels.intersect(words, filter_words)
+            return int(kernels.shard_totals(kernels.count(words)))
+
+        lo, hi = -bound, bound
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if count_le(mid) >= target:
+                hi = mid
+            else:
+                lo = mid + 1
+        value = lo + field.options.base
+        cnt = count_le(lo) - (count_le(lo - 1) if lo > -bound else 0)
+        return ValCount(value=field.from_stored(value), count=cnt)
 
     def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)

@@ -509,3 +509,20 @@ class TestAttrValueNotTranslated:
             # and no bogus key was created in the city field's log
             log = c.servers[0].executor.translate.rows("i", "city")
             assert log.translate(["NYC"], create=False) == [None]
+
+
+class TestPercentileCluster:
+    def test_distributed_percentile(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "amount",
+                                 {"type": "int", "min": 0, "max": 1000})
+        # values spread across shards on different nodes
+        cols = [s * SHARD_WIDTH + k for s in range(6) for k in range(10)]
+        vals = list(range(1, 61))
+        c.client(0).import_values("i", "amount", columnIDs=cols, values=vals)
+        for cl in c.clients[:2]:
+            (p,) = cl.query("i", "Percentile(field=amount, nth=50)")
+            assert p == {"value": 30, "count": 1}
+            (p99,) = cl.query("i", "Percentile(field=amount, nth=100)")
+            assert p99 == {"value": 60, "count": 1}

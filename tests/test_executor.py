@@ -520,3 +520,42 @@ class TestReservedKeyScoping:
         _, _, ex = env
         with pytest.raises(ExecutionError):
             q(ex, "Set(5, f=1, g=2)")
+
+
+class TestPercentile:
+    def test_percentiles(self, env):
+        _, _, ex = env
+        vals = list(range(1, 101))  # 1..100 on cols 1..100
+        sets = " ".join(f"Set({c}, amount={v})"
+                        for c, v in zip(range(1, 101), vals))
+        q(ex, sets)
+        (p50,) = q(ex, "Percentile(field=amount, nth=50)")
+        assert p50.value == 50
+        (p99,) = q(ex, "Percentile(field=amount, nth=99)")
+        assert p99.value == 99
+        (p100,) = q(ex, "Percentile(field=amount, nth=100)")
+        assert p100.value == 100
+
+    def test_percentile_negative_and_filter(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, amount=-10) Set(2, amount=0) Set(3, amount=10)"
+              "Set(1, f=1) Set(2, f=1)")
+        (p,) = q(ex, "Percentile(field=amount, nth=50)")
+        assert p.value == 0
+        (pf,) = q(ex, "Percentile(Row(f=1), field=amount, nth=100)")
+        assert pf.value == 0  # among cols {1, 2}: values {-10, 0}
+
+    def test_percentile_empty(self, env):
+        _, _, ex = env
+        (p,) = q(ex, "Percentile(field=amount, nth=50)")
+        assert (p.value, p.count) == (0, 0)
+
+    def test_percentile_decimal(self, tmp_path):
+        from pilosa_tpu.store import FieldOptions, Holder
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("d", FieldOptions(type="decimal", scale=1))
+        ex = Executor(holder)
+        ex.execute("i", "Set(1, d=1.5) Set(2, d=2.5) Set(3, d=9.5)")
+        (p,) = ex.execute("i", "Percentile(field=d, nth=50)")
+        assert p.value == 2.5
