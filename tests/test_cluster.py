@@ -526,3 +526,35 @@ class TestPercentileCluster:
             assert p == {"value": 30, "count": 1}
             (p99,) = cl.query("i", "Percentile(field=amount, nth=100)")
             assert p99 == {"value": 60, "count": 1}
+
+
+class TestCoordinatorFailover:
+    def test_key_assignment_moves_to_new_coordinator(self, tmp_path):
+        """Kill the coordinator: key creation must reroute to the next
+        alive node (coordinator is computed over alive ids) and reads
+        stay consistent."""
+        with run_cluster(3, str(tmp_path), heartbeat=0.1) as c:
+            c.client(0).create_index("k", {"keys": True})
+            c.client(0).create_field("k", "f", {"keys": True})
+            c.client(0).query("k", 'Set("alice", f="admin")')
+
+            coord_id = c.servers[0].cluster.coordinator_id()
+            coord = c.server_for(coord_id)
+            survivors = [s for s in c.servers if s is not coord]
+            coord.close()
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(len(s.cluster.alive_ids()) == 2 for s in survivors):
+                    break
+                time.sleep(0.05)
+            new_coord = survivors[0].cluster.coordinator_id()
+            assert new_coord != coord_id
+
+            from pilosa_tpu.api.client import Client
+            host, port = survivors[1].cluster.node_id.rsplit(":", 1)
+            cl = Client(host, int(port))
+            # new key creation routes to the NEW coordinator
+            assert cl.query("k", 'Set("bob", f="admin")') == [True]
+            (r,) = cl.query("k", 'Row(f="admin")')
+            assert sorted(r["keys"]) == ["alice", "bob"]
