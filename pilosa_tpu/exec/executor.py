@@ -646,6 +646,25 @@ class Executor:
                         | {-int(v) + base for v in neg})
         return DistinctResult([field.from_stored(v) for v in stored])
 
+    def _execute_includescolumn(self, ctx: _Ctx, call: Call) -> bool:
+        """IncludesColumn(Row(...), column=c) -> bool (v2 parity)."""
+        if len(call.children) != 1:
+            raise ExecutionError(
+                "IncludesColumn: exactly one bitmap child required")
+        column = call.args.get("column")
+        if column is None:
+            raise ExecutionError("IncludesColumn: missing column argument")
+        col_id = self._col_id(ctx, column, create=False)
+        if col_id is None:
+            return False
+        words = self._fused_bitmap(ctx, call.children[0])
+        shard, off = col_id // SHARD_WIDTH, col_id % SHARD_WIDTH
+        if shard not in ctx.shards:
+            return False
+        si = ctx.shards.index(shard)
+        word = int(np.asarray(words[si, off >> 5]))
+        return bool((word >> (off & 31)) & 1)
+
     def _execute_percentile(self, ctx: _Ctx, call: Call) -> ValCount:
         """Percentile(field=f, nth=99.9, filter?): the smallest stored
         value v with count(values <= v) >= nth% of non-null columns —

@@ -559,3 +559,12 @@ class TestPercentile:
         ex.execute("i", "Set(1, d=1.5) Set(2, d=2.5) Set(3, d=9.5)")
         (p,) = ex.execute("i", "Percentile(field=d, nth=50)")
         assert p.value == 2.5
+
+
+class TestIncludesColumn:
+    def test_includes(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+        assert q(ex, "IncludesColumn(Row(f=1), column=1)") == [True]
+        assert q(ex, "IncludesColumn(Row(f=1), column=3)") == [False]
+        assert q(ex, "IncludesColumn(Intersect(Row(f=1), Row(g=1)), column=1)") == [False]
