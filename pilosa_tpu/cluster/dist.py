@@ -305,7 +305,7 @@ def merge_results(call: Call, partials: list):
     name = call.name
     if name == "Count":
         return sum(partials)
-    if name in WRITE_CALLS:
+    if name in WRITE_CALLS or name == "IncludesColumn":
         return any(partials)
     if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
                 "Not", "All", "Shift", "UnionRows"):

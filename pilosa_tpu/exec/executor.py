@@ -657,12 +657,14 @@ class Executor:
         col_id = self._col_id(ctx, column, create=False)
         if col_id is None:
             return False
-        words = self._fused_bitmap(ctx, call.children[0])
         shard, off = col_id // SHARD_WIDTH, col_id % SHARD_WIDTH
         if shard not in ctx.shards:
             return False
-        si = ctx.shards.index(shard)
-        word = int(np.asarray(words[si, off >> 5]))
+        # evaluate only over the owning shard (reference:
+        # executeIncludesColumnCall runs on that shard alone)
+        one = _Ctx(ctx.index, (shard,), ctx.translate_output)
+        words = self._fused_bitmap(one, call.children[0])
+        word = int(np.asarray(words[0, off >> 5]))
         return bool((word >> (off & 31)) & 1)
 
     def _execute_percentile(self, ctx: _Ctx, call: Call) -> ValCount:

@@ -558,3 +558,16 @@ class TestCoordinatorFailover:
             assert cl.query("k", 'Set("bob", f="admin")') == [True]
             (r,) = cl.query("k", 'Row(f="admin")')
             assert sorted(r["keys"]) == ["alice", "bob"]
+
+
+class TestIncludesColumnCluster:
+    def test_includes_column_merged(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        far = 4 * SHARD_WIDTH + 9
+        c.client(0).query("i", f"Set({far}, f=1)")
+        assert c.client(1).query(
+            "i", f"IncludesColumn(Row(f=1), column={far})") == [True]
+        assert c.client(2).query(
+            "i", "IncludesColumn(Row(f=1), column=5)") == [False]

@@ -302,4 +302,4 @@ class TestExecutorProperties:
         (t,) = ex.execute("i", "TopN(f)")
         expect = sorted(((len(cs), -r) for r, cs in model.items() if cs),
                         reverse=True)
-        assert [p.count for p in t.pairs] == [e[0] for e in expect]
+        assert [(p.count, -p.id) for p in t.pairs] == expect
