@@ -323,13 +323,14 @@ class Executor:
             fname = rc.args.get("_field") or rc.args.get("field")
             field = self._field(ctx, str(fname))
             rows = self._rows_of(ctx, field, rc)
-            ps = self.planes.field_plane(ctx.index.name, field,
-                                         VIEW_STANDARD, ctx.shards)
-            if ps.n_rows == 0 or len(rows) == 0:
+            if len(rows) == 0:
                 continue
+            # plane over the SELECTED rows only (memory bounded by the
+            # selection, not the field's row cardinality)
+            ps = self.planes.rows_plane(ctx.index.name, field,
+                                        VIEW_STANDARD, rows, ctx.shards)
             mask = np.zeros(ps.plane.shape[-2], dtype=bool)
-            for r in rows:
-                mask[ps.slot_of[int(r)]] = True
+            mask[:len(rows)] = True
             acc = kernels.union(acc, kernels.union_rows(
                 ps.plane, jnp.asarray(mask)))
         return acc
@@ -913,8 +914,10 @@ class Executor:
             rows = self._rows_of(ctx, f, rc)
             if len(rows) == 0:
                 return GroupCountsResult([])  # no combinations possible
-            ps = self.planes.field_plane(ctx.index.name, f, VIEW_STANDARD,
-                                         ctx.shards)
+            # plane over the selected rows only — GroupBy memory scales
+            # with the Rows() selections, not field cardinality
+            ps = self.planes.rows_plane(ctx.index.name, f, VIEW_STANDARD,
+                                        rows, ctx.shards)
             specs.append((f, rows, ps))
         agg_plane = (self.planes.bsi_plane(ctx.index.name, agg_field,
                                            ctx.shards)

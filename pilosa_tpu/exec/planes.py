@@ -80,6 +80,39 @@ class PlaneCache:
                field.options.bit_depth)
         return self._get(key, field, view_name, shards, self._build_bsi)
 
+    def rows_plane(self, index: str, field: Field, view_name: str,
+                   row_ids: np.ndarray,
+                   shards: tuple[int, ...]) -> PlaneSet:
+        """Plane over EXACTLY the requested rows (GroupBy/UnionRows:
+        memory bounded by the selection, not the field's cardinality)."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        key = ("rows", index, field.name, view_name,
+               tuple(int(r) for r in row_ids), shards)
+        return self._get(key, field, view_name, shards,
+                         lambda f, v, s: self._build_rows(f, v, s, row_ids))
+
+    def _build_rows(self, field: Field, view_name: str,
+                    shards: tuple[int, ...],
+                    row_ids: np.ndarray) -> PlaneSet:
+        r_pad = _pow2(max(1, len(row_ids)))
+        host = np.zeros((len(shards), r_pad, WORDS_PER_SHARD),
+                        dtype=np.uint32)
+        slot_of = {int(r): i for i, r in enumerate(row_ids)}
+        view = field.view(view_name)
+        if view is not None:
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                with frag.lock:
+                    for r, slot in slot_of.items():
+                        bits = frag.rows.get(r)
+                        if bits is not None:
+                            host[si, slot] = bits.words()
+        return PlaneSet(self.place(host), shards, row_ids, slot_of)
+
     def row_words(self, index: str, field: Field, view_name: str,
                   row_id: int, shards: tuple[int, ...]) -> jax.Array:
         """One row across shards: uint32[n_shards, W] (Row-call fast path —
