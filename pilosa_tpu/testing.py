@@ -48,11 +48,21 @@ class TestCluster:
             f"cluster did not reach {n} members: "
             f"{[s.cluster.alive_ids() for s in self.servers]}")
 
-    def await_state(self, state: str, timeout: float = 10.0) -> None:
+    def await_state(self, state: str, timeout: float = 10.0,
+                    stable_for: float = 0.3) -> None:
+        """Wait until every node reports ``state`` AND it stays that way
+        for ``stable_for`` seconds — a join-triggered resize may start a
+        beat after the first NORMAL reading."""
         deadline = time.monotonic() + timeout
+        stable_since = None
         while time.monotonic() < deadline:
             if all(s.cluster.state == state for s in self.servers):
-                return
+                if stable_since is None:
+                    stable_since = time.monotonic()
+                elif time.monotonic() - stable_since >= stable_for:
+                    return
+            else:
+                stable_since = None
             time.sleep(0.05)
         raise TimeoutError(
             f"cluster states {[s.cluster.state for s in self.servers]}")

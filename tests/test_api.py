@@ -217,3 +217,33 @@ class TestInfoEndpoints:
         assert out["seconds"] == 0.2
         import os
         assert os.path.isdir(out["traceDir"])
+
+
+class TestBackupRestoreKeyed:
+    def test_keys_and_attrs_survive(self, tmp_path):
+        holder = Holder(str(tmp_path / "a")).open()
+        api = API(holder)
+        server = Server(api, "127.0.0.1", 0).start()
+        c = Client("127.0.0.1", server.address[1])
+        c.create_index("k", {"keys": True})
+        c.create_field("k", "f", {"keys": True})
+        c.query("k", 'Set("alice", f="admin") SetRowAttrs(f, "admin", tier=1)')
+        c.query("k", 'SetColumnAttrs("alice", plan="pro")')
+        blob = c._do("GET", "/internal/backup")
+        server.close()
+        holder.close()
+
+        holder2 = Holder(str(tmp_path / "b")).open()
+        api2 = API(holder2)
+        server2 = Server(api2, "127.0.0.1", 0).start()
+        c2 = Client("127.0.0.1", server2.address[1])
+        c2._do("POST", "/internal/restore", blob,
+               content_type="application/x-tar")
+        (r,) = c2.query("k", 'Row(f="admin")')
+        assert r["keys"] == ["alice"]
+        # attrs restored
+        idx = holder2.index("k")
+        assert idx.field("f").row_attrs.attrs(1) == {"tier": 1}
+        assert idx.column_attrs.attrs(1) == {"plan": "pro"}
+        server2.close()
+        holder2.close()

@@ -571,3 +571,33 @@ class TestIncludesColumnCluster:
             "i", f"IncludesColumn(Row(f=1), column={far})") == [True]
         assert c.client(2).query(
             "i", "IncludesColumn(Row(f=1), column=5)") == [False]
+
+
+class TestFiveNodeCluster:
+    def test_replicas3_failover_and_aae(self, tmp_path):
+        with run_cluster(5, str(tmp_path), replicas=3, heartbeat=0.1) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 1 for s in range(10)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 10,
+                                    columnIDs=cols)
+            # every shard on 3 nodes
+            for s in range(10):
+                assert len(c.servers[0].cluster.shard_owners("i", s)) == 3
+            # kill two non-coordinator nodes: still answerable
+            coord = c.servers[0].cluster.coordinator_id()
+            victims = [s for s in c.servers
+                       if s.cluster.node_id != coord][:2]
+            for v in victims:
+                v.close()
+            survivors = [s for s in c.servers if s not in victims]
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(len(s.cluster.alive_ids()) == 3 for s in survivors):
+                    break
+                time.sleep(0.05)
+            from pilosa_tpu.api.client import Client
+            host, port = survivors[-1].cluster.node_id.rsplit(":", 1)
+            cl = Client(host, int(port))
+            assert cl.query("i", "Count(Row(f=1))") == [10]
