@@ -31,6 +31,8 @@ class Config:
     heartbeat_interval: float = 2.0
     diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
     # device
+    count_batch_window: float = 0.0    # seconds; >0 coalesces concurrent
+                                       # Count queries into one dispatch
     plane_budget_bytes: int = 4 << 30
     mesh: bool = True                   # shard planes over all local devices
     # multi-host jax (one process per host of a pod slice; the host-level

@@ -1,0 +1,107 @@
+"""Cross-request Count coalescing.
+
+Within-request batching (executor count runs) amortizes fixed
+per-dispatch/per-read costs across one query string; this batcher does
+the same ACROSS concurrent requests: server threads submit planned
+Count trees, a collector waits a tiny window, and one fused program
+answers the whole batch with a single device read.
+
+Motivation (BASELINE.md): transports can impose a fixed cost per
+synchronous device read (~100ms on this image's tunnel; ~10us on local
+hardware).  Under concurrent load, N coalesced Counts pay that cost
+once instead of N times.  Off by default (``count_batch_window`` in the
+server config) — a solo request would only gain latency.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from pilosa_tpu.engine import kernels
+
+
+class _Pending:
+    __slots__ = ("node", "leaves", "event", "result", "error")
+
+    def __init__(self, node, leaves):
+        self.node = node
+        self.leaves = leaves
+        self.event = threading.Event()
+        self.result: int | None = None
+        self.error: Exception | None = None
+
+
+class CountBatcher:
+    def __init__(self, fused, window_s: float = 0.002, max_batch: int = 64):
+        self.fused = fused
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._queue: list[_Pending] = []
+        self._lock = threading.Lock()
+        self._kick = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="pilosa-count-batcher",
+                                            daemon=True)
+            self._thread.start()
+
+    def submit(self, node, leaves) -> int:
+        """Block until the coalesced batch containing this Count runs;
+        returns the host-finished int64 total."""
+        p = _Pending(node, tuple(leaves))
+        with self._lock:
+            self._queue.append(p)
+            self._ensure_worker()
+        self._kick.set()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _loop(self) -> None:
+        from pilosa_tpu.exec.fused import shift_leaves
+        while True:
+            self._kick.wait()
+            # collection window: let concurrent submitters pile in
+            threading.Event().wait(self.window_s)
+            with self._lock:
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+                if not self._queue:
+                    self._kick.clear()
+            if not batch:
+                continue
+            # stacked counts need a uniform shard axis: group by the
+            # leaves' n_shards (differs across indexes / shard sets)
+            groups: dict[int, list[_Pending]] = {}
+            for p in batch:
+                groups.setdefault(int(p.leaves[0].shape[0]), []).append(p)
+            for group in groups.values():
+                self._run_group(group, shift_leaves)
+
+    def _run_group(self, group: list[_Pending], shift_leaves) -> None:
+        try:
+            nodes, all_leaves = [], []
+            for p in group:
+                nodes.append(shift_leaves(p.node, len(all_leaves)))
+                all_leaves.extend(p.leaves)
+            per_shard = self.fused.run_count_batch(
+                tuple(nodes), tuple(all_leaves))
+            host = np.asarray(per_shard).astype(np.int64)
+            for p, row in zip(group, host):
+                p.result = int(row.sum())
+                p.event.set()
+        except Exception:  # noqa: BLE001 — per-item fallback
+            for p in group:
+                try:
+                    p.result = int(kernels.shard_totals(
+                        self.fused.run(p.node, p.leaves, "count")))
+                except Exception as e2:  # noqa: BLE001
+                    p.error = e2
+                finally:
+                    p.event.set()

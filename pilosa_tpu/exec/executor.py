@@ -93,7 +93,7 @@ class _Ctx:
 class Executor:
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
                  place=None, plane_budget: int | None = None, placement=None,
-                 stats=None, tracer=None):
+                 stats=None, tracer=None, count_batch_window: float = 0.0):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -110,6 +110,11 @@ class Executor:
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache()
+        self.batcher = None
+        if count_batch_window > 0:
+            from pilosa_tpu.exec.batcher import CountBatcher
+            self.batcher = CountBatcher(self.fused,
+                                        window_s=count_batch_window)
 
     # ------------------------------------------------------------------ api
 
@@ -622,6 +627,16 @@ class Executor:
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
+        if self.batcher is not None:
+            # cross-request coalescing: plan here, let the batcher run
+            # one program + one read for every concurrent Count
+            from pilosa_tpu.exec.fused import Unfusable
+            try:
+                leaves: list = []
+                node = self._plan(ctx, call.children[0], leaves)
+                return self.batcher.submit(node, leaves)
+            except Unfusable:
+                pass
         # fused: bitwise tree + per-shard popcount in one XLA program;
         # the tiny cross-shard total finishes in int64 on host
         per_shard = self._fused_bitmap(ctx, call.children[0], want="count")

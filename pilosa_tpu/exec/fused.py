@@ -110,16 +110,19 @@ class FusedCache:
     MAX_PROGRAMS = 256
 
     def __init__(self):
+        import threading
         from collections import OrderedDict
         self._programs: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
 
     def run(self, node, leaves, want: str):
         """Execute a planned tree: ``want`` is "words" (bitmap) or
         "count" (fused popcount-reduce scalar)."""
         key = (node, want)
-        fn = self._programs.get(key)
-        if fn is not None:
-            self._programs.move_to_end(key)
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                self._programs.move_to_end(key)
         if fn is None:
             if want == "count":
                 # per-shard int32 counts; the caller finishes the tiny
@@ -129,9 +132,11 @@ class FusedCache:
             else:
                 def program(*ls):
                     return _build(node, ls)
-            fn = self._programs[key] = jax.jit(program)
-            while len(self._programs) > self.MAX_PROGRAMS:
-                self._programs.popitem(last=False)
+            fn = jax.jit(program)
+            with self._lock:
+                self._programs[key] = fn
+                while len(self._programs) > self.MAX_PROGRAMS:
+                    self._programs.popitem(last=False)
         return fn(*leaves)
 
     def run_count_batch(self, nodes: tuple, leaves):
@@ -140,14 +145,17 @@ class FusedCache:
         across every Count in the request (critical on transports with
         a per-read floor; see BASELINE.md)."""
         key = (nodes, "count-batch")
-        fn = self._programs.get(key)
-        if fn is not None:
-            self._programs.move_to_end(key)
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                self._programs.move_to_end(key)
         if fn is None:
             def program(*ls):
                 return jnp.stack([kernels.count(_build(n, ls))
                                   for n in nodes])
-            fn = self._programs[key] = jax.jit(program)
-            while len(self._programs) > self.MAX_PROGRAMS:
-                self._programs.popitem(last=False)
+            fn = jax.jit(program)
+            with self._lock:
+                self._programs[key] = fn
+                while len(self._programs) > self.MAX_PROGRAMS:
+                    self._programs.popitem(last=False)
         return fn(*leaves)

@@ -51,7 +51,8 @@ class PilosaTPUServer:
                                  placement.n_devices)
         self.executor = Executor(
             self.holder, placement=placement, stats=self.stats,
-            plane_budget=self.cfg.plane_budget_bytes)
+            plane_budget=self.cfg.plane_budget_bytes,
+            count_batch_window=self.cfg.count_batch_window)
         self.api = API(self.holder, self.executor)
         # construct (binds the socket; resolves port 0) before the
         # cluster needs the advertised address, then serve

@@ -165,3 +165,41 @@ def test_kill9_server_durability(tmp_path):
     assert list(r.columns) == [10, 40]
     (s_,) = ex.execute("i", "Sum(field=n)")
     assert (s_.value, s_.count) == (777, 1)
+
+
+def test_cross_request_count_batching(tmp_path):
+    """Concurrent Counts through a batching executor coalesce into few
+    programs with exact results."""
+    import threading
+
+    from pilosa_tpu.store import Holder
+    from pilosa_tpu.exec import Executor
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex = Executor(holder, count_batch_window=0.01)
+    for r in range(1, 9):
+        for c in range(r):
+            ex.execute("i", f"Set({c}, f={r})")
+
+    results = {}
+    start = threading.Barrier(8)
+
+    def worker(r):
+        start.wait()
+        (cnt,) = ex.execute("i", f"Count(Row(f={r}))")
+        results[r] = cnt
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(1, 9)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {r: r for r in range(1, 9)}
+    # coalesced: far fewer programs than counts (8 concurrent -> 1-2
+    # batch programs; exact number depends on arrival timing)
+    batch_programs = [k for k in ex.fused._programs
+                     if k[1] == "count-batch"]
+    assert 1 <= len(batch_programs) <= 4
