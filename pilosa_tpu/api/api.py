@@ -64,12 +64,14 @@ class API:
             self.cluster.broadcast_schema()
         return idx
 
-    def delete_index(self, name: str) -> None:
+    def delete_index(self, name: str, direct: bool = False) -> None:
         try:
             self.holder.delete_index(name)
         except KeyError:
             raise ApiError(f"index {name!r} not found", 404)
         self.executor.planes.invalidate(name)
+        if self.cluster is not None and not direct:
+            self.cluster.broadcast_delete(name, None)
 
     def create_field(self, index: str, name: str, options: dict | None = None):
         idx = self._index(index)
@@ -82,13 +84,16 @@ class API:
             self.cluster.broadcast_schema()
         return f
 
-    def delete_field(self, index: str, name: str) -> None:
+    def delete_field(self, index: str, name: str,
+                     direct: bool = False) -> None:
         idx = self._index(index)
         try:
             idx.delete_field(name)
         except KeyError:
             raise ApiError(f"field {name!r} not found", 404)
         self.executor.planes.invalidate(index)
+        if self.cluster is not None and not direct:
+            self.cluster.broadcast_delete(index, name)
 
     def schema(self) -> list[dict]:
         return self.holder.schema()

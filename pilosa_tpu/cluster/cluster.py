@@ -275,6 +275,20 @@ class Cluster:
                 self.logger.warning("schema broadcast to %s failed: %s",
                                     nid, e)
 
+    def broadcast_delete(self, index: str, field: str | None) -> None:
+        """Propagate index/field deletion to every peer (reference:
+        DeleteIndex/DeleteField broadcast messages)."""
+        payload = {"index": index, "field": field}
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                self._client(nid)._json("POST", "/internal/schema/delete",
+                                        payload)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("delete broadcast to %s failed: %s",
+                                    nid, e)
+
     # -- placement / routing -------------------------------------------------
 
     def shard_owners(self, index: str, shard: int) -> list[str]:

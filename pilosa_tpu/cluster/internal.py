@@ -96,6 +96,20 @@ def h_schema_apply(self: Handler) -> None:
     self._reply({"success": True})
 
 
+def h_schema_delete(self: Handler) -> None:
+    b = self._json_body()
+    api = self.server.api
+    try:
+        if b.get("field"):
+            api.delete_field(b["index"], b["field"], direct=True)
+        else:
+            api.delete_index(b["index"], direct=True)
+    except ApiError as e:
+        if e.status != 404:  # already gone on this node is fine
+            raise
+    self._reply({"success": True})
+
+
 def h_translate(self: Handler) -> None:
     b = self._json_body()
     try:
@@ -242,6 +256,7 @@ def register_internal_routes(router: Router) -> None:
     router.add("GET", "/internal/shards", h_shards)
     router.add("GET", "/internal/fragments", h_fragments)
     router.add("POST", "/internal/schema", h_schema_apply)
+    router.add("POST", "/internal/schema/delete", h_schema_delete)
     router.add("POST", "/internal/translate", h_translate)
     router.add("POST", "/internal/translate/replicate", h_translate_replicate)
     router.add("GET", "/internal/translate/tail", h_translate_tail)

@@ -601,3 +601,32 @@ class TestFiveNodeCluster:
             host, port = survivors[-1].cluster.node_id.rsplit(":", 1)
             cl = Client(host, int(port))
             assert cl.query("i", "Count(Row(f=1))") == [10]
+
+
+class TestSchemaDeletionBroadcast:
+    def test_delete_field_and_index_propagate(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).create_field("i", "g")
+        c.client(1).delete_field("i", "f")
+        for s in c.servers:
+            assert s.holder.index("i").field("f") is None
+            assert s.holder.index("i").field("g") is not None
+        c.client(2).delete_index("i")
+        for s in c.servers:
+            assert s.holder.index("i") is None
+
+    def test_import_roaring_routed(self, three_nodes):
+        from pilosa_tpu.store import roaring
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        shard = 4
+        positions = np.array([3, 9], np.uint64)  # row 0, cols 3 and 9
+        blob = roaring.serialize(positions)
+        assert c.client(1).import_roaring("i", "f", shard, blob) == 2
+        for cl in c.clients:
+            (r,) = cl.query("i", "Row(f=0)")
+            assert r["columns"] == [shard * SHARD_WIDTH + 3,
+                                    shard * SHARD_WIDTH + 9]
