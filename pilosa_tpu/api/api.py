@@ -70,6 +70,8 @@ class API:
         except KeyError:
             raise ApiError(f"index {name!r} not found", 404)
         self.executor.planes.invalidate(name)
+        # the index dir (incl. _keys/) is gone; cached logs must go too
+        self.executor.translate.drop(name)
         if self.cluster is not None and not direct:
             self.cluster.broadcast_delete(name, None)
 
@@ -92,6 +94,9 @@ class API:
         except KeyError:
             raise ApiError(f"field {name!r} not found", 404)
         self.executor.planes.invalidate(index)
+        # field delete leaves <index>/_keys/<field>.keys behind: remove
+        # it so a recreated field starts with fresh key state
+        self.executor.translate.drop(index, name, remove_files=True)
         if self.cluster is not None and not direct:
             self.cluster.broadcast_delete(index, name)
 

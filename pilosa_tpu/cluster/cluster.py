@@ -62,6 +62,9 @@ class Cluster:
         self._lock = threading.RLock()
         self._status_ts = 0.0
         self._removed: dict[str, float] = {}  # tombstones: explicit removals
+        # schema tombstones: (index, field|None) -> deletion ts; a full
+        # schema push from a stale peer must not resurrect deletions
+        self._schema_tombstones: dict[tuple, float] = {}
         self._resize_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -83,7 +86,11 @@ class Cluster:
                     for nid in self.nodes:
                         self._last_seen.setdefault(nid, now)
                     self.state = resp.get("state", STATE_NORMAL)
-                self.api.apply_schema(resp.get("schema", []))
+                for t in resp.get("schemaTombstones", []):
+                    self.record_schema_tombstone(t["index"], t.get("field"),
+                                                 t.get("ts", 0.0))
+                self.api.apply_schema(
+                    self.filter_schema(resp.get("schema", [])))
                 self._pull_translate_tails(seed)
                 joined = True
                 self.logger.info("joined cluster via %s (%d nodes)", seed,
@@ -170,8 +177,11 @@ class Cluster:
             self._broadcast_status(cleared=[node["id"]])
             if self.is_coordinator():
                 self.trigger_resize()
+        with self._lock:
+            tombs = [{"index": i, "field": f, "ts": ts}
+                     for (i, f), ts in self._schema_tombstones.items()]
         return {"nodes": list(self.nodes.values()), "state": self.state,
-                "schema": self.api.schema()}
+                "schema": self.api.schema(), "schemaTombstones": tombs}
 
     def handle_heartbeat(self, node_id: str, state: str) -> dict:
         with self._lock:
@@ -261,33 +271,59 @@ class Cluster:
 
     # -- schema broadcast ---------------------------------------------------
 
+    def _broadcast(self, path: str, payload: dict, what: str) -> None:
+        """POST a cluster message to every peer, best-effort (the
+        shared loop behind schema/status/delete broadcasts)."""
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                self._client(nid)._json("POST", path, payload)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("%s broadcast to %s failed: %s",
+                                    what, nid, e)
+
     def broadcast_schema(self) -> None:
         """Push the full schema to every peer (reference: CreateIndex/
         Field broadcast messages)."""
-        schema = self.api.schema()
-        for nid in self.member_ids():
-            if nid == self.node_id:
-                continue
-            try:
-                self._client(nid)._json("POST", "/internal/schema",
-                                        {"schema": schema})
-            except Exception as e:  # noqa: BLE001
-                self.logger.warning("schema broadcast to %s failed: %s",
-                                    nid, e)
+        self._broadcast("/internal/schema",
+                        {"schema": self.api.schema()}, "schema")
 
     def broadcast_delete(self, index: str, field: str | None) -> None:
-        """Propagate index/field deletion to every peer (reference:
-        DeleteIndex/DeleteField broadcast messages)."""
-        payload = {"index": index, "field": field}
-        for nid in self.member_ids():
-            if nid == self.node_id:
+        """Propagate index/field deletion to every peer, recording a
+        tombstone so stale full-schema pushes cannot resurrect it
+        (reference: DeleteIndex/DeleteField broadcast messages)."""
+        ts = time.time()
+        with self._lock:
+            self._schema_tombstones[(index, field)] = ts
+        self._broadcast("/internal/schema/delete",
+                        {"index": index, "field": field, "ts": ts},
+                        "delete")
+
+    def record_schema_tombstone(self, index: str, field: str | None,
+                                ts: float) -> None:
+        with self._lock:
+            cur = self._schema_tombstones.get((index, field), 0.0)
+            self._schema_tombstones[(index, field)] = max(cur, ts)
+
+    def filter_schema(self, schema: list[dict]) -> list[dict]:
+        """Drop schema entries deleted AFTER their creation: an entry
+        whose created_at predates its tombstone is a stale resurrection;
+        a genuine recreate carries a newer created_at and passes."""
+        with self._lock:
+            tombs = dict(self._schema_tombstones)
+        if not tombs:
+            return schema
+        out = []
+        for ispec in schema:
+            its = tombs.get((ispec["name"], None), 0.0)
+            if ispec.get("createdAt", 0.0) <= its:
                 continue
-            try:
-                self._client(nid)._json("POST", "/internal/schema/delete",
-                                        payload)
-            except Exception as e:  # noqa: BLE001
-                self.logger.warning("delete broadcast to %s failed: %s",
-                                    nid, e)
+            fields = [f for f in ispec.get("fields", [])
+                      if f.get("createdAt", 0.0)
+                      > tombs.get((ispec["name"], f["name"]), 0.0)]
+            out.append({**ispec, "fields": fields})
+        return out
 
     # -- placement / routing -------------------------------------------------
 

@@ -92,13 +92,20 @@ def h_fragments(self: Handler) -> None:
 
 
 def h_schema_apply(self: Handler) -> None:
-    self.server.api.apply_schema(self._json_body()["schema"])
+    schema = self._json_body()["schema"]
+    cluster = self.server.api.cluster
+    if cluster is not None:
+        schema = cluster.filter_schema(schema)
+    self.server.api.apply_schema(schema)
     self._reply({"success": True})
 
 
 def h_schema_delete(self: Handler) -> None:
     b = self._json_body()
     api = self.server.api
+    if api.cluster is not None:
+        api.cluster.record_schema_tombstone(b["index"], b.get("field"),
+                                            b.get("ts", 0.0))
     try:
         if b.get("field"):
             api.delete_field(b["index"], b["field"], direct=True)

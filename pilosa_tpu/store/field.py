@@ -57,6 +57,8 @@ class FieldOptions:
     scale: int = 0               # decimal: value stored as int(v * 10^scale)
     epoch: str = ""              # timestamp: ISO epoch, default Unix
     time_unit: str = "s"         # timestamp: s | ms | us | ns
+    created_at: float = 0.0      # wall time of creation (cluster schema
+                                 # tombstones compare against this)
 
     def __post_init__(self):
         if self.type not in (TYPE_SET, TYPE_INT, TYPE_TIME, TYPE_MUTEX,

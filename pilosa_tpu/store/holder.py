@@ -60,13 +60,16 @@ class Holder:
     # -- index management ---------------------------------------------------
 
     def create_index(self, name: str, *, keys: bool = False,
-                     track_existence: bool = True) -> Index:
+                     track_existence: bool = True,
+                     created_at: float = 0.0) -> Index:
+        import time
         with self._lock:
             if name in self.indexes:
                 raise ValueError(f"index {name!r} already exists")
             _validate_name(name)
             idx = Index(os.path.join(self.path, name), name, keys=keys,
-                        track_existence=track_existence, fsync=self.fsync)
+                        track_existence=track_existence, fsync=self.fsync,
+                        created_at=created_at or time.time())
             os.makedirs(idx.path, exist_ok=True)
             idx.save_meta()
             idx.open()
@@ -110,10 +113,12 @@ class Holder:
                             "bitDepth": o.bit_depth, "scale": o.scale,
                             "epoch": o.epoch, "timeUnit": o.time_unit,
                         },
+                        "createdAt": o.created_at,
                     })
                 out.append({"name": iname,
                             "options": {"keys": idx.keys,
                                         "trackExistence": idx.track_existence},
+                            "createdAt": idx.created_at,
                             "fields": fields})
         return out
 
@@ -121,11 +126,16 @@ class Holder:
         """Create any missing indexes/fields from a schema dump (used by
         restore and cluster schema sync)."""
         for ispec in schema:
-            idx = self.ensure_index(
-                ispec["name"],
-                keys=ispec.get("options", {}).get("keys", False),
-                track_existence=ispec.get("options", {}).get("trackExistence", True),
-            )
+            if ispec["name"] in self.indexes:
+                idx = self.indexes[ispec["name"]]
+            else:
+                idx = self.create_index(
+                    ispec["name"],
+                    keys=ispec.get("options", {}).get("keys", False),
+                    track_existence=ispec.get("options", {}).get(
+                        "trackExistence", True),
+                    created_at=ispec.get("createdAt", 0.0),
+                )
             for fspec in ispec.get("fields", []):
                 if fspec["name"] in idx.fields:
                     continue
@@ -139,6 +149,7 @@ class Holder:
                     base=o.get("base", 0), bit_depth=o.get("bitDepth", 0),
                     scale=o.get("scale", 0), epoch=o.get("epoch", ""),
                     time_unit=o.get("timeUnit", "s"),
+                    created_at=fspec.get("createdAt", 0.0),
                 ))
 
 

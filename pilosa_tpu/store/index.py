@@ -22,11 +22,13 @@ EXISTENCE_FIELD = "_exists"
 
 class Index:
     def __init__(self, path: str, name: str, *, keys: bool = False,
-                 track_existence: bool = True, fsync: bool = False):
+                 track_existence: bool = True, fsync: bool = False,
+                 created_at: float = 0.0):
         self.path = path
         self.name = name
         self.keys = keys
         self.track_existence = track_existence
+        self.created_at = created_at
         self.fsync = fsync
         self.fields: dict[str, Field] = {}
         self._column_attrs = None
@@ -41,6 +43,7 @@ class Index:
                 opts = json.load(f)
             self.keys = opts.get("keys", False)
             self.track_existence = opts.get("track_existence", True)
+            self.created_at = opts.get("created_at", 0.0)
         for entry in sorted(os.listdir(self.path)) if os.path.isdir(self.path) else []:
             fpath = os.path.join(self.path, entry)
             if os.path.isdir(fpath) and not entry.startswith("."):
@@ -55,7 +58,8 @@ class Index:
         tmp = os.path.join(self.path, ".meta.tmp")
         with open(tmp, "w") as f:
             json.dump({"keys": self.keys,
-                       "track_existence": self.track_existence}, f)
+                       "track_existence": self.track_existence,
+                       "created_at": self.created_at}, f)
         os.replace(tmp, os.path.join(self.path, ".meta"))
 
     def close(self) -> None:
@@ -68,11 +72,15 @@ class Index:
     # -- fields -------------------------------------------------------------
 
     def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        import time
         with self._lock:
             if name in self.fields:
                 raise ValueError(f"field {name!r} already exists")
+            options = options or FieldOptions()
+            if not options.created_at:
+                options.created_at = time.time()
             f = Field(os.path.join(self.path, name), self.name, name,
-                      options or FieldOptions(), fsync=self.fsync)
+                      options, fsync=self.fsync)
             os.makedirs(f.path, exist_ok=True)
             f.save_meta()
             self.fields[name] = f

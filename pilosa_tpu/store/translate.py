@@ -169,6 +169,27 @@ class TranslateStore:
     def rows(self, index: str, field: str) -> KeyLog:
         return self._log(index, field)
 
+    def drop(self, index: str, field: str | None = None,
+             remove_files: bool = False) -> None:
+        """Forget cached key logs for a deleted index (all its logs) or
+        one field — a recreated index/field must start from empty key
+        state, not inherit the dead one's mappings."""
+        with self._lock:
+            if field is not None:
+                log = self._logs.pop((index, field), None)
+                if log is not None:
+                    log.close()
+                if remove_files:
+                    path = os.path.join(self.holder_path, index, "_keys",
+                                        f"{field}.keys")
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                return
+            for key in [k for k in self._logs if k[0] == index]:
+                self._logs.pop(key).close()
+
     def close(self) -> None:
         with self._lock:
             for log in self._logs.values():

@@ -630,3 +630,43 @@ class TestSchemaDeletionBroadcast:
             (r,) = cl.query("i", "Row(f=0)")
             assert r["columns"] == [shard * SHARD_WIDTH + 3,
                                     shard * SHARD_WIDTH + 9]
+
+
+class TestDeletionTombstones:
+    def test_stale_peer_cannot_resurrect(self, three_nodes):
+        """A full-schema push carrying a deleted index must not
+        resurrect it; a genuine recreate (newer created_at) must."""
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        stale_schema = c.servers[0].api.schema()
+        c.client(1).delete_index("i")
+        for s in c.servers:
+            assert s.holder.index("i") is None
+        # stale push (as a lagging peer would send)
+        c.servers[2].cluster._broadcast(
+            "/internal/schema", {"schema": stale_schema}, "schema")
+        import time
+        time.sleep(0.3)
+        for s in c.servers:
+            assert s.holder.index("i") is None, "resurrected from stale push"
+        # genuine recreate passes (newer created_at beats the tombstone)
+        time.sleep(0.05)
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        for s in c.servers:
+            assert s.holder.index("i") is not None
+
+    def test_recreated_keyed_field_starts_fresh(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        c.client(0).query("k", 'Set("alice", f="admin")')
+        c.client(0).delete_field("k", "f")
+        import time
+        time.sleep(0.05)
+        c.client(0).create_field("k", "f", {"keys": True})
+        (r,) = c.client(0).query("k", 'Row(f="admin")')
+        assert r == {"keys": []}  # no inherited rows or key state
+        log = c.servers[0].executor.translate.rows("k", "f")
+        assert log.translate(["admin"], create=False) == [None]

@@ -363,3 +363,19 @@ class TestReviewRegressions:
         f2 = h2.index("i").field("n")
         assert f2.value(1) == (5, True)
         assert f2.value(2) == (-3, True)
+
+    def test_recreated_index_fresh_keys(self, tmp_path):
+        """Single-node: deleting an index must drop cached key logs so a
+        recreated index starts from empty key state."""
+        from pilosa_tpu.exec import Executor
+        h = Holder(str(tmp_path)).open()
+        h.create_index("k", keys=True)
+        h.index("k").create_field("f", FieldOptions(keys=True))
+        from pilosa_tpu.api import API
+        api = API(h)
+        api.query("k", 'Set("alice", f="admin")')
+        api.delete_index("k")
+        h.create_index("k", keys=True)
+        h.index("k").create_field("f", FieldOptions(keys=True))
+        log = api.executor.translate.columns("k")
+        assert log.translate(["alice"], create=False) == [None]
