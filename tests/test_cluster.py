@@ -617,6 +617,8 @@ class TestSchemaDeletionBroadcast:
         for s in c.servers:
             assert s.holder.index("i") is None
 
+
+class TestImportRoaringCluster:
     def test_import_roaring_routed(self, three_nodes):
         from pilosa_tpu.store import roaring
         c = three_nodes
