@@ -145,18 +145,36 @@ class DistributedExecutor:
                       else self.cluster.index_shards(index))
         groups = self.cluster.group_shards_by_node(index, all_shards)
         sub_call = _strip_truncation(call)
-        partials = []
         local_api = self.cluster.api
-        for node_id, node_shards in groups.items():
-            if node_id == self.cluster.node_id:
-                rs = local_api.executor.execute(
-                    index, Query([sub_call]), shards=list(node_shards),
-                    translate_output=False)
-                partials.append(result_to_json(rs[0]))
-            else:
-                rs = self.cluster.internal_query(
-                    node_id, index, str(sub_call), node_shards)
-                partials.append(rs[0])
+        pql = str(sub_call)
+
+        # remote groups fan out CONCURRENTLY (the reference runs one
+        # goroutine per node, executor.go#mapReduce); the local group
+        # executes on this thread while peers work
+        def remote(node_id, node_shards):
+            return self.cluster.internal_query(node_id, index, pql,
+                                               node_shards)[0]
+
+        from concurrent.futures import ThreadPoolExecutor
+        remote_items = [(n, s) for n, s in groups.items()
+                        if n != self.cluster.node_id]
+        partials = []
+        futures = []
+        pool = None
+        if remote_items:
+            pool = ThreadPoolExecutor(max_workers=len(remote_items))
+            futures = [pool.submit(remote, n, s) for n, s in remote_items]
+        if self.cluster.node_id in groups:
+            rs = local_api.executor.execute(
+                index, Query([sub_call]),
+                shards=list(groups[self.cluster.node_id]),
+                translate_output=False)
+            partials.append(result_to_json(rs[0]))
+        if pool is not None:
+            try:
+                partials.extend(f.result() for f in futures)
+            finally:
+                pool.shutdown(wait=False)
         merged = merge_results(_call_of(call), partials)
         return self._translate_output(index, _call_of(call), merged)
 
@@ -192,19 +210,26 @@ class DistributedExecutor:
 
     def _run_on(self, index: str, call: Call, node_ids, shards):
         """Execute one call on each named node (replica-synchronous for
-        writes); returns the primary's (first) result."""
-        results = []
-        for node_id in node_ids:
+        writes, replicas in parallel); returns the primary's (first)
+        result."""
+        pql = str(call)
+
+        def one(node_id):
             if node_id == self.cluster.node_id:
                 rs = self.cluster.api.executor.execute(
                     index, Query([call]),
                     shards=list(shards) if shards else None,
                     translate_output=False)
-                results.append(result_to_json(rs[0]))
-            else:
-                results.append(self.cluster.internal_query(
-                    node_id, index, str(call), shards)[0])
-        return results
+                return result_to_json(rs[0])
+            return self.cluster.internal_query(node_id, index, pql,
+                                               shards)[0]
+
+        node_ids = list(node_ids)
+        if len(node_ids) == 1:
+            return [one(node_ids[0])]
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(node_ids)) as pool:
+            return list(pool.map(one, node_ids))
 
     # -- key translation at the edge ---------------------------------------
 
