@@ -291,6 +291,36 @@ class API:
         out = io.StringIO()
         col_log = (self.executor.translate.columns(index)
                    if idx.keys else None)
+
+        def col_repr(c: int):
+            return col_log.key_of(int(c)) if col_log else int(c)
+
+        if f.options.type in BSI_TYPES:
+            # BSI export: one "column,value" line per non-null column
+            # (reference: ExportCSV over int fields)
+            from pilosa_tpu.engine.bsi import (EXISTS_ROW, OFFSET_ROW,
+                                               SIGN_ROW)
+            view = f.bsi_view()
+            if view is not None:
+                for shard in sorted(view.fragments):
+                    frag = view.fragment(shard)
+                    exists = frag.row(EXISTS_ROW).columns()
+                    if len(exists) == 0:
+                        continue
+                    vals = np.zeros(len(exists), dtype=np.int64)
+                    for b in range(f.options.bit_depth):
+                        hit = np.isin(exists,
+                                      frag.row(OFFSET_ROW + b).columns())
+                        vals[hit] += 1 << b
+                    neg = np.isin(exists, frag.row(SIGN_ROW).columns())
+                    vals[neg] = -vals[neg]
+                    vals += f.options.base
+                    base_col = np.uint64(shard * SHARD_WIDTH)
+                    for c, v in zip(exists, vals):
+                        out.write(f"{col_repr(int(c) + int(base_col))},"
+                                  f"{f.from_stored(int(v))}\n")
+            return out.getvalue()
+
         row_log = (self.executor.translate.rows(index, field)
                    if f.options.keys else None)
         view = f.standard_view()
@@ -302,8 +332,7 @@ class API:
                         np.uint64(shard * SHARD_WIDTH)
                     rkey = row_log.key_of(r) if row_log else r
                     for c in cols:
-                        ckey = col_log.key_of(int(c)) if col_log else int(c)
-                        out.write(f"{rkey},{ckey}\n")
+                        out.write(f"{rkey},{col_repr(int(c))}\n")
         return out.getvalue()
 
     # -- backup / restore ---------------------------------------------------

@@ -94,22 +94,28 @@ def cmd_import(args) -> int:
     fld_keyed = fld["options"]["keys"]
 
     src = open(args.file) if args.file != "-" else sys.stdin
-    batch_rows, batch_cols, batch_vals, total = [], [], [], 0
+    batch_rows, batch_cols, batch_vals = [], [], []
+    totals = []
+    # parallel batch submission (reference ctl/import.go streams batches
+    # concurrently); server-side locks keep application correct
+    from concurrent.futures import ThreadPoolExecutor
+    pool = ThreadPoolExecutor(max_workers=max(1, args.workers))
+    futures = []
 
-    def flush():
-        nonlocal total
-        if not batch_cols:
-            return
+    def submit(rows, cols, vals):
         ckey = "columnKeys" if idx_keyed else "columnIDs"
         if args.value:
-            total += client.import_values(
-                args.index, args.field,
-                **{ckey: batch_cols, "values": batch_vals})
-        else:
-            rkey = "rowKeys" if fld_keyed else "rowIDs"
-            total += client.import_bits(
-                args.index, args.field,
-                **{rkey: batch_rows, ckey: batch_cols})
+            return client.import_values(
+                args.index, args.field, **{ckey: cols, "values": vals})
+        rkey = "rowKeys" if fld_keyed else "rowIDs"
+        return client.import_bits(
+            args.index, args.field, **{rkey: rows, ckey: cols})
+
+    def flush():
+        if not batch_cols:
+            return
+        futures.append(pool.submit(submit, list(batch_rows),
+                                   list(batch_cols), list(batch_vals)))
         batch_rows.clear(), batch_cols.clear(), batch_vals.clear()
 
     for line in src:
@@ -126,7 +132,9 @@ def cmd_import(args) -> int:
         if len(batch_cols) >= args.batch_size:
             flush()
     flush()
-    print(f"imported (changed {total} bits/values)", file=sys.stderr)
+    totals = [f.result() for f in futures]
+    pool.shutdown()
+    print(f"imported (changed {sum(totals)} bits/values)", file=sys.stderr)
     return 0
 
 
@@ -236,6 +244,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--value", action="store_true",
                     help="CSV is col,value for an int field")
     sp.add_argument("--batch-size", type=int, default=100_000)
+    sp.add_argument("--workers", type=int, default=4,
+                    help="concurrent import batches in flight")
     sp.set_defaults(fn=cmd_import)
 
     sp = sub.add_parser("export", help="export field as CSV")

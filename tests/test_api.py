@@ -247,3 +247,19 @@ class TestBackupRestoreKeyed:
         assert idx.column_attrs.attrs(1) == {"plan": "pro"}
         server2.close()
         holder2.close()
+
+
+class TestBsiExport:
+    def test_export_int_field(self, srv):
+        _, _, _, c = srv
+        c.create_index("i")
+        c.create_field("i", "n", {"type": "int", "min": -100, "max": 100})
+        c.import_values("i", "n", columnIDs=[1, 2, 3], values=[5, -7, 0])
+        assert c.export_csv("i", "n") == "1,5\n2,-7\n3,0\n"
+
+    def test_export_decimal_field(self, srv):
+        _, _, _, c = srv
+        c.create_index("i")
+        c.create_field("i", "d", {"type": "decimal", "scale": 1})
+        c.import_values("i", "d", columnIDs=[4], values=[2.5])
+        assert c.export_csv("i", "d") == "4,2.5\n"
