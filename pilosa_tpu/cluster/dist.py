@@ -141,6 +141,9 @@ class DistributedExecutor:
 
     def _read(self, index: str, call: Call, shards: list[int] | None):
         call = self._translate_input(index, call)
+        if call.name == "Options" and call.args.get("shards") is not None:
+            # Options(shards=[...]) overrides, as in single-node
+            shards = [int(s) for s in call.args["shards"]]
         all_shards = (tuple(shards) if shards is not None
                       else self.cluster.index_shards(index))
         groups = self.cluster.group_shards_by_node(index, all_shards)

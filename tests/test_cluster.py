@@ -672,3 +672,16 @@ class TestDeletionTombstones:
         assert r == {"keys": []}  # no inherited rows or key state
         log = c.servers[0].executor.translate.rows("k", "f")
         assert log.translate(["admin"], create=False) == [None]
+
+
+class TestOptionsShardsCluster:
+    def test_options_shards_respected(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).import_bits("i", "f", rowIDs=[1, 1],
+                                columnIDs=[5, 3 * SHARD_WIDTH + 5])
+        assert c.client(1).query("i", "Count(Row(f=1))") == [2]
+        (n,) = c.client(1).query(
+            "i", "Options(Count(Row(f=1)), shards=[0])")
+        assert n == 1
