@@ -45,14 +45,17 @@ def _strip_truncation(call: Call) -> Call:
     strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",),
              "All": ("limit", "offset")}
     keys = strip.get(eff.name)
-    if not keys or not any(k in eff.args for k in keys):
-        return call
-    new_eff = Call(eff.name,
+    if keys and any(k in eff.args for k in keys):
+        eff = Call(eff.name,
                    {k: v for k, v in eff.args.items() if k not in keys},
                    eff.children)
-    if call is eff:
-        return new_eff
-    return Call(call.name, dict(call.args), [new_eff])
+    if call.name == "Options":
+        # the shards list was already resolved into per-node groups;
+        # forwarding it would make each node re-apply the FULL list
+        # over its replicas and additive merges would over-count
+        args = {k: v for k, v in call.args.items() if k != "shards"}
+        return Call("Options", args, [eff])
+    return eff
 
 
 class DistributedExecutor:

@@ -685,3 +685,20 @@ class TestOptionsShardsCluster:
         (n,) = c.client(1).query(
             "i", "Options(Count(Row(f=1)), shards=[0])")
         assert n == 1
+
+    def test_options_shards_with_replicas_not_double_counted(self, tmp_path):
+        """Regression: the shards list must not be forwarded to nodes —
+        each would re-apply the full list over its replicas and additive
+        merges would over-count."""
+        with run_cluster(3, str(tmp_path), replicas=2) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH for s in range(4)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 4,
+                                    columnIDs=cols)
+            (n,) = c.client(1).query(
+                "i", "Options(Count(Row(f=1)), shards=[0, 1, 2, 3])")
+            assert n == 4
+            (n2,) = c.client(2).query(
+                "i", "Options(Count(Row(f=1)), shards=[0, 2])")
+            assert n2 == 2
