@@ -30,7 +30,7 @@ class Client:
 
     def _do(self, method: str, path: str, body: bytes | None = None,
             content_type: str = "application/json",
-            headers: dict | None = None):
+            headers: dict | None = None, _retried: bool = False):
         hdrs = dict(headers or {})
         if body:
             hdrs["Content-Type"] = content_type
@@ -40,6 +40,12 @@ class Client:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
+        except ConnectionResetError:
+            # transient under connection churn; one retry
+            if _retried:
+                raise ClientError(f"connection reset by {self.base}")
+            return self._do(method, path, body, content_type, headers,
+                            _retried=True)
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             try:
@@ -48,6 +54,10 @@ class Client:
                 pass
             raise ClientError(detail, e.code) from e
         except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), ConnectionResetError) \
+                    and not _retried:
+                return self._do(method, path, body, content_type, headers,
+                                _retried=True)
             raise ClientError(f"cannot reach {self.base}: {e.reason}") from e
         if ctype.startswith("application/json"):
             return json.loads(data)

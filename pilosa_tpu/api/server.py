@@ -331,6 +331,7 @@ class Server:
 
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101,
                  stats=None, logger=None):
+        ThreadingHTTPServer.request_queue_size = 64  # concurrent clients
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.api = api
         self.httpd.router = build_router()
