@@ -702,3 +702,73 @@ class TestOptionsShardsCluster:
             (n2,) = c.client(2).query(
                 "i", "Options(Count(Row(f=1)), shards=[0, 2])")
             assert n2 == 2
+
+
+class TestClusterSingleNodeEquivalence:
+    """The strongest cluster invariant: ANY operation sequence must give
+    identical query results on a 3-node cluster and a single-node
+    holder (generated sequences, every query class checked)."""
+
+    def test_random_ops_equivalent(self, tmp_path):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.exec import Executor, result_to_json
+        from pilosa_tpu.store import Holder
+
+        rng = np.random.default_rng(123)
+        solo_holder = Holder(str(tmp_path / "solo")).open()
+        solo = API(solo_holder, Executor(solo_holder))
+
+        with run_cluster(3, str(tmp_path / "cluster")) as c:
+            # identical schema on both
+            solo.create_index("i")
+            solo.create_field("i", "f")
+            solo.create_field("i", "amount",
+                              {"type": "int", "min": -100, "max": 100})
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).create_field("i", "amount",
+                                     {"type": "int", "min": -100,
+                                      "max": 100})
+            # random op sequence applied to BOTH, spread over 5 shards
+            ops = []
+            for _ in range(120):
+                kind = rng.integers(0, 4)
+                col = int(rng.integers(0, 5)) * SHARD_WIDTH \
+                    + int(rng.integers(0, 50))
+                if kind == 0:
+                    ops.append(f"Set({col}, f={int(rng.integers(1, 6))})")
+                elif kind == 1:
+                    ops.append(f"Clear({col}, f={int(rng.integers(1, 6))})")
+                elif kind == 2:
+                    ops.append(
+                        f"Set({col}, amount={int(rng.integers(-100, 101))})")
+                else:
+                    ops.append(f"Set({col}, f={int(rng.integers(1, 6))}, "
+                               f"2019-0{int(rng.integers(1, 10))}-01T00:00)")
+            pql_ops = " ".join(ops)
+            solo.query("i", pql_ops)
+            # spread writes across different cluster nodes
+            third = len(ops) // 3
+            c.client(0).query("i", " ".join(ops[:third]))
+            c.client(1).query("i", " ".join(ops[third:2 * third]))
+            c.client(2).query("i", " ".join(ops[2 * third:]))
+
+            queries = [
+                "Count(All())",
+                "Count(Row(f=1))", "Count(Row(f=5))",
+                "Row(f=2)", "Intersect(Row(f=1), Row(f=2))",
+                "Union(Row(f=1), Row(f=3), Row(f=5))",
+                "Xor(Row(f=2), Row(f=4))", "Not(Row(f=1))",
+                "TopN(f)", "Rows(f)",
+                "Sum(field=amount)", "Min(field=amount)",
+                "Max(field=amount)", "Count(Row(amount > 0))",
+                "Count(Row(-50 <= amount <= 50))",
+                "Distinct(field=amount)",
+                "Percentile(field=amount, nth=50)",
+                "GroupBy(Rows(f))",
+            ]
+            for pql in queries:
+                (a,) = solo.query("i", pql)["results"]
+                for cl in c.clients:
+                    (b,) = cl.query("i", pql)
+                    assert a == b, f"{pql}: solo={a} cluster={b}"
