@@ -7,6 +7,10 @@
 #include <vector>
 
 extern "C" {
+int64_t rc_union_u32(const uint32_t*, size_t, const uint32_t*, size_t,
+                     uint32_t*);
+int64_t rc_diff_u32(const uint32_t*, size_t, const uint32_t*, size_t,
+                    uint32_t*);
 int64_t rc_cardinality(const uint8_t*, size_t);
 int64_t rc_deserialize(const uint8_t*, size_t, uint64_t*, size_t);
 int64_t rc_serialize(const uint64_t*, size_t, uint8_t*, size_t);
@@ -61,6 +65,20 @@ int main() {
   uint32_t cols[3] = {0, 33, 127};
   assert(rc_pack_columns(cols, 3, words, 4) == 3);
   assert(rc_popcount(words, 4) == 3);
+
+  {
+    uint32_t a[] = {1, 3, 5, 7};
+    uint32_t b[] = {2, 3, 8};
+    uint32_t out[7];
+    assert(rc_union_u32(a, 4, b, 3, out) == 6);
+    uint32_t expect_u[] = {1, 2, 3, 5, 7, 8};
+    for (int i = 0; i < 6; i++) assert(out[i] == expect_u[i]);
+    assert(rc_diff_u32(a, 4, b, 3, out) == 3);
+    uint32_t expect_d[] = {1, 5, 7};
+    for (int i = 0; i < 3; i++) assert(out[i] == expect_d[i]);
+    assert(rc_union_u32(a, 0, b, 3, out) == 3);
+    assert(rc_diff_u32(a, 4, b, 0, out) == 4);
+  }
 
   printf("native codec: all checks passed\n");
   return 0;

@@ -335,3 +335,51 @@ int64_t rc_popcount(const uint32_t* words, size_t n) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Union of two sorted-unique uint32 arrays -> sorted-unique out.
+// out capacity must be >= n + m.  Returns merged length.
+// (RowBits.add hot path: numpy's union1d re-sorts; this is the linear
+// merge for the already-sorted case.)
+int64_t rc_union_u32(const uint32_t* a, size_t n, const uint32_t* b,
+                     size_t m, uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < n && j < m) {
+    uint32_t va = a[i], vb = b[j];
+    if (va < vb) {
+      out[k++] = va;
+      i++;
+    } else if (vb < va) {
+      out[k++] = vb;
+      j++;
+    } else {
+      out[k++] = va;
+      i++;
+      j++;
+    }
+  }
+  while (i < n) out[k++] = a[i++];
+  while (j < m) out[k++] = b[j++];
+  return (int64_t)k;
+}
+
+// Difference a \ b of sorted-unique uint32 arrays. Returns out length.
+int64_t rc_diff_u32(const uint32_t* a, size_t n, const uint32_t* b,
+                    size_t m, uint32_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < n && j < m) {
+    if (a[i] < b[j]) {
+      out[k++] = a[i++];
+    } else if (b[j] < a[i]) {
+      j++;
+    } else {
+      i++;
+      j++;
+    }
+  }
+  while (i < n) out[k++] = a[i++];
+  return (int64_t)k;
+}
+
+}  // extern "C"

@@ -50,6 +50,12 @@ def _load():
     lib.rc_expand_plane.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64,
                                     u64p, ctypes.c_size_t, u32p,
                                     ctypes.c_size_t]
+    lib.rc_union_u32.restype = ctypes.c_int64
+    lib.rc_union_u32.argtypes = [u32p, ctypes.c_size_t, u32p,
+                                 ctypes.c_size_t, u32p]
+    lib.rc_diff_u32.restype = ctypes.c_int64
+    lib.rc_diff_u32.argtypes = [u32p, ctypes.c_size_t, u32p,
+                                ctypes.c_size_t, u32p]
     return lib
 
 
@@ -109,3 +115,21 @@ def expand_plane(buf: bytes, row_width: int, row_slots: np.ndarray,
         len(row_slots),
         plane.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         plane.shape[-1]), "expand_plane")
+
+
+def _u32p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def union_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Linear merge-union of two sorted-unique uint32 arrays."""
+    out = np.empty(len(a) + len(b), dtype=np.uint32)
+    k = _lib.rc_union_u32(_u32p(a), len(a), _u32p(b), len(b), _u32p(out))
+    return out[:k]
+
+
+def diff_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Linear a-minus-b of sorted-unique uint32 arrays."""
+    out = np.empty(len(a), dtype=np.uint32)
+    k = _lib.rc_diff_u32(_u32p(a), len(a), _u32p(b), len(b), _u32p(out))
+    return out[:k]

@@ -29,6 +29,24 @@ from pilosa_tpu.engine.words import (
 DENSE_THRESHOLD = WORDS_PER_SHARD
 
 
+def _union_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of sorted-unique uint32 arrays: native linear merge when
+    built, numpy fallback (which re-sorts) otherwise."""
+    from pilosa_tpu.store import native
+    if native.available():
+        return native.union_sorted_u32(np.ascontiguousarray(a),
+                                       np.ascontiguousarray(b))
+    return np.union1d(a, b)
+
+
+def _diff_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    from pilosa_tpu.store import native
+    if native.available():
+        return native.diff_sorted_u32(np.ascontiguousarray(a),
+                                      np.ascontiguousarray(b))
+    return np.setdiff1d(a, b, assume_unique=True)
+
+
 class RowBits:
     """Bits of one (row, shard) pair.  Not thread-safe; the owning
     fragment serializes access."""
@@ -108,7 +126,7 @@ class RowBits:
             np.bitwise_or.at(self._words, idx, bit)
             self._card = popcount_words(self._words)
             return self._card - before
-        merged = np.union1d(self._cols, cols)
+        merged = _union_sorted(self._cols, cols)
         added = len(merged) - self._card
         self._cols = merged
         self._card = len(merged)
@@ -127,7 +145,7 @@ class RowBits:
             np.bitwise_and.at(self._words, idx, ~bit)
             self._card = popcount_words(self._words)
             return before - self._card
-        kept = np.setdiff1d(self._cols, cols, assume_unique=True)
+        kept = _diff_sorted(self._cols, cols)
         removed = self._card - len(kept)
         self._cols = kept
         self._card = len(kept)
