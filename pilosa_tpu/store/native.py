@@ -29,6 +29,8 @@ def _load():
         return None
     if not os.path.exists(_LIB_PATH):
         return None
+    # an older .so may lack newer symbols: AttributeError below must
+    # also mean "fall back to Python", not a hard import crash
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
@@ -59,7 +61,10 @@ def _load():
     return lib
 
 
-_lib = _load()
+try:
+    _lib = _load()
+except AttributeError:  # stale .so missing newer symbols
+    _lib = None
 
 
 def available() -> bool:
@@ -123,13 +128,19 @@ def _u32p(arr):
 
 def union_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Linear merge-union of two sorted-unique uint32 arrays."""
+    a = np.ascontiguousarray(a, dtype=np.uint32)
+    b = np.ascontiguousarray(b, dtype=np.uint32)
     out = np.empty(len(a) + len(b), dtype=np.uint32)
     k = _lib.rc_union_u32(_u32p(a), len(a), _u32p(b), len(b), _u32p(out))
-    return out[:k]
+    # exact-size copy: callers hold the result long-term and a view
+    # would pin the oversized merge buffer
+    return out[:k].copy()
 
 
 def diff_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Linear a-minus-b of sorted-unique uint32 arrays."""
+    a = np.ascontiguousarray(a, dtype=np.uint32)
+    b = np.ascontiguousarray(b, dtype=np.uint32)
     out = np.empty(len(a), dtype=np.uint32)
     k = _lib.rc_diff_u32(_u32p(a), len(a), _u32p(b), len(b), _u32p(out))
-    return out[:k]
+    return out[:k].copy()

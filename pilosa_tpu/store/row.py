@@ -34,16 +34,14 @@ def _union_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     built, numpy fallback (which re-sorts) otherwise."""
     from pilosa_tpu.store import native
     if native.available():
-        return native.union_sorted_u32(np.ascontiguousarray(a),
-                                       np.ascontiguousarray(b))
+        return native.union_sorted_u32(a, b)
     return np.union1d(a, b)
 
 
 def _diff_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     from pilosa_tpu.store import native
     if native.available():
-        return native.diff_sorted_u32(np.ascontiguousarray(a),
-                                      np.ascontiguousarray(b))
+        return native.diff_sorted_u32(a, b)
     return np.setdiff1d(a, b, assume_unique=True)
 
 
