@@ -106,3 +106,23 @@ class TestCommands:
 
         assert main(["check", "--data-dir", str(data2)]) == 0
         assert "all fragments ok" in capsys.readouterr().out
+
+
+class TestCheckCorruption:
+    def test_check_reports_torn_snapshot(self, tmp_path, capsys):
+        from pilosa_tpu.store import Holder
+        data = str(tmp_path / "data")
+        h = Holder(data).open()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        idx.set_bit("f", 1, 10)
+        h.close()  # snapshots
+        # corrupt the snapshot file body
+        import glob
+        snap = glob.glob(f"{data}/i/f/views/standard/fragments/0")[0]
+        blob = bytearray(open(snap, "rb").read())
+        blob[4:8] = b"\xff\xff\xff\xff"  # absurd container count
+        open(snap, "wb").write(bytes(blob))
+        rc = main(["check", "--data-dir", data])
+        out = capsys.readouterr().out
+        assert rc == 1 or "FATAL" in out or "BAD" in out
