@@ -37,7 +37,6 @@ EXISTS_ROW = 0
 SIGN_ROW = 1
 OFFSET_ROW = 2
 
-_FULL = jnp.uint32(0xFFFFFFFF)
 
 
 def depth_of(plane: jax.Array) -> int:

@@ -1,0 +1,64 @@
+"""Virtual CPU-mesh provisioning (SURVEY.md §5 simulated-mesh recipe).
+
+Multi-chip TPU hardware is not assumed anywhere: the distribution path is
+validated on an n-device *virtual* CPU mesh, the rebuild's analogue of the
+reference's in-process multi-node test cluster (``test/cluster.go#
+MustRunCluster``).  This image's sitecustomize imports jax early with a
+TPU-tunnel PJRT plugin ("axon") registered, so setting env vars is not
+enough — the live jax config must be updated and the non-CPU backend
+factories dropped *before any backend initializes*.  This is the single
+shared implementation of that recipe, used by both ``tests/conftest.py``
+and the driver gate ``__graft_entry__.dryrun_multichip``.
+
+This module must stay a leaf: importing it (and the ``pilosa_tpu``
+package ``__init__``) must not create any jax device value, or the
+default (TPU-tunnel) backend would initialize before the recipe can
+retarget the process — see tests/test_import_hygiene.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu_mesh(n_devices: int) -> bool:
+    """Best-effort in-process provisioning of an ``n_devices`` virtual CPU
+    mesh.  Returns True when a CPU backend with at least ``n_devices``
+    devices is usable in this process.
+
+    Mutates env/config only when no backend has initialized yet; if one
+    has, reports whether it already satisfies the request so callers can
+    fall back (e.g. to a fresh subprocess) without this process's env
+    having been polluted.
+    """
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    try:
+        initialized = _xb.backends_are_initialized()
+    except Exception:
+        initialized = True  # unknown — don't risk retargeting a live backend
+    if initialized:
+        try:
+            return (jax.default_backend() == "cpu"
+                    and len(jax.devices("cpu")) >= n_devices)
+        except Exception:
+            return False
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    jax.config.update("jax_platforms", "cpu")
+    # Keep the 'tpu' platform NAME registered (pallas lowering registration
+    # needs it at import time); jax_platforms=cpu prevents it initializing.
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "tpu"):
+            _xb._backend_factories.pop(_name, None)
+    try:
+        return len(jax.devices("cpu")) >= n_devices
+    except Exception:
+        return False

@@ -6,31 +6,17 @@ the rebuild's equivalent of the reference's in-process multi-node cluster
 harness (``test/cluster.go#MustRunCluster``; SURVEY.md §5).
 """
 
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 # This image injects a TPU-tunnel PJRT plugin ("axon") into every Python
 # process via sitecustomize; initializing it claims the single TPU grant
 # and can block for minutes when another process holds it.  Unit tests are
-# CPU-only by design, so drop the plugin from jax's backend factory
-# registry before any backend is initialized.
-import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
+# CPU-only by design; the shared recipe lives in pilosa_tpu/virtmesh.py
+# (also used by the driver gate __graft_entry__.dryrun_multichip).
+from pilosa_tpu.virtmesh import force_virtual_cpu_mesh
 
-jax.config.update("jax_platforms", "cpu")  # sitecustomize imported jax with
-# JAX_PLATFORMS=axon already read; override the live config too.
-# Drop only the axon tunnel plugin: jax_platforms=cpu already prevents
-# other backends from initializing, and the 'tpu' platform NAME must
-# stay registered or pallas lowering registration fails at import.
-for _name in list(getattr(_xb, "_backend_factories", {})):
-    if _name not in ("cpu", "tpu"):
-        _xb._backend_factories.pop(_name, None)
+if not force_virtual_cpu_mesh(8):
+    raise RuntimeError(
+        "could not provision the 8-device virtual CPU mesh for tests — "
+        "a non-CPU jax backend initialized before conftest ran")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,42 @@
+"""Importing the library must not initialize a jax backend.
+
+The virtual-mesh recipe (pilosa_tpu/virtmesh.py) can only retarget a
+process to the 8-device CPU mesh while NO backend has initialized; a
+module-level jnp constant anywhere in the import graph silently binds
+the default (TPU-tunnel) backend at import time and breaks both the
+test harness and the driver's multichip gate.  Round 2 hit exactly this
+(`_FULL = jnp.uint32(...)` in engine/bsi.py); this test keeps it fixed.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHECK = """
+import jax
+from jax._src import xla_bridge as xb
+import pilosa_tpu
+import pilosa_tpu.exec
+import pilosa_tpu.parallel
+import pilosa_tpu.cluster
+import pilosa_tpu.store.holder
+import pilosa_tpu.pql
+import pilosa_tpu.virtmesh
+assert not xb.backends_are_initialized(), (
+    "importing pilosa_tpu initialized a jax backend — a module-level "
+    "device constant crept in")
+print("import-hygiene OK")
+"""
+
+
+def test_import_does_not_initialize_backend():
+    # CPU-forced env so a violation fails the assert instead of blocking
+    # on the TPU grant.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", _CHECK], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "import-hygiene OK" in proc.stdout
