@@ -1,4 +1,6 @@
 // ASAN-built round-trip test for the native codec (run via `make check`).
+#include <cstring>
+#include <utility>
 
 #include <cassert>
 #include <cstdint>
@@ -78,6 +80,61 @@ int main() {
     for (int i = 0; i < 3; i++) assert(out[i] == expect_d[i]);
     assert(rc_union_u32(a, 0, b, 3, out) == 3);
     assert(rc_diff_u32(a, 4, b, 0, out) == 4);
+  }
+
+  // malformed input must error, not write out of bounds (the round-2
+  // advisory: overlapping runs used to overflow the expansion buffer)
+  {
+    auto run_blob = [](const std::vector<std::pair<uint16_t, uint16_t>>& runs,
+                       uint16_t card_minus_1) {
+      std::vector<uint8_t> b(8 + 12 + 4 + 2 + 4 * runs.size(), 0);
+      b[0] = 12348 & 0xFF; b[1] = 12348 >> 8;      // magic
+      b[4] = 1;                                     // one container
+      b[8 + 8] = 3;                                 // type = run
+      b[8 + 10] = card_minus_1 & 0xFF;
+      b[8 + 11] = card_minus_1 >> 8;
+      uint32_t off = 8 + 12 + 4;
+      std::memcpy(&b[8 + 12], &off, 4);
+      uint16_t nr = (uint16_t)runs.size();
+      std::memcpy(&b[off], &nr, 2);
+      for (size_t r = 0; r < runs.size(); r++) {
+        std::memcpy(&b[off + 2 + 4 * r], &runs[r].first, 2);
+        std::memcpy(&b[off + 2 + 4 * r + 2], &runs[r].second, 2);
+      }
+      return b;
+    };
+    uint64_t out[8];
+    uint64_t big_out[1 << 17];
+    // 100 overlapping full-range runs: would expand to 6.5M values
+    std::vector<std::pair<uint16_t, uint16_t>> evil(100, {0, 65535});
+    auto blob = run_blob(evil, 65535);
+    assert(rc_deserialize(blob.data(), blob.size(), big_out,
+                          sizeof(big_out) / 8) == -5);
+    // descending run (last < start)
+    auto blob2 = run_blob({{10, 3}}, 7);
+    assert(rc_deserialize(blob2.data(), blob2.size(), out, 8) == -5);
+    // out-of-order runs
+    auto blob3 = run_blob({{100, 200}, {50, 60}}, 111);
+    assert(rc_deserialize(blob3.data(), blob3.size(), big_out,
+                          sizeof(big_out) / 8) == -5);
+    // rc_expand_plane shares the expansion path
+    uint64_t slots2[1] = {0};
+    std::vector<uint32_t> plane2(2048, 0);
+    assert(rc_expand_plane(blob.data(), blob.size(), 65536, slots2, 1,
+                           plane2.data(), 2048) == -5);
+    // a valid two-run container still works
+    auto ok = run_blob({{5, 9}, {20, 21}}, 6);
+    assert(rc_deserialize(ok.data(), ok.size(), out, 8) == 7);
+    assert(out[0] == 5 && out[6] == 21);
+    // truncated bitmap container
+    std::vector<uint8_t> tb(8 + 12 + 4 + 100, 0);
+    tb[0] = 12348 & 0xFF; tb[1] = 12348 >> 8;
+    tb[4] = 1;
+    tb[8 + 8] = 2;  // bitmap
+    uint32_t toff = 8 + 12 + 4;
+    std::memcpy(&tb[8 + 12], &toff, 4);
+    assert(rc_deserialize(tb.data(), tb.size(), big_out,
+                          sizeof(big_out) / 8) == -1);
   }
 
   printf("native codec: all checks passed\n");

@@ -81,7 +81,12 @@ int64_t parse_headers(const uint8_t* buf, size_t len,
   return (int64_t)n;
 }
 
-// Expand one container's low-16 values via callback-free append into out.
+// Expand one container's low-16 values into out (capacity 65536 entries:
+// array cardinality and bitmap popcount are bounded by the format, but
+// RUN containers in a malformed blob can overlap/repeat, so runs are
+// validated as strictly ascending and non-overlapping — otherwise this
+// would write past out (untrusted input reaches here via import-roaring,
+// cluster merges, and snapshot files).
 int64_t expand_container(const ContainerRef& c, uint16_t* out) {
   switch (c.type) {
     case kTypeArray: {
@@ -107,9 +112,13 @@ int64_t expand_container(const ContainerRef& c, uint16_t* out) {
       uint16_t nruns = rd16(c.data);
       if (c.data_len < 2ull + 4ull * nruns) return ERR_SHORT;
       size_t n = 0;
+      int64_t prev_last = -1;
       for (uint16_t r = 0; r < nruns; r++) {
         uint32_t start = rd16(c.data + 2 + 4 * r);
         uint32_t last = rd16(c.data + 2 + 4 * r + 2);
+        if (last < start || (int64_t)start <= prev_last) return ERR_ORDER;
+        prev_last = (int64_t)last;
+        if (n + (last - start + 1) > 65536) return ERR_ORDER;
         for (uint32_t v = start; v <= last; v++) out[n++] = (uint16_t)v;
       }
       return (int64_t)n;

@@ -27,7 +27,8 @@ import numpy as np
 
 from pilosa_tpu.engine.words import SHARD_WIDTH
 from pilosa_tpu.store import roaring
-from pilosa_tpu.store.oplog import OP_CLEAR_BITS, OP_CLEAR_ROW, OP_SET_BITS, OpLog
+from pilosa_tpu.store.oplog import (OP_CLEAR_BITS, OP_CLEAR_ROW, OP_SET_BITS,
+                                    OP_SET_ROW, OpLog)
 from pilosa_tpu.store.row import RowBits
 
 # Reference default: compact the op-log into a snapshot after ~2000 ops.
@@ -182,20 +183,18 @@ class Fragment:
 
     def set_row(self, row_id: int, cols: np.ndarray) -> bool:
         """Replace a row's bits wholesale (reference: ``Store()`` /
-        ``fragment.setRow``)."""
+        ``fragment.setRow``).  Logged as ONE op-log record carrying the
+        row's complete new contents, so a crash mid-call can never replay
+        a cleared row without its replacement bits."""
         with self.lock:
             before = self.rows.get(row_id)
             new = RowBits.from_columns(cols)
             before_cols = before.columns() if before is not None else np.empty(0, np.uint32)
             if np.array_equal(before_cols, new.columns()):
                 return False
-            if len(before_cols):
-                self._apply(OP_CLEAR_ROW, row_id, None)
-                self._log(OP_CLEAR_ROW, row_id, None)
-            if new.any():
-                positions = np.uint64(row_id) * _SW + new.columns().astype(np.uint64)
-                self._apply(OP_SET_BITS, 0, positions)
-                self._log(OP_SET_BITS, 0, positions)
+            positions = np.uint64(row_id) * _SW + new.columns().astype(np.uint64)
+            self._apply(OP_SET_ROW, row_id, positions)
+            self._log(OP_SET_ROW, row_id, positions)
             return True
 
     def import_roaring(self, blob: bytes, clear: bool = False) -> int:
@@ -278,6 +277,15 @@ class Fragment:
             if row is not None and row.any():
                 changed = row.cardinality
                 del self.rows[aux]
+        elif op == OP_SET_ROW:
+            old = self.rows.pop(aux, None)
+            if old is not None and old.any():
+                changed += old.cardinality
+            if positions is not None and len(positions):
+                self._check_rows(positions)
+                for r, chunk in _split_by_row(positions):
+                    row = self.rows[r] = RowBits()
+                    changed += row.add(chunk)
         elif op in (OP_SET_BITS, OP_CLEAR_BITS):
             assert positions is not None
             self._check_rows(positions)

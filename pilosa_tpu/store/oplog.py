@@ -9,10 +9,13 @@ write truncates cleanly on replay instead of corrupting the fragment.
 Record layout (little-endian):
 
     u32 crc32 (of everything after this field)
-    u8  op     (1=SET_BITS, 2=CLEAR_BITS, 3=CLEAR_ROW)
-    u64 aux    (row id for CLEAR_ROW, else 0)
+    u8  op     (1=SET_BITS, 2=CLEAR_BITS, 3=CLEAR_ROW, 4=SET_ROW)
+    u64 aux    (row id for CLEAR_ROW/SET_ROW, else 0)
     u32 len    payload byte length
-    payload    roaring-serialized bit positions (SET/CLEAR_BITS)
+    payload    roaring-serialized bit positions (SET/CLEAR_BITS; for
+               SET_ROW the row's complete new contents — one atomic
+               record, so a crash can never replay the clear half of a
+               row replacement without its set half)
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ from pilosa_tpu.store import roaring
 OP_SET_BITS = 1
 OP_CLEAR_BITS = 2
 OP_CLEAR_ROW = 3
+OP_SET_ROW = 4
 
 _HEADER = struct.Struct("<IBQI")
 

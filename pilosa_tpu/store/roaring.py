@@ -87,8 +87,25 @@ def _best_container(lows: np.ndarray) -> tuple[int, object]:
 
 
 def _expand_bitmap(words8192: bytes) -> np.ndarray:
+    if len(words8192) < 8192:
+        raise ValueError(
+            f"roaring: bitmap container truncated ({len(words8192)} < 8192 "
+            "bytes)")
     buf = np.frombuffer(words8192, dtype=np.uint8)
     return np.nonzero(np.unpackbits(buf, bitorder="little"))[0].astype(np.uint16)
+
+
+def _check_runs(starts: np.ndarray, lasts: np.ndarray) -> None:
+    """Reject malformed run lists (untrusted input: imports, cluster
+    merges, snapshot files).  Runs must be non-empty intervals, strictly
+    ascending and non-overlapping — the same rule the native codec
+    enforces (native/roaring_codec.cpp expand_container), which also
+    bounds the expansion at 65536 values."""
+    s = starts.astype(np.int64)
+    e = lasts.astype(np.int64)
+    if np.any(e < s) or np.any(s[1:] <= e[:-1]):
+        raise ValueError("roaring: malformed run container "
+                         "(runs must be ascending, non-overlapping)")
 
 
 def _expand_runs(starts: np.ndarray, lasts: np.ndarray) -> np.ndarray:
@@ -199,6 +216,7 @@ def _deserialize_pilosa(buf: memoryview) -> np.ndarray:
         elif types[i] == TYPE_RUN:
             nr, = struct.unpack_from("<H", buf, off)
             pairs = np.frombuffer(buf, dtype="<u2", count=2 * nr, offset=off + 2)
+            _check_runs(pairs[0::2], pairs[1::2])
             lows = _expand_runs(pairs[0::2], pairs[1::2])
         else:
             raise ValueError(f"roaring: bad container type {types[i]}")
@@ -290,7 +308,11 @@ def read_standard32(buf: bytes | memoryview) -> np.ndarray:
             pairs = np.frombuffer(buf, dtype="<u2", count=2 * nr, offset=pos)
             pos += 4 * nr
             starts = pairs[0::2]
-            lasts = (pairs[0::2].astype(np.int64) + pairs[1::2]).astype(np.uint16)
+            lasts64 = pairs[0::2].astype(np.int64) + pairs[1::2]
+            if np.any(lasts64 > 0xFFFF):
+                raise ValueError("standard32: run exceeds container range")
+            lasts = lasts64.astype(np.uint16)
+            _check_runs(starts, lasts)
             lows = _expand_runs(starts, lasts)
         elif cards[i] > ARRAY_MAX:
             lows = _expand_bitmap(bytes(buf[pos:pos + 8192]))

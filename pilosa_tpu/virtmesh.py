@@ -47,10 +47,20 @@ def force_virtual_cpu_mesh(n_devices: int) -> bool:
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    # replace any pre-existing (possibly smaller) count rather than
+    # deferring to it — once the CPU backend initializes with too few
+    # devices this process can never be re-provisioned
+    kept = [f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    existing = [f for f in flags.split() if f not in kept]
+    count = n_devices
+    for f in existing:
+        try:
+            count = max(count, int(f.split("=", 1)[1]))
+        except (IndexError, ValueError):
+            pass
+    kept.append(f"--xla_force_host_platform_device_count={count}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
 
     jax.config.update("jax_platforms", "cpu")
     # Keep the 'tpu' platform NAME registered (pallas lowering registration

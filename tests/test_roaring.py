@@ -95,3 +95,73 @@ class TestStandard32:
     def test_rejects_wide_values(self):
         with pytest.raises(ValueError):
             roaring.write_standard32(np.array([1 << 33], np.uint64))
+
+
+# ---------------------------------------------------------------------------
+# malformed input (round-2 advisory: overlapping runs overflowed the native
+# expansion buffer; both codec paths must reject, not crash or mis-decode)
+# ---------------------------------------------------------------------------
+
+
+def _run_blob(runs, card_minus_1=0xFFFF):
+    """Hand-build a pilosa-format blob with one RUN container."""
+    import struct
+    payload = struct.pack("<H", len(runs))
+    for start, last in runs:
+        payload += struct.pack("<HH", start, last)
+    out = struct.pack("<HHI", roaring.MAGIC, roaring.VERSION, 1)
+    out += struct.pack("<QHH", 0, roaring.TYPE_RUN, card_minus_1)
+    out += struct.pack("<I", len(out) + 4)
+    return out + payload
+
+
+@pytest.mark.parametrize("runs", [
+    [(0, 65535)] * 100,         # overlapping full-range runs (the PoC)
+    [(10, 3)],                  # descending interval
+    [(100, 200), (50, 60)],     # out of order
+    [(5, 10), (10, 20)],        # overlapping boundary
+])
+def test_malformed_runs_rejected(runs):
+    blob = _run_blob(runs)
+    with pytest.raises(ValueError):
+        roaring.deserialize(blob)
+
+
+def test_malformed_runs_rejected_python_path(monkeypatch):
+    from pilosa_tpu.store import native
+    monkeypatch.setattr(native, "available", lambda: False)
+    for runs in ([(0, 65535)] * 100, [(10, 3)], [(100, 200), (50, 60)]):
+        with pytest.raises(ValueError):
+            roaring.deserialize(_run_blob(runs))
+
+
+def test_valid_runs_still_decode():
+    blob = _run_blob([(5, 9), (20, 21)], card_minus_1=6)
+    np.testing.assert_array_equal(
+        roaring.deserialize(blob), [5, 6, 7, 8, 9, 20, 21])
+
+
+def test_truncated_bitmap_rejected(monkeypatch):
+    import struct
+    out = struct.pack("<HHI", roaring.MAGIC, roaring.VERSION, 1)
+    out += struct.pack("<QHH", 0, roaring.TYPE_BITMAP, 0xFFFF)
+    out += struct.pack("<I", len(out) + 4)
+    blob = out + b"\x00" * 100  # far short of 8192
+    with pytest.raises(ValueError):
+        roaring.deserialize(blob)
+    from pilosa_tpu.store import native
+    monkeypatch.setattr(native, "available", lambda: False)
+    with pytest.raises(ValueError):
+        roaring.deserialize(blob)
+
+
+def test_malformed_standard32_run_rejected():
+    import struct
+    # run-format standard32: one container, one run with length wrapping
+    # past the container range (start 65000 + len 1000)
+    out = struct.pack("<I", roaring.COOKIE_RUN | (0 << 16))
+    out += b"\x01"                      # run flag bitset: container 0 is run
+    out += struct.pack("<HH", 0, 0)     # key, card-1
+    out += struct.pack("<H", 1) + struct.pack("<HH", 65000, 1000)
+    with pytest.raises(ValueError):
+        roaring.deserialize(out)

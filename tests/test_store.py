@@ -379,3 +379,35 @@ class TestReviewRegressions:
         h.index("k").create_field("f", FieldOptions(keys=True))
         log = api.executor.translate.columns("k")
         assert log.translate(["alice"], create=False) == [None]
+
+
+class TestSetRowAtomicity:
+    """Row replacement must be ONE op-log record (round-2 advisory: a
+    crash between a CLEAR_ROW and SET_BITS pair replayed as a cleared
+    row with the replacement lost)."""
+
+    def test_set_row_is_single_oplog_record(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bits(np.array([5, 5], np.uint64), np.array([1, 2], np.uint64))
+        n_before = sum(1 for _ in OpLog(path + ".oplog").replay())
+        assert f.set_row(5, np.array([7, 8, 9]))
+        n_after = sum(1 for _ in OpLog(path + ".oplog").replay())
+        assert n_after == n_before + 1
+
+    def test_set_row_crash_replay(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bits(np.array([5, 5], np.uint64), np.array([1, 2], np.uint64))
+        assert f.set_row(5, np.array([7, 8, 9]))
+        # no close/snapshot — simulate crash; replay must see the NEW row
+        g = Fragment(path, 0).open()
+        np.testing.assert_array_equal(g.row(5).columns(), [7, 8, 9])
+
+    def test_set_row_to_empty_crash_replay(self, tmp_path):
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bits(np.array([5], np.uint64), np.array([1], np.uint64))
+        assert f.set_row(5, np.empty(0, np.uint32))
+        g = Fragment(path, 0).open()
+        assert not g.row(5).any()
