@@ -53,6 +53,43 @@ def main():
     log(f"TopN p50 ({platform}): {p50 * 1e3:.2f} ms @ 1B cols x {n_rows} rows")
     emit(f"topn_p50_ms_1b_cols_{platform}", p50 * 1e3, "ms", t_cpu / p50)
 
+    # Tanimoto-thresholded TopN (fragment.go#top tanimoto arg): same
+    # popcount matrix + intersection counts vs a source row, threshold
+    # on-device, one read
+    src = plane[:, 0, :]
+
+    @jax.jit
+    def topn_tanimoto(p, s, thr):
+        inter = jnp.sum(kernels.row_counts(p, s), axis=0, dtype=jnp.int32)
+        full = jnp.sum(kernels.row_counts(p), axis=0, dtype=jnp.int32)
+        src_n = jnp.sum(kernels.count(s), dtype=jnp.int32)
+        union = src_n + full - inter
+        keep = (inter > 0) & (100.0 * inter >= thr * union)
+        vals, slots = kernels.top_n(jnp.where(keep, inter, 0), 10)
+        return jnp.stack([vals, slots])
+
+    d_src = jax.device_put(src)
+    out_t = np.asarray(topn_tanimoto(d, d_src, 50.0))
+    # oracle
+    if hasattr(np, "bitwise_count"):
+        inter_o = np.bitwise_count(plane & src[:, None, :]).sum(
+            axis=(0, 2), dtype=np.int64)
+        src_o = int(np.bitwise_count(src).sum())
+    else:
+        inter_o = np.array([
+            int(np.unpackbits((plane[:, r] & src).reshape(-1)
+                              .view(np.uint8)).sum())
+            for r in range(n_rows)], np.int64)
+        src_o = int(np.unpackbits(src.reshape(-1).view(np.uint8)).sum())
+    union_o = src_o + counts - inter_o
+    keep_o = (inter_o > 0) & (100.0 * inter_o >= 50.0 * union_o)
+    masked = np.where(keep_o, inter_o, 0)
+    order_t = np.argsort(-masked, kind="stable")[:10]
+    assert list(out_t[1]) == list(order_t), "Tanimoto TopN mismatch vs oracle"
+    p50_t = time_p50(lambda: topn_tanimoto(d, d_src, 50.0), 30)
+    log(f"Tanimoto TopN p50 ({platform}): {p50_t * 1e3:.2f} ms")
+    emit(f"tanimoto_topn_p50_ms_1b_cols_{platform}", p50_t * 1e3, "ms", 0)
+
 
 if __name__ == "__main__":
     main()

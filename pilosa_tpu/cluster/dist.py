@@ -44,10 +44,23 @@ def _strip_truncation(call: Call) -> Call:
     eff = _call_of(call)
     strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",),
              "All": ("limit", "offset")}
-    keys = strip.get(eff.name)
-    if keys and any(k in eff.args for k in keys):
+    keys = strip.get(eff.name) or ()
+    extra = {}
+    if eff.name == "TopN" and "tanimoto" in eff.args:
+        # tanimoto is a RATIO: per-node thresholds don't merge.  Nodes
+        # return intersection+row counts and |src| (``_rowCounts=1``);
+        # the threshold applies on the global sums in merge_results.
+        # Validate here — nodes never see the stripped arg, so the
+        # single-node executor's range check would not run.
+        thr = float(eff.args["tanimoto"])
+        if not 0 < thr <= 100:
+            raise ExecutionError("TopN: tanimoto must be in (0, 100]")
+        keys = keys + ("tanimoto",)
+        extra["_rowCounts"] = 1
+    if extra or (keys and any(k in eff.args for k in keys)):
         eff = Call(eff.name,
-                   {k: v for k, v in eff.args.items() if k not in keys},
+                   {**{k: v for k, v in eff.args.items() if k not in keys},
+                    **extra},
                    eff.children)
     if call.name == "Options":
         # the shards list was already resolved into per-node groups;
@@ -353,10 +366,29 @@ def merge_results(call: Call, partials: list):
         return {"columns": [int(c) for c in cols]}
     if name == "TopN":
         counts: dict[int, int] = {}
-        for p in partials:
-            for pair in p:
-                counts[pair["id"]] = counts.get(pair["id"], 0) + pair["count"]
-        pairs = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        if partials and isinstance(partials[0], dict) and "pairs" in partials[0]:
+            # tanimoto partials: sum intersection counts, row counts and
+            # |src| across nodes, then threshold on the GLOBAL ratio
+            row_counts: dict[int, int] = {}
+            src = 0
+            for p in partials:
+                src += int(p.get("srcCount", 0))
+                for pair in p["pairs"]:
+                    i = pair["id"]
+                    counts[i] = counts.get(i, 0) + pair["count"]
+                    row_counts[i] = (row_counts.get(i, 0)
+                                     + pair.get("rowCount", 0))
+            thr = float(call.args.get("tanimoto", 0))
+            pairs = sorted(
+                ((i, c) for i, c in counts.items()
+                 if c > 0 and 100.0 * c >= thr * (src + row_counts[i] - c)),
+                key=lambda kv: (-kv[1], kv[0]))
+        else:
+            for p in partials:
+                for pair in p:
+                    counts[pair["id"]] = (counts.get(pair["id"], 0)
+                                          + pair["count"])
+            pairs = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
         n = call.args.get("n")
         if n is not None:
             pairs = pairs[: int(n)]
@@ -384,6 +416,10 @@ def merge_results(call: Call, partials: list):
             rows = rows[: int(limit)]
         return {"rows": [int(r) for r in rows]}
     if name == "GroupBy":
+        # aggregate merge depends on the aggregate call: Sum/Count add,
+        # Min/Max take the extremum of per-node extrema
+        agg_call = call.args.get("aggregate")
+        agg_op = agg_call.name if isinstance(agg_call, Call) else None
         merged: dict[tuple, dict] = {}
         for p in partials:
             for g in p:
@@ -395,7 +431,14 @@ def merge_results(call: Call, partials: list):
                 else:
                     hit["count"] += g["count"]
                     if g.get("agg") is not None:
-                        hit["agg"] = (hit.get("agg") or 0) + g["agg"]
+                        if hit.get("agg") is None:
+                            hit["agg"] = g["agg"]
+                        elif agg_op == "Min":
+                            hit["agg"] = min(hit["agg"], g["agg"])
+                        elif agg_op == "Max":
+                            hit["agg"] = max(hit["agg"], g["agg"])
+                        else:
+                            hit["agg"] = hit["agg"] + g["agg"]
         groups = sorted(merged.values(),
                         key=lambda g: [fr.get("rowID", 0)
                                        for fr in g["group"]])

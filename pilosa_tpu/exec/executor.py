@@ -46,7 +46,7 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 RESERVED_KEYS = frozenset({
     "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
     "like", "previous", "aggregate", "sort", "shards", "index",
-    "attrName", "attrValue", "columnAttrs", "excludeColumns",
+    "attrName", "attrValue", "columnAttrs", "excludeColumns", "tanimoto",
 })
 
 _CALL_RESERVED = {
@@ -774,34 +774,70 @@ class Executor:
         field = self._field(ctx, str(fname))
         n = call.args.get("n")
         filter_words = self._filter_words(ctx, call)
+        # tanimoto= threshold (reference: ``fragment.go#top`` tanimoto
+        # arg): keep rows whose tanimoto coefficient against the filter
+        # (source) row, 100·|row∧src| / (|src|+|row|−|row∧src|), meets
+        # the threshold.  ``_rowCounts=1`` is the internal cluster
+        # fan-out mode: return per-row intersection AND full counts plus
+        # |src| so the coordinator can apply the threshold on GLOBAL
+        # sums (per-node ratios don't merge).
+        tanimoto = call.args.get("tanimoto")
+        want_partial = bool(call.args.get("_rowCounts"))
+        if tanimoto is not None:
+            tanimoto = float(tanimoto)
+            if not 0 < tanimoto <= 100:
+                raise ExecutionError("TopN: tanimoto must be in (0, 100]")
+        need_row_counts = want_partial or tanimoto is not None
+        if need_row_counts and filter_words is None:
+            raise ExecutionError(
+                "TopN: tanimoto requires a filter row (source bitmap)")
+        # |src| counts even when this node holds no rows of the target
+        # field — the coordinator's global tanimoto union needs every
+        # node's share of the source row
+        src_count = 0
+        if need_row_counts:
+            src_count = int(kernels.shard_totals(
+                kernels.count(filter_words)))
         # resident path: the whole plane fits the device budget;
         # otherwise stream fixed-shape row blocks (one compile) and
         # accumulate totals on host — the "dense blowup" escape hatch
         # for fields with huge row sets (SURVEY.md §8)
         est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
+        row_totals = None
         if est <= self.planes.budget:
             ps = self.planes.field_plane(ctx.index.name, field,
                                          VIEW_STANDARD, ctx.shards)
             if ps.n_rows == 0:
-                return PairsResult([])
+                return ({"pairs": [], "srcCount": src_count} if want_partial
+                        else PairsResult([]))
             counts = kernels.row_counts(ps.plane, filter_words)
             totals = kernels.shard_totals(counts)[:ps.n_rows]
+            if need_row_counts:
+                row_totals = kernels.shard_totals(
+                    kernels.row_counts(ps.plane, None))[:ps.n_rows]
             all_rows = ps.row_ids
         else:
             block = max(64, int(self.planes.budget
                                 // (len(ctx.shards) * WORDS_PER_SHARD * 4
                                     * 4)))  # /4: chunk + staging headroom
-            parts_rows, parts_totals = [], []
+            parts_rows, parts_totals, parts_row_totals = [], [], []
             for chunk_rows, chunk_plane in self.planes.iter_row_blocks(
                     field, VIEW_STANDARD, ctx.shards, block):
                 counts = kernels.row_counts(chunk_plane, filter_words)
                 parts_totals.append(
                     kernels.shard_totals(counts)[:len(chunk_rows)])
+                if need_row_counts:
+                    parts_row_totals.append(kernels.shard_totals(
+                        kernels.row_counts(chunk_plane, None))
+                        [:len(chunk_rows)])
                 parts_rows.append(chunk_rows)
             if not parts_rows:
-                return PairsResult([])
+                return ({"pairs": [], "srcCount": src_count} if want_partial
+                        else PairsResult([]))
             all_rows = np.concatenate(parts_rows)
             totals = np.concatenate(parts_totals)
+            if need_row_counts:
+                row_totals = np.concatenate(parts_row_totals)
         ids_arg = call.args.get("ids")
         attr_name = call.args.get("attrName")
         if attr_name is not None:
@@ -814,6 +850,17 @@ class Executor:
         if ids_arg is not None:
             wanted = {int(r) for r in ids_arg}
             keep = np.array([int(r) in wanted for r in all_rows])
+            totals = np.where(keep, totals, 0)
+        if want_partial:
+            live = row_totals > 0
+            return {"pairs": [
+                {"id": int(r), "count": int(c), "rowCount": int(rc)}
+                for r, c, rc in zip(all_rows[live], totals[live],
+                                    row_totals[live])],
+                "srcCount": src_count}
+        if tanimoto is not None:
+            union = src_count + row_totals - totals
+            keep = (totals > 0) & (100.0 * totals >= tanimoto * union)
             totals = np.where(keep, totals, 0)
         k = len(all_rows) if n is None else min(int(n), len(all_rows))
         slots = np.argsort(-totals, kind="stable")[:k]
@@ -906,7 +953,16 @@ class Executor:
 
     # -- GroupBy ------------------------------------------------------------
 
+    _GROUPBY_AGGS = {"Sum": "sum", "Count": None, "Min": "minmax",
+                     "Max": "minmax"}
+
     def _execute_groupby(self, ctx: _Ctx, call: Call) -> GroupCountsResult:
+        """Whole combination tree in ONE device program (O(1) dispatches
+        regardless of level count — ``exec.groupby``), replacing the
+        reference's per-combination recursion
+        (``executor.go#executeGroupByShard``)."""
+        from pilosa_tpu.exec import groupby as gb
+
         rows_calls = [c for c in call.children if c.name == "Rows"]
         if not rows_calls:
             raise ExecutionError("GroupBy: at least one Rows child required")
@@ -916,11 +972,27 @@ class Executor:
             filter_words = self._bitmap(ctx, flt)
         agg = call.args.get("aggregate")
         agg_field = None
+        agg_name = None
         if isinstance(agg, Call):
-            if agg.name != "Sum":
-                raise ExecutionError("GroupBy: only Sum aggregate supported")
-            aname = agg.args.get("field") or agg.args.get("_field")
-            agg_field = self._field(ctx, str(aname))
+            if agg.name not in self._GROUPBY_AGGS:
+                raise ExecutionError(
+                    "GroupBy: aggregate must be Sum/Count/Min/Max")
+            agg_name = agg.name
+            if agg_name != "Count":
+                aname = agg.args.get("field") or agg.args.get("_field")
+                agg_field = self._field(ctx, str(aname))
+                if agg_field.options.type not in BSI_TYPES:
+                    raise ExecutionError(
+                        f"GroupBy: aggregate field {aname!r} is not BSI")
+                if (agg_name in ("Min", "Max")
+                        and agg_field.options.bit_depth > gb.MINMAX_MAX_DEPTH):
+                    raise ExecutionError(
+                        "GroupBy: Min/Max aggregate supports bit depth "
+                        f"<= {gb.MINMAX_MAX_DEPTH}")
+        if len(ctx.shards) > gb.MAX_SHARDS:
+            raise ExecutionError(
+                f"GroupBy: more than {gb.MAX_SHARDS} shards per node "
+                "unsupported")
 
         specs = []  # (field, row_ids, PlaneSet)
         for rc in rows_calls:
@@ -948,48 +1020,47 @@ class Executor:
         if prev_tuple is not None and len(prev_tuple) != len(specs):
             raise ExecutionError(
                 "GroupBy: previous= must list one row per Rows call")
-        groups: list[GroupCount] = []
 
-        def recurse(level: int, prefix_words, prefix_rows: list[tuple[Field, int]]):
-            if limit is not None and len(groups) >= int(limit):
-                return
-            f, rows, ps = specs[level]
-            if level == len(specs) - 1:
-                # innermost field vectorizes: ONE popcount-matrix program
-                # computes every row's count against the prefix instead
-                # of a dispatch per (prefix, row) combination
-                totals = kernels.shard_totals(
-                    kernels.row_counts(ps.plane, prefix_words))
-                for rid in rows:
-                    if prev_tuple is not None:
-                        combo = tuple(gr for _, gr in prefix_rows) + (int(rid),)
-                        if combo <= prev_tuple:
-                            continue
-                    cnt = int(totals[ps.slot_of[int(rid)]])
+        last_f, last_rows, last_ps = specs[-1]
+        last_slots = [last_ps.slot_of[int(r)] for r in last_rows]
+        base = agg_field.options.base if agg_field is not None else 0
+        groups: list[GroupCount] = []
+        for combo_rows, out in gb.iter_blocks(
+                specs, filter_words, agg_plane,
+                self._GROUPBY_AGGS.get(agg_name),
+                limited=limit is not None):
+            counts = out["counts"]
+            for c in range(counts.shape[0]):
+                prefix_rows = [(specs[lvl][0], int(combo_rows[c, lvl]))
+                               for lvl in range(len(specs) - 1)]
+                for rid, slot in zip(last_rows, last_slots):
+                    cnt = int(counts[c, slot])
                     if cnt == 0:
                         continue
-                    group = [self._field_row(ctx, gf, gr)
-                             for gf, gr in prefix_rows + [(f, int(rid))]]
+                    if prev_tuple is not None:
+                        combo = (tuple(gr for _, gr in prefix_rows)
+                                 + (int(rid),))
+                        if combo <= prev_tuple:
+                            continue
                     agg_val = None
-                    if agg_plane is not None:
-                        row_w = ps.plane[:, ps.slot_of[int(rid)], :]
-                        words = (row_w if prefix_words is None
-                                 else kernels.intersect(prefix_words, row_w))
-                        t, c = bsik.sum_count(agg_plane.plane, words)
-                        agg_val = t + agg_field.options.base * c
+                    if agg_name == "Count":
+                        agg_val = cnt
+                    elif agg_name == "Sum":
+                        acnt = int(out["cnt"][c, slot])
+                        total = sum(
+                            (int(out["pos"][c, slot, b])
+                             - int(out["neg"][c, slot, b])) << b
+                            for b in range(out["pos"].shape[-1]))
+                        agg_val = total + base * acnt
+                    elif agg_name in ("Min", "Max"):
+                        key = "min" if agg_name == "Min" else "max"
+                        if int(out[key + "_cnt"][c, slot]) > 0:
+                            agg_val = int(out[key][c, slot]) + base
+                    group = [self._field_row(ctx, gf, gr)
+                             for gf, gr in prefix_rows + [(last_f, int(rid))]]
                     groups.append(GroupCount(group, cnt, agg_val))
                     if limit is not None and len(groups) >= int(limit):
-                        return
-                return
-            for rid in rows:
-                row_w = ps.plane[:, ps.slot_of[int(rid)], :]
-                words = (row_w if prefix_words is None
-                         else kernels.intersect(prefix_words, row_w))
-                recurse(level + 1, words, prefix_rows + [(f, int(rid))])
-                if limit is not None and len(groups) >= int(limit):
-                    return
-
-        recurse(0, filter_words, [])
+                        return GroupCountsResult(groups)
         return GroupCountsResult(groups)
 
     def _field_row(self, ctx: _Ctx, field: Field, row_id: int) -> FieldRow:

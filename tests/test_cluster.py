@@ -88,6 +88,59 @@ class TestDistributedQueries:
         (top,) = c.client(1).query("i", "TopN(f, n=2)")
         assert [(p["id"], p["count"]) for p in top] == expect
 
+    def test_topn_tanimoto_distributed(self, three_nodes):
+        # the tanimoto threshold must apply on GLOBAL counts: nodes ship
+        # intersection+row counts and |src|; per-node ratios would merge
+        # wrong when a row's bits spread across nodes
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        c.client(0).create_field("i", "g")
+        src = sorted(oracle[1])[::2] + [4 * SHARD_WIDTH + 123]
+        c.client(0).import_bits("i", "g", rowIDs=[1] * len(src),
+                                columnIDs=src)
+        srcset = set(src)
+        thr = 30.0
+        expect = sorted(
+            ((r, len(cols & srcset)) for r, cols in oracle.items()
+             if len(cols & srcset) > 0
+             and 100.0 * len(cols & srcset) >= thr * len(cols | srcset)),
+            key=lambda kv: (-kv[1], kv[0]))
+        for cl in c.clients:
+            (top,) = cl.query("i", "TopN(f, filter=Row(g=1), tanimoto=30)")
+            assert [(p["id"], p["count"]) for p in top] == expect
+        assert expect, "test must exercise a non-empty threshold pass"
+
+    def test_tanimoto_src_on_fieldless_node(self, three_nodes):
+        # |src| bits live on shards where the TARGET field has no rows:
+        # those nodes must still report their srcCount share or the
+        # global union is undercounted and the threshold over-admits
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).create_field("i", "g")
+        c.client(0).import_bits("i", "f", rowIDs=[10, 10, 10],
+                                columnIDs=[1, 2, 3])
+        src_cols = [1] + [s * SHARD_WIDTH + 9 for s in range(1, 6)]
+        c.client(0).import_bits("i", "g", rowIDs=[1] * len(src_cols),
+                                columnIDs=src_cols)
+        # |src|=6, inter=1, row=3 → union=8, ratio 12.5% < 30
+        for cl in c.clients:
+            (top,) = cl.query("i", "TopN(f, filter=Row(g=1), tanimoto=30)")
+            assert top == []
+            (top,) = cl.query("i", "TopN(f, filter=Row(g=1), tanimoto=12)")
+            assert [(p["id"], p["count"]) for p in top] == [(10, 1)]
+
+    def test_tanimoto_invalid_threshold_distributed(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).create_field("i", "g")
+        c.client(0).query("i", "Set(1, f=10) Set(1, g=1)")
+        for bad in (0, 101, -3):
+            with pytest.raises(Exception):
+                c.client(1).query(
+                    "i", f"TopN(f, filter=Row(g=1), tanimoto={bad})")
+
     def test_bsi_distributed(self, three_nodes):
         c = three_nodes
         c.client(0).create_index("i")
@@ -126,6 +179,28 @@ class TestDistributedQueries:
         got = sorted((tuple(fr["rowID"] for fr in grp["group"]),
                       grp["count"]) for grp in g)
         assert got == [((1, 2), 1), ((1, 3), 1)]
+
+    def test_groupby_minmax_aggregate_distributed(self, three_nodes):
+        # Min/Max aggregates merge as extrema of per-node extrema (not
+        # sums); values live on different nodes' shards
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "a")
+        c.client(0).create_field("i", "v", {"type": "int", "min": -100,
+                                            "max": 100})
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "a", rowIDs=[1, 1], columnIDs=[5, far])
+        c.client(0).import_values("i", "v", columnIDs=[5, far],
+                                  values=[42, -7])
+        for pql, want in [
+            ("GroupBy(Rows(a), aggregate=Min(field=v))", -7),
+            ("GroupBy(Rows(a), aggregate=Max(field=v))", 42),
+            ("GroupBy(Rows(a), aggregate=Sum(field=v))", 35),
+            ("GroupBy(Rows(a), aggregate=Count())", 2),
+        ]:
+            (g,) = c.client(1).query("i", pql)
+            assert [(grp["group"][0]["rowID"], grp["count"], grp["agg"])
+                    for grp in g] == [(1, 2, want)], pql
 
 
 class TestKeyedCluster:

@@ -188,6 +188,41 @@ class TestTopNRowsGroupBy:
         (p,) = q(ex, "TopN(f, n=1)")
         assert [(x.id, x.count) for x in p.pairs] == [(10, 2)]
 
+    def test_topn_tanimoto(self, env):
+        # tanimoto = 100·|row∧src| / |row∪src| (fragment.go#top):
+        # src={1,2,3,4}; row10={1..5} → 80; row20={1,2,9} → 40; row30 → 0
+        _, _, ex = env
+        q(ex, "Set(1, g=1) Set(2, g=1) Set(3, g=1) Set(4, g=1)")
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=10) Set(4, f=10)"
+              "Set(5, f=10)")
+        q(ex, "Set(1, f=20) Set(2, f=20) Set(9, f=20)")
+        q(ex, "Set(7, f=30)")
+        (p,) = q(ex, "TopN(f, filter=Row(g=1), tanimoto=50)")
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 4)]
+        (p,) = q(ex, "TopN(f, filter=Row(g=1), tanimoto=40)")  # 40 inclusive
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 4), (20, 2)]
+        (p,) = q(ex, "TopN(f, filter=Row(g=1), tanimoto=81)")
+        assert p.pairs == []
+
+    def test_topn_tanimoto_cross_shard(self, env):
+        # bits split across shards: the ratio must use global counts
+        _, _, ex = env
+        c2 = SHARD_WIDTH
+        q(ex, f"Set(1, g=1) Set({c2 + 1}, g=1)")
+        q(ex, f"Set(1, f=10) Set({c2 + 1}, f=10) Set({c2 + 2}, f=10)")
+        (p,) = q(ex, "TopN(f, filter=Row(g=1), tanimoto=66)")
+        assert [(x.id, x.count) for x in p.pairs] == [(10, 2)]  # 2/3 ≈ 66.7
+
+    def test_topn_tanimoto_errors(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10)")
+        with pytest.raises(ExecutionError):
+            q(ex, "TopN(f, tanimoto=50)")  # requires a filter
+        with pytest.raises(ExecutionError):
+            q(ex, "TopN(f, filter=Row(g=1), tanimoto=0)")
+        with pytest.raises(ExecutionError):
+            q(ex, "TopN(f, filter=Row(g=1), tanimoto=101)")
+
     def test_rows(self, env):
         _, _, ex = env
         q(ex, "Set(1, f=10) Set(1, f=20) Set(2, f=30)")
@@ -215,6 +250,90 @@ class TestTopNRowsGroupBy:
         assert len(g.groups) == 1
         gc = g.groups[0]
         assert gc.count == 1 and gc.agg == 100
+
+    def test_groupby_count_min_max_aggregates(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=20)"
+              "Set(1, amount=-5) Set(2, amount=8) Set(3, amount=3)")
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Count())")
+        assert [(gc.group[0].row_id, gc.count, gc.agg) for gc in g.groups] \
+            == [(10, 2, 2), (20, 1, 1)]
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Min(field=amount))")
+        assert [(gc.group[0].row_id, gc.agg) for gc in g.groups] \
+            == [(10, -5), (20, 3)]
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Max(field=amount))")
+        assert [(gc.group[0].row_id, gc.agg) for gc in g.groups] \
+            == [(10, 8), (20, 3)]
+
+    def test_groupby_minmax_agg_empty_group_cells(self, env):
+        # a group with no non-null aggregate columns reports agg=None
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=20) Set(2, amount=7)")
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Min(field=amount))")
+        got = {gc.group[0].row_id: gc.agg for gc in g.groups}
+        assert got == {10: None, 20: 7}
+
+    def test_groupby_three_levels_oracle(self, env):
+        holder, idx, ex = env
+        idx.create_field("h")
+        rng = np.random.default_rng(11)
+        oracle: dict[str, dict[int, set[int]]] = {"f": {}, "g": {}, "h": {}}
+        stmts = []
+        for fld in ("f", "g", "h"):
+            for _ in range(60):
+                r, c = int(rng.integers(1, 5)), int(rng.integers(0, 200))
+                oracle[fld].setdefault(r, set()).add(c)
+                stmts.append(f"Set({c}, {fld}={r})")
+        q(ex, " ".join(stmts))
+        (g,) = q(ex, "GroupBy(Rows(f), Rows(g), Rows(h))")
+        expect = []
+        for rf in sorted(oracle["f"]):
+            for rg in sorted(oracle["g"]):
+                for rh in sorted(oracle["h"]):
+                    n = len(oracle["f"][rf] & oracle["g"][rg]
+                            & oracle["h"][rh])
+                    if n:
+                        expect.append(([rf, rg, rh], n))
+        got = [([fr.row_id for fr in gc.group], gc.count) for gc in g.groups]
+        assert got == expect
+
+    def test_groupby_blocked_matches_unblocked(self, env, monkeypatch):
+        # force tiny combination blocks: results must equal the
+        # single-block run (and limit= stops the stream early)
+        from pilosa_tpu.exec import groupby as gb
+        _, _, ex = env
+        rng = np.random.default_rng(13)
+        stmts = []
+        for fld in ("f", "g"):
+            for _ in range(80):
+                stmts.append(f"Set({int(rng.integers(0, 300))}, "
+                             f"{fld}={int(rng.integers(1, 8))})")
+        for col in range(0, 300, 3):
+            stmts.append(f"Set({col}, amount={int(rng.integers(-50, 50))})")
+        q(ex, " ".join(stmts))
+        pql = "GroupBy(Rows(f), Rows(g), aggregate=Sum(field=amount))"
+        (full,) = q(ex, pql)
+        monkeypatch.setattr(gb, "BLOCK_OUT_BYTES", 1)  # 1 combo per block
+        (blocked,) = q(ex, pql)
+        as_tuples = lambda g: [([fr.row_id for fr in gc.group], gc.count,
+                                gc.agg) for gc in g.groups]
+        assert as_tuples(full) == as_tuples(blocked)
+        (lim,) = q(ex, "GroupBy(Rows(f), Rows(g), limit=3)")
+        assert len(lim.groups) == 3
+        assert as_tuples(lim) == [t[:2] + (None,)
+                                  for t in as_tuples(full)[:3]]
+
+    def test_groupby_cross_shard_aggregate(self, env):
+        # min/max must reduce across shards, not per shard
+        _, _, ex = env
+        c2 = SHARD_WIDTH + 1
+        q(ex, f"Set(1, f=10) Set({c2}, f=10)"
+              f"Set(1, amount=9) Set({c2}, amount=-4)")
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Min(field=amount))")
+        assert [(gc.group[0].row_id, gc.count, gc.agg)
+                for gc in g.groups] == [(10, 2, -4)]
+        (g,) = q(ex, "GroupBy(Rows(f), aggregate=Sum(field=amount))")
+        assert g.groups[0].agg == 5
 
 
 class TestTimeFields:
@@ -499,6 +618,25 @@ class TestStreamingTopN:
         (a,) = resident.execute("i", "TopN(f, filter=Row(g=1), n=5)")
         (b,) = streaming.execute("i", "TopN(f, filter=Row(g=1), n=5)")
         assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+
+    def test_streamed_tanimoto_matches_resident(self, tmp_path, rng):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        rows = rng.integers(0, 300, size=2000).astype(np.uint64)
+        cols = rng.choice(SHARD_WIDTH, size=2000, replace=False).astype(np.uint64)
+        idx.field("f").import_bits(rows, cols)
+        # small source row so |row∧src|/|row∪src| clears a 1% threshold
+        idx.field("g").import_bits(np.ones(50, np.uint64), cols[:50])
+        idx.note_columns(cols)
+        resident = Executor(holder)
+        streaming = Executor(holder, plane_budget=4 << 20)
+        pql = "TopN(f, filter=Row(g=1), tanimoto=1)"
+        (a,) = resident.execute("i", pql)
+        (b,) = streaming.execute("i", pql)
+        assert a.pairs and [(p.id, p.count) for p in a.pairs] == \
                [(p.id, p.count) for p in b.pairs]
 
 
