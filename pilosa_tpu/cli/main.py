@@ -184,9 +184,12 @@ def cmd_check(args) -> int:
             for vname, v in f.views.items():
                 for shard, frag in v.fragments.items():
                     try:
-                        n = frag.cardinality()
+                        # positions() forces FULL expansion — container
+                        # bodies validate too, not just the directory
+                        # the lazy mmap open parses
+                        n = len(frag.positions())
                         print(f"ok {iname}/{fname}/{vname}/{shard}: "
-                              f"{n} bits, {len(frag.rows)} rows, "
+                              f"{n} bits, {len(frag.row_ids())} rows, "
                               f"op_n={frag.op_n}")
                     except Exception as e:  # noqa: BLE001
                         problems += 1

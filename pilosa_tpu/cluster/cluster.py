@@ -710,7 +710,7 @@ class Cluster:
             for fname, f in idx.fields.items():
                 for vname, v in f.views.items():
                     for shard, frag in v.fragments.items():
-                        if frag.rows:
+                        if frag.row_ids():
                             out.append({"index": iname, "field": fname,
                                         "view": vname, "shard": shard})
         return out

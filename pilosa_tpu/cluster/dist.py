@@ -100,12 +100,21 @@ class DistributedExecutor:
                     out.append(self._read(index, call, shards))
         return out
 
+    # k-ary search fan-out width: one round ships K Counts per node in
+    # ONE multi-call query (nodes fuse consecutive Counts into a single
+    # program + read), so rounds = log_{K+1}(value range) instead of
+    # log_2 — a 21-bit field resolves in ~6 fan-outs, not ~42
+    PERCENTILE_FANOUT = 16
+
     def _percentile(self, index: str, call: Call, shards):
         """Percentile cannot merge from per-node partials (a median of
-        medians is not a median): run the binary search HERE with
-        cluster-wide counts — each step is one distributed
-        Count(Row(field <= v)) reusing the normal fan-out."""
+        medians is not a median): run a k-ary search HERE with
+        cluster-wide counts — each round one batched multi-Count
+        fan-out over the normal query path."""
         import math
+        # translate key inputs ONCE here — _read_many ships raw PQL to
+        # peers without the per-call _read translation step
+        call = self._translate_input(index, call)
         eff = _call_of(call)
         fname = eff.args.get("field") or eff.args.get("_field")
         nth = eff.args.get("nth")
@@ -123,35 +132,82 @@ class DistributedExecutor:
         flt = eff.args.get("filter")
         children = [c for c in eff.children]
 
-        def dist_count(cond: Condition) -> int:
-            row = Call("Row", {str(fname): cond})
+        def count_call(offset: int) -> Call:
+            v = offset + base
+            if field.options.type == "decimal":
+                v = v / 10**field.options.scale
+            row = Call("Row", {str(fname): Condition("<=", v)})
             tree = (Call("Intersect", {}, [row] + children +
                          ([flt] if isinstance(flt, Call) else []))
                     if (children or isinstance(flt, Call)) else row)
-            return self._read(index, Call("Count", {}, [tree]), shards)
+            return Call("Count", {}, [tree])
 
-        def from_stored_pred(offset: int):
-            # predicate in API space for the stored offset
-            v = offset + base
-            if field.options.type == "decimal":
-                return v / 10**field.options.scale
-            return v
+        def dist_counts(offsets: list[int]) -> list[int]:
+            return self._read_many(index,
+                                   [count_call(o) for o in offsets], shards)
 
-        total = dist_count(Condition("<=", from_stored_pred(bound)))
+        (total,) = dist_counts([bound])
         if total == 0:
             return {"value": 0, "count": 0}
         target = max(1, math.ceil(nth / 100.0 * total))
+        k = self.PERCENTILE_FANOUT
         lo, hi = -bound, bound
         while lo < hi:
-            mid = (lo + hi) // 2
-            if dist_count(Condition("<=", from_stored_pred(mid))) >= target:
-                hi = mid
+            if hi - lo <= k:
+                cands = list(range(lo, hi))
             else:
-                lo = mid + 1
-        below = (dist_count(Condition("<=", from_stored_pred(lo - 1)))
-                 if lo > -bound else 0)
-        cnt = dist_count(Condition("<=", from_stored_pred(lo))) - below
-        return {"value": field.from_stored(lo + base), "count": cnt}
+                cands = sorted({lo + (hi - lo) * (j + 1) // (k + 1)
+                                for j in range(k)})
+            cnts = dist_counts(cands)
+            prev = lo - 1
+            nlo, nhi = None, hi
+            for cand, c in zip(cands, cnts):
+                if c >= target:
+                    nlo, nhi = prev + 1, cand
+                    break
+                prev = cand
+            if nlo is None:
+                nlo = prev + 1
+            lo, hi = nlo, nhi
+        if lo > -bound:
+            at, below = dist_counts([lo, lo - 1])
+        else:
+            (at,), below = dist_counts([lo]), 0
+        return {"value": field.from_stored(lo + base), "count": at - below}
+
+    def _read_many(self, index: str, calls: list[Call], shards):
+        """Fan out SEVERAL Count calls as one query per node (each node
+        fuses the run into one program + read); returns merged ints."""
+        all_shards = (tuple(shards) if shards is not None
+                      else self.cluster.index_shards(index))
+        groups = self.cluster.group_shards_by_node(index, all_shards)
+        pql = "\n".join(str(c) for c in calls)
+
+        def remote(node_id, node_shards):
+            return self.cluster.internal_query(node_id, index, pql,
+                                               node_shards)
+
+        from concurrent.futures import ThreadPoolExecutor
+        remote_items = [(n, s) for n, s in groups.items()
+                        if n != self.cluster.node_id]
+        per_node = []
+        futures, pool = [], None
+        if remote_items:
+            pool = ThreadPoolExecutor(max_workers=len(remote_items))
+            futures = [pool.submit(remote, n, s) for n, s in remote_items]
+        if self.cluster.node_id in groups:
+            rs = self.cluster.api.executor.execute(
+                index, Query(list(calls)),
+                shards=list(groups[self.cluster.node_id]),
+                translate_output=False)
+            per_node.append([result_to_json(r) for r in rs])
+        if pool is not None:
+            try:
+                per_node.extend(f.result() for f in futures)
+            finally:
+                pool.shutdown(wait=False)
+        return [sum(node_counts[i] for node_counts in per_node)
+                for i in range(len(calls))]
 
     # -- reads --------------------------------------------------------------
 

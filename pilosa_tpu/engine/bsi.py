@@ -313,3 +313,85 @@ def min_max(
     bit descent + exact host reconstruction.  NOT jit-safe; inside
     compiled programs use :func:`min_max_bits`."""
     return combine_min_max(min_max_bits(plane, filter_words))
+
+
+def decode_sum_packed(row: np.ndarray) -> tuple[int, int]:
+    """Host decode of one ``fused.run_sum_batch`` row
+    (int32[n_shards, 2*depth+1]) -> exact (sum of offsets, count)."""
+    depth = (row.shape[-1] - 1) // 2
+    return combine_sum(row[:, :depth], row[:, depth:2 * depth], row[:, -1])
+
+
+def decode_minmax_packed(row: np.ndarray):
+    """Host decode of one ``fused.run_minmax_batch`` row
+    (int32[n_shards, 2*depth+4]) -> per-shard (min, min_cnt, max,
+    max_cnt) tuples."""
+    depth = (row.shape[-1] - 4) // 2
+    return combine_min_max({
+        "min_bits": row[:, :depth],
+        "max_bits": row[:, depth:2 * depth],
+        "min_neg": row[:, 2 * depth].astype(bool),
+        "min_cnt": row[:, 2 * depth + 1],
+        "max_neg": row[:, 2 * depth + 2].astype(bool),
+        "max_cnt": row[:, 2 * depth + 3]})
+
+
+# ---------------------------------------------------------------------------
+# Percentile: the whole binary search on device, one dispatch
+# ---------------------------------------------------------------------------
+
+
+def _count_le_device(plane: jax.Array, filter_words, v: jax.Array,
+                     depth: int) -> jax.Array:
+    """count of columns with stored offset <= signed ``v`` — traced-value
+    variant of the executor's compare path (one :func:`range_cmp` with
+    masks derived from the traced scalar instead of host-built)."""
+    neg = v < 0
+    mag_v = jnp.abs(v).astype(jnp.uint32)
+    bits = (mag_v >> jnp.arange(depth, dtype=jnp.uint32)) & jnp.uint32(1)
+    masks = jnp.where(bits > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    le = range_cmp(plane, masks, neg, filter_words)["le"]
+    # int32-exact: total bits <= n_shards * 2^20 < 2^31 for <= 2047 shards
+    return jnp.sum(kernels.popcount(le), dtype=jnp.int32)
+
+
+def percentile_total(plane: jax.Array,
+                     filter_words: jax.Array | None) -> jax.Array:
+    """Non-null (filtered) column count, int32 — the rank universe for
+    :func:`percentile_search`.  The host computes the exact integer
+    target rank from this (device float32 would misround products past
+    2^24; int64 is emulated on TPU)."""
+    return jnp.sum(kernels.popcount(not_null(plane, filter_words)),
+                   dtype=jnp.int32)
+
+
+def percentile_search(plane: jax.Array, filter_words: jax.Array | None,
+                      target: jax.Array):
+    """[offset, count_at_offset] stacked int32: the smallest stored
+    offset whose ``count_le`` reaches ``target`` — the whole binary
+    search as ONE program via ``lax.while_loop`` over compare+popcount
+    steps (the reference's ``executeSumCountShard``-style per-step
+    dispatch pays a device round trip per bit of depth; SURVEY.md §4.4).
+
+    ``target`` is a traced int32 rank >= 1 (exact, host-computed)."""
+    depth = depth_of(plane)
+    bound = (1 << depth) - 1
+
+    def cond(state):
+        lo, hi = state
+        return lo < hi
+
+    def body(state):
+        lo, hi = state
+        mid = (lo + hi) >> 1  # arithmetic shift: floor for negatives
+        le = _count_le_device(plane, filter_words, mid, depth)
+        return jnp.where(le >= target, lo, mid + 1), \
+            jnp.where(le >= target, mid, hi)
+
+    lo, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(-bound), jnp.int32(bound)))
+    at = _count_le_device(plane, filter_words, lo, depth)
+    below = jnp.where(
+        lo > -bound,
+        _count_le_device(plane, filter_words, lo - 1, depth), 0)
+    return jnp.stack([lo, at - below])

@@ -30,17 +30,22 @@ from pilosa_tpu.engine import kernels
 
 
 class _Pending:
-    __slots__ = ("node", "leaves", "event", "result", "error")
+    __slots__ = ("kind", "node", "leaves", "event", "result", "error")
 
-    def __init__(self, node, leaves):
-        self.node = node
-        self.leaves = leaves
+    def __init__(self, kind, node, leaves):
+        self.kind = kind      # "count" | "sum" | "minmax"
+        self.node = node      # count: plan tree; aggregates: None
+        self.leaves = leaves  # count: plan leaves; agg: (plane[, filter])
         self.event = threading.Event()
-        self.result: int | None = None
+        self.result = None
         self.error: Exception | None = None
 
 
 class CountBatcher:
+    """Cross-request coalescing for Count AND the BSI aggregates
+    (Sum/Min/Max join the same collection window; each kind/shape group
+    runs as one fused program + one read)."""
+
     def __init__(self, fused, window_s: float = 0.002, max_batch: int = 64):
         self.fused = fused
         self.window_s = window_s
@@ -57,10 +62,7 @@ class CountBatcher:
                                             daemon=True)
             self._thread.start()
 
-    def submit(self, node, leaves) -> int:
-        """Block until the coalesced batch containing this Count runs;
-        returns the host-finished int64 total."""
-        p = _Pending(node, tuple(leaves))
+    def _submit(self, p: _Pending):
         with self._lock:
             self._queue.append(p)
             self._ensure_worker()
@@ -70,8 +72,22 @@ class CountBatcher:
             raise p.error
         return p.result
 
+    def submit(self, node, leaves) -> int:
+        """Block until the coalesced batch containing this Count runs;
+        returns the host-finished int64 total."""
+        return self._submit(_Pending("count", node, tuple(leaves)))
+
+    def submit_sum(self, plane, filter_words) -> tuple[int, int]:
+        """BSI Sum: (sum of offsets, non-null count), host-finished."""
+        leaves = (plane,) if filter_words is None else (plane, filter_words)
+        return self._submit(_Pending("sum", None, leaves))
+
+    def submit_minmax(self, plane, filter_words):
+        """BSI Min/Max: per-shard (min, min_cnt, max, max_cnt) tuples."""
+        leaves = (plane,) if filter_words is None else (plane, filter_words)
+        return self._submit(_Pending("minmax", None, leaves))
+
     def _loop(self) -> None:
-        from pilosa_tpu.exec.fused import shift_leaves
         while True:
             self._kick.wait()
             # collection window: let concurrent submitters pile in
@@ -83,15 +99,33 @@ class CountBatcher:
                     self._kick.clear()
             if not batch:
                 continue
-            # stacked counts need a uniform shard axis: group by the
-            # leaves' n_shards (differs across indexes / shard sets)
-            groups: dict[int, list[_Pending]] = {}
+            # stacked outputs need uniform shapes: group by kind + the
+            # leaves' n_shards (+ depth via the plane shape for
+            # aggregates — differs across indexes / fields / shard sets)
+            groups: dict[tuple, list[_Pending]] = {}
             for p in batch:
-                groups.setdefault(int(p.leaves[0].shape[0]), []).append(p)
-            for group in groups.values():
-                self._run_group(group, shift_leaves)
+                key = (p.kind, p.leaves[0].shape)
+                groups.setdefault(key, []).append(p)
+            # one program per group, but dispatch groups CONCURRENTLY:
+            # transports that overlap reads across threads (the axon
+            # tunnel does) pay one read floor for the window, not one
+            # per kind
+            items = list(groups.items())
+            if len(items) == 1:
+                self._run_one(*items[0])
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=len(items)) as pool:
+                    list(pool.map(lambda kv: self._run_one(*kv), items))
 
-    def _run_group(self, group: list[_Pending], shift_leaves) -> None:
+    def _run_one(self, key, group):
+        if key[0] == "count":
+            self._run_counts(group)
+        else:
+            self._run_aggs(key[0], group)
+
+    def _run_counts(self, group: list[_Pending]) -> None:
+        from pilosa_tpu.exec.fused import shift_leaves
         try:
             nodes, all_leaves = [], []
             for p in group:
@@ -108,6 +142,46 @@ class CountBatcher:
                 try:
                     p.result = int(kernels.shard_totals(
                         self.fused.run(p.node, p.leaves, "count")))
+                except Exception as e2:  # noqa: BLE001
+                    p.error = e2
+                finally:
+                    p.event.set()
+
+    def _run_aggs(self, kind: str, group: list[_Pending]) -> None:
+        from pilosa_tpu.engine import bsi as bsik
+        # pad the batch to a pow2 bucket (repeating item 0) so the
+        # program set stays bounded per (kind, shape): otherwise every
+        # distinct batch SIZE would compile a fresh program, and the
+        # compiles land on serving latency
+        group.sort(key=lambda p: len(p.leaves))  # canonical flag order:
+        # program variants per bucket stay O(bucket), not O(2^bucket)
+        n = len(group)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        pad = [group[0]] * (bucket - n)
+        flags = tuple(len(p.leaves) == 2 for p in group + pad)
+        all_leaves = tuple(a for p in group + pad for a in p.leaves)
+        try:
+            if kind == "sum":
+                out = np.asarray(self.fused.run_sum_batch(flags, all_leaves))
+                for k, p in enumerate(group):
+                    p.result = bsik.decode_sum_packed(out[k])
+                    p.event.set()
+            else:
+                out = np.asarray(
+                    self.fused.run_minmax_batch(flags, all_leaves))
+                for k, p in enumerate(group):
+                    p.result = bsik.decode_minmax_packed(out[k])
+                    p.event.set()
+        except Exception:  # noqa: BLE001 — per-item fallback
+            for p in group:
+                try:
+                    flt = p.leaves[1] if len(p.leaves) == 2 else None
+                    if kind == "sum":
+                        p.result = bsik.sum_count(p.leaves[0], flt)
+                    else:
+                        p.result = bsik.min_max(p.leaves[0], flt)
                 except Exception as e2:  # noqa: BLE001
                     p.error = e2
                 finally:

@@ -685,9 +685,12 @@ class Executor:
 
     def _execute_percentile(self, ctx: _Ctx, call: Call) -> ValCount:
         """Percentile(field=f, nth=99.9, filter?): the smallest stored
-        value v with count(values <= v) >= nth% of non-null columns —
-        binary search over the value space, one fused compare+count per
-        step (FeatureBase-era Percentile parity)."""
+        value v with count(values <= v) >= nth% of non-null columns.
+        The binary search runs ON DEVICE (``lax.while_loop`` in
+        ``bsi.percentile_search``): two dispatches + two reads total
+        (count, then search with an exact host-computed rank), vs
+        ~2·bit_depth round trips for a host-driven search
+        (FeatureBase-era Percentile parity)."""
         field, filter_words = self._agg_args(ctx, call)
         nth = call.args.get("nth")
         if nth is None:
@@ -696,37 +699,28 @@ class Executor:
         if not 0 <= nth <= 100:
             raise ExecutionError("Percentile: nth must be in [0, 100]")
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
-        exists = bsik.not_null(ps.plane, filter_words)
-        total = int(kernels.shard_totals(kernels.count(exists)))
+        out, total = self.fused.run_percentile(ps.plane, filter_words, nth)
         if total == 0:
             return ValCount(0, 0)
-        import math
-        target = max(1, math.ceil(nth / 100.0 * total))
-
-        depth = field.options.bit_depth
-        bound = (1 << depth) - 1
-
-        def count_le(offset: int) -> int:
-            words = self._bsi_cmp_offset(field, ps, "le", offset)
-            if filter_words is not None:
-                words = kernels.intersect(words, filter_words)
-            return int(kernels.shard_totals(kernels.count(words)))
-
-        lo, hi = -bound, bound
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if count_le(mid) >= target:
-                hi = mid
-            else:
-                lo = mid + 1
-        value = lo + field.options.base
-        cnt = count_le(lo) - (count_le(lo - 1) if lo > -bound else 0)
-        return ValCount(value=field.from_stored(value), count=cnt)
+        out = np.asarray(out)
+        value = int(out[0]) + field.options.base
+        return ValCount(value=field.from_stored(value), count=int(out[1]))
 
     def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
-        total, cnt = bsik.sum_count(ps.plane, filter_words)
+        if self.batcher is not None:
+            # concurrent BSI aggregates coalesce like Counts: one
+            # program + one read per collection window
+            total, cnt = self.batcher.submit_sum(ps.plane, filter_words)
+        else:
+            # same compiled one-read program, batch of one (eager
+            # bit_counts would pay one dispatch per op + 3 reads)
+            flags = (filter_words is not None,)
+            leaves = (ps.plane,) + ((filter_words,)
+                                    if filter_words is not None else ())
+            out = np.asarray(self.fused.run_sum_batch(flags, leaves))[0]
+            total, cnt = bsik.decode_sum_packed(out)
         value = total + field.options.base * cnt
         return ValCount(value=field.from_stored(value) if cnt else 0,
                         count=cnt)
@@ -740,7 +734,14 @@ class Executor:
     def _min_max(self, ctx: _Ctx, call: Call, want_min: bool) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
-        per_shard = bsik.min_max(ps.plane, filter_words)
+        if self.batcher is not None:
+            per_shard = self.batcher.submit_minmax(ps.plane, filter_words)
+        else:
+            flags = (filter_words is not None,)
+            leaves = (ps.plane,) + ((filter_words,)
+                                    if filter_words is not None else ())
+            out = np.asarray(self.fused.run_minmax_batch(flags, leaves))[0]
+            per_shard = bsik.decode_minmax_packed(out)
         # reduce across the shard axis on host (one tuple per shard)
         live = [(mn, mn_c, mx, mx_c)
                 for mn, mn_c, mx, mx_c in per_shard
@@ -885,7 +886,7 @@ class Executor:
         rows = self._rows_of(ctx, field, call)
         if field.options.keys and ctx.translate_output:
             log = self.translate.rows(ctx.index.name, field.name)
-            return RowIdsResult(keys=[log.key_of(int(r)) for r in rows])
+            return RowIdsResult(keys=log.keys_of(rows, strict=False))
         return RowIdsResult(rows=rows)
 
     def _rows_of(self, ctx: _Ctx, field: Field, call: Call) -> np.ndarray:
@@ -902,10 +903,9 @@ class Executor:
             frag = view.fragment(shard) if view is not None else None
             if frag is None or shard not in ctx.shards:
                 return np.empty(0, np.uint64)
-            with frag.lock:
-                rows = np.array([r for r in frag.row_ids()
-                                 if frag.rows[r].contains(off)],
-                                dtype=np.uint64)
+            # vectorized inverted check (generation-cached) instead of a
+            # per-row contains() loop — 100k-row fields answer in ms
+            rows = frag.rows_containing(off)
         else:
             # live rows come straight from the fragment indexes — no
             # plane materialization or device round trip needed
@@ -922,17 +922,20 @@ class Executor:
         like = call.args.get("like")
         if like is not None:
             # SQL-style pattern over row KEYS (reference: Rows like=,
-            # FeatureBase era): % = any run, _ = one char
+            # FeatureBase era): % = any run, _ = one char.  One batched
+            # key lookup + one compiled regex over all rows (not a
+            # per-row key_of + fnmatch pair).
             if not field.options.keys:
                 raise ExecutionError("Rows: like= requires a keyed field")
             import fnmatch
+            import re
             pattern = (str(like).replace("*", "[*]").replace("?", "[?]")
                        .replace("%", "*").replace("_", "?"))
+            rx = re.compile(fnmatch.translate(pattern))
             log = self.translate.rows(ctx.index.name, field.name)
-            rows = np.array([r for r in rows
-                             if fnmatch.fnmatchcase(
-                                 log.key_of(int(r)) or "", pattern)],
-                            dtype=np.uint64)
+            keys = log.keys_of(rows, strict=False)
+            keep = [k is not None and rx.match(k) is not None for k in keys]
+            rows = rows[np.array(keep, dtype=bool)] if len(rows) else rows
         prev = call.args.get("previous")
         if prev is not None:
             prev_id = self._row_id(ctx, field, prev, create=False)

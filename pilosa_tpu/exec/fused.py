@@ -139,23 +139,105 @@ class FusedCache:
                     self._programs.popitem(last=False)
         return fn(*leaves)
 
-    def run_count_batch(self, nodes: tuple, leaves):
-        """K Count trees in ONE program: returns int32[K, n_shards] —
-        one dispatch and one host read amortize fixed per-read costs
-        across every Count in the request (critical on transports with
-        a per-read floor; see BASELINE.md)."""
-        key = (nodes, "count-batch")
+    def _cached(self, key, build):
         with self._lock:
             fn = self._programs.get(key)
             if fn is not None:
                 self._programs.move_to_end(key)
         if fn is None:
-            def program(*ls):
-                return jnp.stack([kernels.count(_build(n, ls))
-                                  for n in nodes])
-            fn = jax.jit(program)
+            fn = jax.jit(build())
             with self._lock:
                 self._programs[key] = fn
                 while len(self._programs) > self.MAX_PROGRAMS:
                     self._programs.popitem(last=False)
-        return fn(*leaves)
+        return fn
+
+    def run_count_batch(self, nodes: tuple, leaves):
+        """K Count trees in ONE program: returns int32[K, n_shards] —
+        one dispatch and one host read amortize fixed per-read costs
+        across every Count in the request (critical on transports with
+        a per-read floor; see BASELINE.md)."""
+        def build():
+            def program(*ls):
+                return jnp.stack([kernels.count(_build(n, ls))
+                                  for n in nodes])
+            return program
+        return self._cached((nodes, "count-batch"), build)(*leaves)
+
+    def run_sum_batch(self, flags: tuple, leaves):
+        """K BSI Sum items (same bit depth) in ONE program.  ``flags[k]``
+        = item k has a filter leaf; leaves alternate plane[, filter] per
+        item.  Returns int32[K, n_shards, 2*depth+1]: per-bit positive
+        counts, per-bit negative counts, non-null count — one stacked
+        array = one host read; ``bsi.combine_sum`` finishes exactly."""
+        def build():
+            def program(*ls):
+                rows = []
+                i = 0
+                for has_filter in flags:
+                    plane = ls[i]
+                    flt = ls[i + 1] if has_filter else None
+                    i += 2 if has_filter else 1
+                    pos, neg, cnt = bsik.bit_counts(plane, flt)
+                    rows.append(jnp.concatenate(
+                        [pos, neg, cnt[..., None]], axis=-1))
+                return jnp.stack(rows)
+            return program
+        return self._cached((flags, "sum-batch"), build)(*leaves)
+
+    def run_percentile(self, plane, filter_words, nth: float):
+        """Percentile in two bounded programs (cached/evicted like every
+        other fused program): total count, then the on-device rank
+        binary search with an exact host-computed integer target (f64
+        host ceil; device f32 would misround past 2^24).  Returns
+        ((offset, count) array | None, total)."""
+        import math
+
+        has_filter = filter_words is not None
+        args = (plane,) + ((filter_words,) if has_filter else ())
+
+        def total_build():
+            def program(*ls):
+                return bsik.percentile_total(
+                    ls[0], ls[1] if has_filter else None)
+            return program
+
+        def search_build():
+            def program(*ls):
+                return bsik.percentile_search(
+                    ls[0], ls[1] if has_filter else None, ls[-1])
+            return program
+
+        key_t = (("pct-total", plane.shape, has_filter), "pct")
+        total = int(self._cached(key_t, total_build)(*args))
+        if total == 0:
+            return None, 0
+        target = min(total, max(1, math.ceil(nth / 100.0 * total)))
+        key_s = (("pct-search", plane.shape, has_filter), "pct")
+        out = self._cached(key_s, search_build)(*args, jnp.int32(target))
+        return out, total
+
+    def run_minmax_batch(self, flags: tuple, leaves):
+        """K BSI Min/Max items (same bit depth) in ONE program; same
+        leaf layout as :meth:`run_sum_batch`.  Returns int32
+        [K, n_shards, 2*depth+4]: min bits, max bits, min_neg, min_cnt,
+        max_neg, max_cnt (``bsi.min_max_bits`` packed for one read)."""
+        def build():
+            def program(*ls):
+                rows = []
+                i = 0
+                for has_filter in flags:
+                    plane = ls[i]
+                    flt = ls[i + 1] if has_filter else None
+                    i += 2 if has_filter else 1
+                    mm = bsik.min_max_bits(plane, flt)
+                    rows.append(jnp.concatenate(
+                        [mm["min_bits"].astype(jnp.int32),
+                         mm["max_bits"].astype(jnp.int32),
+                         mm["min_neg"].astype(jnp.int32)[..., None],
+                         mm["min_cnt"][..., None],
+                         mm["max_neg"].astype(jnp.int32)[..., None],
+                         mm["max_cnt"][..., None]], axis=-1))
+                return jnp.stack(rows)
+            return program
+        return self._cached((flags, "minmax-batch"), build)(*leaves)

@@ -106,11 +106,8 @@ class PlaneCache:
                 frag = view.fragment(s)
                 if frag is None:
                     continue
-                with frag.lock:
-                    for r, slot in slot_of.items():
-                        bits = frag.rows.get(r)
-                        if bits is not None:
-                            host[si, slot] = bits.words()
+                frag.plane_rows(list(slot_of.keys()), host[si],
+                                slots=list(slot_of.values()))
         return PlaneSet(self.place(host), shards, row_ids, slot_of)
 
     def row_words(self, index: str, field: Field, view_name: str,
@@ -167,11 +164,8 @@ class PlaneCache:
                     frag = view.fragment(s)
                     if frag is None:
                         continue
-                    with frag.lock:
-                        for r, slot in slot_of.items():
-                            bits = frag.rows.get(r)
-                            if bits is not None:
-                                host[si, slot] = bits.words()
+                    frag.plane_rows(list(slot_of.keys()), host[si],
+                                    slots=list(slot_of.values()))
             yield chunk, self.place(host)
 
     def zeros(self, n_shards: int) -> jax.Array:
@@ -256,9 +250,9 @@ class PlaneCache:
                 frag = view.fragment(s)
                 if frag is None:
                     continue
-                with frag.lock:
-                    for r in frag.row_ids():
-                        host[si, slot_of[r]] = frag.rows[r].words()
+                rows_here = frag.row_ids()
+                frag.plane_rows(rows_here, host[si],
+                                slots=[slot_of[r] for r in rows_here])
         return PlaneSet(self.place(host), shards, row_ids, slot_of)
 
     def _build_bsi(self, field: Field, view_name: str,
@@ -274,10 +268,8 @@ class PlaneCache:
                 frag = view.fragment(s)
                 if frag is None:
                     continue
-                with frag.lock:
-                    for r in frag.row_ids():
-                        if r < n_rows:
-                            host[si, r] = frag.rows[r].words()
+                rows_here = [r for r in frag.row_ids() if r < n_rows]
+                frag.plane_rows(rows_here, host[si], slots=rows_here)
         row_ids = np.arange(n_rows, dtype=np.uint64)
         return PlaneSet(self.place(host), shards, row_ids,
                         {i: i for i in range(n_rows)})

@@ -20,6 +20,7 @@ Concurrency: one RLock per fragment (reference: per-fragment
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import zlib
 
@@ -48,13 +49,19 @@ class Fragment:
         self.path = path                      # snapshot file
         self.shard = shard
         self.max_op_n = max_op_n
-        self.rows: dict[int, RowBits] = {}
+        self.rows: dict[int, RowBits] = {}    # materialized/overlay rows
         self.op_n = 0
         self.generation = 0                   # bumped per mutation; device
                                               # plane caches key on this
         self.lock = threading.RLock()
         self._oplog = OpLog(path + ".oplog", fsync=fsync)
         self._open = False
+        # lazy snapshot (mmap FromBuffer path, SURVEY.md §3.1 syswrap):
+        # rows still in _snap_pending live only in the mapped file;
+        # _ensure_row materializes them into self.rows on first touch
+        self._snap_mm = None
+        self._snap_dir: roaring.Directory | None = None
+        self._snap_pending: set[int] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -62,19 +69,57 @@ class Fragment:
         with self.lock:
             if self._open:
                 return self
-            if os.path.exists(self.path):
-                with open(self.path, "rb") as f:
-                    self._load_positions(roaring.deserialize(f.read()))
+            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+                self._open_snapshot()
             for op, aux, positions in self._oplog.replay():
                 self._apply(op, aux, positions)
                 self.op_n += 1
             self._open = True
         return self
 
+    def _open_snapshot(self) -> None:
+        """mmap the snapshot and parse only its container directory —
+        zero-copy cold start (the reference's ``roaring.FromBuffer`` over
+        ``syswrap.Mmap``): no bit is expanded until a row is touched."""
+        import mmap as _mmaplib
+        with open(self.path, "rb") as f:
+            head = f.read(2)
+            if len(head) == 2 and struct.unpack("<H", head)[0] == \
+                    roaring.MAGIC:
+                mm = _mmaplib.mmap(f.fileno(), 0,
+                                   access=_mmaplib.ACCESS_READ)
+                self._snap_mm = mm
+                self._snap_dir = roaring.Directory(memoryview(mm))
+                self._snap_pending = set(
+                    int(r) for r in self._snap_dir.row_ids())
+                return
+            # non-pilosa (e.g. standard32) snapshot: legacy eager load
+            f.seek(0)
+            self._load_positions(roaring.deserialize(f.read()))
+
+    def _drop_snapshot(self) -> None:
+        self._snap_dir = None
+        self._snap_pending = set()
+        if self._snap_mm is not None:
+            self._snap_mm.close()
+            self._snap_mm = None
+
+    def _ensure_row(self, row_id: int) -> None:
+        """Materialize one snapshot-resident row into the overlay."""
+        if row_id in self._snap_pending:
+            self.rows[row_id] = RowBits.from_columns(
+                self._snap_dir.expand_row(row_id))
+            self._snap_pending.discard(row_id)
+
+    def _materialize_all(self) -> None:
+        for r in sorted(self._snap_pending):
+            self._ensure_row(r)
+
     def close(self) -> None:
         with self.lock:
             if self.op_n > 0:
                 self.snapshot()
+            self._drop_snapshot()
             self._oplog.close()
             self._open = False
 
@@ -82,11 +127,13 @@ class Fragment:
 
     def row(self, row_id: int) -> RowBits:
         with self.lock:
+            self._ensure_row(row_id)
             return self.rows.get(row_id) or RowBits()
 
     def row_ids(self) -> list[int]:
         with self.lock:
-            return sorted(r for r, b in self.rows.items() if b.any())
+            live = {r for r, b in self.rows.items() if b.any()}
+            return sorted(live | self._snap_pending)
 
     def max_row_id(self) -> int:
         ids = self.row_ids()
@@ -94,11 +141,14 @@ class Fragment:
 
     def cardinality(self) -> int:
         with self.lock:
-            return sum(b.cardinality for b in self.rows.values())
+            pend = sum(self._snap_dir.row_cardinality(r)
+                       for r in self._snap_pending)
+            return pend + sum(b.cardinality for b in self.rows.values())
 
     def positions(self) -> np.ndarray:
         """All set bits as sorted uint64 ``row*ShardWidth + col``."""
         with self.lock:
+            self._materialize_all()
             parts = [
                 np.uint64(r) * _SW + b.columns().astype(np.uint64)
                 for r, b in sorted(self.rows.items())
@@ -107,6 +157,106 @@ class Fragment:
         if not parts:
             return np.empty(0, dtype=np.uint64)
         return np.concatenate(parts)
+
+    def plane_rows(self, row_ids, out: np.ndarray, slots=None) -> None:
+        """Fill ``out[slots[i]] = words of row_ids[i]`` (uint32[.., W]).
+
+        The plane-assembly fast path: rows still resident in the mmap'd
+        snapshot expand straight from the blob — via the C++
+        ``rc_expand_plane`` when built (one pass over the file's
+        containers for any number of rows), else per-row — without ever
+        materializing host ``RowBits``.  Overlay rows copy their packed
+        words.  Rows absent everywhere leave ``out`` untouched (callers
+        pass zeroed slabs)."""
+        from pilosa_tpu.store import native
+        if slots is None:
+            slots = range(len(row_ids))
+        with self.lock:
+            pend, pend_slots = [], []
+            for r, s in zip(row_ids, slots):
+                r = int(r)
+                if r in self._snap_pending:
+                    pend.append(r)
+                    pend_slots.append(s)
+                else:
+                    b = self.rows.get(r)
+                    if b is not None and b.any():
+                        out[s] = b.words()
+            if not pend:
+                return
+            if native.available() and len(pend) >= 8:
+                order = np.argsort(pend)
+                pend_sorted = np.array(pend, np.uint64)[order]
+                tmp = np.zeros((len(pend), out.shape[-1]), np.uint32)
+                native.expand_plane(self._snap_dir.buf, SHARD_WIDTH,
+                                    pend_sorted, tmp)
+                out[np.array(pend_slots)[order]] = tmp
+            else:
+                for r, s in zip(pend, pend_slots):
+                    self._ensure_row(r)
+                    out[s] = self.rows[r].words()
+
+    # Cap on the generation-cached inverted index (sparse bits copied
+    # into one flat array): 64M bits = 256MB.  Beyond it, fall back to
+    # the per-row loop rather than hold a second copy of a huge field.
+    COLINDEX_MAX_BITS = 64 << 20
+
+    def rows_containing(self, col: int) -> np.ndarray:
+        """Sorted row IDs whose bit ``col`` is set — the ``Rows(column=)``
+        membership check (reference: per-row ``row.Includes`` walk in
+        ``executor.go#executeRowsShard``).  One vectorized scan over a
+        generation-cached flat (col, row) copy of the sparse rows plus a
+        short loop over the (cardinality-bounded) dense rows, instead of
+        a Python ``contains()`` call per row — O(rows) interpreter work
+        becomes O(bits) numpy work."""
+        with self.lock:
+            idx = self._colindex()
+            if idx is None:  # over cap: per-row fallback
+                return np.array(sorted(
+                    r for r, b in self.rows.items() if b.contains(col)),
+                    dtype=np.uint64)
+            sp_cols, sp_rows, dense = idx
+            hits = sp_rows[sp_cols == np.uint32(col)]
+            w, bit = col >> 5, np.uint32(1 << (col & 31))
+            dense_hits = [r for r, words in dense if words[w] & bit]
+            out = np.concatenate(
+                [hits, np.array(dense_hits, np.uint64)]) \
+                if dense_hits else hits
+            out.sort()
+            return out.astype(np.uint64)
+
+    def _colindex(self):
+        """(sparse_cols, sparse_rows, dense_list) cached per generation."""
+        cached = getattr(self, "_colindex_cache", None)
+        if cached is not None and cached[0] == self.generation:
+            return cached[1]
+        self._materialize_all()
+        sp_parts, sp_ids, dense = [], [], []
+        total = 0
+        for r, b in self.rows.items():
+            if not b.any():
+                continue
+            if b.is_dense:
+                dense.append((r, b.words()))
+                continue
+            cols = b.columns()
+            total += len(cols)
+            if total > self.COLINDEX_MAX_BITS:
+                self._colindex_cache = (self.generation, None)
+                return None
+            sp_parts.append(cols)
+            sp_ids.append(r)
+        if sp_parts:
+            sp_cols = np.concatenate(sp_parts)
+            sp_rows = np.repeat(
+                np.array(sp_ids, np.uint64),
+                np.array([len(p) for p in sp_parts]))
+        else:
+            sp_cols = np.empty(0, np.uint32)
+            sp_rows = np.empty(0, np.uint64)
+        idx = (sp_cols, sp_rows, dense)
+        self._colindex_cache = (self.generation, idx)
+        return idx
 
     # -- mutation -----------------------------------------------------------
 
@@ -156,6 +306,7 @@ class Fragment:
                 cols = np.asarray(cols, dtype=np.uint32)
                 if len(cols) == 0:
                     continue
+                self._ensure_row(int(row_id))  # lazy snapshot rows
                 if clear:
                     row = self.rows.get(int(row_id))
                     if row is not None:
@@ -187,6 +338,7 @@ class Fragment:
         row's complete new contents, so a crash mid-call can never replay
         a cleared row without its replacement bits."""
         with self.lock:
+            self._ensure_row(row_id)  # no-op check needs snapshot truth
             before = self.rows.get(row_id)
             new = RowBits.from_columns(cols)
             before_cols = before.columns() if before is not None else np.empty(0, np.uint32)
@@ -214,7 +366,7 @@ class Fragment:
         """Rewrite the snapshot file from memory and truncate the op-log
         (reference: ``fragment.snapshot``).  Atomic via temp+rename."""
         with self.lock:
-            blob = roaring.serialize(self.positions())
+            blob = roaring.serialize(self.positions())  # materializes all
             tmp = self.path + ".tmp"
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             with open(tmp, "wb") as f:
@@ -222,6 +374,8 @@ class Fragment:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            # everything now lives in self.rows; the old mapping is stale
+            self._drop_snapshot()
             self._oplog.truncate()
             self.op_n = 0
 
@@ -233,6 +387,7 @@ class Fragment:
         ``fragment.Blocks``, SURVEY.md §4.6)."""
         out: dict[int, int] = {}
         with self.lock:
+            self._materialize_all()
             by_block: dict[int, list[tuple[int, RowBits]]] = {}
             for r, b in self.rows.items():
                 if b.any():
@@ -249,6 +404,8 @@ class Fragment:
         """All positions of one checksum block (for AAE data exchange)."""
         lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
         with self.lock:
+            for r in [r for r in self._snap_pending if lo <= r < hi]:
+                self._ensure_row(r)
             parts = [
                 np.uint64(r) * _SW + b.columns().astype(np.uint64)
                 for r, b in sorted(self.rows.items())
@@ -273,23 +430,32 @@ class Fragment:
         mutation API and op-log replay."""
         changed = 0
         if op == OP_CLEAR_ROW:
+            if aux in self._snap_pending:
+                # whole row drops: count from the directory, never expand
+                changed = self._snap_dir.row_cardinality(aux)
+                self._snap_pending.discard(aux)
             row = self.rows.get(aux)
             if row is not None and row.any():
-                changed = row.cardinality
-                del self.rows[aux]
+                changed += row.cardinality
+            self.rows.pop(aux, None)
         elif op == OP_SET_ROW:
+            if aux in self._snap_pending:
+                changed += self._snap_dir.row_cardinality(aux)
+                self._snap_pending.discard(aux)
             old = self.rows.pop(aux, None)
             if old is not None and old.any():
                 changed += old.cardinality
             if positions is not None and len(positions):
                 self._check_rows(positions)
                 for r, chunk in _split_by_row(positions):
+                    self._snap_pending.discard(r)
                     row = self.rows[r] = RowBits()
                     changed += row.add(chunk)
         elif op in (OP_SET_BITS, OP_CLEAR_BITS):
             assert positions is not None
             self._check_rows(positions)
             for r, chunk in _split_by_row(positions):
+                self._ensure_row(r)
                 if op == OP_SET_BITS:
                     row = self.rows.get(r)
                     if row is None:

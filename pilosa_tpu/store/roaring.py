@@ -227,6 +227,94 @@ def _deserialize_pilosa(buf: memoryview) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# zero-copy directory over a pilosa-format buffer (mmap cold-start path)
+# ---------------------------------------------------------------------------
+
+
+class Directory:
+    """Parsed container directory over a pilosa-64 buffer WITHOUT
+    expanding any bits — the ``roaring.FromBuffer`` analogue (reference:
+    ``syswrap`` mmap open, SURVEY.md §3.1).  Holds only O(containers)
+    header arrays; per-row expansion is on demand.  The buffer (usually
+    an mmap) must outlive the directory."""
+
+    ROW_SHIFT = 4  # key = position >> 16; row = position >> 20 = key >> 4
+
+    def __init__(self, buf):
+        self.buf = memoryview(buf)
+        if len(self.buf) < 8:
+            raise ValueError("roaring: buffer too short")
+        magic, version, n = struct.unpack_from("<HHI", self.buf, 0)
+        if magic != MAGIC:
+            raise ValueError(f"roaring: bad magic {magic}")
+        if version != VERSION:
+            raise ValueError(f"roaring: unsupported version {version}")
+        hdr_end = 8 + 12 * n
+        if len(self.buf) < hdr_end + 4 * n:
+            raise ValueError("roaring: truncated container headers")
+        hdr = np.frombuffer(self.buf, dtype=np.uint8, count=12 * n,
+                            offset=8).reshape(n, 12)
+        self.keys = hdr[:, 0:8].copy().view("<u8").reshape(n)
+        self.types = hdr[:, 8:10].copy().view("<u2").reshape(n)
+        self.cards = (hdr[:, 10:12].copy().view("<u2").reshape(n)
+                      .astype(np.int64) + 1)
+        self.offsets = np.frombuffer(self.buf, dtype="<u4", count=n,
+                                     offset=hdr_end).astype(np.int64)
+        # bounds-check every container's payload now: corruption should
+        # surface at open, not on first touch of some row
+        for i in range(n):
+            off, t = int(self.offsets[i]), int(self.types[i])
+            if t == TYPE_ARRAY:
+                end = off + 2 * int(self.cards[i])
+            elif t == TYPE_BITMAP:
+                end = off + 8192
+            elif t == TYPE_RUN:
+                if off + 2 > len(self.buf):
+                    raise ValueError("roaring: truncated run container")
+                nr, = struct.unpack_from("<H", self.buf, off)
+                end = off + 2 + 4 * nr
+            else:
+                raise ValueError(f"roaring: bad container type {t}")
+            if end > len(self.buf):
+                raise ValueError("roaring: container data out of bounds")
+        self._rows = (self.keys >> np.uint64(self.ROW_SHIFT)).astype(
+            np.uint64)
+
+    def row_ids(self) -> np.ndarray:
+        return np.unique(self._rows)
+
+    def row_cardinality(self, row: int) -> int:
+        return int(self.cards[self._rows == np.uint64(row)].sum())
+
+    def expand_container(self, i: int) -> np.ndarray:
+        """Container i's low-16 values, sorted uint16."""
+        off, t = int(self.offsets[i]), int(self.types[i])
+        if t == TYPE_ARRAY:
+            return np.frombuffer(self.buf, dtype="<u2",
+                                 count=int(self.cards[i]), offset=off)
+        if t == TYPE_BITMAP:
+            return _expand_bitmap(bytes(self.buf[off:off + 8192]))
+        nr, = struct.unpack_from("<H", self.buf, off)
+        pairs = np.frombuffer(self.buf, dtype="<u2", count=2 * nr,
+                              offset=off + 2)
+        _check_runs(pairs[0::2], pairs[1::2])
+        return _expand_runs(pairs[0::2], pairs[1::2])
+
+    def expand_row(self, row: int) -> np.ndarray:
+        """One row's column offsets (sorted uint32) — touches only that
+        row's containers."""
+        idx = np.nonzero(self._rows == np.uint64(row))[0]
+        parts = []
+        for i in idx:
+            base = (int(self.keys[i]) & ((1 << self.ROW_SHIFT) - 1)) << 16
+            parts.append(self.expand_container(int(i)).astype(np.uint32)
+                         | np.uint32(base))
+        if not parts:
+            return np.empty(0, np.uint32)
+        return np.concatenate(parts)
+
+
+# ---------------------------------------------------------------------------
 # standard 32-bit roaring (public spec)
 # ---------------------------------------------------------------------------
 

@@ -86,6 +86,10 @@ class RowBits:
     def cardinality(self) -> int:
         return self._card
 
+    @property
+    def is_dense(self) -> bool:
+        return self._words is not None
+
     def any(self) -> bool:
         return self._card > 0
 

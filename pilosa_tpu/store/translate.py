@@ -123,14 +123,22 @@ class KeyLog:
                 return self._keys[kid - 1]
             return None
 
-    def keys_of(self, ids: np.ndarray) -> list[str]:
+    def keys_of(self, ids: np.ndarray, strict: bool = True) -> list[str]:
+        """Batched id→key lookup under ONE lock acquisition.  ``strict``
+        raises on an unknown id; otherwise unknown ids yield ``None``
+        (the per-id ``key_of`` semantics)."""
         with self._lock:
-            out = []
+            keys = self._keys
+            n = len(keys)
+            out: list[str | None] = []
             for kid in ids:
-                k = self.key_of(int(kid))
-                if k is None:
+                kid = int(kid)
+                if 1 <= kid <= n:
+                    out.append(keys[kid - 1])
+                elif strict:
                     raise KeyError(f"no key for id {kid}")
-                out.append(k)
+                else:
+                    out.append(None)
             return out
 
     def __len__(self) -> int:

@@ -602,6 +602,23 @@ class TestPercentileCluster:
             (p99,) = cl.query("i", "Percentile(field=amount, nth=100)")
             assert p99 == {"value": 60, "count": 1}
 
+    def test_distributed_percentile_keyed_filter(self, three_nodes):
+        # the k-ary fan-out skips the per-call translate step, so the
+        # percentile entry point must key-translate its filter once
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "grp", {"keys": True})
+        c.client(0).create_field("k", "v", {"type": "int", "min": 0,
+                                            "max": 100})
+        for name, val, in_grp in [("a", 10, True), ("b", 20, True),
+                                  ("c", 30, False), ("d", 40, True)]:
+            c.client(0).query("k", f'Set("{name}", v={val})')
+            if in_grp:
+                c.client(0).query("k", f'Set("{name}", grp="one")')
+        (p,) = c.client(1).query(
+            "k", 'Percentile(Row(grp="one"), field=v, nth=50)')
+        assert p == {"value": 20, "count": 1}
+
 
 class TestCoordinatorFailover:
     def test_key_assignment_moves_to_new_coordinator(self, tmp_path):

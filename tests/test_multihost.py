@@ -1,0 +1,101 @@
+"""Multi-host execution: TWO controller processes joined via
+``jax.distributed`` (DCN analogue; SURVEY.md §3.6) running one sharded
+query program over the union of their devices.
+
+The reference scales across hosts with memberlist gossip + HTTP fan-out;
+the rebuild's host-level cluster does that part (tests/test_cluster.py).
+THIS test exercises the other axis — one *pod slice* spanning hosts,
+where every process joins a single JAX runtime and collectives ride
+ICI/DCN — through the real server config path
+(``Config.jax_coordinator`` → ``PilosaTPUServer.open``).
+
+Runs on CPU: each child forces 4 virtual CPU devices, so the global
+mesh has 8 devices across 2 processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import sys
+pid, coord, data_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+from pilosa_tpu.cli.config import Config
+from pilosa_tpu.server import PilosaTPUServer
+
+cfg = Config(bind="127.0.0.1:0", data_dir=data_dir,
+             jax_coordinator=coord, jax_num_processes=2,
+             jax_process_id=pid, mesh=False,
+             anti_entropy_interval=0.0)
+srv = PilosaTPUServer(cfg).open()
+try:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 8
+
+    from pilosa_tpu.parallel import spmd
+
+    # one query program over the union of both processes' devices:
+    # every process holds 4 of the 8 shard blocks
+    rng = np.random.default_rng(0)  # same seed everywhere: shared oracle
+    a = rng.integers(0, 1 << 32, size=(8, 256), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=(8, 256), dtype=np.uint32)
+    mesh = Mesh(np.array(jax.devices()), ("shard",))
+    sh = NamedSharding(mesh, P("shard", None))
+    lo = pid * 4
+    da = jax.make_array_from_process_local_data(sh, a[lo:lo + 4])
+    db = jax.make_array_from_process_local_data(sh, b[lo:lo + 4])
+    got = int(spmd.make_intersect_count_psum(mesh)(da, db))
+    expect = int(np.unpackbits((a & b).view(np.uint8)).sum())
+    assert got == expect, (got, expect)
+    print(f"MULTIHOST_OK {pid} {got}", flush=True)
+finally:
+    srv.close()
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_jax_distributed(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=ROOT)
+    procs = []
+    for pid in range(2):
+        data = tmp_path / f"n{pid}"
+        data.mkdir()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", CHILD, str(pid), coord, str(data)],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    counts = set()
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        line = [l for l in out.splitlines() if l.startswith("MULTIHOST_OK")]
+        assert line, out
+        counts.add(line[0].split()[2])
+    assert len(counts) == 1  # both processes agree on the global count

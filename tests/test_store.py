@@ -120,6 +120,129 @@ class TestFragment:
         assert f.import_roaring(roaring.serialize(positions)) == 3
         assert f.row(1).contains(5)
 
+    def test_rows_containing(self, tmp_path, rng):
+        # sparse + dense rows, against a per-row contains() oracle;
+        # the cache must invalidate on mutation
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        n = 5000
+        rows = rng.integers(0, 200, size=n).astype(np.uint64)
+        cols = rng.integers(0, 1 << 14, size=n).astype(np.uint64)
+        f.set_bits(rows, cols)
+        f.set_bits(np.full(6000, 201, np.uint64),  # one dense row
+                   rng.choice(SHARD_WIDTH, 6000, replace=False).astype(np.uint64))
+        for col in [int(cols[0]), int(cols[7]), 12345, 0]:
+            expect = sorted(r for r in f.row_ids()
+                            if f.rows[r].contains(col))
+            np.testing.assert_array_equal(
+                f.rows_containing(col), np.array(expect, np.uint64),
+                err_msg=f"col {col}")
+        probe = int(cols[0])
+        before = f.rows_containing(probe)
+        f.set_bit(199, probe)
+        after = f.rows_containing(probe)
+        assert 199 in after and set(map(int, before)) - {199} \
+            == set(map(int, after)) - {199}
+
+    def test_rows_containing_over_cap_fallback(self, tmp_path,
+                                               monkeypatch, rng):
+        monkeypatch.setattr(Fragment, "COLINDEX_MAX_BITS", 100)
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        rows = np.arange(300, dtype=np.uint64)
+        f.set_bits(rows, np.full(300, 77, np.uint64))
+        np.testing.assert_array_equal(f.rows_containing(77), rows)
+        assert f.rows_containing(78).size == 0
+
+    def test_lazy_snapshot_open(self, tmp_path, rng):
+        # reopen must NOT expand bits eagerly (mmap FromBuffer path);
+        # reads materialize on demand and stay correct
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        n = 3000
+        rows = rng.integers(0, 50, size=n).astype(np.uint64)
+        cols = rng.choice(1 << 16, size=n, replace=False).astype(np.uint64)
+        f.set_bits(rows, cols)
+        card = f.cardinality()
+        ids = f.row_ids()
+        row7 = f.row(7).columns().copy()
+        f.close()
+
+        g = Fragment(path, 0).open()
+        assert g._snap_dir is not None and len(g._snap_pending) > 0
+        assert not g.rows, "no row may be materialized at open"
+        assert g.row_ids() == ids          # directory-only
+        assert g.cardinality() == card     # directory-only
+        assert 7 in g._snap_pending
+        np.testing.assert_array_equal(g.row(7).columns(), row7)
+        assert 7 not in g._snap_pending    # materialized on touch
+
+        # mutations against still-lazy rows
+        some = int(ids[3])
+        before = g.row(some).cardinality
+        assert g.set_bit(some, 1 << 17)
+        assert g.row(some).cardinality == before + 1
+        assert g.clear_row(int(ids[4])) > 0
+        assert int(ids[4]) not in g.row_ids()
+        g.close()
+
+        h = Fragment(path, 0).open()
+        assert int(ids[4]) not in h.row_ids()
+        np.testing.assert_array_equal(h.row(7).columns(), row7)
+        assert h.cardinality() == len(h.positions())
+
+    def test_grouped_mutation_on_lazy_rows(self, tmp_path):
+        # set_bits_grouped / clear_bits_grouped (the BSI import path)
+        # must materialize snapshot-resident rows before mutating
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        f.set_bits(np.array([3, 3, 3], np.uint64),
+                   np.array([10, 11, 12], np.uint64))
+        f.close()
+
+        g = Fragment(path, 0).open()
+        assert 3 in g._snap_pending
+        assert g.set_bits_grouped([(3, np.array([12, 13], np.uint32))]) == 1
+        np.testing.assert_array_equal(g.row(3).columns(), [10, 11, 12, 13])
+        assert g.cardinality() == 4
+        g.close()
+        h = Fragment(path, 0).open()
+        assert 3 in h._snap_pending
+        assert h.clear_bits_grouped([(3, np.array([10, 99], np.uint32))]) == 1
+        np.testing.assert_array_equal(h.row(3).columns(), [11, 12, 13])
+        # Store() no-op check against a still-lazy row
+        h.close()
+        k = Fragment(path, 0).open()
+        assert not k.set_row(3, np.array([11, 12, 13]))  # identical: no-op
+        assert k.set_row(3, np.array([11]))
+
+    def test_plane_rows_matches_words(self, tmp_path, rng):
+        # plane assembly from the mmap blob (native fast path when
+        # built) must equal per-row words() materialization
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        n = 4000
+        rows = rng.integers(0, 40, size=n).astype(np.uint64)
+        cols = rng.choice(1 << 15, size=n, replace=False).astype(np.uint64)
+        f.set_bits(rows, cols)
+        # one dense row to cross representations
+        f.set_bits(np.full(5000, 41, np.uint64),
+                   rng.choice(SHARD_WIDTH, 5000, replace=False).astype(np.uint64))
+        f.close()
+
+        g = Fragment(path, 0).open()
+        ids = g.row_ids()
+        from pilosa_tpu.engine.words import WORDS_PER_SHARD
+        out = np.zeros((len(ids), WORDS_PER_SHARD), np.uint32)
+        g.plane_rows(ids, out)
+        # compare against materialized truth, and overlay precedence
+        for i, r in enumerate(ids):
+            np.testing.assert_array_equal(out[i], g.row(r).words(),
+                                          err_msg=f"row {r}")
+        g.set_bit(int(ids[0]), 3)  # overlay row 0; rebuild
+        out2 = np.zeros_like(out)
+        g.plane_rows(ids, out2)
+        np.testing.assert_array_equal(out2[0], g.row(int(ids[0])).words())
+        g.close()
+
 
 class TestOpLog:
     def test_crc_rejects_corruption(self, tmp_path):
