@@ -134,8 +134,19 @@ class Handler(BaseHTTPRequestHandler):
     # -- handlers -------------------------------------------------------------
 
     def h_query(self, index: str) -> None:
-        pql = self._body().decode()
-        shards = None
+        # content negotiation (reference: http/handler.go JSON/protobuf):
+        # Content-Type picks the request decoding, Accept the response
+        from pilosa_tpu.api import proto
+        body = self._body()
+        want_proto = proto.CONTENT_TYPE in (self.headers.get("Accept") or "")
+        if proto.CONTENT_TYPE in (self.headers.get("Content-Type") or ""):
+            try:
+                pql, shards = proto.decode_query_request(body)
+            except ValueError as e:
+                raise ApiError(f"bad protobuf request: {e}")
+        else:
+            pql = body.decode()
+            shards = None
         if "shards" in self.query:
             try:
                 shards = [int(s) for s in
@@ -144,8 +155,16 @@ class Handler(BaseHTTPRequestHandler):
                 raise ApiError(f"bad shards param "
                                f"{self.query['shards'][0]!r}")
         profile = "profile" in self.query
-        self._reply(self.server.api.query(index, pql, shards=shards,
-                                          profile=profile))
+        if not want_proto:
+            self._reply(self.server.api.query(index, pql, shards=shards,
+                                              profile=profile))
+            return
+        try:
+            res = self.server.api.query(index, pql, shards=shards)
+            raw = proto.encode_query_response(res["results"])
+        except ApiError as e:
+            raw = proto.encode_query_response(err=str(e))
+        self._reply(raw, content_type=proto.CONTENT_TYPE)
 
     def h_create_index(self, index: str) -> None:
         body = self._json_body()

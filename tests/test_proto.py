@@ -1,0 +1,127 @@
+"""Protobuf content negotiation on the query endpoint: the hand-rolled
+wire codec round-trips every result shape, and proto responses over HTTP
+carry exactly the JSON path's values (api/internal.proto; reference:
+``http/handler.go`` content-type negotiation)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import proto
+
+
+RESULT_CASES = [
+    None,
+    True,
+    False,
+    0,
+    12345678901234,
+    {"columns": [1, 5, 1 << 40]},
+    {"columns": []},
+    {"keys": ["alice", "bob"]},
+    [{"id": 10, "count": 3}, {"id": 0, "count": 1}],
+    [{"key": "admin", "count": 7}],
+    [],
+    {"value": -42, "count": 2},
+    {"value": 1.5, "count": 3},
+    {"rows": [1, 2, 3]},
+    {"rows": []},
+    [{"group": [{"field": "f", "rowID": 10}], "count": 2, "agg": -5},
+     {"group": [{"field": "f", "rowKey": "x"},
+                {"field": "g", "rowID": 0}], "count": 1}],
+    {"values": [-3, 0, 9]},
+    {"values": [0.5, -1.25]},
+    {"values": []},
+]
+
+
+def test_result_round_trips():
+    raw = proto.encode_query_response(RESULT_CASES)
+    out = proto.decode_query_response(raw)
+    assert out["results"] == RESULT_CASES
+
+
+def test_request_round_trip():
+    raw = proto.encode_query_request("Count(Row(f=1))", [0, 5, 954])
+    assert proto.decode_query_request(raw) == ("Count(Row(f=1))",
+                                               [0, 5, 954])
+    raw = proto.encode_query_request("All()")
+    assert proto.decode_query_request(raw) == ("All()", None)
+
+
+def test_error_response():
+    raw = proto.encode_query_response(err="field 'nope' not found")
+    out = proto.decode_query_response(raw)
+    assert out["error"] == "field 'nope' not found"
+    assert out["results"] == []
+
+
+def test_truncated_buffer_rejected():
+    raw = proto.encode_query_response(RESULT_CASES)
+    with pytest.raises(ValueError):
+        proto.decode_query_response(raw[:-3])
+
+
+@pytest.fixture
+def served(tmp_path):
+    import threading
+
+    from pilosa_tpu.api import API, Server
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder, Executor(holder))
+    srv = Server(api, host="127.0.0.1", port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.address[1]}", api
+    srv.close()
+
+
+def _post(url, path, body, headers=None):
+    req = urllib.request.Request(url + path, data=body, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req) as resp:
+        return resp.headers.get("Content-Type"), resp.read()
+
+
+def test_http_negotiation_matches_json(served):
+    url, api = served
+    _post(url, "/index/i", json.dumps({}).encode())
+    _post(url, "/index/i/field/f", json.dumps({}).encode())
+    _post(url, "/index/i/field/v", json.dumps(
+        {"options": {"type": "int", "min": -50, "max": 50}}).encode())
+    _post(url, "/index/i/query",
+          b"Set(1, f=10) Set(2, f=10) Set(2, f=20) Set(1, v=-7)")
+
+    # a write through the proto surface (changed / no-op statuses)
+    _, raw = _post(url, "/index/i/query", b"Set(9, f=10)",
+                   {"Accept": proto.CONTENT_TYPE})
+    assert proto.decode_query_response(raw)["results"] == [True]
+    _, raw = _post(url, "/index/i/query", b"Set(9, f=10)",
+                   {"Accept": proto.CONTENT_TYPE})
+    assert proto.decode_query_response(raw)["results"] == [False]
+
+    for pql in [b"Count(Row(f=10))", b"Row(f=10)", b"TopN(f)",
+                b"Sum(field=v)", b"Min(field=v)",
+                b"GroupBy(Rows(f), aggregate=Count())"]:
+        ct_j, raw_j = _post(url, "/index/i/query", pql)
+        ct_p, raw_p = _post(url, "/index/i/query", pql,
+                            {"Accept": proto.CONTENT_TYPE})
+        assert proto.CONTENT_TYPE in ct_p
+        assert proto.decode_query_response(raw_p)["results"] == \
+            json.loads(raw_j)["results"], pql
+
+    # protobuf-encoded request body
+    body = proto.encode_query_request("Count(Row(f=10))")
+    _, raw = _post(url, "/index/i/query", body,
+                   {"Content-Type": proto.CONTENT_TYPE,
+                    "Accept": proto.CONTENT_TYPE})
+    assert proto.decode_query_response(raw)["results"] == [3]
+
+    # query errors arrive as QueryResponse.err, not HTTP 400 JSON
+    _, raw = _post(url, "/index/i/query", b"Row(nope=1)",
+                   {"Accept": proto.CONTENT_TYPE})
+    assert "nope" in proto.decode_query_response(raw)["error"]
