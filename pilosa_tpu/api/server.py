@@ -159,6 +159,11 @@ class Handler(BaseHTTPRequestHandler):
             self._reply(self.server.api.query(index, pql, shards=shards,
                                               profile=profile))
             return
+        if profile:
+            # QueryResponse has no profile field; fail loudly rather
+            # than silently dropping the span tree the caller asked for
+            raise ApiError("?profile is not supported with "
+                           "application/x-protobuf responses")
         try:
             res = self.server.api.query(index, pql, shards=shards)
             raw = proto.encode_query_response(res["results"])

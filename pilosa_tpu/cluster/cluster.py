@@ -66,6 +66,7 @@ class Cluster:
         # schema push from a stale peer must not resurrect deletions
         self._schema_tombstones: dict[tuple, float] = {}
         self._resize_lock = threading.Lock()
+        self._resize_abort = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -608,8 +609,17 @@ class Cluster:
     # -- resize (reference: ResizeJob, SURVEY.md §3.3) ----------------------
 
     def trigger_resize(self) -> None:
-        """Spawn a background rebalance (coordinator only)."""
+        """Spawn a background rebalance (coordinator only).  Any
+        in-flight job is ABORTED first (reference: ``ResizeJob`` abort on
+        superseding node events) — it stops at the next fragment-copy
+        boundary; the new job recomputes against current membership, so
+        partial copies are never lost, only re-planned."""
+        self._resize_abort.set()
         self._spawn(self._resize_job, "resize")
+
+    def abort_resize(self) -> None:
+        """Abort an in-flight rebalance at the next copy boundary."""
+        self._resize_abort.set()
 
     # -- explicit removal (reference: remove-node resize, SURVEY.md §6) -----
 
@@ -654,6 +664,7 @@ class Cluster:
         Jobs serialize on ``_resize_lock``; the cluster always lands on
         NORMAL afterwards."""
         with self._resize_lock:
+            self._resize_abort.clear()
             self._resize_once()
 
     def _resize_once(self) -> None:
@@ -677,6 +688,11 @@ class Cluster:
                     inventory.setdefault(key, []).append(nid)
             moved = 0
             for (index, field, view, shard), holders in inventory.items():
+                if self._resize_abort.is_set():
+                    self.logger.info(
+                        "resize aborted after %d copies (superseded)",
+                        moved)
+                    return
                 owners = self.shard_owners(index, shard)
                 for dest in owners:
                     if dest in holders:

@@ -234,6 +234,15 @@ def h_resize_trigger(self: Handler) -> None:
     self._reply({"success": True})
 
 
+def h_resize_abort(self: Handler) -> None:
+    """Abort an in-flight rebalance (reference: ResizeJob abort)."""
+    cluster = _cluster(self)
+    if not cluster.is_coordinator():
+        raise ApiError("not the coordinator", 409)
+    cluster.abort_resize()
+    self._reply({"success": True})
+
+
 def h_node_remove_internal(self: Handler) -> None:
     _cluster(self).handle_node_remove(self._json_body())
     self._reply({"success": True})
@@ -274,6 +283,7 @@ def register_internal_routes(router: Router) -> None:
     router.add("POST", "/internal/fragment/merge", h_fragment_merge)
     router.add("POST", "/internal/resize/push", h_resize_push)
     router.add("POST", "/internal/resize/trigger", h_resize_trigger)
+    router.add("POST", "/internal/resize/abort", h_resize_abort)
     router.add("GET", "/internal/attrs/blocks", h_attr_blocks)
     router.add("GET", "/internal/attrs/block", h_attr_block)
     router.add("POST", "/internal/attrs/merge", h_attr_merge)

@@ -799,10 +799,15 @@ class Executor:
         if need_row_counts:
             src_count = int(kernels.shard_totals(
                 kernels.count(filter_words)))
-        # resident path: the whole plane fits the device budget;
-        # otherwise stream fixed-shape row blocks (one compile) and
-        # accumulate totals on host — the "dense blowup" escape hatch
-        # for fields with huge row sets (SURVEY.md §8)
+        # Representation choice (SURVEY.md §8 "dense blowup"):
+        # 1. dense resident plane when it fits the device budget;
+        # 2. no filter → exact counts from host fragment metadata,
+        #    no device at all;
+        # 3. sparse (container-blocked) residency when 12 B/bit fits —
+        #    high-row-cardinality fields stay device-resident and one
+        #    gather+segment-sum program answers each filtered TopN
+        #    (engine/sparse.py), no per-query re-streaming;
+        # 4. last resort: stream fixed-shape row blocks per query.
         est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
         row_totals = None
         if est <= self.planes.budget:
@@ -817,6 +822,49 @@ class Executor:
                 row_totals = kernels.shard_totals(
                     kernels.row_counts(ps.plane, None))[:ps.n_rows]
             all_rows = ps.row_ids
+        elif filter_words is None:
+            # unfiltered: row cardinalities are host truth (directory
+            # sums + overlay) — exact, zero device work
+            all_rows, totals = self._host_row_cards(ctx, field)
+            if len(all_rows) == 0:
+                return PairsResult([])
+        elif (self.planes.sparse_bytes(field, VIEW_STANDARD, ctx.shards)
+              <= self.planes.budget):
+            from pilosa_tpu.engine import sparse as sparsek
+            ss = self.planes.sparse_plane(ctx.index.name, field,
+                                          VIEW_STANDARD, ctx.shards)
+            if ss.n_rows == 0:
+                return ({"pairs": [], "srcCount": src_count} if want_partial
+                        else PairsResult([]))
+            if (n is not None and tanimoto is None and not want_partial
+                    and call.args.get("ids") is None
+                    and call.args.get("attrName") is None):
+                # plain TopN(n, filter): device top_k, read only k pairs
+                # instead of the full (possibly millions-long) counts
+                k = min(int(n), ss.n_rows)
+                k_pad = min(ss.n_rows_pad,
+                            1 << max(0, (k - 1).bit_length()))
+                vals, slots = sparsek.topn_sparse(
+                    filter_words, ss.word_idx, ss.mask, ss.row_ptr,
+                    k_pad)
+                vals = np.asarray(vals)[:k]
+                slots = np.asarray(slots)[:k]
+                live = vals > 0
+                row_ids = ss.row_ids[slots[live]]
+                vals = vals[live]
+                if field.options.keys and ctx.translate_output:
+                    log = self.translate.rows(ctx.index.name, field.name)
+                    return PairsResult(
+                        [Pair(key=k_, count=int(c)) for k_, c in
+                         zip(log.keys_of(row_ids, strict=False), vals)])
+                return PairsResult([Pair(id=int(r), count=int(c))
+                                    for r, c in zip(row_ids, vals)])
+            counts = sparsek.sparse_row_counts(
+                filter_words, ss.word_idx, ss.mask, ss.row_ptr)
+            totals = np.asarray(counts).astype(np.int64)[:ss.n_rows]
+            all_rows = ss.row_ids
+            if need_row_counts:
+                row_totals = ss.row_cards  # host truth, no second pass
         else:
             block = max(64, int(self.planes.budget
                                 // (len(ctx.shards) * WORDS_PER_SHARD * 4
@@ -875,6 +923,22 @@ class Executor:
                                 for r, c in zip(row_ids, vals)])
         return PairsResult([Pair(id=int(r), count=int(c))
                             for r, c in zip(row_ids, vals)])
+
+    def _host_row_cards(self, ctx: _Ctx, field: Field):
+        """Exact per-row cardinalities merged across shards from host
+        fragment metadata (directory sums + overlay) — the unfiltered
+        TopN answer with zero device work."""
+        from pilosa_tpu.exec.planes import merge_row_cards
+        view = field.view(VIEW_STANDARD)
+        frags = []
+        if view is not None:
+            for s in ctx.shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    frags.append(frag)
+        return merge_row_cards(frags)
 
     # -- Rows ---------------------------------------------------------------
 

@@ -39,6 +39,25 @@ def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def merge_row_cards(frags) -> tuple[np.ndarray, np.ndarray]:
+    """Merge per-fragment (row_ids, cardinalities) across shards:
+    (uint64[R] sorted ids, int64[R] summed cards).  Shared by the sparse
+    build and the executor's unfiltered-TopN host path."""
+    id_parts, card_parts = [], []
+    for frag in frags:
+        ids, cards = frag.row_cardinalities()
+        if len(ids):
+            id_parts.append(ids)
+            card_parts.append(cards)
+    if not id_parts:
+        return np.empty(0, np.uint64), np.empty(0, np.int64)
+    all_ids = np.unique(np.concatenate(id_parts))
+    totals = np.zeros(len(all_ids), np.int64)
+    for ids, cards in zip(id_parts, card_parts):
+        totals[np.searchsorted(all_ids, ids)] += cards
+    return all_ids, totals
+
+
 @dataclass
 class PlaneSet:
     """One materialized (field, view): device plane + row-slot mapping."""
@@ -47,6 +66,26 @@ class PlaneSet:
     shards: tuple[int, ...]   # axis-0 ids, PAD_SHARD entries are zeros
     row_ids: np.ndarray       # uint64[R] real rows (slots beyond are pad)
     slot_of: dict[int, int]
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.row_ids)
+
+
+@dataclass
+class SparseSet:
+    """Container-blocked sparse residency (``engine.sparse``): one
+    (field, view) as CSR bit arrays — memory scales with set bits, not
+    rows × shard width (SURVEY.md §8 "dense blowup")."""
+
+    word_idx: jax.Array       # int32[N_pad] flat filter-word index
+    mask: jax.Array           # uint32[N_pad] lane mask (0 = padding)
+    row_ptr: jax.Array        # int32[R_pad+1] CSR row boundaries
+    row_ids: np.ndarray       # uint64[R] sorted global rows
+    row_cards: np.ndarray     # int64[R] full per-row cardinalities
+    shards: tuple[int, ...]
+    nbytes: int
+    n_rows_pad: int           # pow2 row bucket (static program shape)
 
     @property
     def n_rows(self) -> int:
@@ -109,6 +148,89 @@ class PlaneCache:
                 frag.plane_rows(list(slot_of.keys()), host[si],
                                 slots=list(slot_of.values()))
         return PlaneSet(self.place(host), shards, row_ids, slot_of)
+
+    def sparse_bytes(self, field: Field, view_name: str,
+                     shards: tuple[int, ...]) -> int:
+        """Sparse-residency footprint with the SAME pow2 padding the
+        build applies — the budget gate must never admit a set the
+        cache then refuses (which would silently re-build per query)."""
+        view = field.view(view_name)
+        total_bits = 0
+        total_rows = 0
+        if view is not None:
+            for s in shards:
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is not None:
+                    total_bits += frag.cardinality()
+                    total_rows += len(frag.row_cardinalities()[0])
+        return (_pow2(max(1, total_bits)) * 8
+                + (_pow2(max(1, total_rows)) + 1) * 4)
+
+    def sparse_plane(self, index: str, field: Field, view_name: str,
+                     shards: tuple[int, ...]) -> SparseSet:
+        """Device-resident sparse triplets for a high-row-cardinality
+        view (cached/invalidation like dense planes)."""
+        key = ("sparse", index, field.name, view_name, shards)
+        return self._get(key, field, view_name, shards, self._build_sparse)
+
+    def _build_sparse(self, field: Field, view_name: str,
+                      shards: tuple[int, ...]) -> SparseSet:
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        view = field.view(view_name)
+        per_shard = []  # (si, positions)
+        frags = []
+        if view is not None:
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                frags.append(frag)
+                per_shard.append((si, frag.positions()))
+        all_ids, row_cards = merge_row_cards(frags)
+
+        wi_parts, mask_parts, slot_parts = [], [], []
+        for si, pos in per_shard:
+            if not len(pos):
+                continue
+            rows = pos // np.uint64(SHARD_WIDTH)
+            cols = (pos % np.uint64(SHARD_WIDTH)).astype(np.int64)
+            wi_parts.append((si * WORDS_PER_SHARD
+                             + (cols >> 5)).astype(np.int32))
+            mask_parts.append(
+                (np.uint32(1) << (cols & 31).astype(np.uint32)))
+            slot_parts.append(
+                np.searchsorted(all_ids, rows).astype(np.int32))
+        if wi_parts:
+            word_idx = np.concatenate(wi_parts)
+            mask = np.concatenate(mask_parts)
+            rowslot = np.concatenate(slot_parts)
+            order = np.argsort(rowslot, kind="stable")  # CSR row order
+            word_idx, mask, rowslot = (word_idx[order], mask[order],
+                                       rowslot[order])
+        else:
+            word_idx = np.empty(0, np.int32)
+            mask = np.empty(0, np.uint32)
+            rowslot = np.empty(0, np.int32)
+        n_bits = len(word_idx)
+        n_pad = _pow2(max(1, n_bits))
+        pad = n_pad - n_bits
+        if pad:
+            # mask 0: padding contributes nothing to any segment
+            word_idx = np.concatenate([word_idx, np.zeros(pad, np.int32)])
+            mask = np.concatenate([mask, np.zeros(pad, np.uint32)])
+        r_pad = _pow2(max(1, len(all_ids)))
+        # CSR boundaries; pad rows collapse to empty segments at N
+        row_ptr = np.searchsorted(
+            rowslot, np.arange(r_pad + 1, dtype=np.int64)).astype(np.int32)
+        return SparseSet(
+            word_idx=self.place(word_idx), mask=self.place(mask),
+            row_ptr=self.place(row_ptr), row_ids=all_ids,
+            row_cards=row_cards, shards=shards,
+            nbytes=n_pad * 8 + (r_pad + 1) * 4, n_rows_pad=r_pad)
 
     def row_words(self, index: str, field: Field, view_name: str,
                   row_id: int, shards: tuple[int, ...]) -> jax.Array:
@@ -215,7 +337,9 @@ class PlaneCache:
                 self._entries.move_to_end(key)
                 return hit[1]
         ps = build(field, view_name, shards)
-        nbytes = ps.plane.size * 4
+        nbytes = getattr(ps, "nbytes", None)
+        if nbytes is None:
+            nbytes = ps.plane.size * 4
         with self._lock:
             if nbytes <= self.budget:
                 old = self._entries.pop(key, None)

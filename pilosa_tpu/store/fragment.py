@@ -141,22 +141,74 @@ class Fragment:
 
     def cardinality(self) -> int:
         with self.lock:
-            pend = sum(self._snap_dir.row_cardinality(r)
-                       for r in self._snap_pending)
-            return pend + sum(b.cardinality for b in self.rows.values())
+            cached = getattr(self, "_card_cache", None)
+            if cached is not None and cached[0] == self.generation:
+                return cached[1]
+            # vectorized via row_cardinalities: a sparse snapshot can
+            # hold millions of pending rows
+            _, cards = self.row_cardinalities()
+            total = int(cards.sum())
+            self._card_cache = (self.generation, total)
+            return total
 
     def positions(self) -> np.ndarray:
-        """All set bits as sorted uint64 ``row*ShardWidth + col``."""
+        """All set bits as sorted uint64 ``row*ShardWidth + col``.
+
+        Snapshot-resident rows decode straight from the blob (native
+        codec when built) WITHOUT materializing host ``RowBits`` — the
+        bulk path for snapshot compaction and the sparse device build."""
         with self.lock:
-            self._materialize_all()
-            parts = [
+            parts = []
+            if self._snap_pending:
+                snap = roaring.deserialize(self._snap_dir.buf)
+                if len(self._snap_pending) != len(
+                        self._snap_dir.row_ids()):
+                    # some snapshot rows were materialized (overlay wins)
+                    pend = np.fromiter(self._snap_pending, np.uint64,
+                                       len(self._snap_pending))
+                    keep = np.isin(snap // _SW, pend)
+                    snap = snap[keep]
+                parts.append(snap)
+            parts += [
                 np.uint64(r) * _SW + b.columns().astype(np.uint64)
                 for r, b in sorted(self.rows.items())
                 if b.any()
             ]
         if not parts:
             return np.empty(0, dtype=np.uint64)
-        return np.concatenate(parts)
+        if len(parts) == 1:
+            return parts[0]
+        return np.sort(np.concatenate(parts))
+
+    def row_cardinalities(self) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids uint64[R] sorted, cards int64[R]) without expanding
+        any bits: directory sums for snapshot-resident rows, RowBits
+        cardinality for overlay rows."""
+        with self.lock:
+            ids, cards = [], []
+            if self._snap_pending and self._snap_dir is not None:
+                uniq, ucards = self._snap_dir.row_cards()
+                if len(self._snap_pending) != len(uniq):
+                    pend = np.fromiter(self._snap_pending, np.uint64,
+                                       len(self._snap_pending))
+                    keep = np.isin(uniq, pend)
+                    uniq, ucards = uniq[keep], ucards[keep]
+                ids.append(uniq)
+                cards.append(ucards)
+            live = [(r, b.cardinality) for r, b in self.rows.items()
+                    if b.any()]
+            if live:
+                live.sort()
+                ids.append(np.array([r for r, _ in live], np.uint64))
+                cards.append(np.array([c for _, c in live], np.int64))
+        if not ids:
+            return np.empty(0, np.uint64), np.empty(0, np.int64)
+        if len(ids) == 1:
+            return ids[0], cards[0]
+        all_ids = np.concatenate(ids)
+        all_cards = np.concatenate(cards)
+        order = np.argsort(all_ids, kind="stable")
+        return all_ids[order], all_cards[order]
 
     def plane_rows(self, row_ids, out: np.ndarray, slots=None) -> None:
         """Fill ``out[slots[i]] = words of row_ids[i]`` (uint32[.., W]).
@@ -363,10 +415,14 @@ class Fragment:
     # -- durability ---------------------------------------------------------
 
     def snapshot(self) -> None:
-        """Rewrite the snapshot file from memory and truncate the op-log
-        (reference: ``fragment.snapshot``).  Atomic via temp+rename."""
+        """Rewrite the snapshot file and truncate the op-log (reference:
+        ``fragment.snapshot``).  Atomic via temp+rename.  Afterwards the
+        fragment re-opens the NEW file as its lazy backing and drops the
+        overlay — compaction is also the host-memory release point
+        (positions() composes from the old blob + overlay without
+        materializing, so rows must not be left half-resident)."""
         with self.lock:
-            blob = roaring.serialize(self.positions())  # materializes all
+            blob = roaring.serialize(self.positions())
             tmp = self.path + ".tmp"
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             with open(tmp, "wb") as f:
@@ -374,8 +430,10 @@ class Fragment:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
-            # everything now lives in self.rows; the old mapping is stale
             self._drop_snapshot()
+            self.rows = {}
+            if os.path.getsize(self.path) > 0:
+                self._open_snapshot()
             self._oplog.truncate()
             self.op_n = 0
 

@@ -260,31 +260,59 @@ class Directory:
                       .astype(np.int64) + 1)
         self.offsets = np.frombuffer(self.buf, dtype="<u4", count=n,
                                      offset=hdr_end).astype(np.int64)
-        # bounds-check every container's payload now: corruption should
-        # surface at open, not on first touch of some row
-        for i in range(n):
-            off, t = int(self.offsets[i]), int(self.types[i])
-            if t == TYPE_ARRAY:
-                end = off + 2 * int(self.cards[i])
-            elif t == TYPE_BITMAP:
-                end = off + 8192
-            elif t == TYPE_RUN:
-                if off + 2 > len(self.buf):
-                    raise ValueError("roaring: truncated run container")
-                nr, = struct.unpack_from("<H", self.buf, off)
-                end = off + 2 + 4 * nr
-            else:
-                raise ValueError(f"roaring: bad container type {t}")
-            if end > len(self.buf):
-                raise ValueError("roaring: container data out of bounds")
+        # bounds-check every container's payload now, VECTORIZED — a
+        # sparse 5M-row snapshot legitimately has tens of millions of
+        # tiny containers, so corruption checks cannot be a Python loop
+        size = len(self.buf)
+        t, off, cards = self.types, self.offsets, self.cards
+        known = (t == TYPE_ARRAY) | (t == TYPE_BITMAP) | (t == TYPE_RUN)
+        if not known.all():
+            bad = int(t[~known][0])
+            raise ValueError(f"roaring: bad container type {bad}")
+        end = np.where(t == TYPE_ARRAY, off + 2 * cards, off + 8192)
+        run_idx = np.nonzero(t == TYPE_RUN)[0]
+        if len(run_idx):
+            ro = off[run_idx]
+            if int(ro.max()) + 2 > size:
+                raise ValueError("roaring: truncated run container")
+            u8 = np.frombuffer(self.buf, dtype=np.uint8)
+            nr = u8[ro].astype(np.int64) | (u8[ro + 1].astype(np.int64)
+                                            << 8)
+            end[run_idx] = ro + 2 + 4 * nr
+        if len(end) and int(end.max()) > size:
+            raise ValueError("roaring: container data out of bounds")
         self._rows = (self.keys >> np.uint64(self.ROW_SHIFT)).astype(
             np.uint64)
+        # keys ascend in every writer we know; a sorted row axis turns
+        # per-row container lookup into searchsorted
+        self._rows_sorted = bool(np.all(self._rows[1:] >= self._rows[:-1])) \
+            if n > 1 else True
 
     def row_ids(self) -> np.ndarray:
         return np.unique(self._rows)
 
+    def _row_container_idx(self, row: int) -> np.ndarray:
+        if self._rows_sorted:
+            lo = np.searchsorted(self._rows, np.uint64(row), "left")
+            hi = np.searchsorted(self._rows, np.uint64(row), "right")
+            return np.arange(lo, hi)
+        return np.nonzero(self._rows == np.uint64(row))[0]
+
     def row_cardinality(self, row: int) -> int:
-        return int(self.cards[self._rows == np.uint64(row)].sum())
+        return int(self.cards[self._row_container_idx(row)].sum())
+
+    def row_cards(self) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids uint64[R], cardinalities int64[R]) for every row —
+        vectorized over the container directory, no expansion.  Cached:
+        the buffer is immutable, and callers (per-query budget checks)
+        hit this hot."""
+        cached = getattr(self, "_row_cards_cache", None)
+        if cached is None:
+            uniq, inv = np.unique(self._rows, return_inverse=True)
+            cards = np.zeros(len(uniq), np.int64)
+            np.add.at(cards, inv, self.cards)
+            cached = self._row_cards_cache = (uniq, cards)
+        return cached
 
     def expand_container(self, i: int) -> np.ndarray:
         """Container i's low-16 values, sorted uint16."""
@@ -303,7 +331,7 @@ class Directory:
     def expand_row(self, row: int) -> np.ndarray:
         """One row's column offsets (sorted uint32) — touches only that
         row's containers."""
-        idx = np.nonzero(self._rows == np.uint64(row))[0]
+        idx = self._row_container_idx(row)
         parts = []
         for i in idx:
             base = (int(self.keys[i]) & ((1 << self.ROW_SHIFT) - 1)) << 16

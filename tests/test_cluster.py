@@ -274,6 +274,42 @@ class TestReplicationAndFailover:
             assert after == 6
 
 
+class TestResizeAbort:
+    def test_abort_stops_at_copy_boundary_and_retrigger_converges(
+            self, tmp_path):
+        with run_cluster(2, str(tmp_path), replicas=2) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH for s in range(8)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 8,
+                                    columnIDs=cols)
+            coord = next(s for s in c.servers if s.cluster.is_coordinator())
+            other = next(s for s in c.servers if s is not coord)
+            # fabricate under-replication: drop several fragments from
+            # the non-coordinator so a rebalance has >1 copy to make
+            view = other.holder.index("i").field("f").standard_view()
+            dropped = [sh for sh in list(view.fragments)[:4]]
+            for sh in dropped:
+                view.fragments[sh].clear_row(1)
+            pushes = []
+            orig = coord.cluster.push_fragment
+
+            def aborting_push(*a, **kw):
+                pushes.append(a)
+                coord.cluster.abort_resize()  # abort after first copy
+                return orig(*a, **kw)
+
+            coord.cluster.push_fragment = aborting_push
+            coord.cluster._resize_job()
+            assert len(pushes) == 1  # stopped at the copy boundary
+            assert coord.cluster.state == "NORMAL"
+            # a fresh (unaborted) job completes the plan
+            coord.cluster.push_fragment = orig
+            coord.cluster._resize_job()
+            for sh in dropped:
+                assert view.fragments[sh].row(1).any()
+
+
 class TestAntiEntropy:
     def test_repair_diverged_replica(self, tmp_path):
         with run_cluster(2, str(tmp_path), replicas=2) as c:

@@ -640,6 +640,76 @@ class TestStreamingTopN:
                [(p.id, p.count) for p in b.pairs]
 
 
+class TestSparseTopN:
+    """Container-blocked sparse residency (engine/sparse.py): fields too
+    big for a dense plane stay device-resident as per-bit triplets; every
+    representation must agree with the dense resident path."""
+
+    def _setup(self, tmp_path, rng, n_rows=500, n_bits=4000):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        rows = rng.integers(0, n_rows, size=n_bits).astype(np.uint64)
+        cols = rng.choice(SHARD_WIDTH + 1000, size=n_bits,
+                          replace=False).astype(np.uint64)
+        idx.field("f").import_bits(rows, cols)  # spans 2 shards
+        idx.field("g").import_bits(np.ones(n_bits // 2, np.uint64),
+                                   cols[: n_bits // 2])
+        idx.create_field("h")  # small source row: tanimoto can pass
+        idx.field("h").import_bits(np.ones(40, np.uint64), cols[:40])
+        idx.note_columns(cols)
+        resident = Executor(holder)
+        # dense (512-row bucket × 2 shards = 128MB) over budget;
+        # sparse (4000 bits × 12B) well under → sparse path
+        sparse_ex = Executor(holder, plane_budget=1 << 20)
+        return resident, sparse_ex
+
+    def test_sparse_matches_resident(self, tmp_path, rng):
+        resident, sparse_ex = self._setup(tmp_path, rng)
+        for pql in ["TopN(f, filter=Row(g=1), n=10)",
+                    "TopN(f, filter=Row(g=1))",
+                    "TopN(f, filter=Row(g=1), ids=[3, 7, 9])",
+                    "TopN(f, filter=Row(h=1), tanimoto=1)"]:
+            (a,) = resident.execute("i", pql)
+            (b,) = sparse_ex.execute("i", pql)
+            assert a.pairs, pql  # must exercise non-empty results
+            assert [(p.id, p.count) for p in a.pairs] == \
+                   [(p.id, p.count) for p in b.pairs], pql
+        # the sparse residency is cached on device, not per-query
+        assert any(k[0] == "sparse" for k in sparse_ex.planes._entries)
+
+    def test_unfiltered_uses_host_cards(self, tmp_path, rng):
+        resident, sparse_ex = self._setup(tmp_path, rng)
+        (a,) = resident.execute("i", "TopN(f, n=20)")
+        (b,) = sparse_ex.execute("i", "TopN(f, n=20)")
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+        # no device representation needed for unfiltered TopN
+        assert not any(k[0] in ("sparse", "plane")
+                       for k in sparse_ex.planes._entries)
+
+    def test_streaming_when_sparse_over_budget(self, tmp_path, rng):
+        resident, _ = self._setup(tmp_path, rng)
+        holder = resident.holder
+        tiny = Executor(holder, plane_budget=16 << 10)  # < bits × 12
+        (a,) = resident.execute("i", "TopN(f, filter=Row(g=1), n=10)")
+        (b,) = tiny.execute("i", "TopN(f, filter=Row(g=1), n=10)")
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+
+    def test_sparse_invalidates_on_mutation(self, tmp_path, rng):
+        resident, sparse_ex = self._setup(tmp_path, rng)
+        pql = "TopN(f, filter=Row(g=1), n=5)"
+        sparse_ex.execute("i", pql)
+        # mutate: a column of g's row 1 gains an f bit in a fresh row
+        resident.execute("i", "Set(0, g=1) Set(0, f=499)")
+        (a,) = resident.execute("i", pql)
+        (b,) = sparse_ex.execute("i", pql)
+        assert [(p.id, p.count) for p in a.pairs] == \
+               [(p.id, p.count) for p in b.pairs]
+
+
 class TestReservedKeyScoping:
     def test_field_named_like_option(self, tmp_path):
         holder = Holder(str(tmp_path)).open()

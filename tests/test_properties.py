@@ -85,6 +85,54 @@ class TestCodecProperties:
         assert native.serialize(arr) == bytes(out)
 
 
+class TestSparseLayoutProperties:
+    """engine/sparse.py gather+segment-sum vs a numpy set oracle."""
+
+    bits = st.lists(
+        st.tuples(st.integers(0, 200),          # row
+                  st.integers(0, 3 * 4096)),    # column (small word range)
+        min_size=0, max_size=400, unique=True)
+    filt = st.lists(st.integers(0, 3 * 4096), max_size=200, unique=True)
+
+    @given(bits, filt)
+    @settings(max_examples=100, deadline=None)
+    def test_sparse_counts_vs_oracle(self, bits, filt):
+        from pilosa_tpu.engine import sparse as sparsek
+        from pilosa_tpu.engine.words import WORDS_PER_SHARD
+
+        rows = sorted({r for r, _ in bits})
+        slot = {r: i for i, r in enumerate(rows)}
+        n_rows = max(1, len(rows))
+        order = sorted(bits, key=lambda rc: (slot[rc[0]], rc[1]))
+        word_idx = np.array([c >> 5 for _, c in order], np.int32)
+        mask = np.array([1 << (c & 31) for _, c in order], np.uint32)
+        rowslot = np.array([slot[r] for r, _ in order], np.int32)
+        # pad rows AND bits to uneven sizes: padding must contribute 0
+        r_pad = n_rows + 3
+        row_ptr = np.searchsorted(
+            rowslot, np.arange(r_pad + 1, dtype=np.int64)).astype(np.int32)
+        pad = 7
+        word_idx = np.concatenate([word_idx, np.zeros(pad, np.int32)])
+        mask = np.concatenate([mask, np.zeros(pad, np.uint32)])
+
+        fw = np.zeros((1, WORDS_PER_SHARD), np.uint32)
+        for c in filt:
+            fw[0, c >> 5] |= np.uint32(1) << np.uint32(c & 31)
+
+        counts = np.asarray(sparsek.sparse_row_counts(
+            fw, word_idx, mask, row_ptr))
+        assert counts.shape == (r_pad,)
+        fset = set(filt)
+        for r in rows:
+            expect = len({c for rr, c in bits if rr == r} & fset)
+            assert counts[slot[r]] == expect, f"row {r}"
+        assert (counts[n_rows:] == 0).all()  # pad rows count 0
+        vals, slots = sparsek.topn_sparse(fw, word_idx, mask, row_ptr,
+                                          min(5, n_rows))
+        order_np = np.argsort(-counts, kind="stable")[: min(5, n_rows)]
+        assert list(np.asarray(vals)) == list(counts[order_np])
+
+
 class TestKernelProperties:
     @given(cols, cols)
     @settings(max_examples=100, deadline=None)

@@ -125,3 +125,9 @@ def test_http_negotiation_matches_json(served):
     _, raw = _post(url, "/index/i/query", b"Row(nope=1)",
                    {"Accept": proto.CONTENT_TYPE})
     assert "nope" in proto.decode_query_response(raw)["error"]
+
+    # ?profile has no proto representation: explicit 400, not silence
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        _post(url, "/index/i/query?profile=1", b"Count(Row(f=10))",
+              {"Accept": proto.CONTENT_TYPE})

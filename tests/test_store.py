@@ -189,6 +189,29 @@ class TestFragment:
         np.testing.assert_array_equal(h.row(7).columns(), row7)
         assert h.cardinality() == len(h.positions())
 
+    def test_auto_snapshot_keeps_lazy_rows_visible(self, tmp_path):
+        # compaction during serving must not lose snapshot-resident
+        # rows that were never materialized: after snapshot() the
+        # fragment re-opens the new blob as its lazy backing
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0, max_op_n=5).open()
+        f.set_bits(np.arange(50, dtype=np.uint64),
+                   np.arange(50, dtype=np.uint64))
+        f.close()
+
+        g = Fragment(path, 0, max_op_n=5).open()
+        assert len(g._snap_pending) == 50
+        for i in range(8):  # crosses max_op_n -> auto snapshot
+            g.set_bit(100 + i, 7)
+        assert g.op_n <= 5
+        assert g.cardinality() == 58
+        assert g.row(3).contains(3)          # pre-compaction lazy row
+        assert len(g.row_ids()) == 58
+        # and the new backing file is the merged truth
+        g.close()
+        h = Fragment(path, 0).open()
+        assert h.cardinality() == 58 and h.row(105).contains(7)
+
     def test_grouped_mutation_on_lazy_rows(self, tmp_path):
         # set_bits_grouped / clear_bits_grouped (the BSI import path)
         # must materialize snapshot-resident rows before mutating
