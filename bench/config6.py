@@ -34,14 +34,15 @@ def main():
     idx.field("f").import_bits(np.ones(n, np.uint64), cols)
     idx.field("g").import_bits(np.ones(n // 2, np.uint64), cols[: n // 2])
 
-    api = API(holder, Executor(holder))
+    # cross-request batcher: any number of HTTP clients funnel through
+    # ONE device stream (r1: the tunnel crashed at 16 raw concurrent
+    # streams; batched, 32 clients are safe and faster)
+    api = API(holder, Executor(holder, count_batch_window=0.004))
     server = Server(api, "127.0.0.1", 0).start()
     expect = n // 2
     pql = "Count(Intersect(Row(f=1), Row(g=1)))"
 
-    # 8 threads: the axon tunnel has crashed outright (C++ abort) at 16
-    # concurrent device streams; real hardware has no such limit
-    n_threads, reps = 8, 25
+    n_threads, reps = 32, 25
     clients = [Client("127.0.0.1", server.address[1])
                for _ in range(n_threads)]
     clients[0].query("bench", pql)  # warm compile
@@ -55,16 +56,22 @@ def main():
             if got != expect:
                 errors.append(got)
 
-    threads = [threading.Thread(target=worker, args=(c,)) for c in clients]
-    for t in threads:
-        t.start()
-    barrier.wait()
-    t0 = time.perf_counter()
-    for t in threads:
-        t.join()
-    dt = time.perf_counter() - t0
+    def run_burst():
+        ts = [threading.Thread(target=worker, args=(c,)) for c in clients]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        return time.perf_counter() - t0
+
+    warm = run_burst()  # batch-bucket program compiles land here
+    dt = run_burst()
     assert not errors, errors[:3]
     qps = n_threads * reps / dt
+    log(f"first burst incl. bucket compiles: "
+        f"{n_threads * reps / warm:,.1f} qps")
     platform = jax.devices()[0].platform
     log(f"e2e HTTP server ({platform}): {qps:,.1f} qps, "
         f"{n_threads} clients x {reps} Count(Intersect) @ 16M cols, "

@@ -85,6 +85,51 @@ class TestCodecProperties:
         assert native.serialize(arr) == bytes(out)
 
 
+class TestDirectoryProperties:
+    """roaring.Directory (the lazy mmap view) must agree with full
+    deserialization for every serializable bit set."""
+
+    @given(st.lists(st.tuples(st.integers(0, 300),
+                              st.integers(0, (1 << 20) - 1)),
+                    max_size=300, unique=True))
+    @settings(max_examples=100, deadline=None)
+    def test_directory_vs_deserialize(self, bits):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        positions = np.unique(np.array(
+            [r * SHARD_WIDTH + c for r, c in bits], np.uint64))
+        blob = roaring.serialize(positions)
+        d = roaring.Directory(memoryview(blob))
+        rows = {r for r, _ in bits}
+        assert set(map(int, d.row_ids())) == rows
+        ids, cards = d.row_cards()
+        assert cards.sum() == len(positions)
+        for r in rows:
+            expect = sorted(c for rr, c in bits if rr == r)
+            np.testing.assert_array_equal(d.expand_row(r), expect,
+                                          err_msg=f"row {r}")
+            assert d.row_cardinality(r) == len(expect)
+
+    @given(st.lists(st.tuples(st.integers(0, 50),
+                              st.integers(0, (1 << 20) - 1)),
+                    min_size=1, max_size=100, unique=True),
+           st.integers(1, 60))
+    @settings(max_examples=50, deadline=None)
+    def test_truncated_blob_rejected_at_open(self, bits, cut):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        positions = np.unique(np.array(
+            [r * SHARD_WIDTH + c for r, c in bits], np.uint64))
+        blob = roaring.serialize(positions)
+        cut = min(cut, len(blob) - 1)
+        try:
+            d = roaring.Directory(memoryview(blob[:len(blob) - cut]))
+        except ValueError:
+            return  # rejected at open: the desired outcome
+        # a shorter prefix may still contain a complete, valid
+        # directory whose containers all fit — then reads must not crash
+        for r in d.row_ids():
+            d.expand_row(int(r))
+
+
 class TestSparseLayoutProperties:
     """engine/sparse.py gather+segment-sum vs a numpy set oracle."""
 
