@@ -202,6 +202,10 @@ def _enc_result(r) -> bytes:
             out += _sub(5, sub)
         return out
     if isinstance(r, dict):
+        if "fields" in r:  # Extract: tabular, no proto representation
+            raise ValueError(
+                "Extract results are not representable in the protobuf "
+                "schema; request JSON")
         if "columns" in r or ("keys" in r and "rows" not in r
                               and "value" not in r and "values" not in r):
             sub = _packed(1, r.get("columns", []), _varint)

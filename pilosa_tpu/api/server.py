@@ -166,9 +166,15 @@ class Handler(BaseHTTPRequestHandler):
                            "application/x-protobuf responses")
         try:
             res = self.server.api.query(index, pql, shards=shards)
-            raw = proto.encode_query_response(res["results"])
         except ApiError as e:
             raw = proto.encode_query_response(err=str(e))
+        else:
+            try:
+                raw = proto.encode_query_response(res["results"])
+            except ValueError as e:  # result shape has no proto encoding
+                # a client error (asked for proto on an Extract), and
+                # answered IN proto so the caller can decode it
+                raw = proto.encode_query_response(err=str(e))
         self._reply(raw, content_type=proto.CONTENT_TYPE)
 
     def h_create_index(self, index: str) -> None:

@@ -35,6 +35,16 @@ def _call_of(call: Call) -> Call:
     return call.children[0] if call.name == "Options" and call.children else call
 
 
+def _nested_limit(call: Call, top: bool = True) -> bool:
+    eff = _call_of(call) if top else call
+    if eff.name == "Limit" and not top:
+        return True
+    if any(_nested_limit(c, False) for c in eff.children):
+        return True
+    return any(isinstance(v, Call) and _nested_limit(v, False)
+               for v in eff.args.values())
+
+
 def _strip_truncation(call: Call) -> Call:
     """Remove per-node truncation args (TopN n, Rows/GroupBy limit) from
     the fan-out sub-query — each node must return full partials or the
@@ -43,7 +53,7 @@ def _strip_truncation(call: Call) -> Call:
     count vectors instead)."""
     eff = _call_of(call)
     strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",),
-             "All": ("limit", "offset")}
+             "All": ("limit", "offset"), "Limit": ("limit", "offset")}
     keys = strip.get(eff.name) or ()
     extra = {}
     if eff.name == "TopN" and "tanimoto" in eff.args:
@@ -212,6 +222,28 @@ class DistributedExecutor:
     # -- reads --------------------------------------------------------------
 
     def _read(self, index: str, call: Call, shards: list[int] | None):
+        eff0 = _call_of(call)
+        if (eff0.name == "Extract" and eff0.children
+                and eff0.children[0].name == "Limit"):
+            # Extract(Limit(...), fields): resolve the Limit FIRST as a
+            # top-level distributed call (exact: paging on the merged
+            # ascending column list), then fan out the Extract with the
+            # resolved columns as a ConstRow literal
+            cols = self._read(index, eff0.children[0], shards)
+            sel = Call("ConstRow", {"columns": (cols.get("columns")
+                                                or cols.get("keys")
+                                                or [])})
+            call = Call("Extract", dict(eff0.args),
+                        [sel] + list(eff0.children[1:]))
+        if _nested_limit(call):
+            # per-node Limit then merge is NOT global Limit: column
+            # order crosses node boundaries.  Top-level Limit is exact
+            # (limit stripped from fan-out, applied on the merged list);
+            # Extract(Limit(...), ...) is rewritten above.
+            raise ExecutionError(
+                "Limit nested under another call is not supported in "
+                "cluster mode; apply Limit as the outermost call or as "
+                "Extract's filter")
         call = self._translate_input(index, call)
         if call.name == "Options" and call.args.get("shards") is not None:
             # Options(shards=[...]) overrides, as in single-node
@@ -365,10 +397,35 @@ class DistributedExecutor:
 
         return walk(call)
 
+    def _translate_extract(self, index: str, idx, merged):
+        """Edge translation for merged Extract results: column ids →
+        keys (keyed index), keyed fields' row values → keys."""
+        if idx.keys:
+            ids = [c.pop("column") for c in merged["columns"]]
+            for c, k in zip(merged["columns"],
+                            self.cluster.keys_of(index, None, ids)):
+                c["key"] = k
+        for fi, spec in enumerate(merged.get("fields", [])):
+            f = idx.field(spec["name"])
+            if f is None or not f.options.keys:
+                continue
+            for c in merged["columns"]:
+                v = c["rows"][fi]
+                if isinstance(v, list):
+                    c["rows"][fi] = self.cluster.keys_of(
+                        index, spec["name"], v)
+                elif v is not None and not isinstance(v, bool):
+                    c["rows"][fi] = self.cluster.keys_of(
+                        index, spec["name"], [v])[0]
+        return merged
+
     def _translate_output(self, index: str, call: Call, merged):
         idx = self.cluster.api.holder.index(index)
         if merged is None or idx is None:
             return merged
+        if isinstance(merged, dict) and call.name == "Extract" \
+                and "columns" in merged:
+            return self._translate_extract(index, idx, merged)
         if isinstance(merged, dict) and "columns" in merged and idx.keys:
             keys = self.cluster.keys_of(index, None, merged["columns"])
             return {"keys": keys}
@@ -408,11 +465,11 @@ def merge_results(call: Call, partials: list):
     if name in WRITE_CALLS or name == "IncludesColumn":
         return any(partials)
     if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
-                "Not", "All", "Shift", "UnionRows"):
+                "Not", "All", "Shift", "UnionRows", "ConstRow", "Limit"):
         cols = np.unique(np.concatenate(
             [np.asarray(p.get("columns", []), dtype=np.uint64)
              for p in partials]))
-        if name == "All":
+        if name in ("All", "Limit"):
             # paging applies to the MERGED list (per-node paging was
             # stripped from the fan-out)
             offset = int(call.args.get("offset", 0))
@@ -420,6 +477,19 @@ def merge_results(call: Call, partials: list):
             end = None if limit is None else offset + int(limit)
             cols = cols[offset:end]
         return {"columns": [int(c) for c in cols]}
+    if name == "Extract":
+        from pilosa_tpu.exec.executor import Executor
+        fields = partials[0].get("fields", []) if partials else []
+        cols = [c for p in partials for c in p.get("columns", [])]
+        if len(cols) > Executor.MAX_EXTRACT_COLUMNS:
+            # per-node caps pass individually; the merged result must
+            # honor the same memory bound
+            raise ExecutionError(
+                f"Extract: {len(cols)} columns across the cluster; cap "
+                f"is {Executor.MAX_EXTRACT_COLUMNS} — narrow the filter "
+                "or use Limit as Extract's filter")
+        cols.sort(key=lambda c: c.get("column", 0))
+        return {"fields": fields, "columns": cols}
     if name == "TopN":
         counts: dict[int, int] = {}
         if partials and isinstance(partials[0], dict) and "pairs" in partials[0]:

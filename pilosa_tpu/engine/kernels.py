@@ -142,6 +142,20 @@ def union_rows(plane: jax.Array, row_mask: jax.Array) -> jax.Array:
     )
 
 
+def column_bits(plane: jax.Array, word_idx: jax.Array,
+                bit_idx: jax.Array) -> jax.Array:
+    """Membership of k columns in every row: one gather per column word.
+
+    plane: uint32[S, R, W]; word_idx int32[k] (word of each column
+    within its shard), bit_idx uint32[k] -> uint32[S, R, k] 0/1.  The
+    device half of ``Extract`` (reference: v2 ``executeExtract``) — k
+    column probes against all rows in ONE program instead of a host
+    walk per (column, row).
+    """
+    g = plane[:, :, word_idx]
+    return (g >> bit_idx[None, None, :]) & jnp.uint32(1)
+
+
 def shift(words: jax.Array, n: int = 1) -> jax.Array:
     """Shift every bit's column position up by ``n`` within its shard
     (reference: v2 ``Shift(row, n)`` — bits crossing the shard boundary

@@ -26,9 +26,9 @@ from pilosa_tpu.engine import bsi as bsik
 from pilosa_tpu.engine import kernels
 from pilosa_tpu.engine.words import SHARD_WIDTH, WORDS_PER_SHARD, unpack_columns
 from pilosa_tpu.exec.planes import PAD_SHARD, PlaneCache
-from pilosa_tpu.exec.result import (FieldRow, GroupCount, GroupCountsResult,
-                                    Pair, PairsResult, RowIdsResult,
-                                    RowResult, ValCount)
+from pilosa_tpu.exec.result import (ExtractResult, FieldRow, GroupCount,
+                                    GroupCountsResult, Pair, PairsResult,
+                                    RowIdsResult, RowResult, ValCount)
 from pilosa_tpu.pql import parse
 from pilosa_tpu.pql.ast import BETWEEN_OPS, Call, Condition, Query
 from pilosa_tpu.store.field import BSI_TYPES, Field
@@ -72,7 +72,7 @@ def _field_arg(call: Call):
 
 _BITMAP_CALLS = frozenset({
     "Row", "Intersect", "Union", "Difference", "Xor", "Not", "All", "Range",
-    "Shift", "UnionRows",
+    "Shift", "UnionRows", "ConstRow", "Limit",
 })
 
 _SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
@@ -303,7 +303,64 @@ class Executor:
             # UnionRows(Rows(f)): OR of every row the Rows call selects
             # (reference: v2 executeUnionRows)
             return leaf(self._union_rows(ctx, call))
+        if name == "ConstRow":
+            return leaf(self._const_row(ctx, call))
+        if name == "Limit":
+            # order-based truncation needs a host column pass; keep it
+            # out of the fused program (the caller falls back to eager)
+            from pilosa_tpu.exec.fused import Unfusable
+            raise Unfusable("Limit is host-ordered")
         raise ExecutionError(f"not a bitmap call: {name}")
+
+    def _const_row(self, ctx: _Ctx, call: Call) -> jax.Array:
+        """ConstRow(columns=[...]): a literal bitmap (reference: v2
+        ``executeConstRow``).  Unknown keys resolve to nothing.
+        Columns whose shard is outside the queried shard set drop —
+        execution is per-shard over the index's shards, exactly as a
+        ConstRow column in a data-less shard drops upstream."""
+        cols = call.args.get("columns")
+        if cols is None:
+            raise ExecutionError("ConstRow: missing columns argument")
+        host = np.zeros((len(ctx.shards), WORDS_PER_SHARD), np.uint32)
+        shard_slot = {s: si for si, s in enumerate(ctx.shards)}
+        for c in cols:
+            cid = self._col_id(ctx, c, create=False)
+            if cid is None:
+                continue
+            si = shard_slot.get(cid // SHARD_WIDTH)
+            if si is None:
+                continue
+            off = cid % SHARD_WIDTH
+            host[si, off >> 5] |= np.uint32(1) << np.uint32(off & 31)
+        return self.planes.place(host)
+
+    def _limit_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
+        """Limit(bitmap, limit=, offset=): truncate the ascending column
+        list (reference: v2 ``executeLimitCall``) — inherently ordered,
+        so the column set round-trips through the host."""
+        if len(call.children) != 1:
+            raise ExecutionError("Limit: exactly one bitmap child required")
+        offset = int(call.args.get("offset", 0))
+        limit = call.args.get("limit")
+        if offset < 0 or (limit is not None and int(limit) < 0):
+            raise ExecutionError("Limit: limit/offset must be >= 0")
+        host = np.asarray(self._fused_bitmap(ctx, call.children[0]))
+        parts = [offs.astype(np.uint64) + np.uint64(s * SHARD_WIDTH)
+                 for _, s, offs in self._shard_offsets(ctx, host)]
+        all_cols = (np.concatenate(parts) if parts
+                    else np.empty(0, np.uint64))
+        end = None if limit is None else offset + int(limit)
+        sel = all_cols[offset:end]
+        out = np.zeros((len(ctx.shards), WORDS_PER_SHARD), np.uint32)
+        if len(sel):
+            shard_slot = {s: si for si, s in enumerate(ctx.shards)}
+            si_arr = np.array([shard_slot[int(c) // SHARD_WIDTH]
+                               for c in sel])
+            offs = (sel % np.uint64(SHARD_WIDTH)).astype(np.int64)
+            np.bitwise_or.at(
+                out, (si_arr, offs >> 5),
+                (np.uint32(1) << (offs & 31).astype(np.uint32)))
+        return self.planes.place(out)
 
     @staticmethod
     def _shift_n(call: Call) -> int:
@@ -447,6 +504,10 @@ class Executor:
                                  self._shift_n(call))
         if name == "UnionRows":
             return self._union_rows(ctx, call)
+        if name == "ConstRow":
+            return self._const_row(ctx, call)
+        if name == "Limit":
+            return self._limit_bitmap(ctx, call)
         raise ExecutionError(f"not a bitmap call: {name}")
 
     def _row_bitmap(self, ctx: _Ctx, call: Call) -> jax.Array:
@@ -595,15 +656,23 @@ class Executor:
     def _zeros(self, ctx: _Ctx) -> jax.Array:
         return self.planes.zeros(len(ctx.shards))
 
-    def _to_row_result(self, ctx: _Ctx, words: jax.Array) -> RowResult:
-        host = np.asarray(words)
-        parts = []
+    def _shard_offsets(self, ctx: _Ctx, host: np.ndarray):
+        """Unpack a host bitmap (n_shards, W) into non-empty per-shard
+        ascending column offsets: [(slot, shard, offsets uint)] — the one
+        owner of the words→columns idiom (RowResult/Limit/Extract)."""
+        out = []
         for si, s in enumerate(ctx.shards):
             if s == PAD_SHARD:
                 continue
-            cols = unpack_columns(host[si])
-            if len(cols):
-                parts.append(cols + np.uint64(s * SHARD_WIDTH))
+            offs = unpack_columns(host[si])
+            if len(offs):
+                out.append((si, s, offs))
+        return out
+
+    def _to_row_result(self, ctx: _Ctx, words: jax.Array) -> RowResult:
+        host = np.asarray(words)
+        parts = [offs.astype(np.uint64) + np.uint64(s * SHARD_WIDTH)
+                 for _, s, offs in self._shard_offsets(ctx, host)]
         columns = (np.concatenate(parts) if parts
                    else np.empty(0, np.uint64))
         if ctx.index.keys and ctx.translate_output:
@@ -923,6 +992,132 @@ class Executor:
                                 for r, c in zip(row_ids, vals)])
         return PairsResult([Pair(id=int(r), count=int(c))
                             for r, c in zip(row_ids, vals)])
+
+    # -- Extract ------------------------------------------------------------
+
+    # Extract materializes per-column values; wrap wide selections in
+    # Limit(...) — the cap keeps one call from expanding a billion rows
+    MAX_EXTRACT_COLUMNS = 100_000
+
+    def _execute_extract(self, ctx: _Ctx, call: Call) -> ExtractResult:
+        """Extract(bitmap, Rows(f), ...): per selected column, each
+        field's value(s) (reference: v2 ``executeExtract`` /
+        ``ExtractedTable``).  Set-like fields answer with ONE device
+        gather program (``kernels.column_bits``) over the resident
+        plane; BSI fields read per-column host values."""
+        if not call.children:
+            raise ExecutionError("Extract: bitmap filter child required")
+        flt, *field_calls = call.children
+        bad = [c.name for c in field_calls if c.name != "Rows"]
+        if bad:
+            raise ExecutionError(
+                f"Extract: field children must be Rows calls, got {bad}")
+        fields = []
+        for fc in field_calls:
+            fname = fc.args.get("_field") or fc.args.get("field")
+            if fname is None:
+                raise ExecutionError("Extract: Rows child missing field")
+            fields.append(self._field(ctx, str(fname)))
+
+        host = np.asarray(self._fused_bitmap(ctx, flt))
+        col_parts = self._shard_offsets(ctx, host)
+        columns = (np.concatenate(
+            [offs.astype(np.uint64) + np.uint64(s * SHARD_WIDTH)
+             for _, s, offs in col_parts])
+            if col_parts else np.empty(0, np.uint64))
+        if len(columns) > self.MAX_EXTRACT_COLUMNS:
+            raise ExecutionError(
+                f"Extract: {len(columns)} columns selected; cap is "
+                f"{self.MAX_EXTRACT_COLUMNS} — narrow the filter or wrap "
+                "it in Limit(...)")
+
+        per_field = [self._extract_field(ctx, f, col_parts, len(columns))
+                     for f in fields]
+        if ctx.index.keys and ctx.translate_output:
+            log = self.translate.columns(ctx.index.name)
+            col_out = log.keys_of(columns, strict=False)
+        else:
+            col_out = [int(c) for c in columns]
+        return ExtractResult(
+            field_specs=[(f.name, f.options.type) for f in fields],
+            columns=[(c, [vals[i] for vals in per_field])
+                     for i, c in enumerate(col_out)])
+
+    def _extract_field(self, ctx: _Ctx, field: Field, col_parts,
+                       n_cols: int) -> list:
+        """One field's value per selected column (list of length n_cols).
+        col_parts: [(si, shard, offsets ascending)]."""
+        opts = field.options
+        if opts.type in BSI_TYPES:
+            out = []
+            for _, s, offs in col_parts:
+                base = s * SHARD_WIDTH
+                for off in offs:
+                    v, ok = field.value(base + int(off))
+                    out.append(v if ok else None)
+            return out
+        out: list = [None] * n_cols
+        key_log = (self.translate.rows(ctx.index.name, field.name)
+                   if opts.keys and ctx.translate_output else None)
+        est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
+        if est > self.planes.budget:
+            # huge-cardinality field: per-column inverted check on host
+            # (generation-cached CSR scan) instead of a plane build
+            view = field.view(VIEW_STANDARD)
+            pos = 0
+            for _, s, offs in col_parts:
+                frag = view.fragment(s) if view is not None else None
+                for off in offs:
+                    rows = (frag.rows_containing(int(off))
+                            if frag is not None else np.empty(0, np.uint64))
+                    out[pos] = self._extract_cell(opts, key_log, rows)
+                    pos += 1
+            return out
+        # set-like: membership of each column in every row, one device
+        # gather program per shard plane
+        ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
+                                     ctx.shards)
+        pos = 0
+        for si, s, offs in col_parts:
+            k = len(offs)
+            if ps.n_rows == 0:
+                rows_by_col = [np.empty(0, np.int64)] * k
+            else:
+                # pow2-pad k: one compiled program per bucket, not per
+                # distinct selected-column count (the CountBatcher
+                # recompile-storm lesson)
+                k_pad = 1 << max(0, (k - 1).bit_length())
+                word_idx = np.zeros(k_pad, np.int32)
+                bit_idx = np.zeros(k_pad, np.uint32)
+                word_idx[:k] = (offs.astype(np.int64) >> 5)
+                bit_idx[:k] = (offs.astype(np.int64) & 31)
+                key = (("colbits", ps.plane.shape, k_pad), "extract")
+                fn = self.fused._cached(
+                    key, lambda: kernels.column_bits)
+                bits = np.asarray(fn(ps.plane[si:si + 1],
+                                     jnp.asarray(word_idx),
+                                     jnp.asarray(bit_idx)))[0]  # (R, k_pad)
+                rows_by_col = [ps.row_ids[np.nonzero(
+                    bits[:ps.n_rows, j])[0]] for j in range(k)]
+            for j in range(k):
+                out[pos] = self._extract_cell(opts, key_log,
+                                              rows_by_col[j])
+                pos += 1
+        return out
+
+    @staticmethod
+    def _extract_cell(opts, key_log, rows):
+        """One (column, field) cell from the column's member row ids."""
+        if opts.type == "bool":
+            return bool(rows[-1]) if len(rows) else None
+        if opts.type == "mutex":
+            if not len(rows):
+                return None
+            r = int(rows[0])
+            return key_log.key_of(r) if key_log else r
+        if key_log is not None:
+            return key_log.keys_of(rows, strict=False)
+        return [int(r) for r in rows]
 
     def _host_row_cards(self, ctx: _Ctx, field: Field):
         """Exact per-row cardinalities merged across shards from host

@@ -123,6 +123,25 @@ class GroupCountsResult:
         return [g.to_json() for g in self.groups]
 
 
+@dataclass
+class ExtractResult:
+    """``Extract()`` result (reference: v2 ``ExtractedTable`` — shape
+    reconstructed from memory of the upstream JSON surface): per
+    selected column, each requested field's value(s)."""
+
+    field_specs: list[tuple[str, str]]  # (name, type)
+    columns: list  # (column id | key, [per-field value])
+
+    def to_json(self):
+        return {
+            "fields": [{"name": n, "type": t} for n, t in self.field_specs],
+            "columns": [
+                ({"key": c, "rows": vals} if isinstance(c, str)
+                 else {"column": int(c), "rows": vals})
+                for c, vals in self.columns],
+        }
+
+
 def result_to_json(r):
     """Any handler result -> JSON-able value (bool/int pass through)."""
     if hasattr(r, "to_json"):

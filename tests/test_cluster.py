@@ -274,6 +274,76 @@ class TestReplicationAndFailover:
             assert after == 6
 
 
+class TestExtractLimitCluster:
+    def test_extract_distributed(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).create_field("i", "v", {"type": "int", "min": -100,
+                                            "max": 100})
+        far = 4 * SHARD_WIDTH + 2
+        c.client(0).import_bits("i", "f", rowIDs=[10, 20, 10],
+                                columnIDs=[1, 1, far])
+        c.client(0).import_values("i", "v", columnIDs=[1, far],
+                                  values=[-7, 33])
+        for cl in c.clients:
+            (r,) = cl.query(
+                "i", f"Extract(ConstRow(columns=[1, {far}, 99]),"
+                     "Rows(f), Rows(v))")
+            assert r["fields"] == [{"name": "f", "type": "set"},
+                                   {"name": "v", "type": "int"}]
+            assert r["columns"] == [
+                {"column": 1, "rows": [[10, 20], -7]},
+                {"column": 99, "rows": [[], None]},  # selected, no values
+                {"column": far, "rows": [[10], 33]},
+            ]
+
+    def test_extract_keyed_distributed(self, three_nodes):
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f", {"keys": True})
+        c.client(0).create_field("k", "m")  # unkeyed alongside
+        c.client(0).query("k", 'Set("alice", f="admin") '
+                               'Set("alice", f="dev") '
+                               'Set("bob", m=3)')
+        for cl in c.clients[:2]:
+            (r,) = cl.query(
+                "k", 'Extract(Union(Row(f="admin"), Row(m=3)),'
+                     'Rows(f), Rows(m))')
+            by_key = {c_["key"]: c_["rows"] for c_ in r["columns"]}
+            assert by_key["alice"] == [["admin", "dev"], []]
+            assert by_key["bob"] == [[], [3]]
+
+    def test_top_level_limit_distributed(self, three_nodes):
+        # limit/offset stripped from fan-out, applied on the merged
+        # ascending column list — exact across node boundaries
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        all_cols = sorted(set().union(*oracle.values()))
+        (r,) = c.client(1).query("i", "Limit(All(), limit=7, offset=3)")
+        assert r["columns"] == all_cols[3:10]
+
+    def test_nested_limit_rejected(self, three_nodes):
+        from pilosa_tpu.api.client import ClientError
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        c.client(0).query("i", "Set(1, f=10)")
+        with pytest.raises(ClientError, match="Limit nested"):
+            c.client(0).query("i", "Count(Limit(Row(f=10), limit=1))")
+
+    def test_extract_limit_filter_distributed(self, three_nodes):
+        # Extract(Limit(...)) rewrites to a resolved ConstRow fan-out:
+        # exact global paging, then per-node extraction
+        c = three_nodes
+        oracle = spread_bits(c.client(0))
+        all_cols = sorted(set().union(*oracle.values()))
+        (r,) = c.client(1).query(
+            "i", "Extract(Limit(All(), limit=3, offset=2), Rows(f))")
+        got = [c_["column"] for c_ in r["columns"]]
+        assert got == all_cols[2:5]
+
+
 class TestResizeAbort:
     def test_abort_stops_at_copy_boundary_and_retrigger_converges(
             self, tmp_path):

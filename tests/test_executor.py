@@ -640,6 +640,75 @@ class TestStreamingTopN:
                [(p.id, p.count) for p in b.pairs]
 
 
+class TestConstRowLimitExtract:
+    """v2 PQL parity: ConstRow / Limit / Extract."""
+
+    def test_constrow(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(5, f=10) Set(9, f=10)")
+        (r,) = q(ex, "ConstRow(columns=[1, 9, 77])")
+        np.testing.assert_array_equal(r.columns, [1, 9, 77])
+        (r,) = q(ex, "Intersect(Row(f=10), ConstRow(columns=[1, 9, 77]))")
+        np.testing.assert_array_equal(r.columns, [1, 9])
+        assert q(ex, "Count(ConstRow(columns=[]))") == [0]
+
+    def test_limit(self, env):
+        _, _, ex = env
+        c2 = SHARD_WIDTH + 3
+        q(ex, f"Set(1, f=10) Set(5, f=10) Set(9, f=10) Set({c2}, f=10)")
+        (r,) = q(ex, "Limit(Row(f=10), limit=2)")
+        np.testing.assert_array_equal(r.columns, [1, 5])
+        (r,) = q(ex, "Limit(Row(f=10), limit=2, offset=1)")
+        np.testing.assert_array_equal(r.columns, [5, 9])
+        (r,) = q(ex, "Limit(Row(f=10), offset=3)")  # crosses shards
+        np.testing.assert_array_equal(r.columns, [c2])
+        assert q(ex, "Count(Limit(Row(f=10), limit=3))") == [3]
+        with pytest.raises(ExecutionError):
+            q(ex, "Limit(Row(f=10), limit=-1)")
+
+    def test_extract(self, env):
+        holder, idx, ex = env
+        q(ex, "Set(1, f=10) Set(1, f=20) Set(2, f=10) Set(3, g=7)"
+              "Set(1, amount=-5) Set(3, amount=8)")
+        (r,) = q(ex, "Extract(ConstRow(columns=[1, 2, 3]),"
+                     "Rows(f), Rows(g), Rows(amount))")
+        assert r.field_specs == [("f", "set"), ("g", "set"),
+                                 ("amount", "int")]
+        assert r.columns == [
+            (1, [[10, 20], [], -5]),
+            (2, [[10], [], None]),
+            (3, [[], [7], 8]),
+        ]
+
+    def test_extract_with_limit_filter(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=10)")
+        (r,) = q(ex, "Extract(Limit(Row(f=10), limit=2), Rows(f))")
+        assert [c for c, _ in r.columns] == [1, 2]
+
+    def test_extract_column_cap(self, env, monkeypatch):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) Set(2, f=10) Set(3, f=10)")
+        monkeypatch.setattr(Executor, "MAX_EXTRACT_COLUMNS", 2)
+        with pytest.raises(ExecutionError):
+            q(ex, "Extract(Row(f=10), Rows(f))")
+
+    def test_extract_bool_and_mutex(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("b", FieldOptions(type="bool"))
+        idx.create_field("m", FieldOptions(type="mutex"))
+        ex = Executor(holder)
+        q(ex, "Set(1, b=true) Set(2, b=false) Set(1, m=5) Set(1, m=9)")
+        (r,) = q(ex, "Extract(ConstRow(columns=[1, 2, 4]),"
+                     "Rows(b), Rows(m))")
+        assert r.columns == [
+            (1, [True, 9]),   # mutex: last Set wins
+            (2, [False, None]),
+            (4, [None, None]),
+        ]
+
+
 class TestSparseTopN:
     """Container-blocked sparse residency (engine/sparse.py): fields too
     big for a dense plane stay device-resident as per-bit triplets; every

@@ -131,3 +131,10 @@ def test_http_negotiation_matches_json(served):
     with pytest.raises(urllib.error.HTTPError):
         _post(url, "/index/i/query?profile=1", b"Count(Row(f=10))",
               {"Accept": proto.CONTENT_TYPE})
+
+    # Extract is tabular — no proto encoding; the error arrives as a
+    # decodable proto QueryResponse.err, not a JSON body
+    _, raw = _post(url, "/index/i/query",
+                   b"Extract(ConstRow(columns=[1]), Rows(f))",
+                   {"Accept": proto.CONTENT_TYPE})
+    assert "not representable" in proto.decode_query_response(raw)["error"]
