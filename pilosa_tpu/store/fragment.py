@@ -253,6 +253,12 @@ class Fragment:
     # the per-row loop rather than hold a second copy of a huge field.
     COLINDEX_MAX_BITS = 64 << 20
 
+    # Lazy fragments with more pending snapshot rows than this answer
+    # rows_containing from a direct positions() scan instead of the
+    # colindex — building the cache would materialize millions of
+    # RowBits (the cache also caps itself by bits, COLINDEX_MAX_BITS).
+    COLINDEX_MAX_PENDING = 100_000
+
     def rows_containing(self, col: int) -> np.ndarray:
         """Sorted row IDs whose bit ``col`` is set — the ``Rows(column=)``
         membership check (reference: per-row ``row.Includes`` walk in
@@ -262,6 +268,11 @@ class Fragment:
         a Python ``contains()`` call per row — O(rows) interpreter work
         becomes O(bits) numpy work."""
         with self.lock:
+            if len(self._snap_pending) > self.COLINDEX_MAX_PENDING:
+                pos = self.positions()  # blob-composed, no materialize
+                rows = pos[pos % _SW == np.uint64(col)] // _SW
+                rows.sort()
+                return rows.astype(np.uint64)
             idx = self._colindex()
             if idx is None:  # over cap: per-row fallback
                 return np.array(sorted(
@@ -445,33 +456,28 @@ class Fragment:
         ``fragment.Blocks``, SURVEY.md §4.6)."""
         out: dict[int, int] = {}
         with self.lock:
-            self._materialize_all()
-            by_block: dict[int, list[tuple[int, RowBits]]] = {}
-            for r, b in self.rows.items():
-                if b.any():
-                    by_block.setdefault(r // HASH_BLOCK_SIZE, []).append((r, b))
-            for blk, members in by_block.items():
-                crc = 0
-                for r, b in sorted(members):
-                    pos = np.uint64(r) * _SW + b.columns().astype(np.uint64)
-                    crc = zlib.crc32(pos.astype("<u8").tobytes(), crc)
-                out[blk] = crc
+            # one vectorized pass over positions() (snapshot rows decode
+            # from the blob — no RowBits materialization, so AAE stays
+            # cheap on multi-million-row sparse fragments)
+            pos = self.positions()
+        if not len(pos):
+            return out
+        blocks = (pos // _SW // np.uint64(HASH_BLOCK_SIZE)).astype(np.int64)
+        uniq, starts = np.unique(blocks, return_index=True)
+        bounds = np.append(starts, len(pos))
+        data = pos.astype("<u8")
+        for i, blk in enumerate(uniq):
+            out[int(blk)] = zlib.crc32(
+                data[bounds[i]:bounds[i + 1]].tobytes())
         return out
 
     def block_positions(self, block: int) -> np.ndarray:
         """All positions of one checksum block (for AAE data exchange)."""
-        lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
+        lo = np.uint64(block * HASH_BLOCK_SIZE) * _SW
+        hi = np.uint64((block + 1) * HASH_BLOCK_SIZE) * _SW
         with self.lock:
-            for r in [r for r in self._snap_pending if lo <= r < hi]:
-                self._ensure_row(r)
-            parts = [
-                np.uint64(r) * _SW + b.columns().astype(np.uint64)
-                for r, b in sorted(self.rows.items())
-                if lo <= r < hi and b.any()
-            ]
-        if not parts:
-            return np.empty(0, dtype=np.uint64)
-        return np.concatenate(parts)
+            pos = self.positions()
+        return pos[(pos >= lo) & (pos < hi)]
 
     def merge_positions(self, positions: np.ndarray) -> int:
         """Union positions in (AAE repair receive path)."""

@@ -189,6 +189,29 @@ class TestFragment:
         np.testing.assert_array_equal(h.row(7).columns(), row7)
         assert h.cardinality() == len(h.positions())
 
+    def test_blocks_and_rows_containing_stay_lazy(self, tmp_path,
+                                                  monkeypatch, rng):
+        # AAE checksums + Rows(column=) on a lazy fragment must not
+        # materialize the row set; results equal the materialized truth
+        path = str(tmp_path / "0")
+        f = Fragment(path, 0).open()
+        n = 4000
+        rows = rng.integers(0, 500, size=n).astype(np.uint64)
+        cols = rng.integers(0, 1 << 14, size=n).astype(np.uint64)
+        f.set_bits(rows, cols)
+        truth_blocks = f.blocks()
+        probe = int(cols[0])
+        truth_rows = f.rows_containing(probe)
+        truth_bp = f.block_positions(2)
+        f.close()
+
+        g = Fragment(path, 0).open()
+        monkeypatch.setattr(Fragment, "COLINDEX_MAX_PENDING", 10)
+        assert g.blocks() == truth_blocks
+        np.testing.assert_array_equal(g.rows_containing(probe), truth_rows)
+        np.testing.assert_array_equal(g.block_positions(2), truth_bp)
+        assert not g.rows, "lazy reads must not materialize rows"
+
     def test_auto_snapshot_keeps_lazy_rows_visible(self, tmp_path):
         # compaction during serving must not lose snapshot-resident
         # rows that were never materialized: after snapshot() the
