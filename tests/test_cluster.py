@@ -964,6 +964,14 @@ class TestClusterSingleNodeEquivalence:
                 "Distinct(field=amount)",
                 "Percentile(field=amount, nth=50)",
                 "GroupBy(Rows(f))",
+                # round-2 surface
+                "TopN(f, filter=Row(f=1), tanimoto=10)",
+                "GroupBy(Rows(f), aggregate=Min(field=amount))",
+                "GroupBy(Rows(f), aggregate=Max(field=amount))",
+                "GroupBy(Rows(f), aggregate=Count())",
+                f"ConstRow(columns=[3, {SHARD_WIDTH + 7}, 99])",
+                "Limit(Row(f=1), limit=5, offset=2)",
+                "Extract(Limit(All(), limit=6), Rows(f), Rows(amount))",
             ]
             for pql in queries:
                 (a,) = solo.query("i", pql)["results"]
