@@ -39,6 +39,36 @@ def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+@jax.jit
+def _scatter_cells(plane, cell_rows, cell_words, cell_vals, reset_rows,
+                   reset_vals):
+    flat = plane.reshape(-1, plane.shape[-1])
+    flat = flat.at[reset_rows].set(reset_vals, mode="drop")
+    flat = flat.at[cell_rows, cell_words].set(cell_vals, mode="drop")
+    return flat.reshape(plane.shape)
+
+
+def _apply_plane_cells(plane, cell_rows, cell_words, cell_vals,
+                       reset_rows, reset_vals):
+    """Scatter changed cells / whole rows into a resident device plane.
+    Index arrays pow2-pad with out-of-range values (``mode="drop"``) so
+    the compiled program set stays bounded per plane shape."""
+    total = plane.shape[0] * plane.shape[1]
+    w = plane.shape[-1]
+    n1, n2 = _pow2(len(cell_rows)), _pow2(len(reset_rows))
+    cr = np.full(n1, total, np.int32)
+    cw = np.zeros(n1, np.int32)
+    cv = np.zeros(n1, np.uint32)
+    cr[:len(cell_rows)] = cell_rows
+    cw[:len(cell_words)] = cell_words
+    cv[:len(cell_vals)] = cell_vals
+    rr = np.full(n2, total, np.int32)
+    rv = np.zeros((n2, w), np.uint32)
+    rr[:len(reset_rows)] = reset_rows
+    rv[:len(reset_vals)] = reset_vals
+    return _scatter_cells(plane, cr, cw, cv, rr, rv)
+
+
 def merge_row_cards(frags) -> tuple[np.ndarray, np.ndarray]:
     """Merge per-fragment (row_ids, cardinalities) across shards:
     (uint64[R] sorted ids, int64[R] summed cards).  Shared by the sparse
@@ -102,6 +132,7 @@ class PlaneCache:
         self._zeros: dict[int, jax.Array] = {}
         self._bytes = 0
         self._lock = threading.RLock()
+        self.incremental_applied = 0  # delta-scatter refreshes (stats)
 
     # -- public -------------------------------------------------------------
 
@@ -336,6 +367,10 @@ class PlaneCache:
             if hit is not None and hit[0] == gens:
                 self._entries.move_to_end(key)
                 return hit[1]
+        if hit is not None and key[0] in ("plane", "bsi", "rows", "row"):
+            ps = self._incremental(key, field, view_name, shards, hit)
+            if ps is not None:
+                return ps
         ps = build(field, view_name, shards)
         nbytes = getattr(ps, "nbytes", None)
         if nbytes is None:
@@ -351,6 +386,102 @@ class PlaneCache:
                     _, (_, _, old_bytes) = self._entries.popitem(last=False)
                     self._bytes -= old_bytes
         return ps
+
+    # Incremental cap: beyond this many changed (row, word) cells a
+    # full rebuild is cheaper than the scatter
+    MAX_INCR_CELLS = 4096
+
+    def _incremental(self, key, field: Field, view_name: str,
+                     shards: tuple[int, ...], hit):
+        """Refresh a cached device plane IN PLACE from fragments'
+        mutation journals instead of rebuilding + re-uploading — the
+        device half of SURVEY.md §4.5 ingest (host delta queues →
+        device scatter).  Returns the refreshed PlaneSet, or None when
+        the journal can't cover the gap (fall back to rebuild)."""
+        old_gens, ps, nbytes = hit
+        kind = key[0]
+        view = field.view(view_name)
+        if view is None or len(old_gens) != len(shards):
+            # no view yet, or the entry was cached before the view
+            # existed (_gens returns () then): rebuild
+            return None
+        if kind == "row":
+            the_row = key[4]
+        r_pad = 1 if kind == "row" else ps.plane.shape[1]
+        cell_rows, cell_words, cell_vals = [], [], []
+        reset_rows, reset_vals = [], []
+        actual = list(old_gens)
+        for si, s in enumerate(shards):
+            if s == PAD_SHARD:
+                continue
+            frag = view.fragment(s)
+            if frag is None:
+                if old_gens[si] != -1:
+                    return None  # fragment vanished: rebuild
+                continue
+            with frag.lock:
+                if old_gens[si] == -1:
+                    return None  # new fragment: row set unknown
+                if frag.generation == old_gens[si]:
+                    continue
+                cells = frag.changed_cells_since(old_gens[si])
+                if cells is None:
+                    return None
+                for r, words in cells.items():
+                    # running cap: don't assemble millions of cells
+                    # only to discard them
+                    if (len(cell_rows) + 64 * len(reset_rows)
+                            > self.MAX_INCR_CELLS):
+                        return None
+                    if kind == "plane":
+                        slot = ps.slot_of.get(int(r))
+                        if slot is None:
+                            return None  # new row: shape/row set changed
+                    elif kind == "bsi":
+                        if r >= r_pad:
+                            return None  # bit depth grew
+                        slot = int(r)
+                    elif kind == "rows":
+                        slot = ps.slot_of.get(int(r))
+                        if slot is None:
+                            continue  # outside the selection
+                    else:  # "row"
+                        if int(r) != the_row:
+                            continue
+                        slot = 0
+                        words = None  # refresh the whole single row
+                    flat = si * r_pad + slot
+                    row_words = frag.row(int(r)).words()
+                    if words is None:
+                        reset_rows.append(flat)
+                        reset_vals.append(np.array(row_words, np.uint32))
+                    else:
+                        w_arr = np.fromiter(words, np.int64, len(words))
+                        cell_rows.extend([flat] * len(w_arr))
+                        cell_words.extend(int(w) for w in w_arr)
+                        cell_vals.extend(
+                            np.asarray(row_words)[w_arr].tolist())
+                actual[si] = frag.generation
+        n_cells = len(cell_rows) + 64 * len(reset_rows)
+        if n_cells > self.MAX_INCR_CELLS:
+            return None
+        new_plane = _apply_plane_cells(
+            ps.plane if kind != "row" else ps.plane[:, None, :],
+            np.asarray(cell_rows, np.int32), np.asarray(cell_words, np.int32),
+            np.asarray(cell_vals, np.uint32),
+            np.asarray(reset_rows, np.int32),
+            (np.stack(reset_vals) if reset_vals
+             else np.zeros((0, ps.plane.shape[-1]), np.uint32)))
+        if kind == "row":
+            new_plane = new_plane[:, 0, :]
+        new_ps = PlaneSet(new_plane, ps.shards, ps.row_ids, ps.slot_of)
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is not None and cur[1] is ps:  # not replaced meanwhile
+                self._entries[key] = (tuple(actual), new_ps, nbytes)
+                self._entries.move_to_end(key)
+        self.incremental_applied += 1
+        return new_ps
 
     def _build_plane(self, field: Field, view_name: str,
                      shards: tuple[int, ...]) -> PlaneSet:

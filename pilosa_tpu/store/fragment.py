@@ -62,6 +62,17 @@ class Fragment:
         self._snap_mm = None
         self._snap_dir: roaring.Directory | None = None
         self._snap_pending: set[int] = set()
+        # recent-mutation journal for incremental device-plane updates
+        # (exec.planes): (generation_after, {row: word_idx set | None}),
+        # None = whole row changed.  Bounded; a gap means "rebuild".
+        from collections import deque
+        self._recent: deque = deque(maxlen=self.RECENT_MAX)
+
+    # journal bounds: entries beyond RECENT_MAX or ops touching more
+    # cells than RECENT_CELL_CAP evict history (planes falls back to a
+    # full rebuild — bulk imports SHOULD rebuild)
+    RECENT_MAX = 128
+    RECENT_CELL_CAP = 8192
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -365,6 +376,7 @@ class Fragment:
         with self.lock:
             changed = 0
             parts = []
+            delta: dict = {}
             for row_id, cols in groups:
                 cols = np.asarray(cols, dtype=np.uint32)
                 if len(cols) == 0:
@@ -381,9 +393,14 @@ class Fragment:
                     if row is None:
                         row = self.rows[int(row_id)] = RowBits()
                     changed += row.add(cols)
+                words = np.unique(cols >> np.uint32(5))
+                prev = delta.get(int(row_id))
+                delta[int(row_id)] = (words if prev is None
+                                      else np.union1d(prev, words))
                 parts.append(np.uint64(row_id) * _SW + cols.astype(np.uint64))
             if changed:
                 self.generation += 1
+                self._note_delta(delta)
                 self._log(op, 0, np.concatenate(parts))
             return changed
 
@@ -489,10 +506,49 @@ class Fragment:
 
     # -- internal -----------------------------------------------------------
 
+    def _note_delta(self, rows_words: dict) -> None:
+        """Journal one mutation's touched cells for incremental device
+        updates: {row: unique word idxs | None = whole row}."""
+        cells = sum(64 if v is None else len(v)
+                    for v in rows_words.values())
+        if cells > self.RECENT_CELL_CAP:
+            self._recent.clear()
+            self._recent.append((self.generation, None))  # gap marker
+        else:
+            self._recent.append((self.generation, rows_words))
+
+    def changed_cells_since(self, gen: int):
+        """Merged {row: word idx set | None} covering generations
+        (gen, current], or None if the journal has gaps (caller must
+        rebuild).  ``{}`` when nothing changed."""
+        with self.lock:
+            if gen == self.generation:
+                return {}
+            if gen > self.generation:
+                # cached gens AHEAD of this fragment: it was replaced
+                # (e.g. field dropped+recreated) — force a rebuild
+                return None
+            entries = [(g, rw) for g, rw in self._recent if g > gen]
+            if [g for g, _ in entries] != list(range(gen + 1,
+                                                     self.generation + 1)):
+                return None
+            merged: dict = {}
+            for _, rw in entries:
+                if rw is None:
+                    return None  # oversized op: rebuild
+                for r, words in rw.items():
+                    if words is None or merged.get(r, 0) is None:
+                        merged[r] = None
+                    else:
+                        merged.setdefault(r, set()).update(
+                            int(w) for w in words)
+            return merged
+
     def _apply(self, op: int, aux: int, positions: np.ndarray | None) -> int:
         """Apply an op to memory; returns bits changed.  Shared by the
         mutation API and op-log replay."""
         changed = 0
+        delta: dict = {}
         if op == OP_CLEAR_ROW:
             if aux in self._snap_pending:
                 # whole row drops: count from the directory, never expand
@@ -502,6 +558,7 @@ class Fragment:
             if row is not None and row.any():
                 changed += row.cardinality
             self.rows.pop(aux, None)
+            delta[aux] = None
         elif op == OP_SET_ROW:
             if aux in self._snap_pending:
                 changed += self._snap_dir.row_cardinality(aux)
@@ -509,12 +566,14 @@ class Fragment:
             old = self.rows.pop(aux, None)
             if old is not None and old.any():
                 changed += old.cardinality
+            delta[aux] = None
             if positions is not None and len(positions):
                 self._check_rows(positions)
                 for r, chunk in _split_by_row(positions):
                     self._snap_pending.discard(r)
                     row = self.rows[r] = RowBits()
                     changed += row.add(chunk)
+                    delta[r] = None
         elif op in (OP_SET_BITS, OP_CLEAR_BITS):
             assert positions is not None
             self._check_rows(positions)
@@ -531,10 +590,12 @@ class Fragment:
                         changed += row.remove(chunk)
                         if not row.any():
                             del self.rows[r]
+                delta[r] = np.unique(chunk >> np.uint32(5))
         else:
             raise ValueError(f"fragment: unknown op {op}")
         if changed:
             self.generation += 1
+            self._note_delta(delta)
         return changed
 
     def _check_rows(self, positions: np.ndarray) -> None:
