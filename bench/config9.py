@@ -1,0 +1,67 @@
+"""Config #9 (extra): serving under writes — query latency right after a
+mutation, with the device plane resident.
+
+Round 1 invalidated the whole cached plane on ANY write: the next query
+paid a full host rebuild + HBM re-upload (tens of seconds at 800MB).
+Round 2 scatters just the changed (row, word) cells from the fragment's
+mutation journal into the resident plane (planes._incremental), so the
+post-write query costs one small scatter + the query itself."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import emit, log
+
+
+def main():
+    import tempfile
+
+    import jax
+
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    rng = np.random.default_rng(9)
+    holder = Holder(tempfile.mkdtemp()).open()
+    idx = holder.create_index("i", track_existence=False)
+    idx.create_field("f")
+    n, n_shards = 2_000_000, 96
+    rows = rng.integers(0, 64, n).astype(np.uint64)
+    cols = rng.choice(n_shards << 20, n, replace=False).astype(np.uint64)
+    idx.field("f").import_bits(rows, cols)  # 96 shards × 64 rows ≈ 800MB
+    idx.note_columns(cols)
+    ex = Executor(holder)
+    platform = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    ex.execute("i", "TopN(f, n=3)")
+    t_build = time.perf_counter() - t0
+    log(f"first TopN (build + upload + compile): {t_build:.1f}s")
+
+    # steady state: mutate + query, plane refreshed by delta scatter
+    ex.execute("i", "Set(1, f=5)")
+    ex.execute("i", "TopN(f, n=3)")  # warm the scatter program
+    lats = []
+    for i in range(10):
+        t0 = time.perf_counter()
+        ex.execute("i", f"Set({i * 7 + 2}, f={int(rng.integers(0, 64))})")
+        (p,) = ex.execute("i", "TopN(f, n=3)")
+        lats.append(time.perf_counter() - t0)
+    p50 = float(np.median(lats))
+    assert ex.planes.incremental_applied >= 10
+    fresh = Executor(holder)
+    assert [(x.id, x.count) for x in p.pairs] == \
+           [(x.id, x.count)
+            for x in fresh.execute("i", "TopN(f, n=3)")[0].pairs]
+    log(f"write+query p50 with resident plane: {p50 * 1e3:.0f} ms "
+        f"(r1 behavior = full rebuild ≈ {t_build:.1f}s per write)")
+    emit(f"write_then_query_p50_ms_800mb_plane_{platform}", p50 * 1e3,
+         "ms", t_build / p50)
+
+
+if __name__ == "__main__":
+    main()

@@ -86,12 +86,14 @@ class FieldOptions:
 
 class Field:
     def __init__(self, path: str, index_name: str, name: str,
-                 options: FieldOptions | None = None, *, fsync: bool = False):
+                 options: FieldOptions | None = None, *, fsync: bool = False,
+                 snapshot_submit=None):
         self.path = path
         self.index_name = index_name
         self.name = name
         self.options = options or FieldOptions()
         self.fsync = fsync
+        self.snapshot_submit = snapshot_submit
         self.views: dict[str, View] = {}
         self._row_attrs = None
         self._lock = threading.RLock()
@@ -106,7 +108,9 @@ class Field:
         views_dir = os.path.join(self.path, "views")
         if os.path.isdir(views_dir):
             for name in os.listdir(views_dir):
-                v = View(os.path.join(views_dir, name), name, fsync=self.fsync)
+                v = View(os.path.join(views_dir, name), name,
+                         fsync=self.fsync,
+                         snapshot_submit=self.snapshot_submit)
                 self.views[name] = v.open()
         return self
 
@@ -142,7 +146,8 @@ class Field:
             v = self.views.get(name)
             if v is None and create:
                 v = View(os.path.join(self.path, "views", name), name,
-                         fsync=self.fsync).open()
+                         fsync=self.fsync,
+                         snapshot_submit=self.snapshot_submit).open()
                 self.views[name] = v
             return v
 

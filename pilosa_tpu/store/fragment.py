@@ -45,10 +45,14 @@ class Fragment:
     """Bits of one (field, view, shard)."""
 
     def __init__(self, path: str, shard: int, *, max_op_n: int = MAX_OP_N,
-                 fsync: bool = False):
+                 fsync: bool = False, snapshot_submit=None):
         self.path = path                      # snapshot file
         self.shard = shard
         self.max_op_n = max_op_n
+        # when set, op-log compaction is handed to a background queue
+        # (reference: the fragment snapshot queue in holder.go) instead
+        # of running inline on the write path
+        self._snapshot_submit = snapshot_submit
         self.rows: dict[int, RowBits] = {}    # materialized/overlay rows
         self.op_n = 0
         self.generation = 0                   # bumped per mutation; device
@@ -460,8 +464,15 @@ class Fragment:
             os.replace(tmp, self.path)
             self._drop_snapshot()
             self.rows = {}
-            if os.path.getsize(self.path) > 0:
-                self._open_snapshot()
+            try:
+                if os.path.getsize(self.path) > 0:
+                    self._open_snapshot()
+            except Exception:
+                # mmap/fd failure must not leave the fragment EMPTY in
+                # memory (a later compaction would persist that empty
+                # state over the good file): fall back to eager load
+                # from the blob just written
+                self._load_positions(roaring.deserialize(blob))
             self._oplog.truncate()
             self.op_n = 0
 
@@ -606,7 +617,18 @@ class Fragment:
         self._oplog.append(op, aux, positions)
         self.op_n += 1
         if self.op_n > self.max_op_n:
-            self.snapshot()
+            if self._snapshot_submit is not None:
+                self._snapshot_submit(self)  # background compaction
+            else:
+                self.snapshot()
+
+    def maybe_snapshot(self) -> None:
+        """Background-queue entry point: compact only if still OVER the
+        threshold — a dedup race can enqueue a fragment twice, and the
+        duplicate must not re-serialize a huge fragment for one op."""
+        with self.lock:
+            if self._open and self.op_n > self.max_op_n:
+                self.snapshot()
 
     def _load_positions(self, positions: np.ndarray) -> None:
         for r, cols in _split_by_row(positions):

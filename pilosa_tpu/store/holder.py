@@ -21,12 +21,77 @@ from pilosa_tpu.store.field import FieldOptions
 from pilosa_tpu.store.index import Index
 
 
+class SnapshotQueue:
+    """Background op-log compaction (reference: the fragment snapshot
+    queue in ``holder.go``): the write path hands over-threshold
+    fragments here instead of paying serialize+fsync inline.  Dedupes
+    by fragment identity; the worker starts lazily on first submit."""
+
+    def __init__(self):
+        self._pending: list = []
+        self._inq: set[int] = set()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def submit(self, frag) -> None:
+        with self._cv:
+            if not self._stop:
+                if id(frag) in self._inq:
+                    return
+                self._inq.add(id(frag))
+                self._pending.append(frag)
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._loop, name="pilosa-snapshot",
+                        daemon=True)
+                    self._thread.start()
+                self._cv.notify()
+                return
+        frag.maybe_snapshot()  # queue closed: old inline behavior
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._pending:
+                    return
+                frag = self._pending.pop(0)
+                self._inq.discard(id(frag))
+            try:
+                frag.maybe_snapshot()
+            except Exception:  # noqa: BLE001 — a failed compaction only
+                # defers (the op-log remains the truth), but never
+                # silently: disk-full here would otherwise loop forever
+                import logging
+                logging.getLogger("pilosa_tpu.store").exception(
+                    "background snapshot failed for %s", frag.path)
+
+    def close(self) -> None:
+        """Stop accepting work and drop the backlog — fragments compact
+        themselves on close anyway."""
+        with self._cv:
+            self._stop = True
+            self._pending.clear()
+            self._inq.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
 class Holder:
-    def __init__(self, path: str, *, fsync: bool = False):
+    def __init__(self, path: str, *, fsync: bool = False,
+                 async_snapshots: bool = True):
         self.path = path
         self.fsync = fsync
         self.indexes: dict[str, Index] = {}
         self._lock = threading.RLock()
+        self._snap_queue = SnapshotQueue() if async_snapshots else None
+
+    @property
+    def _submit(self):
+        return self._snap_queue.submit if self._snap_queue else None
 
     def open(self) -> "Holder":
         """Scan and open the whole tree; indexes open concurrently
@@ -40,18 +105,22 @@ class Holder:
             for entry in entries:
                 self.indexes[entry] = Index(
                     os.path.join(self.path, entry), entry,
-                    fsync=self.fsync).open()
+                    fsync=self.fsync, snapshot_submit=self._submit).open()
             return self
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=min(8, len(entries))) as pool:
             opened = pool.map(
                 lambda e: (e, Index(os.path.join(self.path, e), e,
-                                    fsync=self.fsync).open()), entries)
+                                    fsync=self.fsync,
+                                    snapshot_submit=self._submit).open()),
+                entries)
             for entry, idx in opened:
                 self.indexes[entry] = idx
         return self
 
     def close(self) -> None:
+        if self._snap_queue is not None:
+            self._snap_queue.close()
         with self._lock:
             for idx in self.indexes.values():
                 idx.close()
@@ -69,7 +138,8 @@ class Holder:
             _validate_name(name)
             idx = Index(os.path.join(self.path, name), name, keys=keys,
                         track_existence=track_existence, fsync=self.fsync,
-                        created_at=created_at or time.time())
+                        created_at=created_at or time.time(),
+                        snapshot_submit=self._submit)
             os.makedirs(idx.path, exist_ok=True)
             idx.save_meta()
             idx.open()

@@ -23,13 +23,14 @@ EXISTENCE_FIELD = "_exists"
 class Index:
     def __init__(self, path: str, name: str, *, keys: bool = False,
                  track_existence: bool = True, fsync: bool = False,
-                 created_at: float = 0.0):
+                 created_at: float = 0.0, snapshot_submit=None):
         self.path = path
         self.name = name
         self.keys = keys
         self.track_existence = track_existence
         self.created_at = created_at
         self.fsync = fsync
+        self.snapshot_submit = snapshot_submit
         self.fields: dict[str, Field] = {}
         self._column_attrs = None
         self._lock = threading.RLock()
@@ -47,8 +48,9 @@ class Index:
         for entry in sorted(os.listdir(self.path)) if os.path.isdir(self.path) else []:
             fpath = os.path.join(self.path, entry)
             if os.path.isdir(fpath) and not entry.startswith("."):
-                self.fields[entry] = Field(fpath, self.name, entry,
-                                           fsync=self.fsync).open()
+                self.fields[entry] = Field(
+                    fpath, self.name, entry, fsync=self.fsync,
+                    snapshot_submit=self.snapshot_submit).open()
         if self.track_existence and EXISTENCE_FIELD not in self.fields:
             self._create_existence()
         return self
@@ -80,7 +82,8 @@ class Index:
             if not options.created_at:
                 options.created_at = time.time()
             f = Field(os.path.join(self.path, name), self.name, name,
-                      options, fsync=self.fsync)
+                      options, fsync=self.fsync,
+                      snapshot_submit=self.snapshot_submit)
             os.makedirs(f.path, exist_ok=True)
             f.save_meta()
             self.fields[name] = f

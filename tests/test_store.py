@@ -290,6 +290,45 @@ class TestFragment:
         g.close()
 
 
+class TestSnapshotQueue:
+    def test_background_compaction(self, tmp_path):
+        import time
+
+        from pilosa_tpu.store.holder import SnapshotQueue
+        q = SnapshotQueue()
+        f = Fragment(str(tmp_path / "0"), 0, max_op_n=10,
+                     snapshot_submit=q.submit).open()
+        for i in range(25):
+            f.set_bit(0, i)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and f.op_n > 10:
+            time.sleep(0.02)
+        assert f.op_n <= 10, "background queue never compacted"
+        assert os.path.exists(str(tmp_path / "0"))
+        assert f.cardinality() == 25
+        q.close()
+        # queue closed: the write path falls back to inline compaction
+        for i in range(25, 45):
+            f.set_bit(0, i)
+        assert f.op_n <= 10
+        f.close()
+        g = Fragment(str(tmp_path / "0"), 0).open()
+        assert g.cardinality() == 45
+
+    def test_holder_wires_the_queue(self, tmp_path):
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i", track_existence=False)
+        f = idx.create_field("f")
+        frag = f.view("standard", create=True).fragment(0, create=True)
+        assert frag._snapshot_submit is not None
+        h.close()
+        h2 = Holder(str(tmp_path), async_snapshots=False).open()
+        frag2 = (h2.index("i").field("f").view("standard", create=True)
+                 .fragment(0, create=True))
+        assert frag2._snapshot_submit is None
+        h2.close()
+
+
 class TestOpLog:
     def test_crc_rejects_corruption(self, tmp_path):
         path = str(tmp_path / "log")
