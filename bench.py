@@ -1,9 +1,23 @@
-"""Headline benchmark: Count(Row) throughput on a 1B-column index.
+"""Headline benchmark: Count(Row) throughput on a 1B-column index,
+measured THROUGH THE PRODUCT PATH (real on-disk index -> Holder ->
+Executor -> fused count batch -> API), with the raw-kernel roofline
+alongside for the breakdown.
 
 BASELINE.json north star: ">=10x CPU QPS on Intersect+Count at 1B
-columns".  1B columns = 954 shards x 2^20; a 64-row field plane is
-resident in HBM and one fused XLA program answers 64 Count queries (the
+columns".  1B columns = 954 shards x 2^20; a 32-row field plane is
+resident in HBM and one fused XLA program answers 32 Count queries (the
 per-row popcount matrix reduced over shards) with a single host read.
+
+Two measurement tiers, same data, same concurrency:
+
+- **raw kernel**: jitted count over an in-memory device plane — the
+  device ceiling.
+- **product**: the index is written to disk as real roaring fragment
+  snapshot files, opened through Holder (mmap + directory parse),
+  served via ``API.query`` running 32-Count PQL requests through the
+  executor's fused count-batch (one program + one read per request),
+  every response verified against the numpy oracle.  A REST variant
+  (HTTP server, JSON) is timed for the wire overhead figure.
 
 Measurement honesty (determined empirically on this image's axon
 tunnel): the tunnel imposes a fixed ~100ms RPC cost on EVERY
@@ -25,7 +39,11 @@ Prints exactly ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import sys
+import tempfile
+import threading
 import time
 
 import numpy as np
@@ -34,6 +52,9 @@ N_SHARDS = 954  # ceil(1e9 / 2^20) -> 1.0003e9 columns
 N_ROWS = 32     # queries per dispatch (4GB plane: the tunnel's transfer
                 # and read-RPC costs vary run to run; keep total bounded)
 WORDS = 32768
+
+INDEX = "bench"
+FIELD = "f"
 
 
 def log(*a):
@@ -52,23 +73,65 @@ def plane_bitcount(plane: np.ndarray) -> np.ndarray:
     return np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
 
 
-def main() -> None:
+def median_serve(run_once, label: str, max_runs: int = 5,
+                 min_runs: int = 3, budget_s: float = 180.0):
+    """Median-of-N burst qps: the tunnel's throughput wanders run to run
+    (r2 saw +-36% on one shot), so one JSON line must not be a dice
+    roll.  Every individual run goes to stderr."""
+    runs: list[float] = []
+    deadline = time.monotonic() + budget_s
+    for rep in range(max_runs):
+        qps = run_once()
+        if qps is not None:
+            runs.append(qps)
+            log(f"{label} run {rep + 1}: {qps:,.1f} qps")
+        if time.monotonic() > deadline and len(runs) >= min_runs:
+            break
+    if not runs:
+        return None, []
+    return float(np.median(runs)), runs
+
+
+def concurrent_burst(fn_verify, n_threads: int, iters: int,
+                     queries_per_call: int):
+    """Run ``fn_verify()`` (one batched dispatch + oracle check) from
+    ``n_threads`` concurrent clients; returns qps or None on error."""
+    barrier = threading.Barrier(n_threads + 1)
+    errors: list[str] = []
+
+    def worker():
+        barrier.wait()
+        for _ in range(iters):
+            try:
+                fn_verify()
+            except Exception as e:  # noqa: BLE001 — surface after join
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        log(f"burst errors: {errors[:3]}")
+        return None
+    return queries_per_call * iters * n_threads / dt
+
+
+# ---------------------------------------------------------------------------
+# tier 1: raw kernel (device ceiling)
+# ---------------------------------------------------------------------------
+
+
+def raw_kernel_tier(plane: np.ndarray, oracle: np.ndarray):
     import jax
+    import jax.numpy as jnp
 
     from pilosa_tpu.engine import kernels
-
-    rng = np.random.default_rng(42)
-    # ~25% density rows over 1B columns
-    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
-                         dtype=np.uint32)
-    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
-    log(f"plane: {plane.nbytes / 1e9:.2f} GB, {N_ROWS} rows x 1B cols")
-
-    t0 = time.perf_counter()
-    oracle = cpu_counts(plane)
-    t_cpu_total = time.perf_counter() - t0
-    cpu_qps = N_ROWS / t_cpu_total
-    log(f"cpu stand-in reference: {cpu_qps:,.2f} count-queries/s @ 1B cols")
 
     platform = jax.devices()[0].platform
     t0 = time.perf_counter()
@@ -77,11 +140,9 @@ def main() -> None:
     log(f"host->HBM {plane.nbytes / 1e9:.1f}GB: "
         f"{time.perf_counter() - t0:.2f}s")
 
-    import jax.numpy as jnp
-
     @jax.jit
     def count_batch(p):
-        # 64 Count(Row) queries in one program: per-row popcounts
+        # 32 Count(Row) queries in one program: per-row popcounts
         # reduced over the shard axis (ICI collective when meshed)
         return jnp.sum(kernels.row_counts(p), axis=0, dtype=jnp.int32)
 
@@ -89,13 +150,13 @@ def main() -> None:
     # synchronous mode, so everything after is honestly timed)
     got = np.asarray(count_batch(d)).astype(np.int64)
     np.testing.assert_array_equal(got, oracle)
-    log("counts verified against numpy oracle")
+    log("raw-kernel counts verified against numpy oracle")
 
     lat = []
     deadline = time.monotonic() + 90  # bounded even if the tunnel is slow
-    for i in range(10):
+    for _ in range(10):
         t0 = time.perf_counter()
-        vals = np.asarray(count_batch(d))  # execute + read
+        np.asarray(count_batch(d))  # execute + read
         lat.append(time.perf_counter() - t0)
         if time.monotonic() > deadline and len(lat) >= 5:
             break
@@ -116,56 +177,171 @@ def main() -> None:
             f"ms/dispatch = {plane.nbytes / (t / n_chain) / 1e9:.0f} GB/s "
             f"device throughput (HBM spec ~819 GB/s on v5e)")
 
-    # headline: the realistic serving condition — concurrent clients.
-    # The tunnel overlaps reads across threads (BASELINE.md), so
-    # throughput scales with dispatch concurrency; 32 streams recover
-    # ~84% of HBM bandwidth end-to-end; every read is oracle-verified.
-    import threading
-
-    def serve(n_threads, iters=6):
-        barrier = threading.Barrier(n_threads + 1)
-        errors = []
-
-        def worker():
-            barrier.wait()
-            for _ in range(iters):
-                try:
-                    got = np.asarray(count_batch(d)).astype(np.int64)
-                    if not np.array_equal(got, oracle):
-                        errors.append("mismatch")
-                except Exception as e:  # noqa: BLE001 — surface after join
-                    errors.append(repr(e))
-
-        threads = [threading.Thread(target=worker)
-                   for _ in range(n_threads)]
-        for t in threads:
-            t.start()
-        barrier.wait()
-        t0 = time.perf_counter()
-        for t in threads:
-            t.join()
-        dt = time.perf_counter() - t0
-        if errors:
-            return None, errors
-        return N_ROWS * iters * n_threads / dt, []
+    def one_call():
+        got = np.asarray(count_batch(d)).astype(np.int64)
+        if not np.array_equal(got, oracle):
+            raise AssertionError("count mismatch")
 
     n_threads = 32
-    qps, errs = serve(n_threads)
+
+    def burst():
+        return concurrent_burst(one_call, n_threads, iters=6,
+                                queries_per_call=N_ROWS)
+
+    qps, runs = median_serve(burst, "raw-kernel")
     if qps is None:
-        # a flaky tunnel day: fall back to the r1-proven concurrency
-        # rather than losing the headline outright
-        log(f"32-stream serving failed ({errs[:2]}); retrying at 8")
+        log("32-stream raw serving failed; retrying at 8")
         n_threads = 8
-        qps, errs = serve(n_threads)
-    assert qps is not None, f"concurrent serving failed: {errs[:3]}"
-    log(f"device ({platform}): {n_threads}-way concurrent batched counts "
-        f"-> {qps:,.1f} count-queries/s @ 1B cols, all reads verified")
+        qps, runs = median_serve(burst, "raw-kernel@8")
+    assert qps is not None, "raw-kernel concurrent serving failed"
+    log(f"raw kernel ({platform}): {n_threads}-way concurrent batched "
+        f"counts -> median {qps:,.1f} qps @ 1B cols over {len(runs)} "
+        f"runs (spread {min(runs):,.0f}-{max(runs):,.0f})")
+    del d
+    return platform, qps, n_threads
+
+
+# ---------------------------------------------------------------------------
+# tier 2: product path (Holder -> Executor -> API [-> REST])
+# ---------------------------------------------------------------------------
+
+
+def write_product_index(plane: np.ndarray, data_dir: str) -> None:
+    """Write the plane as a REAL on-disk index: schema through the
+    Holder, one pilosa-format roaring snapshot file per shard
+    (vectorized bulk writer ``roaring.serialize_dense``)."""
+    from pilosa_tpu.store import Holder, roaring
+
+    t0 = time.perf_counter()
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    total = 0
+    for s in range(plane.shape[0]):
+        blob = roaring.serialize_dense(plane[s])
+        total += len(blob)
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(blob)
+    log(f"product index written: {plane.shape[0]} fragment snapshots, "
+        f"{total / 1e9:.2f} GB in {time.perf_counter() - t0:.1f}s")
+
+
+def product_tier(data_dir: str, oracle: np.ndarray, n_threads: int):
+    from pilosa_tpu.api import API, Server
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.pql.parser import parse
+    from pilosa_tpu.store import Holder
+
+    t0 = time.perf_counter()
+    holder = Holder(data_dir).open()
+    log(f"holder cold open: {(time.perf_counter() - t0) * 1e3:.0f} ms")
+    api = API(holder, Executor(holder))
+
+    pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+    t0 = time.perf_counter()
+    parse(pql)
+    log(f"PQL parse ({N_ROWS} calls): "
+        f"{(time.perf_counter() - t0) * 1e3:.2f} ms/request")
+
+    want = [int(c) for c in oracle]
+    t0 = time.perf_counter()
+    res = api.query(INDEX, pql)["results"]
+    log(f"first product query (plane build from mmap + HBM transfer + "
+        f"compile): {time.perf_counter() - t0:.1f}s")
+    assert res == want, "product-path counts diverge from oracle"
+    log("product-path counts verified against numpy oracle")
+
+    def one_call():
+        if api.query(INDEX, pql)["results"] != want:
+            raise AssertionError("product count mismatch")
+
+    def burst():
+        return concurrent_burst(one_call, n_threads, iters=6,
+                                queries_per_call=N_ROWS)
+
+    qps, runs = median_serve(burst, "product")
+    if qps is not None:
+        log(f"product path: {n_threads}-way concurrent 32-Count PQL "
+            f"requests -> median {qps:,.1f} qps @ 1B cols over "
+            f"{len(runs)} runs (spread {min(runs):,.0f}-{max(runs):,.0f})")
+
+    # REST variant: same workload over HTTP+JSON (wire overhead figure)
+    rest_qps = None
+    try:
+        import urllib.request
+
+        srv = Server(api, host="127.0.0.1", port=0)
+        st = threading.Thread(target=srv.serve_forever, daemon=True)
+        st.start()
+        url = (f"http://127.0.0.1:{srv.address[1]}"
+               f"/index/{INDEX}/query")
+        body = pql.encode()
+
+        def rest_call():
+            req = urllib.request.Request(url, data=body, method="POST")
+            with urllib.request.urlopen(req) as resp:
+                if json.loads(resp.read())["results"] != want:
+                    raise AssertionError("REST count mismatch")
+
+        rest_call()  # warm
+        rest_qps = concurrent_burst(rest_call, n_threads, iters=3,
+                                    queries_per_call=N_ROWS)
+        if rest_qps is not None:
+            log(f"REST variant: {n_threads}-way concurrent -> "
+                f"{rest_qps:,.1f} qps (HTTP+JSON wire overhead included)")
+        srv.close()
+    except Exception as e:  # noqa: BLE001 — REST figure is informative
+        log(f"REST variant failed (non-fatal): {e!r}")
+
+    holder.close()
+    return qps, rest_qps
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    # ~25% density rows over 1B columns
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    log(f"plane: {plane.nbytes / 1e9:.2f} GB, {N_ROWS} rows x 1B cols")
+
+    t0 = time.perf_counter()
+    oracle = cpu_counts(plane)
+    t_cpu_total = time.perf_counter() - t0
+    cpu_qps = N_ROWS / t_cpu_total
+    log(f"cpu stand-in reference: {cpu_qps:,.2f} count-queries/s @ 1B cols")
+
+    platform, raw_qps, n_threads = raw_kernel_tier(plane, oracle)
+
+    data_dir = tempfile.mkdtemp(prefix="pilosa_bench_")
+    try:
+        write_product_index(plane, data_dir)
+        del plane  # holder/mmap is the source of truth from here on
+        prod_qps, rest_qps = product_tier(data_dir, oracle, n_threads)
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    # headline: the product path IS the database (VERDICT r2 #1).  Fall
+    # back to the raw-kernel figure only if the product tier failed
+    # outright; the stderr log always carries both for the breakdown.
+    if prod_qps is not None:
+        headline, metric = prod_qps, "product_count_qps_1b_cols"
+        log(f"product/raw ratio: {prod_qps / raw_qps:.2f} "
+            f"(product serves {prod_qps / raw_qps * 100:.0f}% of the "
+            f"raw-kernel ceiling at the same concurrency)")
+    else:
+        headline, metric = raw_qps, "concurrent_count_qps_1b_cols"
+        log("product tier failed; headline falls back to raw kernel")
 
     print(json.dumps({
-        "metric": f"concurrent_count_qps_1b_cols_{platform}",
-        "value": round(qps, 2),
+        "metric": f"{metric}_{platform}",
+        "value": round(headline, 2),
         "unit": "qps",
-        "vs_baseline": round(qps / cpu_qps, 3),
+        "vs_baseline": round(headline / cpu_qps, 3),
     }))
 
 

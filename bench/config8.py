@@ -161,6 +161,57 @@ def main():
         f"streaming {t_stream * 1e3:.0f} ms ({how}; "
         f"{t_stream / t_sparse:.1f}x)")
 
+    # ---- Part C: mesh-sharded sparse residency -----------------------
+    # The r2 gather floor (~50M gathers/s single-chip) divides by the
+    # device count under the device-blocked CSR layout: each chip
+    # gathers only its shard-local bits and counts merge with one psum.
+    # Virtual CPU devices share this host's one core, so wall-clock is
+    # not a scaling proxy (see config5's r2 retraction) — this part
+    # proves EXACTNESS at every mesh width and reports the per-device
+    # gather volume, which is the quantity the floor divides by.
+    if jax.device_count() >= 2:
+        from pilosa_tpu.parallel import MeshPlacement
+
+        n_shards_c, n_rows_c = 8, 100_000
+        rows_c = np.repeat(np.arange(n_rows_c, dtype=np.uint64), 8)
+        cols_c = rng.integers(0, n_shards_c * SHARD_WIDTH,
+                              size=len(rows_c)).astype(np.uint64)
+        d3 = tempfile.mkdtemp()
+        h3 = Holder(d3).open()
+        idx3 = h3.create_index("wide", track_existence=False)
+        idx3.create_field("f")
+        idx3.create_field("g")
+        idx3.field("f").import_bits(rows_c, cols_c)
+        gc = np.unique(rng.choice(n_shards_c * SHARD_WIDTH, size=400_000,
+                                  replace=False).astype(np.uint64))
+        idx3.field("g").import_bits(np.ones(len(gc), np.uint64), gc)
+        idx3.note_columns(cols_c)
+
+        flat_ex = Executor(h3, plane_budget=64 << 20)
+        (want_c,) = flat_ex.execute("wide", pql)
+        canon = lambda pairs: sorted(((p.count, p.id) for p in pairs),
+                                     key=lambda t: (-t[0], t[1]))
+        flat_ss = [v[1] for k, v in flat_ex.planes._entries.items()
+                   if k[0] == "sparse"][0]
+        flat_bits = int(flat_ss.word_idx.shape[-1])
+        for ndev in (2, 4, 8):
+            if jax.device_count() < ndev:
+                continue
+            mex = Executor(h3, plane_budget=64 << 20,
+                           placement=MeshPlacement(jax.devices()[:ndev]))
+            (got_c,) = mex.execute("wide", pql)
+            assert canon(got_c.pairs) == canon(want_c.pairs), ndev
+            ss = [v[1] for k, v in mex.planes._entries.items()
+                  if k[0] == "sparse"][0]
+            per_dev = int(ss.word_idx.shape[-1])
+            log(f"C: mesh x{ndev}: exact; per-device gather volume "
+                f"{per_dev / 1e3:.0f}k bits vs {flat_bits / 1e3:.0f}k "
+                f"flat ({flat_bits / per_dev:.1f}x less per chip)")
+    else:
+        log("C: mesh-sharded sparse skipped (single device; run under "
+            "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8 for the simulated-mesh leg)")
+
     emit(f"sparse_topn_warm_ms_5m_rows_{platform}", t_warm * 1e3, "ms",
          t_stream / t_sparse)
 

@@ -203,8 +203,36 @@ class API:
                 primary_changed = got
         return primary_changed or 0
 
+    @staticmethod
+    def _proto_or_json_forward(path: str, encode, json_body):
+        """Forwarded import batches ride the protobuf wire (packed
+        varint id arrays, SURVEY.md §3.3 internal proto), encoded
+        LAZILY on the first remote owner — all-local routing
+        (single-node clusters, owner-local shards) must not pay the
+        encode.  Inputs the codec refuses (heterogeneous timestamps,
+        out-of-int64 values: ValueError) fall back to JSON, which
+        allows them."""
+        from pilosa_tpu.api import proto
+        cache: list = []
+
+        def remote(client):
+            if not cache:
+                try:
+                    cache.append((encode(), True))
+                except ValueError:
+                    cache.append((None, False))
+            body, is_proto = cache[0]
+            if is_proto:
+                return client._do(
+                    "POST", path, body, content_type=proto.CONTENT_TYPE,
+                    headers={"X-Pilosa-Direct": "1"})["changed"]
+            return client._json("POST", path, json_body(),
+                                headers={"X-Pilosa-Direct": "1"})["changed"]
+        return remote
+
     def _route_import_bits(self, index: str, field: str, rows, cols,
                            timestamps, clear: bool) -> int:
+        from pilosa_tpu.api import proto
         shards = cols // np.uint64(SHARD_WIDTH)
         changed = 0
         for shard in np.unique(shards):
@@ -213,35 +241,41 @@ class API:
             sub_cols = [int(c) for c in cols[m]]
             sub_ts = ([timestamps[i] for i in np.nonzero(m)[0]]
                       if timestamps is not None else None)
+            remote = self._proto_or_json_forward(
+                f"/index/{index}/field/{field}/import",
+                lambda: proto.encode_import_request(
+                    row_ids=sub_rows, col_ids=sub_cols,
+                    timestamps=sub_ts, clear=clear),
+                lambda: {"rowIDs": sub_rows, "columnIDs": sub_cols,
+                         "timestamps": sub_ts, "clear": clear})
             changed += self._route_to_owners(
                 index, int(shard),
                 lambda: self.import_bits(
                     index, field, row_ids=sub_rows, col_ids=sub_cols,
                     timestamps=sub_ts, clear=clear, direct=True),
-                lambda client: client._json(
-                    "POST", f"/index/{index}/field/{field}/import",
-                    {"rowIDs": sub_rows, "columnIDs": sub_cols,
-                     "timestamps": sub_ts, "clear": clear},
-                    headers={"X-Pilosa-Direct": "1"})["changed"])
+                remote)
         return changed
 
     def _route_import_values(self, index: str, field: str, cols,
                              values) -> int:
+        from pilosa_tpu.api import proto
         shards = cols // np.uint64(SHARD_WIDTH)
         changed = 0
         for shard in np.unique(shards):
             m = shards == shard
             sub_cols = [int(c) for c in cols[m]]
             sub_vals = [values[i] for i in np.nonzero(m)[0]]
+            remote = self._proto_or_json_forward(
+                f"/index/{index}/field/{field}/importValue",
+                lambda: proto.encode_import_value_request(
+                    col_ids=sub_cols, values=sub_vals),
+                lambda: {"columnIDs": sub_cols, "values": sub_vals})
             changed += self._route_to_owners(
                 index, int(shard),
                 lambda: self.import_values(
                     index, field, col_ids=sub_cols, values=sub_vals,
                     direct=True),
-                lambda client: client._json(
-                    "POST", f"/index/{index}/field/{field}/importValue",
-                    {"columnIDs": sub_cols, "values": sub_vals},
-                    headers={"X-Pilosa-Direct": "1"})["changed"])
+                remote)
         return changed
 
     def import_roaring(self, index: str, field: str, shard: int, blob: bytes,

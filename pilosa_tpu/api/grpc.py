@@ -1,0 +1,105 @@
+"""gRPC query/ingest surface (reference: the v2-era gRPC server,
+``grpc.go`` + ``proto/`` — SURVEY.md §3.3).
+
+Service ``pilosa_tpu.Pilosa`` with unary rpcs:
+
+    Query(QueryRequest) -> QueryResponse
+    Import(ImportRequest) -> ImportResponse
+    ImportValue(ImportValueRequest) -> ImportResponse
+
+Messages are the ones in ``api/internal.proto`` (QueryRequest.index
+carries the index name — there is no URL path here), encoded by the
+project's dependency-free codec (``api/proto.py``).  The server uses
+grpcio's *generic method handlers* over raw bytes, so no
+protoc/grpc_tools codegen exists at build or run time; any client
+generated from internal.proto interoperates, and Python callers can use
+``channel.unary_unary`` with the same codec (see tests/test_grpc.py).
+
+Application errors arrive as ``QueryResponse.err`` /
+``ImportResponse.err`` with gRPC status OK — a non-OK unary status
+would drop the response message, and the err field is the schema's
+error contract (matching the HTTP proto surface's decodable bodies).
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.api import proto
+from pilosa_tpu.api.api import API, ApiError
+
+SERVICE = "pilosa_tpu.Pilosa"
+
+
+class GrpcServer:
+    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        import grpc
+        from concurrent import futures
+
+        self.api = api
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        rpcs = {
+            "Query": grpc.unary_unary_rpc_method_handler(self._query),
+            "Import": grpc.unary_unary_rpc_method_handler(self._import),
+            "ImportValue": grpc.unary_unary_rpc_method_handler(
+                self._import_value),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, rpcs),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "GrpcServer":
+        self._server.start()
+        return self
+
+    def close(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+    # -- rpcs (raw request bytes -> raw response bytes) ----------------------
+
+    def _query(self, request: bytes, context) -> bytes:
+        try:
+            pql, shards, index = proto.decode_query_request_indexed(request)
+        except ValueError as e:
+            return proto.encode_query_response(err=f"bad request: {e}")
+        if not index:
+            return proto.encode_query_response(err="missing index")
+        try:
+            res = self.api.query(index, pql, shards=shards)
+            return proto.encode_query_response(res["results"])
+        except (ApiError, ValueError) as e:
+            return proto.encode_query_response(err=str(e))
+
+    def _import(self, request: bytes, context) -> bytes:
+        try:
+            b = proto.decode_import_request(request)
+        except ValueError as e:
+            return proto.encode_import_response(err=f"bad request: {e}")
+        if not b["index"] or not b["field"]:
+            return proto.encode_import_response(err="missing index/field")
+        try:
+            changed = self.api.import_bits(
+                b["index"], b["field"], row_ids=b["row_ids"],
+                col_ids=b["col_ids"], row_keys=b["row_keys"],
+                col_keys=b["col_keys"], timestamps=b["timestamps"],
+                clear=b["clear"])
+            return proto.encode_import_response(changed)
+        except ApiError as e:
+            return proto.encode_import_response(err=str(e))
+
+    def _import_value(self, request: bytes, context) -> bytes:
+        try:
+            b = proto.decode_import_value_request(request)
+        except ValueError as e:
+            return proto.encode_import_response(err=f"bad request: {e}")
+        if not b["index"] or not b["field"]:
+            return proto.encode_import_response(err="missing index/field")
+        try:
+            changed = self.api.import_values(
+                b["index"], b["field"], col_ids=b["col_ids"],
+                col_keys=b["col_keys"], values=b["values"])
+            return proto.encode_import_response(changed)
+        except ApiError as e:
+            return proto.encode_import_response(err=str(e))

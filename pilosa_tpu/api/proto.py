@@ -65,10 +65,52 @@ def _sub(field: int, raw: bytes) -> bytes:
     return _tag(field, _LEN) + _varint(len(raw)) + raw
 
 
+def _vec_varints(values) -> bytes:
+    """Packed varint body for a uint64 array, vectorized: per-value
+    byte lengths by comparison ladder, then one numpy pass per varint
+    byte position.  A Python per-int loop measured 7× slower than
+    C-json on 100k-id import batches — the packed arrays ARE the wire,
+    so this is the codec's hot path."""
+    import numpy as _np
+    try:
+        v = _np.asarray(values, dtype=_np.uint64)
+    except OverflowError as e:
+        raise ValueError(f"proto: value out of uint64 range: {e}")
+    lens = _np.ones(len(v), _np.int64)
+    for g in range(1, 10):
+        lens += (v >= (_np.uint64(1) << _np.uint64(7 * g)))
+    offs = _np.cumsum(lens) - lens
+    out = _np.zeros(int(lens.sum()), _np.uint8)
+    for g in range(10):
+        m = lens > g
+        if not m.any():
+            break
+        byte = ((v[m] >> _np.uint64(7 * g))
+                & _np.uint64(0x7F)).astype(_np.uint8)
+        out[offs[m] + g] = byte | _np.where(lens[m] > g + 1, 0x80,
+                                            0).astype(_np.uint8)
+    return out.tobytes()
+
+
+def _vec_zigzag(values):
+    """int64 list/array -> zigzagged uint64 array (vectorized).
+    Out-of-int64 inputs raise ValueError (not numpy's OverflowError),
+    so callers' fall-back-to-JSON handling fires."""
+    import numpy as _np
+    try:
+        v = _np.asarray(values, dtype=_np.int64)
+    except OverflowError as e:
+        raise ValueError(f"proto: value out of sint64 range: {e}")
+    return ((v << 1) ^ (v >> 63)).view(_np.uint64)
+
+
 def _packed(field: int, values, enc) -> bytes:
     if not len(values):
         return b""
-    raw = b"".join(enc(int(v)) for v in values)
+    if enc is _varint:
+        raw = _vec_varints(values)
+    else:
+        raw = b"".join(enc(int(v)) for v in values)
     return _tag(field, _LEN) + _varint(len(raw)) + raw
 
 
@@ -122,11 +164,26 @@ class _Reader:
 def _packed_uints(raw) -> list[int]:
     if isinstance(raw, int):  # unpacked single element
         return [raw]
-    r = _Reader(raw)
-    out = []
-    while r.pos < len(raw):
-        out.append(r.varint())
-    return out
+    if not len(raw):
+        return []
+    # vectorized: varint boundaries are the bytes without the
+    # continuation bit; one numpy pass per byte position reconstructs
+    # every value (counterpart of _vec_varints)
+    import numpy as _np
+    buf = _np.frombuffer(raw, _np.uint8)
+    ends = _np.nonzero((buf & 0x80) == 0)[0]
+    if not len(ends) or int(ends[-1]) != len(buf) - 1:
+        raise ValueError("proto: truncated packed varint")
+    starts = _np.concatenate(([0], ends[:-1] + 1))
+    lens = ends - starts + 1
+    if int(lens.max()) > 10:
+        raise ValueError("proto: varint too long")
+    vals = _np.zeros(len(starts), _np.uint64)
+    for g in range(int(lens.max())):
+        m = lens > g
+        vals[m] |= ((buf[starts[m] + g] & _np.uint8(0x7F))
+                    .astype(_np.uint64) << _np.uint64(7 * g))
+    return vals.tolist()
 
 
 # -- QueryRequest ------------------------------------------------------------
@@ -143,10 +200,166 @@ def decode_query_request(buf: bytes) -> tuple[str, list[int] | None]:
     return pql, shards
 
 
-def encode_query_request(pql: str, shards=None) -> bytes:
+def decode_query_request_indexed(buf: bytes) \
+        -> tuple[str, list[int] | None, str]:
+    """-> (pql, shards or None, index) — the gRPC form, where no URL
+    path carries the index name."""
+    pql, shards = decode_query_request(buf)
+    index = ""
+    for field, wire, val in _Reader(buf).fields():
+        if field == 3 and wire == _LEN:
+            index = val.decode()
+    return pql, shards, index
+
+
+def encode_query_request(pql: str, shards=None, index: str = "") -> bytes:
     out = _string(1, pql)
     if shards:
         out += _packed(2, shards, _varint)
+    out += _string(3, index)
+    return out
+
+
+# -- Import requests ---------------------------------------------------------
+
+
+def encode_import_request(*, index: str = "", field: str = "",
+                          row_ids=None, col_ids=None, row_keys=None,
+                          col_keys=None, timestamps=None,
+                          clear: bool = False) -> bytes:
+    """ImportRequest bytes.  ``timestamps`` must be homogeneous — all
+    epoch ints or all ISO strings; a mixed list raises ValueError (the
+    caller falls back to the JSON wire, which allows heterogeneity)."""
+    out = _string(1, index) + _string(2, field)
+    if row_ids is not None and len(row_ids):
+        out += _packed(3, row_ids, _varint)
+    if col_ids is not None and len(col_ids):
+        out += _packed(4, col_ids, _varint)
+    for k in row_keys or []:
+        out += _string(5, k)
+    for k in col_keys or []:
+        out += _string(6, k)
+    if timestamps is not None and len(timestamps):
+        if all(isinstance(t, int) for t in timestamps):
+            out += _packed(7, _vec_zigzag([int(t) for t in timestamps]),
+                           _varint)
+        elif all(isinstance(t, str) for t in timestamps):
+            for t in timestamps:
+                out += _string(9, t)
+        else:
+            raise ValueError("proto: mixed timestamp types")
+    if clear:
+        out += _uint(8, 1)
+    return out
+
+
+def decode_import_request(buf: bytes) -> dict:
+    """-> kwargs-shaped dict (row_ids/col_ids/row_keys/col_keys/
+    timestamps/clear/index/field); absent lists are None."""
+    index = field_name = ""
+    row_ids: list | None = None
+    col_ids: list | None = None
+    row_keys: list | None = None
+    col_keys: list | None = None
+    ts: list | None = None
+    clear = False
+    for field, wire, val in _Reader(buf).fields():
+        if field == 1:
+            index = val.decode()
+        elif field == 2:
+            field_name = val.decode()
+        elif field == 3:
+            row_ids = (row_ids or []) + _packed_uints(val)
+        elif field == 4:
+            col_ids = (col_ids or []) + _packed_uints(val)
+        elif field == 5:
+            row_keys = row_keys if row_keys is not None else []
+            row_keys.append(val.decode())
+        elif field == 6:
+            col_keys = col_keys if col_keys is not None else []
+            col_keys.append(val.decode())
+        elif field == 7:
+            ts = (ts or []) + [_unzigzag(v) for v in _packed_uints(val)]
+        elif field == 8:
+            clear = bool(val)
+        elif field == 9:
+            ts = ts if ts is not None else []
+            ts.append(val.decode())
+    return {"index": index, "field": field_name, "row_ids": row_ids,
+            "col_ids": col_ids, "row_keys": row_keys,
+            "col_keys": col_keys, "timestamps": ts, "clear": clear}
+
+
+def encode_import_value_request(*, index: str = "", field: str = "",
+                                col_ids=None, col_keys=None,
+                                values=None) -> bytes:
+    out = _string(1, index) + _string(2, field)
+    if col_ids is not None and len(col_ids):
+        out += _packed(3, col_ids, _varint)
+    for k in col_keys or []:
+        out += _string(4, k)
+    vals = values if values is not None else []
+    if len(vals):
+        if all(isinstance(v, bool) for v in vals):
+            raise ValueError("proto: bool import values")
+        if all(isinstance(v, int) for v in vals):
+            out += _packed(5, _vec_zigzag([int(v) for v in vals]), _varint)
+        elif all(isinstance(v, (int, float)) for v in vals):
+            raw = b"".join(struct.pack("<d", float(v)) for v in vals)
+            out += _tag(6, _LEN) + _varint(len(raw)) + raw
+        elif all(isinstance(v, str) for v in vals):
+            for v in vals:
+                out += _string(7, v)
+        else:
+            raise ValueError("proto: mixed import value types")
+    return out
+
+
+def decode_import_value_request(buf: bytes) -> dict:
+    index = field_name = ""
+    col_ids: list | None = None
+    col_keys: list | None = None
+    values: list | None = None
+    for field, wire, val in _Reader(buf).fields():
+        if field == 1:
+            index = val.decode()
+        elif field == 2:
+            field_name = val.decode()
+        elif field == 3:
+            col_ids = (col_ids or []) + _packed_uints(val)
+        elif field == 4:
+            col_keys = col_keys if col_keys is not None else []
+            col_keys.append(val.decode())
+        elif field == 5:
+            values = (values or []) + [_unzigzag(v)
+                                       for v in _packed_uints(val)]
+        elif field == 6:
+            values = (values or []) + list(
+                struct.unpack(f"<{len(val) // 8}d", val))
+        elif field == 7:
+            values = values if values is not None else []
+            values.append(val.decode())
+    return {"index": index, "field": field_name, "col_ids": col_ids,
+            "col_keys": col_keys, "values": values}
+
+
+def encode_import_response(changed: int = 0, err: str = "") -> bytes:
+    out = b""
+    if changed:
+        out += _tag(1, _VARINT) + _varint(_zigzag(int(changed)))
+    return out + _string(2, err)
+
+
+def decode_import_response(buf: bytes) -> dict:
+    changed, err = 0, ""
+    for field, wire, val in _Reader(buf).fields():
+        if field == 1:
+            changed = _unzigzag(val)
+        elif field == 2:
+            err = val.decode()
+    out = {"changed": changed}
+    if err:
+        out["error"] = err
     return out
 
 
@@ -206,11 +419,14 @@ def _enc_result(r) -> bytes:
             raise ValueError(
                 "Extract results are not representable in the protobuf "
                 "schema; request JSON")
-        if "columns" in r or ("keys" in r and "rows" not in r
-                              and "value" not in r and "values" not in r):
+        keyed = ("keys" in r and "rows" not in r
+                 and "value" not in r and "values" not in r)
+        if "columns" in r or keyed:
             sub = _packed(1, r.get("columns", []), _varint)
             for k in r.get("keys", []) or []:
                 sub += _string(2, k)
+            if keyed:  # explicit flag so {"keys": []} round-trips
+                sub += _uint(3, 1)
             return _uint(1, T_ROW) + _sub(2, sub)
         if "rows" in r:
             return _uint(1, T_ROWIDS) + _packed(7, r["rows"], _varint)
@@ -223,7 +439,7 @@ def _enc_result(r) -> bytes:
                 raw = b"".join(struct.pack("<d", float(v)) for v in vals)
                 return out + (_tag(11, _LEN) + _varint(len(raw)) + raw
                               if raw else b"")
-            return out + _packed(10, [_zigzag(int(v)) for v in vals],
+            return out + _packed(10, _vec_zigzag([int(v) for v in vals]),
                                  _varint)
     raise ValueError(f"proto: unencodable result {type(r)}")
 
@@ -258,6 +474,7 @@ def _dec_valcount(raw: bytes) -> dict:
 def _dec_result(raw: bytes):
     typ = 0
     row_cols, row_keys = [], []
+    row_keyed = False
     n = 0
     changed = False
     pairs, groups, row_ids, values = [], [], [], []
@@ -271,6 +488,8 @@ def _dec_result(raw: bytes):
                     row_cols += _packed_uints(v2)
                 elif f2 == 2:
                     row_keys.append(v2.decode())
+                elif f2 == 3:
+                    row_keyed = bool(v2)
         elif field == 3:
             n = val
         elif field == 4:
@@ -328,7 +547,9 @@ def _dec_result(raw: bytes):
     if typ == T_COUNT:
         return n
     if typ == T_ROW:
-        return {"keys": row_keys} if row_keys else {"columns": row_cols}
+        if row_keyed or row_keys:
+            return {"keys": row_keys}
+        return {"columns": row_cols}
     if typ == T_PAIRS:
         return pairs
     if typ == T_VALCOUNT:

@@ -34,6 +34,9 @@ class Config:
     count_batch_window: float = 0.0    # seconds; >0 coalesces concurrent
                                        # Count queries into one dispatch
     plane_budget_bytes: int = 4 << 30
+    max_map_count: int = 32768          # live snapshot mmaps before LRU
+                                        # heap demotion (syswrap parity)
+    grpc_bind: str = ""                 # host:port; "" disables gRPC
     mesh: bool = True                   # shard planes over all local devices
     # multi-host jax (one process per host of a pod slice; the host-level
     # cluster layer above is independent of this)

@@ -21,12 +21,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="TOML config file")
     p.add_argument("--bind", help="host:port to serve on / connect to")
     p.add_argument("--data-dir", dest="data_dir", help="storage directory")
+    p.add_argument("--grpc-bind", dest="grpc_bind",
+                   help="host:port for the gRPC surface (default off)")
     p.add_argument("--verbose", action="store_true", default=None)
 
 
 def _load_cfg(args) -> cfgmod.Config:
     overrides = {k: getattr(args, k, None)
-                 for k in ("bind", "data_dir", "verbose")}
+                 for k in ("bind", "data_dir", "verbose", "grpc_bind")}
     return cfgmod.load(args.config, overrides=overrides)
 
 

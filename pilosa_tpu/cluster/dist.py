@@ -20,7 +20,7 @@ import numpy as np
 
 from pilosa_tpu.exec import result_to_json
 from pilosa_tpu.exec.executor import ExecutionError
-from pilosa_tpu.pql import parse
+from pilosa_tpu.pql import parse_cached
 from pilosa_tpu.pql.ast import Call, Condition, Query
 
 WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store"})
@@ -52,7 +52,11 @@ def _strip_truncation(call: Call) -> Call:
     same reason, ``executeTopN`` SURVEY.md §4.3; here nodes return full
     count vectors instead)."""
     eff = _call_of(call)
-    strip = {"TopN": ("n",), "Rows": ("limit",), "GroupBy": ("limit",),
+    # GroupBy having= also strips: per-node partial counts/sums cannot
+    # be thresholded locally; the filter applies to the global sums in
+    # merge_results
+    strip = {"TopN": ("n",), "Rows": ("limit",),
+             "GroupBy": ("limit", "having"),
              "All": ("limit", "offset"), "Limit": ("limit", "offset")}
     keys = strip.get(eff.name) or ()
     extra = {}
@@ -93,7 +97,7 @@ class DistributedExecutor:
     def execute_json(self, index: str, pql: str,
                      shards: list[int] | None = None, tracer=None) -> list:
         from contextlib import nullcontext
-        query = parse(pql)
+        query = parse_cached(pql)
         out = []
         for call in query.calls:
             name = _call_of(call).name
@@ -568,6 +572,15 @@ def merge_results(call: Call, partials: list):
         groups = sorted(merged.values(),
                         key=lambda g: [fr.get("rowID", 0)
                                        for fr in g["group"]])
+        having = call.args.get("having")
+        if having is not None:
+            from pilosa_tpu.exec.executor import Executor
+            metric, cond = Executor.parse_having(having, agg_op)
+            groups = [g for g in groups
+                      if (g["count"] if metric == "count"
+                          else g.get("agg")) is not None
+                      and cond.matches(g["count"] if metric == "count"
+                                       else g["agg"])]
         limit = call.args.get("limit")
         if limit is not None:
             groups = groups[: int(limit)]

@@ -156,6 +156,18 @@ def column_bits(plane: jax.Array, word_idx: jax.Array,
     return (g >> bit_idx[None, None, :]) & jnp.uint32(1)
 
 
+def column_bits_grouped(plane: jax.Array, word_idx: jax.Array,
+                        bit_idx: jax.Array) -> jax.Array:
+    """Per-shard column probes: word_idx int32[S, k] / bit_idx
+    uint32[S, k] select DIFFERENT columns in each shard ->
+    uint32[S, R, k].  One program (and one host read) covers an entire
+    Extract regardless of how many shards the selected columns span —
+    the per-shard :func:`column_bits` dispatch loop costs one read per
+    shard, ruinous on transports with a per-read floor (BASELINE.md)."""
+    g = jnp.take_along_axis(plane, word_idx[:, None, :], axis=2)
+    return (g >> bit_idx[:, None, :]) & jnp.uint32(1)
+
+
 def shift(words: jax.Array, n: int = 1) -> jax.Array:
     """Shift every bit's column position up by ``n`` within its shard
     (reference: v2 ``Shift(row, n)`` — bits crossing the shard boundary

@@ -27,6 +27,7 @@ from host fragment metadata (:mod:`pilosa_tpu.exec.planes`).
 
 from __future__ import annotations
 
+import functools as _functools
 from functools import partial
 
 import jax
@@ -60,3 +61,67 @@ def sparse_row_counts(filter_words: jax.Array, word_idx: jax.Array,
     """Full per-row count vector — for callers that need every row
     (tanimoto thresholding, ids= restriction, cluster partials)."""
     return _counts(filter_words, word_idx, mask, row_ptr)
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded form: shard-local CSR blocks + psum over ICI
+# ---------------------------------------------------------------------------
+#
+# Under a device mesh the filter plane is sharded over its shard axis;
+# a global-index gather would force XLA to all-gather the filter to
+# every chip.  Instead the CSR arrays are built PER DEVICE (word
+# indices local to the device's filter block, see
+# ``planes.PlaneCache._build_sparse``): each chip gathers only from its
+# resident filter words, computes partial per-row counts over its own
+# bits, and one ``psum`` over ICI produces exact global counts — which
+# also divides the measured ~50M gathers/s single-chip floor
+# (BASELINE.md r2) by the device count.
+
+
+def _partial_counts(axis: str):
+    def block(fw, wi, mask, rp):
+        # block shapes: fw (S/D, W), wi/mask (1, Nd), rp (1, R_pad+1)
+        local = _counts(fw, wi[0], mask[0], rp[0])
+        return jax.lax.psum(local, axis)
+    return block
+
+
+@_functools.lru_cache(maxsize=64)
+def _mesh_program(mesh, axis: str, k: int | None):
+    """jitted (filter, word_idx, mask, row_ptr) -> counts | top_k.
+    Cached per (mesh, axis, k): shard_map re-wrapping per call would
+    retrace every query."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sm = shard_map(
+        _partial_counts(axis), mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None)),
+        out_specs=P())
+    if k is None:
+        return jax.jit(sm)
+    # top_k runs on the replicated (tiny) count vector post-collective
+    return jax.jit(lambda fw, wi, m, rp: jax.lax.top_k(
+        sm(fw, wi, m, rp), k))
+
+
+def topn_sparse_meshed(mesh, axis: str, filter_words: jax.Array,
+                       word_idx: jax.Array, mask: jax.Array,
+                       row_ptr: jax.Array, k: int):
+    """(values int32[k], slots int32[k]) over device-blocked CSR arrays
+    (word_idx/mask int32|uint32[D, Nd_pad], row_ptr int32[D, R_pad+1],
+    axis 0 sharded over ``mesh``)."""
+    return _mesh_program(mesh, axis, int(k))(filter_words, word_idx,
+                                             mask, row_ptr)
+
+
+def sparse_row_counts_meshed(mesh, axis: str, filter_words: jax.Array,
+                             word_idx: jax.Array, mask: jax.Array,
+                             row_ptr: jax.Array) -> jax.Array:
+    """Exact global int32[R_pad] counts from per-device partials."""
+    return _mesh_program(mesh, axis, None)(filter_words, word_idx,
+                                           mask, row_ptr)

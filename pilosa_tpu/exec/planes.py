@@ -106,16 +106,25 @@ class PlaneSet:
 class SparseSet:
     """Container-blocked sparse residency (``engine.sparse``): one
     (field, view) as CSR bit arrays — memory scales with set bits, not
-    rows × shard width (SURVEY.md §8 "dense blowup")."""
+    rows × shard width (SURVEY.md §8 "dense blowup").
 
-    word_idx: jax.Array       # int32[N_pad] flat filter-word index
-    mask: jax.Array           # uint32[N_pad] lane mask (0 = padding)
-    row_ptr: jax.Array        # int32[R_pad+1] CSR row boundaries
+    Two layouts: unmeshed (``mesh is None``) arrays are flat
+    (``word_idx int32[N_pad]`` of global flat filter indices); meshed
+    arrays are DEVICE-BLOCKED (``int32[D, Nd_pad]`` with word indices
+    local to each device's filter block, axis 0 sharded over the mesh)
+    so each chip gathers only from its resident filter words and counts
+    merge with one ``psum`` (``engine.sparse`` mesh form)."""
+
+    word_idx: jax.Array       # int32[N_pad] | int32[D, Nd_pad]
+    mask: jax.Array           # uint32 same shape (0 = padding)
+    row_ptr: jax.Array        # int32[R_pad+1] | int32[D, R_pad+1]
     row_ids: np.ndarray       # uint64[R] sorted global rows
     row_cards: np.ndarray     # int64[R] full per-row cardinalities
     shards: tuple[int, ...]
     nbytes: int
     n_rows_pad: int           # pow2 row bucket (static program shape)
+    mesh: object = None       # jax.sharding.Mesh when device-blocked
+    axis: str | None = None   # mesh axis name
 
     @property
     def n_rows(self) -> int:
@@ -123,10 +132,15 @@ class SparseSet:
 
 
 class PlaneCache:
-    def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET):
+    def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET,
+                 placement=None):
         """``place(np_array) -> jax.Array`` controls device placement /
-        mesh sharding; default is plain ``jax.device_put``."""
-        self.place = place or jax.device_put
+        mesh sharding; default is plain ``jax.device_put``.
+        ``placement`` (the MeshPlacement the executor runs under, if
+        any) additionally drives the sparse build's device blocking."""
+        self.place = place or (placement.place if placement is not None
+                               else jax.device_put)
+        self.placement = placement
         self.budget = budget_bytes
         self._entries: OrderedDict[tuple, tuple[tuple, object, int]] = OrderedDict()
         self._zeros: dict[int, jax.Array] = {}
@@ -149,6 +163,19 @@ class PlaneCache:
         key = ("bsi", index, field.name, view_name, shards,
                field.options.bit_depth)
         return self._get(key, field, view_name, shards, self._build_bsi)
+
+    def has_plane(self, index: str, field: Field, view_name: str,
+                  shards: tuple[int, ...]) -> bool:
+        """Whether a FRESH whole-view plane is resident (generations
+        match).  Callers skip their admission/budget walks on True —
+        so a stale hit must return False: the field may have grown
+        past the budget since admission, and ``field_plane`` would
+        rebuild it at the new size unconditionally."""
+        key = ("plane", index, field.name, view_name, shards)
+        with self._lock:
+            hit = self._entries.get(key)
+        return hit is not None and hit[0] == self._gens(field, view_name,
+                                                        shards)
 
     def rows_plane(self, index: str, field: Field, view_name: str,
                    row_ids: np.ndarray,
@@ -180,24 +207,40 @@ class PlaneCache:
                                 slots=list(slot_of.values()))
         return PlaneSet(self.place(host), shards, row_ids, slot_of)
 
+    def _sparse_mesh(self):
+        """(D, mesh, axis) when the sparse build should device-block:
+        a 1-D shard mesh with >1 device (2-D word-split meshes keep the
+        flat layout replicated — sparse CP-splitting is not built)."""
+        p = self.placement
+        if (p is not None and getattr(p, "words_size", 1) == 1
+                and getattr(p, "n_devices", 1) > 1
+                and getattr(p, "mesh", None) is not None):
+            return p.n_devices, p.mesh, p.axis
+        return None
+
     def sparse_bytes(self, field: Field, view_name: str,
                      shards: tuple[int, ...]) -> int:
-        """Sparse-residency footprint with the SAME pow2 padding the
-        build applies — the budget gate must never admit a set the
-        cache then refuses (which would silently re-build per query)."""
+        """Sparse-residency footprint with the SAME padding the build
+        applies — the budget gate must never admit a set the cache then
+        refuses (which would silently re-build per query).  Meshed:
+        every device block pads to the LARGEST device's pow2 bucket, so
+        the estimate groups per-shard cardinalities by device."""
         view = field.view(view_name)
-        total_bits = 0
+        mesh_info = self._sparse_mesh()
+        d = mesh_info[0] if mesh_info else 1
+        per_dev = np.zeros(d, np.int64)
         total_rows = 0
         if view is not None:
-            for s in shards:
+            spd = max(1, len(shards) // d)
+            for si, s in enumerate(shards):
                 if s == PAD_SHARD:
                     continue
                 frag = view.fragment(s)
                 if frag is not None:
-                    total_bits += frag.cardinality()
+                    per_dev[min(si // spd, d - 1)] += frag.cardinality()
                     total_rows += len(frag.row_cardinalities()[0])
-        return (_pow2(max(1, total_bits)) * 8
-                + (_pow2(max(1, total_rows)) + 1) * 4)
+        r_term = (_pow2(max(1, total_rows)) + 1) * 4 * d
+        return d * _pow2(max(1, int(per_dev.max()))) * 8 + r_term
 
     def sparse_plane(self, index: str, field: Field, view_name: str,
                      shards: tuple[int, ...]) -> SparseSet:
@@ -210,6 +253,13 @@ class PlaneCache:
                       shards: tuple[int, ...]) -> SparseSet:
         from pilosa_tpu.engine.words import SHARD_WIDTH
         view = field.view(view_name)
+        mesh_info = self._sparse_mesh()
+        d = mesh_info[0] if mesh_info else 1
+        if len(shards) % d:
+            raise AssertionError(
+                f"sparse build: {len(shards)} shards not padded to the "
+                f"{d}-device mesh (executor pads via placement)")
+        spd = len(shards) // d
         per_shard = []  # (si, positions)
         frags = []
         if view is not None:
@@ -222,46 +272,65 @@ class PlaneCache:
                 frags.append(frag)
                 per_shard.append((si, frag.positions()))
         all_ids, row_cards = merge_row_cards(frags)
+        r_pad = _pow2(max(1, len(all_ids)))
 
-        wi_parts, mask_parts, slot_parts = [], [], []
+        # per-device bit lists; unmeshed is the d == 1 special case.
+        # word indices are LOCAL to the device's filter block (si % spd)
+        # so each chip's gather never leaves its resident words.
+        wi_parts: list[list] = [[] for _ in range(d)]
+        mask_parts: list[list] = [[] for _ in range(d)]
+        slot_parts: list[list] = [[] for _ in range(d)]
         for si, pos in per_shard:
             if not len(pos):
                 continue
+            dev = si // spd
             rows = pos // np.uint64(SHARD_WIDTH)
             cols = (pos % np.uint64(SHARD_WIDTH)).astype(np.int64)
-            wi_parts.append((si * WORDS_PER_SHARD
-                             + (cols >> 5)).astype(np.int32))
-            mask_parts.append(
+            wi_parts[dev].append(((si % spd) * WORDS_PER_SHARD
+                                  + (cols >> 5)).astype(np.int32))
+            mask_parts[dev].append(
                 (np.uint32(1) << (cols & 31).astype(np.uint32)))
-            slot_parts.append(
+            slot_parts[dev].append(
                 np.searchsorted(all_ids, rows).astype(np.int32))
-        if wi_parts:
-            word_idx = np.concatenate(wi_parts)
-            mask = np.concatenate(mask_parts)
-            rowslot = np.concatenate(slot_parts)
-            order = np.argsort(rowslot, kind="stable")  # CSR row order
-            word_idx, mask, rowslot = (word_idx[order], mask[order],
-                                       rowslot[order])
-        else:
-            word_idx = np.empty(0, np.int32)
-            mask = np.empty(0, np.uint32)
-            rowslot = np.empty(0, np.int32)
-        n_bits = len(word_idx)
-        n_pad = _pow2(max(1, n_bits))
-        pad = n_pad - n_bits
-        if pad:
-            # mask 0: padding contributes nothing to any segment
-            word_idx = np.concatenate([word_idx, np.zeros(pad, np.int32)])
-            mask = np.concatenate([mask, np.zeros(pad, np.uint32)])
-        r_pad = _pow2(max(1, len(all_ids)))
-        # CSR boundaries; pad rows collapse to empty segments at N
-        row_ptr = np.searchsorted(
-            rowslot, np.arange(r_pad + 1, dtype=np.int64)).astype(np.int32)
+
+        def assemble(parts_w, parts_m, parts_s):
+            if parts_w:
+                wi = np.concatenate(parts_w)
+                mk = np.concatenate(parts_m)
+                sl = np.concatenate(parts_s)
+                order = np.argsort(sl, kind="stable")  # CSR row order
+                return wi[order], mk[order], sl[order]
+            return (np.empty(0, np.int32), np.empty(0, np.uint32),
+                    np.empty(0, np.int32))
+
+        blocks = [assemble(wi_parts[i], mask_parts[i], slot_parts[i])
+                  for i in range(d)]
+        n_pad = _pow2(max(1, max(len(b[0]) for b in blocks)))
+        wi_out = np.zeros((d, n_pad), np.int32)
+        mk_out = np.zeros((d, n_pad), np.uint32)  # mask 0 = padding
+        rp_out = np.empty((d, r_pad + 1), np.int32)
+        for i, (wi, mk, sl) in enumerate(blocks):
+            wi_out[i, :len(wi)] = wi
+            mk_out[i, :len(mk)] = mk
+            # CSR boundaries; pad rows collapse to empty segments at N
+            rp_out[i] = np.searchsorted(
+                sl, np.arange(r_pad + 1, dtype=np.int64))
+        nbytes = d * n_pad * 8 + d * (r_pad + 1) * 4
+        if mesh_info:
+            _, mesh, axis = mesh_info
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(mesh, P(axis, None))
+            return SparseSet(
+                word_idx=jax.device_put(wi_out, sh),
+                mask=jax.device_put(mk_out, sh),
+                row_ptr=jax.device_put(rp_out, sh), row_ids=all_ids,
+                row_cards=row_cards, shards=shards, nbytes=nbytes,
+                n_rows_pad=r_pad, mesh=mesh, axis=axis)
         return SparseSet(
-            word_idx=self.place(word_idx), mask=self.place(mask),
-            row_ptr=self.place(row_ptr), row_ids=all_ids,
-            row_cards=row_cards, shards=shards,
-            nbytes=n_pad * 8 + (r_pad + 1) * 4, n_rows_pad=r_pad)
+            word_idx=self.place(wi_out[0]), mask=self.place(mk_out[0]),
+            row_ptr=self.place(rp_out[0]), row_ids=all_ids,
+            row_cards=row_cards, shards=shards, nbytes=nbytes,
+            n_rows_pad=r_pad)
 
     def row_words(self, index: str, field: Field, view_name: str,
                   row_id: int, shards: tuple[int, ...]) -> jax.Array:
@@ -353,11 +422,9 @@ class PlaneCache:
         view = field.view(view_name)
         if view is None:
             return ()
-        out = []
-        for s in shards:
-            frag = view.fragment(s) if s != PAD_SHARD else None
-            out.append(frag.generation if frag is not None else -1)
-        return tuple(out)
+        # PAD_SHARD (-1) is never a fragment key, so it maps to -1
+        # like any absent shard
+        return view.generations(shards)
 
     def _get(self, key, field: Field, view_name: str,
              shards: tuple[int, ...], build) -> PlaneSet:
@@ -539,6 +606,8 @@ class PlaneCache:
                     continue
                 frag = view.fragment(s)
                 if frag is not None:
-                    host[si] = frag.row(row_id).words()
+                    # plane_rows: snapshot rows come straight off the
+                    # blob (bitmap containers memcpy) — no RowBits
+                    frag.plane_rows([row_id], host[si:si + 1], slots=[0])
         return PlaneSet(self.place(host), shards,
                         np.array([row_id], np.uint64), {row_id: 0})

@@ -8,6 +8,7 @@ upstream ``*pql.Call``.
 """
 
 from pilosa_tpu.pql.ast import Call, Condition, Query
-from pilosa_tpu.pql.parser import ParseError, parse
+from pilosa_tpu.pql.parser import ParseError, parse, parse_cached
 
-__all__ = ["Call", "Condition", "Query", "ParseError", "parse"]
+__all__ = ["Call", "Condition", "Query", "ParseError", "parse",
+           "parse_cached"]

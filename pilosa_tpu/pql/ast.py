@@ -42,6 +42,17 @@ class Condition:
             return f"{self.value[0]} {lo_op} x {hi_op} {self.value[1]}"
         return f"x {self.op} {self.value}"
 
+    def matches(self, val) -> bool:
+        """Host-side scalar evaluation (GroupBy ``having=`` filtering)."""
+        if self.op in BETWEEN_OPS:
+            lo, hi = self.value
+            lo_ok = val > lo if self.op.startswith("<>") else val >= lo
+            hi_ok = val < hi if self.op.endswith("><") else val <= hi
+            return bool(lo_ok and hi_ok)
+        v = self.value
+        return bool({"==": val == v, "!=": val != v, "<": val < v,
+                     "<=": val <= v, ">": val > v, ">=": val >= v}[self.op])
+
 
 @dataclass
 class Call:

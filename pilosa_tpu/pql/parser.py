@@ -28,6 +28,8 @@ positional position map to ``_field``.
 
 from __future__ import annotations
 
+import functools as _functools
+
 from typing import Any
 
 from pilosa_tpu.pql import lexer as lx
@@ -213,3 +215,13 @@ def parse(src: str) -> Query:
     """Parse a PQL string into a :class:`Query` (reference:
     ``pql.ParseString``)."""
     return _Parser(src).query()
+
+
+@_functools.lru_cache(maxsize=512)
+def parse_cached(src: str) -> Query:
+    """Bounded memoized :func:`parse` for the serving hot path: repeated
+    query shapes skip the parser entirely.  Callers must treat the
+    returned AST as IMMUTABLE — every consumer that rewrites calls
+    (cluster fan-out translation, Limit/Extract rewriting) copies first
+    (``dist.py#_translate_input`` walk)."""
+    return parse(src)

@@ -24,6 +24,7 @@ class PilosaTPUServer:
         self.executor: Executor | None = None
         self.api: API | None = None
         self.http: HttpServer | None = None
+        self.grpc = None
         self.cluster = None
         self.diagnostics = None
 
@@ -41,6 +42,8 @@ class PilosaTPUServer:
                             if self.cfg.jax_process_id >= 0 else None))
             self.logger.info("jax.distributed: process %d of %d",
                              jax.process_index(), jax.process_count())
+        from pilosa_tpu.store import syswrap
+        syswrap.GLOBAL.set_max(self.cfg.max_map_count)
         self.holder.open()
         placement = None
         if self.cfg.mesh:
@@ -65,6 +68,13 @@ class PilosaTPUServer:
                                    port=self.http.address[1])
             self.api.cluster = self.cluster
         self.http.start()
+        if self.cfg.grpc_bind:
+            from pilosa_tpu.api.grpc import GrpcServer
+            ghost, _, gport = self.cfg.grpc_bind.rpartition(":")
+            self.grpc = GrpcServer(self.api, ghost or "127.0.0.1",
+                                   int(gport)).start()
+            self.logger.info("grpc: listening on %s:%d",
+                             ghost or "127.0.0.1", self.grpc.port)
         if self.cluster is not None:
             self.cluster.open()
         from pilosa_tpu.obs.diagnostics import Diagnostics
@@ -79,6 +89,8 @@ class PilosaTPUServer:
             self.diagnostics.close()
         if self.cluster is not None:
             self.cluster.close()
+        if self.grpc is not None:
+            self.grpc.close()
         if self.http is not None:
             self.http.close()
         if self.executor is not None:

@@ -95,8 +95,12 @@ class Fragment:
     def _open_snapshot(self) -> None:
         """mmap the snapshot and parse only its container directory —
         zero-copy cold start (the reference's ``roaring.FromBuffer`` over
-        ``syswrap.Mmap``): no bit is expanded until a row is touched."""
+        ``syswrap.Mmap``): no bit is expanded until a row is touched.
+        Map count is bounded by ``syswrap.GLOBAL`` (LRU demotion to a
+        heap copy — the reference's mmap→heap fallback)."""
         import mmap as _mmaplib
+
+        from pilosa_tpu.store import syswrap
         with open(self.path, "rb") as f:
             head = f.read(2)
             if len(head) == 2 and struct.unpack("<H", head)[0] == \
@@ -107,16 +111,40 @@ class Fragment:
                 self._snap_dir = roaring.Directory(memoryview(mm))
                 self._snap_pending = set(
                     int(r) for r in self._snap_dir.row_ids())
+                syswrap.GLOBAL.register(self)
                 return
             # non-pilosa (e.g. standard32) snapshot: legacy eager load
             f.seek(0)
             self._load_positions(roaring.deserialize(f.read()))
 
+    def _demote_map(self) -> bool:
+        """Swap the mmap'd snapshot for a heap copy (syswrap LRU
+        eviction); returns False when the timed lock acquire fails so
+        the pool can keep tracking this fragment (on contention the cap
+        stays soft rather than deadlocking against a concurrent
+        opener)."""
+        if not self.lock.acquire(timeout=1.0):
+            return False
+        try:
+            if self._snap_mm is None or self._snap_dir is None:
+                return True  # nothing to demote — already heap/absent
+            heap = bytes(self._snap_dir.buf)
+            self._snap_dir = roaring.Directory(memoryview(heap))
+            self._snap_mm = None  # closed when the last view dies
+            return True
+        finally:
+            self.lock.release()
+
     def _drop_snapshot(self) -> None:
+        from pilosa_tpu.store import syswrap
+        syswrap.GLOBAL.release(self)
         self._snap_dir = None
         self._snap_pending = set()
         if self._snap_mm is not None:
-            self._snap_mm.close()
+            try:
+                self._snap_mm.close()
+            except BufferError:
+                pass  # in-flight views; refcounting closes it later
             self._snap_mm = None
 
     def _ensure_row(self, row_id: int) -> None:
@@ -140,8 +168,14 @@ class Fragment:
 
     # -- reads --------------------------------------------------------------
 
+    def _touch_map(self) -> None:
+        if self._snap_mm is not None:
+            from pilosa_tpu.store import syswrap
+            syswrap.GLOBAL.touch(self)
+
     def row(self, row_id: int) -> RowBits:
         with self.lock:
+            self._touch_map()
             self._ensure_row(row_id)
             return self.rows.get(row_id) or RowBits()
 
@@ -149,6 +183,15 @@ class Fragment:
         with self.lock:
             live = {r for r, b in self.rows.items() if b.any()}
             return sorted(live | self._snap_pending)
+
+    @property
+    def present(self) -> bool:
+        """Cheap row-presence check WITHOUT expanding snapshot bits:
+        overlay rows or rows still resident in the mmap'd snapshot.
+        (``rows`` alone misses lazily-opened snapshot fragments — a
+        cold-reopened multi-shard index would report no shards and
+        queries would silently cover only shard 0.)"""
+        return bool(self.rows) or bool(self._snap_pending)
 
     def max_row_id(self) -> int:
         ids = self.row_ids()
@@ -173,6 +216,7 @@ class Fragment:
         codec when built) WITHOUT materializing host ``RowBits`` — the
         bulk path for snapshot compaction and the sparse device build."""
         with self.lock:
+            self._touch_map()
             parts = []
             if self._snap_pending:
                 snap = roaring.deserialize(self._snap_dir.buf)
@@ -239,6 +283,7 @@ class Fragment:
         if slots is None:
             slots = range(len(row_ids))
         with self.lock:
+            self._touch_map()
             pend, pend_slots = [], []
             for r, s in zip(row_ids, slots):
                 r = int(r)
@@ -259,70 +304,85 @@ class Fragment:
                                     pend_sorted, tmp)
                 out[np.array(pend_slots)[order]] = tmp
             else:
+                # few rows: per-row directory slices (bitmap containers
+                # memcpy from the blob) — no RowBits materialization,
+                # and unlike the native one-pass expand it never walks
+                # containers of rows that weren't asked for
                 for r, s in zip(pend, pend_slots):
-                    self._ensure_row(r)
-                    out[s] = self.rows[r].words()
+                    self._snap_dir.row_words(r, out[s])
 
     # Cap on the generation-cached inverted index (sparse bits copied
-    # into one flat array): 64M bits = 256MB.  Beyond it, fall back to
-    # the per-row loop rather than hold a second copy of a huge field.
+    # into one flat array): 64M bits = 256MB.  Beyond it a second flat
+    # copy of a huge field is not held.
     COLINDEX_MAX_BITS = 64 << 20
 
-    # Lazy fragments with more pending snapshot rows than this answer
-    # rows_containing from a direct positions() scan instead of the
-    # colindex — building the cache would materialize millions of
-    # RowBits (the cache also caps itself by bits, COLINDEX_MAX_BITS).
-    COLINDEX_MAX_PENDING = 100_000
+    # Building the colindex materializes every row as a host RowBits —
+    # fine for 100k rows, pathological for a 5M-row lazy snapshot (GBs
+    # of per-object overhead for 20M actual bits).  Row-counts beyond
+    # this cap skip the cache regardless of bit count.
+    COLINDEX_MAX_ROWS = 100_000
+
+    # With the colindex unavailable, fragments with at most this many
+    # rows answer by per-row O(1) word probes; beyond it, one
+    # vectorized positions() scan of the blob (O(bits) numpy, zero
+    # materialization).  Regime crossover measured on this host
+    # (round 3): 64 dense rows × 15M bits — probes 132 ms vs scan
+    # 984 ms (7×); 500k sparse rows × 2M bits — scan 213 ms vs
+    # probe-loop ≈4.5 s extrapolated (20×, and the scan materializes
+    # zero host rows).
+    COLINDEX_CONTAINS_MAX_ROWS = 4096
 
     def rows_containing(self, col: int) -> np.ndarray:
         """Sorted row IDs whose bit ``col`` is set — the ``Rows(column=)``
         membership check (reference: per-row ``row.Includes`` walk in
-        ``executor.go#executeRowsShard``).  One vectorized scan over a
-        generation-cached flat (col, row) copy of the sparse rows plus a
-        short loop over the (cardinality-bounded) dense rows, instead of
-        a Python ``contains()`` call per row — O(rows) interpreter work
-        becomes O(bits) numpy work."""
+        ``executor.go#executeRowsShard``).
+
+        One decision, three regimes, chosen from directory metadata
+        BEFORE any row materializes (unified in round 3 — the old
+        over-cap path materialized every row first):
+
+        1. bits ≤ COLINDEX_MAX_BITS: generation-cached flat (col, row)
+           index, vectorized scan per query (the common case);
+        2. few rows of many bits: per-row O(1) word probes;
+        3. many rows of many bits: one vectorized blob positions()
+           scan, no host row objects."""
         with self.lock:
-            if len(self._snap_pending) > self.COLINDEX_MAX_PENDING:
-                pos = self.positions()  # blob-composed, no materialize
-                rows = pos[pos % _SW == np.uint64(col)] // _SW
-                rows.sort()
-                return rows.astype(np.uint64)
-            idx = self._colindex()
-            if idx is None:  # over cap: per-row fallback
-                return np.array(sorted(
-                    r for r, b in self.rows.items() if b.contains(col)),
+            ids, cards = self.row_cardinalities()
+            if (int(cards.sum()) <= self.COLINDEX_MAX_BITS
+                    and len(ids) <= self.COLINDEX_MAX_ROWS):
+                sp_cols, sp_rows, dense = self._colindex()
+                hits = sp_rows[sp_cols == np.uint32(col)]
+                w, bit = col >> 5, np.uint32(1 << (col & 31))
+                dense_hits = [r for r, words in dense if words[w] & bit]
+                out = np.concatenate(
+                    [hits, np.array(dense_hits, np.uint64)]) \
+                    if dense_hits else hits
+                out.sort()
+                return out.astype(np.uint64)
+            if len(ids) <= self.COLINDEX_CONTAINS_MAX_ROWS:
+                return np.array(
+                    [int(r) for r in ids if self.row(int(r)).contains(col)],
                     dtype=np.uint64)
-            sp_cols, sp_rows, dense = idx
-            hits = sp_rows[sp_cols == np.uint32(col)]
-            w, bit = col >> 5, np.uint32(1 << (col & 31))
-            dense_hits = [r for r, words in dense if words[w] & bit]
-            out = np.concatenate(
-                [hits, np.array(dense_hits, np.uint64)]) \
-                if dense_hits else hits
-            out.sort()
-            return out.astype(np.uint64)
+            pos = self.positions()  # blob-composed, no materialize
+            rows = pos[pos % _SW == np.uint64(col)] // _SW
+            rows.sort()
+            return rows.astype(np.uint64)
 
     def _colindex(self):
-        """(sparse_cols, sparse_rows, dense_list) cached per generation."""
+        """(sparse_cols, sparse_rows, dense_list) cached per generation.
+        Only called with total bits pre-checked under the cap."""
         cached = getattr(self, "_colindex_cache", None)
         if cached is not None and cached[0] == self.generation:
             return cached[1]
         self._materialize_all()
         sp_parts, sp_ids, dense = [], [], []
-        total = 0
         for r, b in self.rows.items():
             if not b.any():
                 continue
             if b.is_dense:
                 dense.append((r, b.words()))
                 continue
-            cols = b.columns()
-            total += len(cols)
-            if total > self.COLINDEX_MAX_BITS:
-                self._colindex_cache = (self.generation, None)
-                return None
-            sp_parts.append(cols)
+            sp_parts.append(b.columns())
             sp_ids.append(r)
         if sp_parts:
             sp_cols = np.concatenate(sp_parts)

@@ -176,6 +176,46 @@ def serialize(positions: np.ndarray) -> bytes:
     return bytes(out)
 
 
+def serialize_dense(words: np.ndarray, row_ids: np.ndarray | None = None
+                    ) -> bytes:
+    """Packed row words -> pilosa-format bytes, fully vectorized.
+
+    ``words`` is ``uint32[R, W]`` (row-major packed bits, W*32 = shard
+    width); ``row_ids`` the global row id per slab row (default 0..R-1).
+    Every non-empty 65536-bit block is written as a BITMAP container —
+    valid format but not minimal for sparse/runny blocks (use
+    :func:`serialize` for minimality).  This is the bulk writer for
+    dense synthetic/bench indexes: no per-position work, essentially a
+    popcount + memory-layout transform (reference:
+    ``roaring/roaring.go#WriteTo``)."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    r, w = words.shape
+    cw = 65536 // 32                      # uint32 words per container
+    if w % cw:
+        raise ValueError(f"roaring: row width {w * 32} not a multiple "
+                         "of 65536 bits")
+    per_row = w // cw
+    if row_ids is None:
+        row_ids = np.arange(r, dtype=np.uint64)
+    conts = words.reshape(r * per_row, cw)
+    cards = np.bitwise_count(conts).sum(axis=1, dtype=np.int64)
+    keys = (np.repeat(np.asarray(row_ids, np.uint64), per_row)
+            * np.uint64(per_row)
+            + np.tile(np.arange(per_row, dtype=np.uint64), r))
+    nz = cards > 0
+    conts, cards, keys = conts[nz], cards[nz], keys[nz]
+    n = len(keys)
+    meta = np.zeros(n, dtype=[("k", "<u8"), ("t", "<u2"), ("c", "<u2")])
+    meta["k"] = keys
+    meta["t"] = TYPE_BITMAP
+    meta["c"] = cards - 1                 # stored as cardinality-1
+    data_start = 8 + 12 * n + 4 * n
+    offsets = (data_start
+               + 8192 * np.arange(n, dtype=np.int64)).astype("<u4")
+    return (struct.pack("<HHI", MAGIC, VERSION, n) + meta.tobytes()
+            + offsets.tobytes() + conts.astype("<u4").tobytes())
+
+
 def deserialize(buf: bytes | memoryview) -> np.ndarray:
     """Pilosa-format or standard-32-bit bytes -> sorted uint64 positions."""
     buf = memoryview(buf)
@@ -327,6 +367,26 @@ class Directory:
                               offset=off + 2)
         _check_runs(pairs[0::2], pairs[1::2])
         return _expand_runs(pairs[0::2], pairs[1::2])
+
+    def row_words(self, row: int, out: np.ndarray) -> None:
+        """OR one row's bits into ``out`` (uint32[32768], the row's
+        packed words) straight from the blob: bitmap containers are a
+        plain memcpy of their 8KB payload — no position expansion, no
+        repacking.  The fast path for assembling device planes from
+        mmap'd snapshots (array/run containers scatter their bits)."""
+        for i in self._row_container_idx(row):
+            i = int(i)
+            base_word = (int(self.keys[i])
+                         & ((1 << self.ROW_SHIFT) - 1)) * 2048
+            if int(self.types[i]) == TYPE_BITMAP:
+                off = int(self.offsets[i])
+                out[base_word:base_word + 2048] |= np.frombuffer(
+                    self.buf, dtype="<u4", count=2048, offset=off)
+            else:
+                lows = self.expand_container(i).astype(np.int32)
+                np.bitwise_or.at(
+                    out, base_word + (lows >> 5),
+                    (np.uint32(1) << (lows & 31).astype(np.uint32)))
 
     def expand_row(self, row: int) -> np.ndarray:
         """One row's column offsets (sorted uint32) — touches only that

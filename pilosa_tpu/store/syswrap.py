@@ -1,0 +1,78 @@
+"""Resource caps for snapshot mmaps (reference: ``syswrap/`` —
+``maxMapCount`` with transparent mmap→heap fallback, SURVEY.md §3.1).
+
+Every open fragment holds one mmap of its snapshot file; a large holder
+(hundreds of indexes × fields × shards) can exhaust ``vm.max_map_count``
+or the fd limit.  The process-global :data:`GLOBAL` pool bounds live
+maps: fragments register on mmap-open (LRU order, touched on read);
+over the cap the least-recently-used fragment is DEMOTED — its
+directory re-parses over a heap copy of the blob and the map is
+released — and if demotion can't proceed (lock contention) the opener
+itself falls back to a heap read.  Both fallbacks keep every query
+path working, trading memory for map slots exactly like the
+reference's heap fallback.
+
+The map is never force-closed: demotion drops the owning references
+and lets refcounting reclaim it once in-flight readers (numpy views
+over the buffer) finish — avoiding ``BufferError`` on exported views.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+# Default cap: comfortably under Linux's vm.max_map_count default
+# (65530), leaving headroom for the allocator/XLA's own mappings.
+DEFAULT_MAX_MAPS = 32768
+
+
+class MapPool:
+    def __init__(self, max_maps: int = DEFAULT_MAX_MAPS):
+        self.max_maps = max_maps
+        self._lock = threading.Lock()
+        self._order: "OrderedDict[int, weakref.ref]" = OrderedDict()
+
+    def set_max(self, n: int) -> None:
+        self.max_maps = max(1, int(n))
+
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+    def register(self, frag) -> None:
+        """Register ``frag`` as a map holder.  Over the cap, LRU
+        holders are demoted to heap (outside this pool's lock —
+        demotion takes the victim fragment's own lock with a timeout;
+        on contention the cap is soft for that victim rather than
+        risking lock-order deadlock between two opening fragments)."""
+        victims = []
+        with self._lock:
+            while len(self._order) >= self.max_maps:
+                _, ref = self._order.popitem(last=False)
+                v = ref()
+                if v is not None:
+                    victims.append(v)
+            self._order[id(frag)] = weakref.ref(frag)
+        for v in victims:
+            if not v._demote_map():
+                # lock contention: the victim still holds its map —
+                # re-track it at the LRU head so it stays countable
+                # and demotable next time
+                with self._lock:
+                    self._order[id(v)] = weakref.ref(v)
+                    self._order.move_to_end(id(v), last=False)
+
+    def touch(self, frag) -> None:
+        with self._lock:
+            if id(frag) in self._order:
+                self._order.move_to_end(id(frag))
+
+    def release(self, frag) -> None:
+        with self._lock:
+            self._order.pop(id(frag), None)
+
+
+GLOBAL = MapPool()

@@ -59,7 +59,18 @@ class View:
 
     def available_shards(self) -> list[int]:
         with self._lock:
-            return sorted(s for s, f in self.fragments.items() if f.rows)
+            return sorted(s for s, f in self.fragments.items() if f.present)
+
+    def generations(self, shards) -> tuple:
+        """Fragment generation per shard (-1 = absent), ONE lock
+        acquisition for the whole list — the device plane cache
+        revalidates on every query, so per-shard ``fragment()`` calls
+        (954 lock round trips on a 1B-column index) are serving-path
+        poison."""
+        with self._lock:
+            frags = self.fragments
+            return tuple(
+                frags[s].generation if s in frags else -1 for s in shards)
 
     def max_row_id(self) -> int:
         with self._lock:

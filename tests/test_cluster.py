@@ -180,6 +180,30 @@ class TestDistributedQueries:
                       grp["count"]) for grp in g)
         assert got == [((1, 2), 1), ((1, 3), 1)]
 
+    def test_groupby_having_distributed(self, three_nodes):
+        # having thresholds apply to GLOBAL sums: each node alone sees
+        # count 1 for row 1, so a local having(count > 1) would wrongly
+        # drop it — the strip+merge path must keep it
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "a")
+        c.client(0).create_field("i", "v", {"type": "int", "min": -100,
+                                            "max": 100})
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "a", rowIDs=[1, 1, 2],
+                                columnIDs=[5, far, 6])
+        c.client(0).import_values("i", "v", columnIDs=[5, far, 6],
+                                  values=[40, 30, 9])
+        (g,) = c.client(1).query(
+            "i", "GroupBy(Rows(a), having=Condition(count > 1))")
+        assert [(grp["group"][0]["rowID"], grp["count"]) for grp in g] \
+            == [(1, 2)]
+        (g,) = c.client(2).query(
+            "i", "GroupBy(Rows(a), aggregate=Sum(field=v),"
+                 "having=Condition(sum >= 70))")
+        assert [(grp["group"][0]["rowID"], grp["agg"]) for grp in g] \
+            == [(1, 70)]
+
     def test_groupby_minmax_aggregate_distributed(self, three_nodes):
         # Min/Max aggregates merge as extrema of per-node extrema (not
         # sums); values live on different nodes' shards

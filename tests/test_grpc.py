@@ -1,0 +1,142 @@
+"""gRPC surface (reference: v2 grpc.go): the generic-handler service
+speaks the internal.proto messages via the dependency-free codec; query
+results must equal the HTTP/JSON surface's."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grpc")
+
+from pilosa_tpu.api import proto  # noqa: E402
+from pilosa_tpu.api.grpc import SERVICE, GrpcServer  # noqa: E402
+
+
+@pytest.fixture
+def served(tmp_path):
+    from pilosa_tpu.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder, Executor(holder))
+    srv = GrpcServer(api, port=0).start()
+    yield srv, api
+    srv.close()
+    holder.close()
+
+
+def _stubs(port):
+    import grpc
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    ident = lambda b: b  # raw-bytes (de)serializers — our codec does the work
+    return {
+        m: chan.unary_unary(f"/{SERVICE}/{m}", request_serializer=ident,
+                            response_deserializer=ident)
+        for m in ("Query", "Import", "ImportValue")
+    }
+
+
+def test_grpc_query_import_round_trip(served):
+    srv, api = served
+    api.create_index("i")
+    api.create_field("i", "f")
+    api.create_field("i", "v", {"type": "int", "min": -50, "max": 50})
+    stubs = _stubs(srv.port)
+
+    out = proto.decode_import_response(stubs["Import"](
+        proto.encode_import_request(index="i", field="f",
+                                    row_ids=[1, 1, 2],
+                                    col_ids=[5, 9, 5])))
+    assert out == {"changed": 3}
+
+    out = proto.decode_import_response(stubs["ImportValue"](
+        proto.encode_import_value_request(index="i", field="v",
+                                          col_ids=[5, 9],
+                                          values=[-7, 40])))
+    # "changed" counts bit-plane mutations (HTTP surface semantics);
+    # the Sum query below verifies the values landed exactly
+    assert "error" not in out and out["changed"] > 0
+
+    resp = proto.decode_query_response(stubs["Query"](
+        proto.encode_query_request(
+            "Count(Row(f=1)) Row(f=1) Sum(field=v) TopN(f)", index="i")))
+    assert "error" not in resp
+    count, row, s, topn = resp["results"]
+    assert count == 2
+    assert row == {"columns": [5, 9]}
+    assert s == {"value": 33, "count": 2}
+    assert topn == api.query("i", "TopN(f)")["results"][0]
+
+
+def test_grpc_errors_decodable(served):
+    srv, api = served
+    api.create_index("i")
+    stubs = _stubs(srv.port)
+    resp = proto.decode_query_response(stubs["Query"](
+        proto.encode_query_request("Count(Row(f=1))", index="nope")))
+    assert "nope" in resp["error"]
+    resp = proto.decode_query_response(stubs["Query"](
+        proto.encode_query_request("Count(Row(f=1))")))  # no index
+    assert "index" in resp["error"]
+    out = proto.decode_import_response(stubs["Import"](
+        proto.encode_import_request(index="i", field="missing",
+                                    row_ids=[1], col_ids=[2])))
+    assert "missing" in out["error"]
+
+
+def test_grpc_through_server_config(tmp_path):
+    from pilosa_tpu.cli.config import Config
+    from pilosa_tpu.server import PilosaTPUServer
+
+    cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path),
+                 grpc_bind="127.0.0.1:0", mesh=False)
+    srv = PilosaTPUServer(cfg).open()
+    try:
+        srv.api.create_index("i")
+        srv.api.create_field("i", "f")
+        stubs = _stubs(srv.grpc.port)
+        proto.decode_import_response(stubs["Import"](
+            proto.encode_import_request(index="i", field="f",
+                                        row_ids=[1], col_ids=[3])))
+        resp = proto.decode_query_response(stubs["Query"](
+            proto.encode_query_request("Count(Row(f=1))", index="i")))
+        assert resp["results"] == [1]
+    finally:
+        srv.close()
+
+
+def test_import_request_codec_round_trip():
+    raw = proto.encode_import_request(
+        index="i", field="f", row_ids=[1, 2], col_ids=[5, 1 << 40],
+        timestamps=[1609459200, -5], clear=True)
+    b = proto.decode_import_request(raw)
+    assert b == {"index": "i", "field": "f", "row_ids": [1, 2],
+                 "col_ids": [5, 1 << 40], "row_keys": None,
+                 "col_keys": None, "timestamps": [1609459200, -5],
+                 "clear": True}
+    raw = proto.encode_import_request(row_keys=["a"], col_keys=["x", "y"],
+                                      timestamps=["2021-01-01T00:00:00"])
+    b = proto.decode_import_request(raw)
+    assert (b["row_keys"], b["col_keys"], b["timestamps"], b["clear"]) == \
+        (["a"], ["x", "y"], ["2021-01-01T00:00:00"], False)
+    with pytest.raises(ValueError):
+        proto.encode_import_request(timestamps=[1, "2021-01-01T00:00:00"])
+
+
+def test_import_value_codec_round_trip():
+    for values in ([1, -2, 3], [0.5, -1.25], ["2021-01-01T00:00:00"]):
+        raw = proto.encode_import_value_request(index="i", field="v",
+                                                col_ids=[1, 2, 3][:len(values)],
+                                                values=values)
+        b = proto.decode_import_value_request(raw)
+        assert b["values"] == values, values
+
+
+def test_out_of_range_ints_raise_value_error():
+    # numpy OverflowError must surface as ValueError so the cluster
+    # router's fall-back-to-JSON handling fires (review r3 finding)
+    with pytest.raises(ValueError):
+        proto.encode_import_request(row_ids=[1], col_ids=[2],
+                                    timestamps=[1 << 70])
+    with pytest.raises(ValueError):
+        proto.encode_import_request(row_ids=[1 << 70], col_ids=[2])

@@ -20,6 +20,7 @@ RESULT_CASES = [
     {"columns": [1, 5, 1 << 40]},
     {"columns": []},
     {"keys": ["alice", "bob"]},
+    {"keys": []},  # keyed row with zero columns must stay key-shaped
     [{"id": 10, "count": 3}, {"id": 0, "count": 1}],
     [{"key": "admin", "count": 7}],
     [],
@@ -121,20 +122,27 @@ def test_http_negotiation_matches_json(served):
                     "Accept": proto.CONTENT_TYPE})
     assert proto.decode_query_response(raw)["results"] == [3]
 
-    # query errors arrive as QueryResponse.err, not HTTP 400 JSON
-    _, raw = _post(url, "/index/i/query", b"Row(nope=1)",
-                   {"Accept": proto.CONTENT_TYPE})
+    # query errors carry the same HTTP status as the JSON surface (400),
+    # with a decodable proto QueryResponse.err body
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(url, "/index/i/query", b"Row(nope=1)",
+              {"Accept": proto.CONTENT_TYPE})
+    assert exc.value.code == 400
+    raw = exc.value.read()
     assert "nope" in proto.decode_query_response(raw)["error"]
 
     # ?profile has no proto representation: explicit 400, not silence
-    import urllib.error
     with pytest.raises(urllib.error.HTTPError):
         _post(url, "/index/i/query?profile=1", b"Count(Row(f=10))",
               {"Accept": proto.CONTENT_TYPE})
 
-    # Extract is tabular — no proto encoding; the error arrives as a
+    # Extract is tabular — no proto encoding; 400 with the error as a
     # decodable proto QueryResponse.err, not a JSON body
-    _, raw = _post(url, "/index/i/query",
-                   b"Extract(ConstRow(columns=[1]), Rows(f))",
-                   {"Accept": proto.CONTENT_TYPE})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(url, "/index/i/query",
+              b"Extract(ConstRow(columns=[1]), Rows(f))",
+              {"Accept": proto.CONTENT_TYPE})
+    assert exc.value.code == 400
+    raw = exc.value.read()
     assert "not representable" in proto.decode_query_response(raw)["error"]

@@ -165,3 +165,77 @@ def test_malformed_standard32_run_rejected():
     out += struct.pack("<H", 1) + struct.pack("<HH", 65000, 1000)
     with pytest.raises(ValueError):
         roaring.deserialize(out)
+
+
+class TestSerializeDense:
+    def test_matches_position_serializer(self):
+        rng = np.random.default_rng(7)
+        # dense rows: every container exceeds array cardinality, so the
+        # general serializer also picks bitmap containers -> byte-equal
+        words = rng.integers(0, 1 << 32, size=(3, 4096), dtype=np.uint32)
+        blob = roaring.serialize_dense(words, np.array([0, 2, 9],
+                                                       np.uint64))
+        width = words.shape[1] * 32
+        pos_parts = []
+        for slab_row, rid in enumerate([0, 2, 9]):
+            cols = np.nonzero(np.unpackbits(
+                words[slab_row].view(np.uint8), bitorder="little"))[0]
+            pos_parts.append(rid * width + cols.astype(np.uint64))
+        positions = np.concatenate(pos_parts)
+        assert blob == roaring.serialize(positions)
+
+    def test_round_trip_with_sparse_and_empty_blocks(self):
+        rng = np.random.default_rng(8)
+        words = np.zeros((2, 4096), dtype=np.uint32)
+        words[0, :10] = rng.integers(1, 1 << 32, 10, dtype=np.uint32)
+        # container 1 of row 0 and all of row 1's first block stay empty
+        words[1, 2048 + 5] = 0x80000001
+        blob = roaring.serialize_dense(words)
+        got = roaring.deserialize(blob)
+        width = words.shape[1] * 32
+        want = np.concatenate([
+            r * width + np.nonzero(np.unpackbits(
+                words[r].view(np.uint8), bitorder="little"))[0].astype(
+                np.uint64)
+            for r in range(2)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_directory_row_cards(self):
+        # Directory's row decoding assumes full shard-width rows
+        # (key >> 4 = row), so this case uses 32768-word rows
+        rng = np.random.default_rng(9)
+        words = rng.integers(0, 1 << 32, size=(4, 32768), dtype=np.uint32)
+        blob = roaring.serialize_dense(words)
+        d = roaring.Directory(memoryview(blob))
+        ids, cards = d.row_cards()
+        np.testing.assert_array_equal(ids, np.arange(4, dtype=np.uint64))
+        np.testing.assert_array_equal(
+            cards, np.bitwise_count(words).sum(axis=1))
+
+    def test_bad_width_rejected(self):
+        with pytest.raises(ValueError):
+            roaring.serialize_dense(np.zeros((1, 1000), np.uint32))
+
+
+class TestDirectoryRowWords:
+    def test_row_words_matches_expand_row(self):
+        rng = np.random.default_rng(11)
+        width = 1 << 20
+        # mixed container types in one row: dense block (bitmap), small
+        # block (array), consecutive run block
+        cols = np.concatenate([
+            rng.choice(65536, size=20000, replace=False),          # bitmap
+            65536 + rng.choice(65536, size=50, replace=False),     # array
+            2 * 65536 + np.arange(9000),                           # run
+        ]).astype(np.uint64)
+        positions = np.unique(np.concatenate(
+            [3 * width + cols, 7 * width + cols[:100]]))
+        blob = roaring.serialize(positions)
+        d = roaring.Directory(memoryview(blob))
+        for row in (3, 7, 5):
+            out = np.zeros(32768, np.uint32)
+            d.row_words(row, out)
+            got = np.nonzero(np.unpackbits(
+                out.view(np.uint8), bitorder="little"))[0]
+            np.testing.assert_array_equal(got, d.expand_row(row),
+                                          err_msg=f"row {row}")

@@ -206,7 +206,9 @@ class TestFragment:
         f.close()
 
         g = Fragment(path, 0).open()
-        monkeypatch.setattr(Fragment, "COLINDEX_MAX_PENDING", 10)
+        # force the no-materialize positions-scan regime
+        monkeypatch.setattr(Fragment, "COLINDEX_MAX_ROWS", 10)
+        monkeypatch.setattr(Fragment, "COLINDEX_CONTAINS_MAX_ROWS", 0)
         assert g.blocks() == truth_blocks
         np.testing.assert_array_equal(g.rows_containing(probe), truth_rows)
         np.testing.assert_array_equal(g.block_positions(2), truth_bp)
@@ -619,3 +621,87 @@ class TestSetRowAtomicity:
         assert f.set_row(5, np.empty(0, np.uint32))
         g = Fragment(path, 0).open()
         assert not g.row(5).any()
+
+
+class TestColdReopenShardDiscovery:
+    def test_available_shards_after_snapshot_reopen(self, tmp_path):
+        """Lazily-opened snapshot fragments (no overlay rows yet) must
+        still count as available — before the fix, a cold-reopened
+        multi-shard index reported no shards and the executor silently
+        fell back to shard 0 only."""
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        cols = np.array([5, SHARD_WIDTH + 6, 2 * SHARD_WIDTH + 7],
+                        np.uint64)
+        f.import_bits(np.array([1, 1, 1], np.uint64), cols)
+        for s in (0, 1, 2):
+            f.view("standard").fragment(s).snapshot()
+        h.close()
+
+        h2 = Holder(str(tmp_path)).open()
+        try:
+            idx2 = h2.index("i")
+            assert idx2.available_shards() == [0, 1, 2]
+            # end-to-end: a shard-unrestricted Count must cover them all
+            from pilosa_tpu.exec import Executor
+            ex = Executor(h2)
+            assert ex.execute("i", "Count(Row(f=1))") == [3]
+        finally:
+            h2.close()
+
+
+class TestSyswrapMapCap:
+    def test_holder_survives_more_fragments_than_map_cap(self, tmp_path):
+        """syswrap parity (reference: syswrap maxMapCount): open far
+        more snapshot fragments than the live-map cap; LRU fragments
+        demote to heap copies, every query stays exact, and the live
+        map count respects the cap."""
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.store import syswrap
+
+        n_shards, cap = 120, 10
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        cols = (np.arange(n_shards, dtype=np.uint64) * SHARD_WIDTH + 7)
+        f.import_bits(np.ones(n_shards, np.uint64), cols)
+        for s in range(n_shards):
+            f.view("standard").fragment(s).snapshot()
+        h.close()
+
+        old_max = syswrap.GLOBAL.max_maps
+        syswrap.GLOBAL.set_max(cap)
+        try:
+            h2 = Holder(str(tmp_path)).open()
+            frags = [h2.index("i").field("f").view("standard").fragment(s)
+                     for s in range(n_shards)]
+            live = sum(1 for fr in frags if fr._snap_mm is not None)
+            assert live <= cap, live
+            assert syswrap.GLOBAL.live <= cap
+            # demoted fragments answer from their heap copy
+            ex = Executor(h2)
+            assert ex.execute("i", "Count(Row(f=1))") == [n_shards]
+            (row,) = ex.execute("i", "Row(f=1)")
+            np.testing.assert_array_equal(row.columns, cols)
+            h2.close()
+        finally:
+            syswrap.GLOBAL.set_max(old_max)
+
+    def test_demoted_fragment_still_mutates(self, tmp_path):
+        from pilosa_tpu.store import syswrap
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        f.import_bits(np.array([1], np.uint64), np.array([5], np.uint64))
+        frag = f.view("standard").fragment(0)
+        frag.snapshot()
+        h.close()
+        h2 = Holder(str(tmp_path)).open()
+        frag2 = h2.index("i").field("f").view("standard").fragment(0)
+        assert frag2._snap_mm is not None
+        frag2._demote_map()
+        assert frag2._snap_mm is None
+        assert frag2.set_bit(1, 9)
+        np.testing.assert_array_equal(frag2.row(1).columns(), [5, 9])
+        h2.close()
