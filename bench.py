@@ -41,6 +41,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess  # noqa: F401 — used by the watchdog parent
 import sys
 import tempfile
 import threading
@@ -247,11 +248,24 @@ def product_tier(data_dir: str, oracle: np.ndarray, n_threads: int):
     log(f"PQL parse ({N_ROWS} calls): "
         f"{(time.perf_counter() - t0) * 1e3:.2f} ms/request")
 
+    # decomposed warmup: host plane assembly + HBM transfer first,
+    # then the first query (compile + dispatch + read) on top
+    ex = api.executor
+    idx = holder.index(INDEX)
+    fld = idx.field(FIELD)
+    shards = tuple(idx.available_shards())
+    t0 = time.perf_counter()
+    ps = ex.planes.field_plane(INDEX, fld, "standard", shards)
+    import jax as _jax
+    _jax.block_until_ready(ps.plane)
+    log(f"plane build (mmap expand + device_put): "
+        f"{time.perf_counter() - t0:.1f}s")
+
     want = [int(c) for c in oracle]
     t0 = time.perf_counter()
     res = api.query(INDEX, pql)["results"]
-    log(f"first product query (plane build from mmap + HBM transfer + "
-        f"compile): {time.perf_counter() - t0:.1f}s")
+    log(f"first product query (compile + dispatch + read): "
+        f"{time.perf_counter() - t0:.1f}s")
     assert res == want, "product-path counts diverge from oracle"
     log("product-path counts verified against numpy oracle")
 
@@ -302,6 +316,60 @@ def product_tier(data_dir: str, oracle: np.ndarray, n_threads: int):
 
 
 def main() -> None:
+    """Watchdog wrapper: the axon tunnel intermittently wedges
+    multi-GB programs at their first device read (observed round 3:
+    ~half of runs; small programs unaffected).  The measurement runs in
+    a child process; if the child logs nothing for STALL_S seconds it
+    is killed and retried, so one wedge cannot cost the round its
+    benchmark.  The child prints the single JSON line; the parent
+    forwards it."""
+    if os.environ.get("PILOSA_BENCH_CHILD"):
+        _measure()
+        return
+    attempts = int(os.environ.get("PILOSA_BENCH_ATTEMPTS", "3"))
+    stall_s = float(os.environ.get("PILOSA_BENCH_STALL_S", "420"))
+    for attempt in range(1, attempts + 1):
+        env = dict(os.environ, PILOSA_BENCH_CHILD="1")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        last = [time.monotonic()]
+
+        def pump(stream=proc.stderr):
+            for line in stream:
+                sys.stderr.buffer.write(line)
+                sys.stderr.flush()
+                last[0] = time.monotonic()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        stalled = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if time.monotonic() - last[0] > stall_s:
+                log(f"bench child silent >{stall_s:.0f}s (tunnel wedge); "
+                    f"killing — attempt {attempt}/{attempts}")
+                proc.kill()
+                proc.wait()
+                stalled = True
+                break
+            time.sleep(5)
+        if not stalled and proc.returncode == 0:
+            out = proc.stdout.read().decode().strip()
+            if out:
+                print(out.splitlines()[-1])
+                return
+            log("bench child produced no output; retrying")
+        elif not stalled:
+            log(f"bench child exited rc={proc.returncode}; retrying")
+        if attempt < attempts:
+            time.sleep(90)  # let the tunnel-side session drain
+    raise SystemExit("bench: every attempt stalled or failed")
+
+
+def _measure() -> None:
     rng = np.random.default_rng(42)
     # ~25% density rows over 1B columns
     plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),

@@ -23,6 +23,27 @@ _VARINT, _I64, _LEN = 0, 1, 2
 CONTENT_TYPE = "application/x-protobuf"
 
 
+def _malformed_as_value_error(fn):
+    """Decoders promise ValueError on ANY malformed input (the HTTP and
+    gRPC layers translate that into decodable 400s / .err responses) —
+    but the raw parsing raises struct.error on short fixed-width
+    payloads, AttributeError on wire-type confusion (int where bytes
+    expected), and UnicodeDecodeError on bad UTF-8."""
+    import functools
+    import struct as _struct
+
+    @functools.wraps(fn)
+    def wrapped(buf):
+        try:
+            return fn(buf)
+        except (ValueError, _struct.error, AttributeError,
+                UnicodeDecodeError, TypeError, IndexError) as e:
+            if type(e) is ValueError:
+                raise
+            raise ValueError(f"proto: malformed message: {e}")
+    return wrapped
+
+
 # -- primitives --------------------------------------------------------------
 
 
@@ -189,6 +210,7 @@ def _packed_uints(raw) -> list[int]:
 # -- QueryRequest ------------------------------------------------------------
 
 
+@_malformed_as_value_error
 def decode_query_request(buf: bytes) -> tuple[str, list[int] | None]:
     """-> (pql, shards or None)."""
     pql, shards = "", None
@@ -200,14 +222,19 @@ def decode_query_request(buf: bytes) -> tuple[str, list[int] | None]:
     return pql, shards
 
 
+@_malformed_as_value_error
 def decode_query_request_indexed(buf: bytes) \
         -> tuple[str, list[int] | None, str]:
     """-> (pql, shards or None, index) — the gRPC form, where no URL
-    path carries the index name."""
-    pql, shards = decode_query_request(buf)
-    index = ""
+    path carries the index name.  One pass over the buffer."""
+    pql, index = "", ""
+    shards = None
     for field, wire, val in _Reader(buf).fields():
-        if field == 3 and wire == _LEN:
+        if field == 1 and wire == _LEN:
+            pql = val.decode()
+        elif field == 2:
+            shards = (shards or []) + _packed_uints(val)
+        elif field == 3 and wire == _LEN:
             index = val.decode()
     return pql, shards, index
 
@@ -253,6 +280,7 @@ def encode_import_request(*, index: str = "", field: str = "",
     return out
 
 
+@_malformed_as_value_error
 def decode_import_request(buf: bytes) -> dict:
     """-> kwargs-shaped dict (row_ids/col_ids/row_keys/col_keys/
     timestamps/clear/index/field); absent lists are None."""
@@ -315,6 +343,7 @@ def encode_import_value_request(*, index: str = "", field: str = "",
     return out
 
 
+@_malformed_as_value_error
 def decode_import_value_request(buf: bytes) -> dict:
     index = field_name = ""
     col_ids: list | None = None
@@ -350,6 +379,7 @@ def encode_import_response(changed: int = 0, err: str = "") -> bytes:
     return out + _string(2, err)
 
 
+@_malformed_as_value_error
 def decode_import_response(buf: bytes) -> dict:
     changed, err = 0, ""
     for field, wire, val in _Reader(buf).fields():
@@ -563,6 +593,7 @@ def _dec_result(raw: bytes):
     raise ValueError(f"proto: unknown result type {typ}")
 
 
+@_malformed_as_value_error
 def decode_query_response(buf: bytes) -> dict:
     err = ""
     results = []

@@ -255,11 +255,23 @@ class Executor:
                    for v in values]
         ps = self.planes.field_plane(ctx.index.name, field, VIEW_STANDARD,
                                      ctx.shards)
-        key = (("countbatch-plane", ps.plane.shape), "count")
-        fn = self.fused._cached(key, lambda: kernels.row_counts)
-        # int32 per-shard counts (exact: 2^20 bits < 2^31), int64 on host
-        host = np.asarray(fn(ps.plane)).astype(np.int64)  # one read
-        totals = host.sum(axis=0)
+        # cross-shard reduce on DEVICE when int32 stays exact
+        # (n_shards * 2^20 < 2^31): the read shrinks from
+        # int32[S, R] to int32[R] — on transports with per-read costs
+        # the smaller payload is the serving hot path.  Wider shard
+        # sets keep per-shard counts and finish in int64 on host
+        # (engine int32 policy).
+        if len(ctx.shards) <= (1 << 31) // SHARD_WIDTH - 1:
+            key = (("countbatch-plane-reduced", ps.plane.shape), "count")
+            fn = self.fused._cached(
+                key, lambda: (lambda p: jnp.sum(
+                    kernels.row_counts(p), axis=0, dtype=jnp.int32)))
+            totals = np.asarray(fn(ps.plane)).astype(np.int64)  # one read
+        else:
+            key = (("countbatch-plane", ps.plane.shape), "count")
+            fn = self.fused._cached(key, lambda: kernels.row_counts)
+            host = np.asarray(fn(ps.plane)).astype(np.int64)
+            totals = host.sum(axis=0)
         out = []
         for rid in row_ids:
             slot = (ps.slot_of.get(int(rid)) if rid is not None else None)

@@ -210,6 +210,12 @@ def serialize_dense(words: np.ndarray, row_ids: np.ndarray | None = None
     meta["t"] = TYPE_BITMAP
     meta["c"] = cards - 1                 # stored as cardinality-1
     data_start = 8 + 12 * n + 4 * n
+    if data_start + 8192 * n > 0xFFFFFFFF:
+        # the format's offsets are uint32: fail loudly like serialize()
+        # does, never wrap silently into a corrupt-but-parseable blob
+        raise ValueError(
+            f"roaring: blob exceeds the 4 GB format limit ({n} bitmap "
+            "containers)")
     offsets = (data_start
                + 8192 * np.arange(n, dtype=np.int64)).astype("<u4")
     return (struct.pack("<HHI", MAGIC, VERSION, n) + meta.tobytes()

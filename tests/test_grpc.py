@@ -140,3 +140,18 @@ def test_out_of_range_ints_raise_value_error():
                                     timestamps=[1 << 70])
     with pytest.raises(ValueError):
         proto.encode_import_request(row_ids=[1 << 70], col_ids=[2])
+
+
+def test_malformed_decode_raises_value_error():
+    # struct.error / wire-type confusion must surface as ValueError so
+    # the HTTP/gRPC layers answer with decodable errors (review r3)
+    from pilosa_tpu.api.proto import _tag, _varint, _LEN, _VARINT
+    bad_float = _tag(6, _LEN) + _varint(9) + b"\x00" * 9  # not %8
+    with pytest.raises(ValueError):
+        proto.decode_import_value_request(bad_float)
+    bad_string = _tag(1, _VARINT) + _varint(5)  # int where bytes due
+    with pytest.raises(ValueError):
+        proto.decode_import_request(bad_string)
+    # decode_query_request guards its wire types explicitly and skips
+    # mismatches (proto3 unknown-field lenience) — tolerate, not crash
+    assert proto.decode_query_request(bad_string) == ("", None)

@@ -705,3 +705,56 @@ class TestSyswrapMapCap:
         assert frag2.set_bit(1, 9)
         np.testing.assert_array_equal(frag2.row(1).columns(), [5, 9])
         h2.close()
+
+    def test_demotion_races_concurrent_readers(self, tmp_path):
+        """Readers holding views over the mmap while the pool demotes:
+        results stay exact and nothing deadlocks (the demote uses a
+        timed lock acquire; failed victims stay tracked)."""
+        import threading
+
+        from pilosa_tpu.store import syswrap
+
+        n_frags, cap = 24, 4
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        cols = (np.arange(n_frags, dtype=np.uint64) * SHARD_WIDTH + 3)
+        f.import_bits(np.ones(n_frags, np.uint64), cols)
+        for s in range(n_frags):
+            f.view("standard").fragment(s).snapshot()
+        h.close()
+
+        old_max = syswrap.GLOBAL.max_maps
+        syswrap.GLOBAL.set_max(cap)
+        try:
+            h2 = Holder(str(tmp_path)).open()
+            frags = [h2.index("i").field("f").view("standard").fragment(s)
+                     for s in range(n_frags)]
+            errors = []
+
+            def reader():
+                out = np.zeros((1, 32768), np.uint32)
+                for _ in range(50):
+                    for fr in frags:
+                        out[:] = 0
+                        fr.plane_rows([1], out, slots=[0])
+                        if int(np.bitwise_count(out).sum()) != 1:
+                            errors.append("bad bits")
+                            return
+
+            def demoter():
+                for _ in range(100):
+                    for fr in frags:
+                        fr._demote_map()
+
+            threads = ([threading.Thread(target=reader) for _ in range(3)]
+                       + [threading.Thread(target=demoter)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "deadlock"
+            assert not errors, errors
+            h2.close()
+        finally:
+            syswrap.GLOBAL.set_max(old_max)
