@@ -350,9 +350,15 @@ def main() -> None:
                 break
             if time.monotonic() - last[0] > stall_s:
                 log(f"bench child silent >{stall_s:.0f}s (tunnel wedge); "
-                    f"killing — attempt {attempt}/{attempts}")
-                proc.kill()
-                proc.wait()
+                    f"terminating — attempt {attempt}/{attempts}")
+                # SIGTERM first: a hard kill of the TPU-holding process
+                # is itself implicated in prolonging tunnel wedges
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
                 stalled = True
                 break
             time.sleep(5)
@@ -365,7 +371,7 @@ def main() -> None:
         elif not stalled:
             log(f"bench child exited rc={proc.returncode}; retrying")
         if attempt < attempts:
-            time.sleep(90)  # let the tunnel-side session drain
+            time.sleep(180)  # let the tunnel-side session drain
     raise SystemExit("bench: every attempt stalled or failed")
 
 

@@ -428,7 +428,10 @@ class API:
         return {"state": state, "nodes": nodes,
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
-                "devices": devices}
+                "devices": devices,
+                # HBM working set (reference: /status occupancy; the
+                # device plane cache is the resident working set here)
+                "planeCache": self.executor.planes.stats()}
 
     def info(self) -> dict:
         import os

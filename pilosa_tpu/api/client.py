@@ -92,12 +92,39 @@ class Client:
         return self._json("DELETE", f"/index/{index}/field/{name}")
 
     def import_bits(self, index: str, field: str, **body):
-        return self._json("POST", f"/index/{index}/field/{field}/import",
-                          body)["changed"]
+        """Bulk bit import; batches ride the protobuf wire when the
+        codec accepts them (2.5× smaller, less CPU than JSON at 100k
+        pairs — BASELINE.md r3), falling back to JSON otherwise
+        (heterogeneous timestamp lists, out-of-range ints)."""
+        from pilosa_tpu.api import proto
+        try:
+            raw = proto.encode_import_request(
+                row_ids=body.get("rowIDs"), col_ids=body.get("columnIDs"),
+                row_keys=body.get("rowKeys"),
+                col_keys=body.get("columnKeys"),
+                timestamps=body.get("timestamps"),
+                clear=bool(body.get("clear", False)))
+        except ValueError:
+            return self._json(
+                "POST", f"/index/{index}/field/{field}/import",
+                body)["changed"]
+        return self._do("POST", f"/index/{index}/field/{field}/import",
+                        raw, content_type=proto.CONTENT_TYPE)["changed"]
 
     def import_values(self, index: str, field: str, **body):
-        return self._json("POST", f"/index/{index}/field/{field}/importValue",
-                          body)["changed"]
+        from pilosa_tpu.api import proto
+        try:
+            raw = proto.encode_import_value_request(
+                col_ids=body.get("columnIDs"),
+                col_keys=body.get("columnKeys"),
+                values=body.get("values"))
+        except ValueError:
+            return self._json(
+                "POST", f"/index/{index}/field/{field}/importValue",
+                body)["changed"]
+        return self._do("POST",
+                        f"/index/{index}/field/{field}/importValue",
+                        raw, content_type=proto.CONTENT_TYPE)["changed"]
 
     def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
                        view: str = "standard"):

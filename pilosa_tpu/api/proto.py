@@ -262,9 +262,16 @@ def encode_import_request(*, index: str = "", field: str = "",
         out += _packed(3, row_ids, _varint)
     if col_ids is not None and len(col_ids):
         out += _packed(4, col_ids, _varint)
+    # empty strings are unrepresentable on this wire (zero-valued
+    # fields elide — an empty key would silently vanish and desync the
+    # parallel arrays): refuse so callers' JSON fallback fires
     for k in row_keys or []:
+        if not k:
+            raise ValueError("proto: empty row key")
         out += _string(5, k)
     for k in col_keys or []:
+        if not k:
+            raise ValueError("proto: empty column key")
         out += _string(6, k)
     if timestamps is not None and len(timestamps):
         if all(isinstance(t, int) for t in timestamps):
@@ -272,6 +279,8 @@ def encode_import_request(*, index: str = "", field: str = "",
                            _varint)
         elif all(isinstance(t, str) for t in timestamps):
             for t in timestamps:
+                if not t:
+                    raise ValueError("proto: empty timestamp")
                 out += _string(9, t)
         else:
             raise ValueError("proto: mixed timestamp types")
@@ -325,6 +334,8 @@ def encode_import_value_request(*, index: str = "", field: str = "",
     if col_ids is not None and len(col_ids):
         out += _packed(3, col_ids, _varint)
     for k in col_keys or []:
+        if not k:  # see encode_import_request: empty strings elide
+            raise ValueError("proto: empty column key")
         out += _string(4, k)
     vals = values if values is not None else []
     if len(vals):
@@ -333,10 +344,24 @@ def encode_import_value_request(*, index: str = "", field: str = "",
         if all(isinstance(v, int) for v in vals):
             out += _packed(5, _vec_zigzag([int(v) for v in vals]), _varint)
         elif all(isinstance(v, (int, float)) for v in vals):
+            # mixed ints encode as float64: refuse ints the double
+            # can't carry exactly (|v| > 2^53) — silent rounding is
+            # data corruption, the JSON fallback carries them intact
+            for v in vals:
+                if isinstance(v, int):
+                    try:
+                        exact = int(float(v)) == v
+                    except OverflowError:
+                        exact = False
+                    if not exact:
+                        raise ValueError(
+                            f"proto: int {v} not exact in float64")
             raw = b"".join(struct.pack("<d", float(v)) for v in vals)
             out += _tag(6, _LEN) + _varint(len(raw)) + raw
         elif all(isinstance(v, str) for v in vals):
             for v in vals:
+                if not v:
+                    raise ValueError("proto: empty value string")
                 out += _string(7, v)
         else:
             raise ValueError("proto: mixed import value types")

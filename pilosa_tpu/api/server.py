@@ -304,6 +304,14 @@ class Handler(BaseHTTPRequestHandler):
 
     def h_metrics(self) -> None:
         stats = getattr(self.server, "stats", None)
+        if stats is not None:
+            # refresh device working-set gauges at scrape time
+            pc = self.server.api.executor.planes.stats()
+            stats.gauge("plane_cache_bytes", pc["bytes"])
+            stats.gauge("plane_cache_budget_bytes", pc["budgetBytes"])
+            stats.gauge("plane_cache_entries", pc["entries"])
+            stats.gauge("plane_cache_incremental_refreshes_total",
+                        pc["incrementalRefreshes"])
         text = stats.prometheus_text() if stats is not None else ""
         self._reply(text.encode(),
                     content_type="text/plain; version=0.0.4")

@@ -223,10 +223,44 @@ class DistributedExecutor:
         return [sum(node_counts[i] for node_counts in per_node)
                 for i in range(len(calls))]
 
+    def _resolve_nested_limits(self, index: str, call: Call,
+                               shards) -> Call:
+        """Rewrite non-top-level Limit subtrees into resolved ConstRow
+        literals, bottom-up (inner Limits resolve first, so a Limit
+        whose child contains another Limit also works)."""
+        def resolve(node: Call) -> Call:
+            kids = [resolve(c) for c in node.children]
+            args = {k: (resolve(v) if isinstance(v, Call) else v)
+                    for k, v in node.args.items()}
+            node = Call(node.name, args, kids)
+            if node.name == "Limit":
+                cols = self._read(index, node, shards)
+                return Call("ConstRow",
+                            {"columns": (cols.get("columns")
+                                         or cols.get("keys") or [])})
+            return node
+
+        eff = _call_of(call)
+        # the top-level Limit itself stays (strip+merge handles it
+        # exactly); only its/other calls' SUBTREES rewrite
+        rebuilt = Call(eff.name,
+                       {k: (resolve(v) if isinstance(v, Call) else v)
+                        for k, v in eff.args.items()},
+                       [resolve(c) for c in eff.children])
+        if call.name == "Options" and call.children:
+            return Call("Options", dict(call.args), [rebuilt])
+        return rebuilt
+
     # -- reads --------------------------------------------------------------
 
     def _read(self, index: str, call: Call, shards: list[int] | None):
         eff0 = _call_of(call)
+        if call.name == "Options" and call.args.get("shards") is not None:
+            # apply the shard override BEFORE any rewrite that issues
+            # its own distributed reads (Extract(Limit) / nested-Limit
+            # resolution) — those must page over the restricted shard
+            # set, exactly as the single-node executor scopes the tree
+            shards = [int(s) for s in call.args["shards"]]
         if (eff0.name == "Extract" and eff0.children
                 and eff0.children[0].name == "Limit"):
             # Extract(Limit(...), fields): resolve the Limit FIRST as a
@@ -241,13 +275,13 @@ class DistributedExecutor:
                         [sel] + list(eff0.children[1:]))
         if _nested_limit(call):
             # per-node Limit then merge is NOT global Limit: column
-            # order crosses node boundaries.  Top-level Limit is exact
-            # (limit stripped from fan-out, applied on the merged list);
-            # Extract(Limit(...), ...) is rewritten above.
-            raise ExecutionError(
-                "Limit nested under another call is not supported in "
-                "cluster mode; apply Limit as the outermost call or as "
-                "Extract's filter")
+            # order crosses node boundaries.  Generalizing the Extract
+            # rewrite above: resolve EVERY nested Limit subtree as its
+            # own exact top-level distributed read (limit applied on
+            # the globally merged ascending column list) and substitute
+            # the result as a ConstRow literal — one extra fan-out
+            # round per nested Limit, exactness preserved.
+            call = self._resolve_nested_limits(index, call, shards)
         call = self._translate_input(index, call)
         if call.name == "Options" and call.args.get("shards") is not None:
             # Options(shards=[...]) overrides, as in single-node

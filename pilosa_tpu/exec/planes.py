@@ -405,6 +405,14 @@ class PlaneCache:
             self._zeros[key] = placed
         return placed
 
+    def stats(self) -> dict:
+        """Occupancy snapshot for /status and /metrics (one lock; the
+        only supported external view of the cache's internals)."""
+        with self._lock:
+            return {"bytes": self._bytes, "budgetBytes": self.budget,
+                    "entries": len(self._entries),
+                    "incrementalRefreshes": self.incremental_applied}
+
     def invalidate(self, index: str | None = None) -> None:
         with self._lock:
             if index is None:

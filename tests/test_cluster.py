@@ -347,14 +347,33 @@ class TestExtractLimitCluster:
         (r,) = c.client(1).query("i", "Limit(All(), limit=7, offset=3)")
         assert r["columns"] == all_cols[3:10]
 
-    def test_nested_limit_rejected(self, three_nodes):
-        from pilosa_tpu.api.client import ClientError
+    def test_nested_limit_resolved_exactly(self, three_nodes):
+        # nested Limits resolve as their own exact distributed reads
+        # (ConstRow substitution, generalizing the Extract rewrite):
+        # global column order must hold across node boundaries
         c = three_nodes
-        c.client(0).create_index("i")
-        c.client(0).create_field("i", "f")
-        c.client(0).query("i", "Set(1, f=10)")
-        with pytest.raises(ClientError, match="Limit nested"):
-            c.client(0).query("i", "Count(Limit(Row(f=10), limit=1))")
+        oracle = spread_bits(c.client(0))
+        all_cols = sorted(set().union(*oracle.values()))
+        want = all_cols[:7]
+        for cl in (c.client(0), c.client(1)):
+            assert cl.query("i", "Count(Limit(All(), limit=7))") == \
+                [len(want)]
+            (r,) = cl.query(
+                "i", "Intersect(Limit(All(), limit=7), All())")
+            assert r["columns"] == want
+        # Options(shards=) scopes nested-Limit resolution too: the
+        # inner read must page over the restricted shard set only
+        import numpy as np
+        shard1 = sorted(c for c in all_cols
+                        if SHARD_WIDTH <= c < 2 * SHARD_WIDTH)[:2]
+        (r,) = c.client(0).query(
+            "i", "Options(Intersect(Limit(All(), limit=2), All()),"
+                 "shards=[1])")
+        assert r["columns"] == shard1
+        # doubly nested: inner Limit resolves before the outer one
+        (r,) = c.client(2).query(
+            "i", "Limit(Intersect(Limit(All(), limit=7), All()), limit=3)")
+        assert r["columns"] == want[:3]
 
     def test_extract_limit_filter_distributed(self, three_nodes):
         # Extract(Limit(...)) rewrites to a resolved ConstRow fan-out:

@@ -155,3 +155,23 @@ def test_malformed_decode_raises_value_error():
     # decode_query_request guards its wire types explicitly and skips
     # mismatches (proto3 unknown-field lenience) — tolerate, not crash
     assert proto.decode_query_request(bad_string) == ("", None)
+
+
+def test_codec_refuses_unrepresentable_inputs():
+    # empty strings elide on the wire (parallel arrays would desync) and
+    # ints beyond float64 precision would silently round — both must
+    # raise so the JSON fallback carries them intact (review r3)
+    with pytest.raises(ValueError):
+        proto.encode_import_request(row_keys=["", "a"],
+                                    col_keys=["x", "y"])
+    with pytest.raises(ValueError):
+        proto.encode_import_request(row_keys=["a"], col_keys=["x"],
+                                    timestamps=[""])
+    with pytest.raises(ValueError):
+        proto.encode_import_value_request(col_ids=[1, 2],
+                                          values=[(1 << 53) + 1, 0.5])
+    # exactly-representable mixed values still encode
+    b = proto.decode_import_value_request(
+        proto.encode_import_value_request(col_ids=[1, 2],
+                                          values=[4, 0.5]))
+    assert b["values"] == [4.0, 0.5]

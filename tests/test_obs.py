@@ -113,3 +113,38 @@ class TestDiagnostics:
         d = Diagnostics(Holder(str(tmp_path)).open(), interval=0.0).start()
         assert d._thread is None
         d.close()
+
+
+def test_plane_cache_metrics_and_status(tmp_path):
+    """HBM working-set visibility: /status planeCache block and
+    prometheus gauges refreshed at scrape time."""
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.api import API, Server
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs.metrics import Stats
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder, Executor(holder))
+    srv = Server(api, host="127.0.0.1", port=0, stats=Stats())
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.address[1]}"
+    try:
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Set(1, f=2)")
+        api.query("i", "Count(Row(f=2))")  # populates a plane entry
+        import json
+        st = json.loads(urllib.request.urlopen(url + "/status").read())
+        pc = st["planeCache"]
+        assert pc["entries"] >= 1 and pc["bytes"] > 0
+        assert pc["budgetBytes"] > pc["bytes"]
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "plane_cache_bytes" in text
+        assert "plane_cache_entries" in text
+    finally:
+        srv.close()
+        holder.close()
