@@ -310,7 +310,7 @@ class Handler(BaseHTTPRequestHandler):
             stats.gauge("plane_cache_bytes", pc["bytes"])
             stats.gauge("plane_cache_budget_bytes", pc["budgetBytes"])
             stats.gauge("plane_cache_entries", pc["entries"])
-            stats.gauge("plane_cache_incremental_refreshes_total",
+            stats.gauge("plane_cache_incremental_refreshes",
                         pc["incrementalRefreshes"])
         text = stats.prometheus_text() if stats is not None else ""
         self._reply(text.encode(),

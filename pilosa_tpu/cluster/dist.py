@@ -254,33 +254,20 @@ class DistributedExecutor:
     # -- reads --------------------------------------------------------------
 
     def _read(self, index: str, call: Call, shards: list[int] | None):
-        eff0 = _call_of(call)
         if call.name == "Options" and call.args.get("shards") is not None:
             # apply the shard override BEFORE any rewrite that issues
             # its own distributed reads (Extract(Limit) / nested-Limit
             # resolution) — those must page over the restricted shard
             # set, exactly as the single-node executor scopes the tree
             shards = [int(s) for s in call.args["shards"]]
-        if (eff0.name == "Extract" and eff0.children
-                and eff0.children[0].name == "Limit"):
-            # Extract(Limit(...), fields): resolve the Limit FIRST as a
-            # top-level distributed call (exact: paging on the merged
-            # ascending column list), then fan out the Extract with the
-            # resolved columns as a ConstRow literal
-            cols = self._read(index, eff0.children[0], shards)
-            sel = Call("ConstRow", {"columns": (cols.get("columns")
-                                                or cols.get("keys")
-                                                or [])})
-            call = Call("Extract", dict(eff0.args),
-                        [sel] + list(eff0.children[1:]))
         if _nested_limit(call):
             # per-node Limit then merge is NOT global Limit: column
-            # order crosses node boundaries.  Generalizing the Extract
-            # rewrite above: resolve EVERY nested Limit subtree as its
-            # own exact top-level distributed read (limit applied on
-            # the globally merged ascending column list) and substitute
-            # the result as a ConstRow literal — one extra fan-out
-            # round per nested Limit, exactness preserved.
+            # order crosses node boundaries.  Resolve EVERY nested
+            # Limit subtree (Extract(Limit(...)) included) as its own
+            # exact top-level distributed read (limit applied on the
+            # globally merged ascending column list) and substitute the
+            # result as a ConstRow literal — one extra fan-out round
+            # per nested Limit, exactness preserved.
             call = self._resolve_nested_limits(index, call, shards)
         call = self._translate_input(index, call)
         if call.name == "Options" and call.args.get("shards") is not None:

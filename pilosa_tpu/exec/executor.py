@@ -1444,43 +1444,75 @@ class Executor:
                 specs, filter_words, agg_plane,
                 self._GROUPBY_AGGS.get(agg_name),
                 limited=limit is not None):
-            counts = out["counts"]
-            for c in range(counts.shape[0]):
+            counts = np.asarray(out["counts"])  # (C, slots)
+            slots = np.asarray(last_slots, np.int64)
+            sub = counts[:, slots].astype(np.int64)  # (C, L)
+            # per-group aggregates computed VECTORIZED over the whole
+            # block (the per-group Python bit-descent walked O(depth)
+            # ints per group — a 125k-group GroupBy spent seconds there)
+            aggs = None
+            agg_ok = None
+            if agg_name == "Count":
+                aggs = sub
+            elif agg_name == "Sum":
+                pos = np.asarray(out["pos"])[:, slots].astype(np.int64)
+                neg = np.asarray(out["neg"])[:, slots].astype(np.int64)
+                acnt = np.asarray(out["cnt"])[:, slots].astype(np.int64)
+                depth = pos.shape[-1]
+                # int64 matmul only while provably exact: the weighted
+                # bit sums are bounded by max_count·2^(depth+1) and the
+                # base term by |base|·max_cnt.  Past the bound (deep
+                # BSI × huge groups) fall back to exact Python big-int
+                # accumulation, matching Sum's host-finish policy.
+                max_count = int(max(np.abs(pos).max(initial=0),
+                                    np.abs(neg).max(initial=0)))
+                bound = (max_count << (depth + 1)) + \
+                    abs(int(base)) * int(np.abs(acnt).max(initial=0))
+                if depth <= 62 and bound < (1 << 62):
+                    weights = np.int64(1) << np.arange(depth,
+                                                       dtype=np.int64)
+                    aggs = (pos - neg) @ weights + base * acnt
+                else:
+                    aggs = np.empty(pos.shape[:2], dtype=object)
+                    for c in range(pos.shape[0]):
+                        for li in range(pos.shape[1]):
+                            aggs[c, li] = sum(
+                                (int(pos[c, li, b]) - int(neg[c, li, b]))
+                                << b for b in range(depth)) \
+                                + base * int(acnt[c, li])
+            elif agg_name in ("Min", "Max"):
+                key = "min" if agg_name == "Min" else "max"
+                aggs = (np.asarray(out[key])[:, slots].astype(np.int64)
+                        + base)
+                agg_ok = np.asarray(out[key + "_cnt"])[:, slots] > 0
+            keep = sub > 0
+            if having_cond is not None:
+                if having_metric == "count":
+                    keep = keep & having_cond.matches_array(sub)
+                elif aggs is None:
+                    keep = np.zeros_like(keep)
+                else:
+                    # a group with no aggregate value (Min/Max over an
+                    # empty cell) cannot pass a sum condition
+                    if agg_ok is not None:
+                        keep = keep & agg_ok
+                    keep = keep & having_cond.matches_array(aggs)
+            for c, li in zip(*np.nonzero(keep)):
                 prefix_rows = [(specs[lvl][0], int(combo_rows[c, lvl]))
                                for lvl in range(len(specs) - 1)]
-                for rid, slot in zip(last_rows, last_slots):
-                    cnt = int(counts[c, slot])
-                    if cnt == 0:
+                rid = int(last_rows[li])
+                if prev_tuple is not None:
+                    combo = (tuple(gr for _, gr in prefix_rows) + (rid,))
+                    if combo <= prev_tuple:
                         continue
-                    if prev_tuple is not None:
-                        combo = (tuple(gr for _, gr in prefix_rows)
-                                 + (int(rid),))
-                        if combo <= prev_tuple:
-                            continue
-                    agg_val = None
-                    if agg_name == "Count":
-                        agg_val = cnt
-                    elif agg_name == "Sum":
-                        acnt = int(out["cnt"][c, slot])
-                        total = sum(
-                            (int(out["pos"][c, slot, b])
-                             - int(out["neg"][c, slot, b])) << b
-                            for b in range(out["pos"].shape[-1]))
-                        agg_val = total + base * acnt
-                    elif agg_name in ("Min", "Max"):
-                        key = "min" if agg_name == "Min" else "max"
-                        if int(out[key + "_cnt"][c, slot]) > 0:
-                            agg_val = int(out[key][c, slot]) + base
-                    if having_cond is not None:
-                        metric = (cnt if having_metric == "count"
-                                  else agg_val)
-                        if metric is None or not having_cond.matches(metric):
-                            continue
-                    group = [self._field_row(ctx, gf, gr)
-                             for gf, gr in prefix_rows + [(last_f, int(rid))]]
-                    groups.append(GroupCount(group, cnt, agg_val))
-                    if limit is not None and len(groups) >= int(limit):
-                        return GroupCountsResult(groups)
+                agg_val = None
+                if aggs is not None and (agg_ok is None or agg_ok[c, li]):
+                    agg_val = int(aggs[c, li])
+                group = [self._field_row(ctx, gf, gr)
+                         for gf, gr in prefix_rows + [(last_f, rid)]]
+                groups.append(GroupCount(group, int(sub[c, li]), agg_val))
+                if limit is not None and len(groups) >= int(limit):
+                    return GroupCountsResult(groups)
         return GroupCountsResult(groups)
 
     def _field_row(self, ctx: _Ctx, field: Field, row_id: int) -> FieldRow:

@@ -97,7 +97,11 @@ def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
     if not prefix_planes:
         return jax.tree.map(lambda x: x[None],
                             body(jnp.zeros((0,), jnp.int32)))
-    return jax.lax.map(body, combo_idx)
+    # batch_size vmaps combos in chunks: a plain lax.map serializes one
+    # tiny AND+popcount kernel per combination (measured ~1.7 ms each on
+    # a v5e — 4.3 s for a 50x50 prefix grid); 32-wide batches amortize
+    # the per-iteration overhead while bounding the fused intermediate
+    return jax.lax.map(body, combo_idx, batch_size=32)
 
 
 def combo_grid(levels: list[np.ndarray]) -> np.ndarray:

@@ -53,6 +53,21 @@ class Condition:
         return bool({"==": val == v, "!=": val != v, "<": val < v,
                      "<=": val <= v, ">": val > v, ">=": val >= v}[self.op])
 
+    def matches_array(self, vals):
+        """Vectorized :meth:`matches` over a numpy array -> bool mask
+        (the GroupBy having filter runs once per result block, not once
+        per group)."""
+        import numpy as np
+        v = np.asarray(vals)
+        if self.op in BETWEEN_OPS:
+            lo, hi = self.value
+            lo_ok = v > lo if self.op.startswith("<>") else v >= lo
+            hi_ok = v < hi if self.op.endswith("><") else v <= hi
+            return lo_ok & hi_ok
+        c = self.value
+        return {"==": v == c, "!=": v != c, "<": v < c,
+                "<=": v <= c, ">": v > c, ">=": v >= c}[self.op]
+
 
 @dataclass
 class Call:

@@ -363,7 +363,6 @@ class TestExtractLimitCluster:
             assert r["columns"] == want
         # Options(shards=) scopes nested-Limit resolution too: the
         # inner read must page over the restricted shard set only
-        import numpy as np
         shard1 = sorted(c for c in all_cols
                         if SHARD_WIDTH <= c < 2 * SHARD_WIDTH)[:2]
         (r,) = c.client(0).query(
