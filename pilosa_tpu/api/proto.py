@@ -482,6 +482,9 @@ def _enc_result(r) -> bytes:
                 sub += _string(2, k)
             if keyed:  # explicit flag so {"keys": []} round-trips
                 sub += _uint(3, 1)
+            if r.get("rowAttrs"):
+                import json as _json
+                sub += _string(4, _json.dumps(r["rowAttrs"]))
             return _uint(1, T_ROW) + _sub(2, sub)
         if "rows" in r:
             return _uint(1, T_ROWIDS) + _packed(7, r["rows"], _varint)
@@ -530,6 +533,7 @@ def _dec_result(raw: bytes):
     typ = 0
     row_cols, row_keys = [], []
     row_keyed = False
+    row_attrs = None
     n = 0
     changed = False
     pairs, groups, row_ids, values = [], [], [], []
@@ -545,6 +549,9 @@ def _dec_result(raw: bytes):
                     row_keys.append(v2.decode())
                 elif f2 == 3:
                     row_keyed = bool(v2)
+                elif f2 == 4:
+                    import json as _json
+                    row_attrs = _json.loads(v2.decode())
         elif field == 3:
             n = val
         elif field == 4:
@@ -602,9 +609,11 @@ def _dec_result(raw: bytes):
     if typ == T_COUNT:
         return n
     if typ == T_ROW:
-        if row_keyed or row_keys:
-            return {"keys": row_keys}
-        return {"columns": row_cols}
+        out = ({"keys": row_keys} if row_keyed or row_keys
+               else {"columns": row_cols})
+        if row_attrs:
+            out["rowAttrs"] = row_attrs
+        return out
     if typ == T_PAIRS:
         return pairs
     if typ == T_VALCOUNT:

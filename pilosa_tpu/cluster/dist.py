@@ -453,7 +453,10 @@ class DistributedExecutor:
             return self._translate_extract(index, idx, merged)
         if isinstance(merged, dict) and "columns" in merged and idx.keys:
             keys = self.cluster.keys_of(index, None, merged["columns"])
-            return {"keys": keys}
+            out = {"keys": keys}
+            if merged.get("rowAttrs"):  # carried through key translation
+                out["rowAttrs"] = merged["rowAttrs"]
+            return out
         fname = call.args.get("_field") or call.args.get("field")
         field = idx.field(str(fname)) if fname else None
         keyed_field = field is not None and field.options.keys
@@ -501,7 +504,12 @@ def merge_results(call: Call, partials: list):
             limit = call.args.get("limit")
             end = None if limit is None else offset + int(limit)
             cols = cols[offset:end]
-        return {"columns": [int(c) for c in cols]}
+        out = {"columns": [int(c) for c in cols]}
+        for p in partials:  # row attrs are replicated — any node's copy
+            if p.get("rowAttrs"):
+                out["rowAttrs"] = p["rowAttrs"]
+                break
+        return out
     if name == "Extract":
         from pilosa_tpu.exec.executor import Executor
         fields = partials[0].get("fields", []) if partials else []

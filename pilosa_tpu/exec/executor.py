@@ -47,10 +47,11 @@ RESERVED_KEYS = frozenset({
     "from", "to", "limit", "offset", "n", "field", "ids", "filter", "column",
     "like", "previous", "aggregate", "sort", "shards", "index",
     "attrName", "attrValue", "columnAttrs", "excludeColumns", "tanimoto",
+    "excludeRowAttrs",
 })
 
 _CALL_RESERVED = {
-    "Row": frozenset({"from", "to"}),
+    "Row": frozenset({"from", "to", "excludeRowAttrs"}),
     "Range": frozenset({"from", "to"}),
     "Set": frozenset(),
     "Clear": frozenset(),
@@ -317,6 +318,8 @@ class Executor:
         if call.name in _BITMAP_CALLS:
             words = self._fused_bitmap(ctx, call)
             result = self._to_row_result(ctx, words)
+            if call.name == "Row":
+                self._attach_row_attrs(ctx, call, result)
             if call.name == "All":
                 # All(limit=, offset=) pages the column list (v2 parity)
                 offset = int(call.args.get("offset", 0))
@@ -331,6 +334,31 @@ class Executor:
         if handler is None:
             raise ExecutionError(f"unknown call {call.name!r}")
         return handler(ctx, call)
+
+    def _attach_row_attrs(self, ctx: _Ctx, call: Call,
+                          result: "RowResult") -> None:
+        """A plain ``Row(field=row)`` result carries the row's
+        attributes (reference: v1 ``Row.Attrs`` in the JSON response;
+        suppressed with ``excludeRowAttrs=true``)."""
+        if call.args.get("excludeRowAttrs"):
+            return
+        hit = _field_arg(call)
+        if hit is None:
+            return
+        fname, value = hit
+        if isinstance(value, (Condition, Call)):
+            return
+        field = ctx.index.field(str(fname))
+        if field is None or field.options.type in BSI_TYPES:
+            return
+        if not field.has_row_attrs:  # never CREATE a store on a read
+            return
+        row_id = self._row_id(ctx, field, value, create=False)
+        if row_id is None:
+            return
+        attrs = field.row_attrs.attrs(int(row_id))
+        if attrs:
+            result.row_attrs = {str(k): v for k, v in attrs.items()}
 
     # -- bitmap calls -------------------------------------------------------
 

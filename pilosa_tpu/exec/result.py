@@ -23,12 +23,16 @@ class RowResult:
         default_factory=lambda: np.empty(0, np.uint64))
     keys: list[str] | None = None
     attrs: dict | None = None  # column attrs (Options columnAttrs=true)
+    row_attrs: dict | None = None  # the queried row's attributes
+    # (reference: v1 Row.Attrs; suppressed by excludeRowAttrs=true)
 
     def to_json(self):
         out = ({"keys": self.keys} if self.keys is not None
                else {"columns": [int(c) for c in self.columns]})
         if self.attrs is not None:
             out["attrs"] = {str(k): v for k, v in self.attrs.items()}
+        if self.row_attrs is not None:
+            out["rowAttrs"] = self.row_attrs
         return out
 
 

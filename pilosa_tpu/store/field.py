@@ -139,6 +139,16 @@ class Field:
                     os.path.join(self.path, "_attrs.db"))
             return self._row_attrs
 
+    @property
+    def has_row_attrs(self) -> bool:
+        """Whether an attr store EXISTS, without creating one — pure
+        read paths (Row results attaching attrs) must not write a
+        sqlite file to a possibly read-only data dir."""
+        with self._lock:
+            if self._row_attrs is not None:
+                return True
+        return os.path.exists(os.path.join(self.path, "_attrs.db"))
+
     # -- views --------------------------------------------------------------
 
     def view(self, name: str, create: bool = False) -> View | None:

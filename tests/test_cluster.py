@@ -180,6 +180,34 @@ class TestDistributedQueries:
                       grp["count"]) for grp in g)
         assert got == [((1, 2), 1), ((1, 3), 1)]
 
+    def test_row_attrs_distributed_keyed(self, three_nodes):
+        # keyed-index key translation must carry rowAttrs through
+        c = three_nodes
+        c.client(0).create_index("k", {"keys": True})
+        c.client(0).create_field("k", "f")
+        c.client(0).query("k", 'Set("alice", f=3)')
+        c.client(0).query("k", 'SetRowAttrs(f, 3, tier="gold")')
+        (r,) = c.client(1).query("k", "Row(f=3)")
+        assert r["keys"] == ["alice"]
+        assert r.get("rowAttrs") == {"tier": "gold"}
+
+    def test_row_attrs_distributed(self, three_nodes):
+        # the merged Row result carries the row's attributes (attrs are
+        # replicated, so any node's partial supplies them)
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "f", rowIDs=[1, 1],
+                                columnIDs=[5, far])
+        c.client(0).query("i", 'SetRowAttrs(f, 1, team="infra")')
+        for cl in (c.client(1), c.client(2)):
+            (r,) = cl.query("i", "Row(f=1)")
+            assert r["columns"] == [5, far]
+            assert r.get("rowAttrs") == {"team": "infra"}
+            (r2,) = cl.query("i", "Row(f=1, excludeRowAttrs=true)")
+            assert "rowAttrs" not in r2
+
     def test_groupby_having_distributed(self, three_nodes):
         # having thresholds apply to GLOBAL sums: each node alone sees
         # count 1 for row 1, so a local having(count > 1) would wrongly

@@ -972,3 +972,29 @@ class TestCountBatchPlanePath:
         assert q(ex, "Count(Row(f=10)) Count(Row(f=10))",
                  shards=[]) == [0, 0]
         assert q(ex, "Count(Row(f=10))", shards=[]) == [0]
+
+
+class TestRowAttrsOnRowResults:
+    def test_row_result_carries_row_attrs(self, env):
+        _, _, ex = env
+        q(ex, "Set(1, f=10) SetRowAttrs(f, 10, team=\"infra\", rank=3)")
+        (r,) = q(ex, "Row(f=10)")
+        assert r.row_attrs == {"team": "infra", "rank": 3}
+        # excludeRowAttrs suppresses (reference: QueryRequest flag)
+        (r2,) = q(ex, "Row(f=10, excludeRowAttrs=true)")
+        assert r2.row_attrs is None
+        # rows with no attrs attach nothing
+        (r3,) = q(ex, "Row(f=99)")
+        assert r3.row_attrs is None
+        # composite calls don't attach
+        (r4,) = q(ex, "Union(Row(f=10))")
+        assert r4.row_attrs is None
+
+    def test_read_never_creates_attr_store(self, env):
+        import os
+        holder, idx, ex = env
+        q(ex, "Set(1, g=5)")
+        (r,) = q(ex, "Row(g=5)")
+        assert r.row_attrs is None
+        assert not os.path.exists(
+            os.path.join(idx.field("g").path, "_attrs.db"))

@@ -21,6 +21,7 @@ RESULT_CASES = [
     {"columns": []},
     {"keys": ["alice", "bob"]},
     {"keys": []},  # keyed row with zero columns must stay key-shaped
+    {"columns": [1, 2], "rowAttrs": {"team": "infra", "rank": 3}},
     [{"id": 10, "count": 3}, {"id": 0, "count": 1}],
     [{"key": "admin", "count": 7}],
     [],
