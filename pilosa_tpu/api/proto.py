@@ -482,9 +482,12 @@ def _enc_result(r) -> bytes:
                 sub += _string(2, k)
             if keyed:  # explicit flag so {"keys": []} round-trips
                 sub += _uint(3, 1)
-            if r.get("rowAttrs"):
+            if r.get("rowAttrs") or r.get("attrs"):
                 import json as _json
-                sub += _string(4, _json.dumps(r["rowAttrs"]))
+                if r.get("rowAttrs"):
+                    sub += _string(4, _json.dumps(r["rowAttrs"]))
+                if r.get("attrs"):
+                    sub += _string(5, _json.dumps(r["attrs"]))
             return _uint(1, T_ROW) + _sub(2, sub)
         if "rows" in r:
             return _uint(1, T_ROWIDS) + _packed(7, r["rows"], _varint)
@@ -534,6 +537,7 @@ def _dec_result(raw: bytes):
     row_cols, row_keys = [], []
     row_keyed = False
     row_attrs = None
+    col_attrs = None
     n = 0
     changed = False
     pairs, groups, row_ids, values = [], [], [], []
@@ -552,6 +556,9 @@ def _dec_result(raw: bytes):
                 elif f2 == 4:
                     import json as _json
                     row_attrs = _json.loads(v2.decode())
+                elif f2 == 5:
+                    import json as _json
+                    col_attrs = _json.loads(v2.decode())
         elif field == 3:
             n = val
         elif field == 4:
@@ -613,6 +620,8 @@ def _dec_result(raw: bytes):
                else {"columns": row_cols})
         if row_attrs:
             out["rowAttrs"] = row_attrs
+        if col_attrs:
+            out["attrs"] = col_attrs
         return out
     if typ == T_PAIRS:
         return pairs

@@ -456,6 +456,13 @@ class DistributedExecutor:
             out = {"keys": keys}
             if merged.get("rowAttrs"):  # carried through key translation
                 out["rowAttrs"] = merged["rowAttrs"]
+            if merged.get("attrs"):
+                # column-attr maps re-key from column ids to column keys
+                # (the id axis is gone from a keyed response)
+                id_to_key = {str(c): k for c, k in
+                             zip(merged["columns"], keys)}
+                out["attrs"] = {id_to_key.get(i, i): a
+                                for i, a in merged["attrs"].items()}
             return out
         fname = call.args.get("_field") or call.args.get("field")
         field = idx.field(str(fname)) if fname else None
@@ -509,6 +516,11 @@ def merge_results(call: Call, partials: list):
             if p.get("rowAttrs"):
                 out["rowAttrs"] = p["rowAttrs"]
                 break
+        # column attrs (Options columnAttrs=true): each node annotates
+        # its own columns; the merged map is their union
+        attr_maps = [p["attrs"] for p in partials if p.get("attrs")]
+        if attr_maps:
+            out["attrs"] = {k: v for m in attr_maps for k, v in m.items()}
         return out
     if name == "Extract":
         from pilosa_tpu.exec.executor import Executor

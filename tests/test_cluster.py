@@ -180,6 +180,31 @@ class TestDistributedQueries:
                       grp["count"]) for grp in g)
         assert got == [((1, 2), 1), ((1, 3), 1)]
 
+    def test_column_attrs_distributed(self, three_nodes):
+        # Options(columnAttrs=true): per-node attr maps union at merge
+        c = three_nodes
+        c.client(0).create_index("i")
+        c.client(0).create_field("i", "f")
+        far = 4 * SHARD_WIDTH
+        c.client(0).import_bits("i", "f", rowIDs=[1, 1],
+                                columnIDs=[5, far])
+        c.client(0).query("i", 'SetColumnAttrs(5, region="eu")')
+        c.client(0).query("i", f'SetColumnAttrs({far}, region="us")')
+        (r,) = c.client(1).query(
+            "i", "Options(Row(f=1), columnAttrs=true)")
+        assert r["columns"] == [5, far]
+        assert r["attrs"] == {"5": {"region": "eu"},
+                              str(far): {"region": "us"}}
+        # keyed index: attr maps re-key to column keys
+        c.client(0).create_index("ka", {"keys": True})
+        c.client(0).create_field("ka", "f")
+        c.client(0).query("ka", 'Set("alice", f=3)')
+        c.client(0).query("ka", 'SetColumnAttrs("alice", region="eu")')
+        (r,) = c.client(1).query(
+            "ka", "Options(Row(f=3), columnAttrs=true)")
+        assert r["keys"] == ["alice"]
+        assert r["attrs"] == {"alice": {"region": "eu"}}
+
     def test_row_attrs_distributed_keyed(self, three_nodes):
         # keyed-index key translation must carry rowAttrs through
         c = three_nodes

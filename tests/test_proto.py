@@ -22,6 +22,7 @@ RESULT_CASES = [
     {"keys": ["alice", "bob"]},
     {"keys": []},  # keyed row with zero columns must stay key-shaped
     {"columns": [1, 2], "rowAttrs": {"team": "infra", "rank": 3}},
+    {"columns": [5], "attrs": {"5": {"region": "eu"}}},
     [{"id": 10, "count": 3}, {"id": 0, "count": 1}],
     [{"key": "admin", "count": 7}],
     [],
