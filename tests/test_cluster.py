@@ -760,6 +760,8 @@ class TestClusterWithDeviceMesh:
             c.client(0).import_bits("i", "f", rowIDs=[1] * 5, columnIDs=cols)
             c.client(1).import_values("i", "amount", columnIDs=cols[:3],
                                       values=[10, -20, 30])
+            c.client(0).import_bits("i", "f", rowIDs=[2, 2],
+                                    columnIDs=cols[:2])
             for cl in c.clients:
                 assert cl.query("i", "Count(Row(f=1))") == [5]
                 (r,) = cl.query("i", "Row(f=1)")
@@ -767,7 +769,22 @@ class TestClusterWithDeviceMesh:
                 (s,) = cl.query("i", "Sum(field=amount)")
                 assert s == {"value": 20, "count": 3}
                 (t,) = cl.query("i", "TopN(f)")
-                assert t == [{"id": 1, "count": 5}]
+                assert t == [{"id": 1, "count": 5}, {"id": 2, "count": 2}]
+                # round-3 surfaces under cluster x mesh composition:
+                # having= thresholds global counts; nested Limit
+                # resolves exactly; BSI Extract reads off the plane
+                (g,) = cl.query(
+                    "i", "GroupBy(Rows(f), having=Condition(count > 2))")
+                assert [(x["group"][0]["rowID"], x["count"])
+                        for x in g] == [(1, 5)]
+                assert cl.query(
+                    "i", "Count(Limit(Row(f=1), limit=3))") == [3]
+                (e,) = cl.query(
+                    "i", f"Extract(ConstRow(columns=[{cols[0]},"
+                         f"{cols[1]}]), Rows(amount))")
+                by_col = {x["column"]: x["rows"][0]
+                          for x in e["columns"]}
+                assert by_col == {cols[0]: 10, cols[1]: -20}
 
 
 class TestAttrValueNotTranslated:
