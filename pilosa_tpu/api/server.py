@@ -1,16 +1,16 @@
 """REST server over the API façade.
 
-Reference: ``http/handler.go`` (SURVEY.md §3.3).  Routes (same surface,
-JSON bodies instead of protobuf — content negotiation is a deliberate
-simplification):
+Reference: ``http/handler.go`` (SURVEY.md §3.3).  Routes (same
+surface; query and import endpoints content-negotiate JSON or
+``application/x-protobuf`` per ``api/internal.proto``):
 
     POST   /index/{i}/query                     PQL body -> {"results": [...]}
     POST   /index/{i}                           create index
     DELETE /index/{i}
     POST   /index/{i}/field/{f}                 create field
     DELETE /index/{i}/field/{f}
-    POST   /index/{i}/field/{f}/import          bulk bits (JSON)
-    POST   /index/{i}/field/{f}/importValue     bulk BSI values (JSON)
+    POST   /index/{i}/field/{f}/import          bulk bits (JSON|proto)
+    POST   /index/{i}/field/{f}/importValue     bulk values (JSON|proto)
     POST   /index/{i}/field/{f}/import-roaring/{shard}   binary roaring
     GET    /export?index=i&field=f              CSV
     GET    /schema | /status | /info | /version | /metrics
