@@ -1,0 +1,33 @@
+"""The headline bench is the driver's round artifact: a code change
+that breaks it costs the round its benchmark.  Run the measurement
+child end-to-end at toy scale (raw tier + product tier + REST variant)
+on CPU and assert the one-JSON-line contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_child_end_to_end_toy_scale():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PILOSA_BENCH_CHILD="1", PILOSA_BENCH_SHARDS="2",
+               PILOSA_BENCH_ROWS="4")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert out["unit"] == "qps" and out["value"] > 0
+    assert out["metric"].startswith(("product_count_qps_1b_cols",
+                                     "concurrent_count_qps_1b_cols"))
+    # the salvage line the watchdog parent depends on must be present
+    assert any(ln.startswith("BENCH-SALVAGE ")
+               for ln in proc.stderr.splitlines()), "salvage line missing"
