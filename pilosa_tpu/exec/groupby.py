@@ -105,13 +105,14 @@ def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
 
 
 def combo_grid(levels: list[np.ndarray]) -> np.ndarray:
-    """Cartesian product of per-level arrays in lexicographic order:
-    int32[C, L].  ``levels`` may be row-slot or row-id arrays."""
+    """Cartesian product of per-level arrays in lexicographic order,
+    [C, L] in the input dtype (row-slot int32 or row-id uint64 — row
+    ids are uint64 like the storage layer's; fragment._check_rows caps
+    them at 2^40)."""
     if not levels:
         return np.zeros((1, 0), np.int32)
     grids = np.meshgrid(*levels, indexing="ij")
-    return np.stack([g.reshape(-1) for g in grids],
-                    axis=-1).astype(np.int64)
+    return np.stack([g.reshape(-1) for g in grids], axis=-1)
 
 
 # Per-dispatch device-output budget: bounds the combination block so a
@@ -128,7 +129,7 @@ def iter_blocks(specs, filter_words, agg_plane, agg_kind,
     """Execute the program over lexicographic combination blocks.
 
     specs: list of (field, rows np.ndarray, PlaneSet); the last spec is
-    the vectorized innermost level.  Yields (combo_rows int64[B, L-1],
+    the vectorized innermost level.  Yields (combo_rows uint64[B, L-1],
     outputs dict of np arrays) in combination order; callers stop
     consuming once a ``limit=`` is satisfied.  Blocks are padded to one
     static shape (single compile), the pad tail is sliced off here.
@@ -136,7 +137,7 @@ def iter_blocks(specs, filter_words, agg_plane, agg_kind,
     *prefix_specs, (last_f, last_rows, last_ps) = specs
     slot_levels = [np.array([ps.slot_of[int(r)] for r in rows], np.int32)
                    for _, rows, ps in prefix_specs]
-    row_levels = [np.asarray(rows, np.int64) for _, rows, _ in prefix_specs]
+    row_levels = [np.asarray(rows, np.uint64) for _, rows, _ in prefix_specs]
     combo_slots = combo_grid(slot_levels).astype(np.int32)
     combo_rows = combo_grid(row_levels)
     n_combos = combo_slots.shape[0]

@@ -119,12 +119,89 @@ class GroupCount:
         return out
 
 
-@dataclass
 class GroupCountsResult:
-    groups: list[GroupCount]
+    """GroupBy result held COLUMNAR (a row-id matrix plus count/agg
+    arrays) with ``GroupCount`` objects materialized lazily.
+
+    Rationale (reference: ``executor.go#executeGroupBy`` returns
+    ``[]GroupCount`` eagerly): a 125k-group GroupBy spent ~1 s of its
+    1.7 s warm latency constructing per-group dataclass objects after
+    the aggregate math was already vectorized.  Arrays in, objects only
+    at the access/serialization edge.
+
+    Columnar form: ``fields`` (one name per Rows level), ``row_ids``
+    int64[G, L], optional per-level ``row_keys`` (translated key lists
+    for keyed fields), ``counts`` int64[G], optional ``aggs`` [G]
+    (int64 or object dtype for big ints) with ``agg_mask`` marking
+    which groups carry a valid aggregate.
+    """
+
+    __slots__ = ("_groups", "fields", "row_ids", "row_keys", "counts",
+                 "aggs", "agg_mask")
+
+    def __init__(self, groups: list[GroupCount] | None = None, *,
+                 fields: list[str] | None = None, row_ids=None,
+                 row_keys: list | None = None, counts=None, aggs=None,
+                 agg_mask=None):
+        self._groups = groups
+        self.fields = fields or []
+        self.row_ids = row_ids
+        self.row_keys = row_keys
+        self.counts = counts
+        self.aggs = aggs
+        self.agg_mask = agg_mask
+
+    def __eq__(self, other):
+        return (isinstance(other, GroupCountsResult)
+                and self.groups == other.groups)
+
+    def __len__(self):
+        if self._groups is not None:
+            return len(self._groups)
+        return 0 if self.row_ids is None else len(self.row_ids)
+
+    @property
+    def groups(self) -> list[GroupCount]:
+        if self._groups is None:
+            self._groups = [
+                GroupCount([FieldRow(f, row_key=k) if k is not None
+                            else FieldRow(f, row_id=r)
+                            for f, r, k in zip(self.fields, rows, keys)],
+                           count, agg)
+                for rows, keys, count, agg in self._iter_columns()]
+        return self._groups
+
+    def _iter_columns(self):
+        """Yield (row_ids, row_keys, count, agg|None) per group from the
+        columnar store, converting numpy scalars to Python ints once."""
+        ids = self.row_ids.tolist()
+        counts = self.counts.tolist()
+        n_levels = len(self.fields)
+        keys_by_level = self.row_keys or [None] * n_levels
+        aggs = None
+        if self.aggs is not None:
+            aggs = self.aggs.tolist()
+            mask = (self.agg_mask.tolist() if self.agg_mask is not None
+                    else [True] * len(aggs))
+        for i, (rows, count) in enumerate(zip(ids, counts)):
+            keys = [kl[i] if kl is not None else None
+                    for kl in keys_by_level]
+            agg = aggs[i] if aggs is not None and mask[i] else None
+            yield rows, keys, count, agg
 
     def to_json(self):
-        return [g.to_json() for g in self.groups]
+        if self._groups is not None:
+            return [g.to_json() for g in self._groups]
+        out = []
+        for rows, keys, count, agg in self._iter_columns():
+            group = [{"field": f, "rowKey": k} if k is not None
+                     else {"field": f, "rowID": r}
+                     for f, r, k in zip(self.fields, rows, keys)]
+            g = {"group": group, "count": count}
+            if agg is not None:
+                g["agg"] = agg
+            out.append(g)
+        return out
 
 
 @dataclass

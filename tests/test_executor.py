@@ -242,6 +242,20 @@ class TestTopNRowsGroupBy:
         got = [([fr.row_id for fr in gc.group], gc.count) for gc in g.groups]
         assert got == [([10, 5], 1), ([10, 6], 1)]
 
+    def test_groupby_large_row_ids(self, env):
+        # row ids live in uint64 space (capped at 2^40 by the
+        # fragment position encoding — fragment._check_rows, mirroring
+        # the upstream bound); the columnar assembly keeps them exact
+        # end to end in uint64
+        _, _, ex = env
+        big = (1 << 39) + 5
+        q(ex, f"Set(1, f={big}) Set(2, f={big}) Set(1, g=7) Set(2, g=8)")
+        (g,) = q(ex, "GroupBy(Rows(f), Rows(g))")
+        got = [([fr.row_id for fr in gc.group], gc.count) for gc in g.groups]
+        assert got == [([big, 7], 1), ([big, 8], 1)]
+        blob = g.to_json()
+        assert blob[0]["group"][0]["rowID"] == big
+
     def test_groupby_filter_and_aggregate(self, env):
         _, _, ex = env
         q(ex, "Set(1, f=10) Set(2, f=10) Set(1, amount=100) Set(2, amount=50)")
