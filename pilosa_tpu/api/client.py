@@ -22,9 +22,11 @@ class ClientError(Exception):
 
 class Client:
     def __init__(self, host: str = "127.0.0.1", port: int = 10101,
-                 timeout: float = 60.0):
-        self.base = f"http://{host}:{port}"
+                 timeout: float = 60.0, ssl_context=None):
+        scheme = "https" if ssl_context is not None else "http"
+        self.base = f"{scheme}://{host}:{port}"
         self.timeout = timeout
+        self._ssl = ssl_context
 
     # -- transport ----------------------------------------------------------
 
@@ -37,7 +39,8 @@ class Client:
         req = urllib.request.Request(
             self.base + path, data=body, method=method, headers=hdrs)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
         except ConnectionResetError:
@@ -59,6 +62,12 @@ class Client:
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True)
             raise ClientError(f"cannot reach {self.base}: {e.reason}") from e
+        except OSError as e:
+            # TLS alerts (e.g. mTLS 'certificate required') can surface
+            # as raw ssl.SSLError during getresponse(), outside
+            # urllib's URLError wrapping — same contract: ClientError
+            raise ClientError(f"transport error from {self.base}: {e}") \
+                from e
         if ctype.startswith("application/json"):
             return json.loads(data)
         return data

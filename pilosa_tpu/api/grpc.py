@@ -31,7 +31,7 @@ SERVICE = "pilosa_tpu.Pilosa"
 
 class GrpcServer:
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 8):
+                 max_workers: int = 8, credentials=None):
         import grpc
         from concurrent import futures
 
@@ -46,7 +46,13 @@ class GrpcServer:
         }
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, rpcs),))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if credentials is not None:
+            # same tls block as the REST surface (api.tls.
+            # grpc_server_credentials)
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", credentials)
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -57,6 +57,10 @@ class Handler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
     server_version = "pilosa-tpu/" + __version__
+    # socket read timeout (StreamRequestHandler applies it per
+    # connection): reclaims handler threads from clients that stall
+    # mid-handshake or idle forever without closing
+    timeout = 120
 
     # -- plumbing ------------------------------------------------------------
 
@@ -401,14 +405,40 @@ def build_router() -> Router:
     return r
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        # failed TLS handshakes (plaintext probes, port scanners) and
+        # client disconnects are per-connection noise, not server
+        # errors — log at debug instead of dumping tracebacks
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, OSError):  # incl. ssl.SSLError, disconnects
+            logger = getattr(self, "logger", None)
+            if logger is not None:
+                logger.debug("http connection error from %s: %r",
+                             client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
+
 class Server:
     """HTTP server wrapper: ``serve_forever`` on a background thread
     (reference: ``server.go#Server.Open`` / handler listen-serve)."""
 
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101,
-                 stats=None, logger=None):
-        ThreadingHTTPServer.request_queue_size = 64  # concurrent clients
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+                 stats=None, logger=None, ssl_context=None):
+        _HTTPServer.request_queue_size = 64  # concurrent clients
+        self.httpd = _HTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            # TLS terminates here (reference: server/config.go tls
+            # section).  do_handshake_on_connect=False: the handshake
+            # runs in the per-connection handler thread on first read,
+            # NOT in the accept loop — a client that connects and never
+            # sends a ClientHello would otherwise wedge accept() and
+            # with it the whole HTTP surface (and this node's liveness)
+            self.httpd.socket = ssl_context.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.httpd.api = api
         self.httpd.router = build_router()
         self.httpd.stats = stats

@@ -38,6 +38,15 @@ class Config:
                                         # heap demotion (syswrap parity)
     grpc_bind: str = ""                 # host:port; "" disables gRPC
     mesh: bool = True                   # shard planes over all local devices
+    # tls (reference: server/config.go [tls] section) — one block turns
+    # on HTTPS, TLS internode fan-out, and gRPC TLS together; the
+    # node's certificate doubles as its client cert for mTLS when
+    # enable_client_auth requires peers to authenticate
+    tls_certificate: str = ""           # PEM cert path; "" = plaintext
+    tls_key: str = ""                   # PEM private key path
+    tls_ca_certificate: str = ""        # CA bundle for verifying peers
+    tls_skip_verify: bool = False       # outbound: skip server-cert check
+    tls_enable_client_auth: bool = False  # inbound: require client certs
     # multi-host jax (one process per host of a pod slice; the host-level
     # cluster layer above is independent of this)
     jax_coordinator: str = ""           # host:port of process 0; "" = single
@@ -83,6 +92,15 @@ def load(path: str | None = None, env: dict | None = None,
             data = tomllib.load(f)
         for k, v in data.items():
             k = k.replace("-", "_")
+            if k == "tls" and isinstance(v, dict):
+                # [tls] table, upstream-style: certificate = "...", ...
+                for tk, tv in v.items():
+                    tk = "tls_" + tk.replace("-", "_")
+                    if tk not in fields:
+                        raise ValueError(
+                            f"unknown [tls] key {tk[4:]!r} in {path}")
+                    setattr(cfg, tk, tv)
+                continue
             if k not in fields:
                 raise ValueError(f"unknown config key {k!r} in {path}")
             setattr(cfg, k, v)
@@ -98,9 +116,31 @@ def load(path: str | None = None, env: dict | None = None,
             setattr(cfg, k, v)
 
     cfg.data_dir = os.path.expanduser(cfg.data_dir)
+    for k in ("tls_certificate", "tls_key", "tls_ca_certificate"):
+        v = getattr(cfg, k)
+        if v:
+            setattr(cfg, k, os.path.expanduser(v))
     if not cfg.name:
         cfg.name = cfg.bind
     return cfg
+
+
+def tls_of(cfg: Config):
+    """The resolved tls block as an :class:`pilosa_tpu.api.tls.TLSConfig`."""
+    from pilosa_tpu.api.tls import TLSConfig
+    return TLSConfig(
+        certificate=cfg.tls_certificate, key=cfg.tls_key,
+        ca_certificate=cfg.tls_ca_certificate,
+        skip_verify=cfg.tls_skip_verify,
+        enable_client_auth=cfg.tls_enable_client_auth)
+
+
+def client_ssl_of(cfg: Config):
+    """Outbound TLS context for this config (internode fan-out, CLI
+    client), or None when the tls block is off — the single recipe
+    every surface shares."""
+    from pilosa_tpu.api.tls import client_context
+    return client_context(tls_of(cfg))
 
 
 def _resolve_type(t):

@@ -24,17 +24,33 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--grpc-bind", dest="grpc_bind",
                    help="host:port for the gRPC surface (default off)")
     p.add_argument("--verbose", action="store_true", default=None)
+    p.add_argument("--tls-certificate", dest="tls_certificate",
+                   help="PEM certificate; enables TLS on every surface")
+    p.add_argument("--tls-key", dest="tls_key", help="PEM private key")
+    p.add_argument("--tls-ca-certificate", dest="tls_ca_certificate",
+                   help="CA bundle for verifying peers")
+    p.add_argument("--tls-skip-verify", dest="tls_skip_verify",
+                   action="store_true", default=None,
+                   help="outbound: accept any server certificate")
+    p.add_argument("--tls-enable-client-auth",
+                   dest="tls_enable_client_auth", action="store_true",
+                   default=None, help="inbound: require client certs")
+
+
+_CLI_KEYS = ("bind", "data_dir", "verbose", "grpc_bind",
+             "tls_certificate", "tls_key", "tls_ca_certificate",
+             "tls_skip_verify", "tls_enable_client_auth")
 
 
 def _load_cfg(args) -> cfgmod.Config:
-    overrides = {k: getattr(args, k, None)
-                 for k in ("bind", "data_dir", "verbose", "grpc_bind")}
+    overrides = {k: getattr(args, k, None) for k in _CLI_KEYS}
     return cfgmod.load(args.config, overrides=overrides)
 
 
 def _client(cfg: cfgmod.Config):
     from pilosa_tpu.api.client import Client
-    return Client(cfg.host, cfg.port)
+    return Client(cfg.host, cfg.port,
+                  ssl_context=cfgmod.client_ssl_of(cfg))
 
 
 # -- commands ---------------------------------------------------------------
@@ -49,8 +65,9 @@ def cmd_server(args) -> int:
     from pilosa_tpu.server import PilosaTPUServer
     srv = PilosaTPUServer(cfg)
     srv.open()
-    log.info("listening on http://%s:%d data=%s", cfg.host, cfg.port,
-             cfg.data_dir)
+    scheme = "https" if cfg.tls_certificate else "http"
+    log.info("listening on %s://%s:%d data=%s", scheme, cfg.host,
+             cfg.port, cfg.data_dir)
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))

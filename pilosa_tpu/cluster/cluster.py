@@ -55,6 +55,10 @@ class Cluster:
             self.node_id: {"id": self.node_id, "uri": self.node_id,
                            "state": STATE_STARTING}}
         self._last_seen: dict[str, float] = {}
+        # internode TLS (upstream: internode client certs,
+        # server/config.go); one context for every peer client
+        from pilosa_tpu.cli.config import client_ssl_of
+        self._client_ssl_ctx = client_ssl_of(cfg)
         self.state = STATE_STARTING
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
@@ -142,7 +146,8 @@ class Cluster:
             c = self._clients.get(node_id)
             if c is None:
                 host, port = node_id.rsplit(":", 1)
-                c = self._clients[node_id] = Client(host, int(port))
+                c = self._clients[node_id] = Client(
+                    host, int(port), ssl_context=self._client_ssl_ctx)
             return c
 
     def member_ids(self) -> list[str]:

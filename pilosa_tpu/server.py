@@ -57,10 +57,20 @@ class PilosaTPUServer:
             plane_budget=self.cfg.plane_budget_bytes,
             count_batch_window=self.cfg.count_batch_window)
         self.api = API(self.holder, self.executor)
+        from pilosa_tpu.api import tls as tlsmod
+        from pilosa_tpu.cli.config import tls_of
+        tls_cfg = tls_of(self.cfg)
+        ssl_ctx = tlsmod.server_context(tls_cfg)
+        if ssl_ctx is not None:
+            self.logger.info(
+                "tls: serving HTTPS%s; internode calls use TLS",
+                " with required client certs"
+                if tls_cfg.enable_client_auth else "")
         # construct (binds the socket; resolves port 0) before the
         # cluster needs the advertised address, then serve
         self.http = HttpServer(self.api, self.cfg.host, self.cfg.port,
-                               stats=self.stats, logger=self.logger)
+                               stats=self.stats, logger=self.logger,
+                               ssl_context=ssl_ctx)
         if self.cfg.seeds or self.cfg.replicas > 1 or self.cfg.cluster_enabled:
             from pilosa_tpu.cluster import Cluster
             self.cluster = Cluster(self.cfg, self.api, stats=self.stats,
@@ -71,8 +81,10 @@ class PilosaTPUServer:
         if self.cfg.grpc_bind:
             from pilosa_tpu.api.grpc import GrpcServer
             ghost, _, gport = self.cfg.grpc_bind.rpartition(":")
-            self.grpc = GrpcServer(self.api, ghost or "127.0.0.1",
-                                   int(gport)).start()
+            self.grpc = GrpcServer(
+                self.api, ghost or "127.0.0.1", int(gport),
+                credentials=tlsmod.grpc_server_credentials(tls_cfg),
+            ).start()
             self.logger.info("grpc: listening on %s:%d",
                              ghost or "127.0.0.1", self.grpc.port)
         if self.cluster is not None:

@@ -21,10 +21,21 @@ class TestCluster:
 
     def __init__(self, servers: list[PilosaTPUServer]):
         self.servers = servers
+        self._ssl_by_server: dict[PilosaTPUServer, object] = {}
 
     @property
     def clients(self) -> list[Client]:
-        return [Client("127.0.0.1", s.http.address[1]) for s in self.servers]
+        return [Client("127.0.0.1", s.http.address[1],
+                       ssl_context=self._client_ssl(s))
+                for s in self.servers]
+
+    def _client_ssl(self, s: PilosaTPUServer):
+        # one context per server (tests poll .clients in loops; rebuilding
+        # re-reads the PEM files every time)
+        if s not in self._ssl_by_server:
+            from pilosa_tpu.cli.config import client_ssl_of
+            self._ssl_by_server[s] = client_ssl_of(s.cfg)
+        return self._ssl_by_server[s]
 
     def client(self, i: int = 0) -> Client:
         return self.clients[i]
@@ -75,8 +86,9 @@ class TestCluster:
 @contextmanager
 def run_cluster(n: int, base_dir: str, replicas: int = 1,
                 heartbeat: float = 0.2, anti_entropy: float = 0.0,
-                mesh: bool = False):
-    """Boot an n-node in-process cluster; yields a :class:`TestCluster`."""
+                mesh: bool = False, **cfg_kwargs):
+    """Boot an n-node in-process cluster; yields a :class:`TestCluster`.
+    Extra ``cfg_kwargs`` (e.g. a tls block) apply to every node."""
     servers: list[PilosaTPUServer] = []
     try:
         seed_bind = None
@@ -90,6 +102,7 @@ def run_cluster(n: int, base_dir: str, replicas: int = 1,
                 heartbeat_interval=heartbeat,
                 anti_entropy_interval=anti_entropy,
                 mesh=mesh,
+                **cfg_kwargs,
             )
             srv = PilosaTPUServer(cfg).open()
             servers.append(srv)

@@ -1,0 +1,247 @@
+"""TLS across the HTTP surface, internode fan-out, and gRPC
+(reference: upstream server/config.go [tls] section — server cert/key,
+CA, internode client certs).  Certs are generated self-signed per test
+session with the cryptography package; plaintext remains the default
+everywhere else in the suite."""
+
+import datetime
+import ssl
+
+import pytest
+
+cryptography = pytest.importorskip("cryptography")
+
+from cryptography import x509  # noqa: E402
+from cryptography.hazmat.primitives import hashes, serialization  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import ec  # noqa: E402
+from cryptography.x509.oid import NameOID  # noqa: E402
+
+from pilosa_tpu.api.client import Client, ClientError  # noqa: E402
+from pilosa_tpu.api.tls import (TLSConfig, client_context,  # noqa: E402
+                                grpc_server_credentials, server_context)
+from pilosa_tpu.cli.config import Config, load, tls_of  # noqa: E402
+from pilosa_tpu.server import PilosaTPUServer  # noqa: E402
+from pilosa_tpu.testing import run_cluster  # noqa: E402
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _cert(subject_cn, issuer_cert, issuer_key, *, is_ca=False, san=True):
+    """One EC cert; self-signed CA when issuer_cert is None."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    issuer = issuer_cert.subject if issuer_cert is not None \
+        else _name(subject_cn)
+    sign_key = issuer_key if issuer_key is not None else key
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (x509.CertificateBuilder()
+         .subject_name(_name(subject_cn))
+         .issuer_name(issuer)
+         .public_key(key.public_key())
+         .serial_number(x509.random_serial_number())
+         .not_valid_before(now - datetime.timedelta(minutes=5))
+         .not_valid_after(now + datetime.timedelta(hours=2))
+         .add_extension(x509.BasicConstraints(ca=is_ca, path_length=None),
+                        critical=True))
+    if san:
+        b = b.add_extension(x509.SubjectAlternativeName([
+            x509.DNSName("localhost"),
+            x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1")),
+        ]), critical=False)
+    return b.sign(sign_key, hashes.SHA256()), key
+
+
+def _write(tmp, name, cert, key):
+    cert_path = tmp / f"{name}.crt"
+    key_path = tmp / f"{name}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """CA + a node cert signed by it (SAN localhost/127.0.0.1)."""
+    tmp = tmp_path_factory.mktemp("pki")
+    ca_cert, ca_key = _cert("pilosa-test-ca", None, None, is_ca=True,
+                            san=False)
+    node_cert, node_key = _cert("pilosa-node", ca_cert, ca_key)
+    ca = _write(tmp, "ca", ca_cert, ca_key)
+    node = _write(tmp, "node", node_cert, node_key)
+    return {"ca_cert": ca[0], "cert": node[0], "key": node[1]}
+
+
+def _tls_kwargs(pki, client_auth=False):
+    return dict(tls_certificate=pki["cert"], tls_key=pki["key"],
+                tls_ca_certificate=pki["ca_cert"],
+                tls_enable_client_auth=client_auth)
+
+
+class TestContexts:
+    def test_disabled_block_yields_none(self):
+        assert server_context(TLSConfig()) is None
+        assert client_context(TLSConfig()) is None
+        assert grpc_server_credentials(TLSConfig()) is None
+
+    def test_validation(self, pki):
+        with pytest.raises(ValueError, match="key missing"):
+            server_context(TLSConfig(certificate=pki["cert"]))
+        with pytest.raises(ValueError, match="ca_certificate"):
+            server_context(TLSConfig(
+                certificate=pki["cert"], key=pki["key"],
+                enable_client_auth=True))
+
+    def test_config_toml_tls_table(self, pki, tmp_path):
+        p = tmp_path / "c.toml"
+        p.write_text(
+            "bind = \"127.0.0.1:0\"\n[tls]\n"
+            f"certificate = \"{pki['cert']}\"\nkey = \"{pki['key']}\"\n"
+            f"ca-certificate = \"{pki['ca_cert']}\"\n"
+            "enable-client-auth = true\n")
+        cfg = load(str(p), env={})
+        tls = tls_of(cfg)
+        assert tls.certificate == pki["cert"]
+        assert tls.enable_client_auth
+        with pytest.raises(ValueError, match="unknown \\[tls\\] key"):
+            p.write_text("[tls]\nnope = 1\n")
+            load(str(p), env={})
+
+
+@pytest.fixture
+def https_server(pki, tmp_path):
+    cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                 mesh=False, **_tls_kwargs(pki))
+    srv = PilosaTPUServer(cfg).open()
+    yield srv, srv.http.address[1]
+    srv.close()
+
+
+class TestHTTPS:
+    def test_query_roundtrip(self, pki, https_server):
+        _, port = https_server
+        ctx = client_context(TLSConfig(ca_certificate=pki["ca_cert"]))
+        c = Client("127.0.0.1", port, ssl_context=ctx)
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(3, f=1) Set(70, f=1)")
+        assert c.query("i", "Count(Row(f=1))") == [2]
+
+    def test_plaintext_client_rejected(self, https_server):
+        _, port = https_server
+        c = Client("127.0.0.1", port)  # speaks http:// at a TLS socket
+        with pytest.raises(ClientError):
+            c.status()
+
+    def test_unverified_client_rejected(self, https_server):
+        _, port = https_server
+        # default trust store does not contain the test CA
+        ctx = ssl.create_default_context()
+        c = Client("127.0.0.1", port, ssl_context=ctx)
+        with pytest.raises(ClientError, match="cannot reach"):
+            c.status()
+
+    def test_idle_tcp_client_does_not_wedge_accepts(self, pki,
+                                                    https_server):
+        # regression (r4 review): with do_handshake_on_connect=True the
+        # handshake ran inside accept(), so one connected-but-silent
+        # client froze the whole HTTP surface
+        import socket
+
+        _, port = https_server
+        idle = socket.create_connection(("127.0.0.1", port))
+        try:
+            ctx = client_context(TLSConfig(ca_certificate=pki["ca_cert"]))
+            assert Client("127.0.0.1", port, ssl_context=ctx,
+                          timeout=10).version()
+        finally:
+            idle.close()
+
+    def test_skip_verify(self, https_server):
+        _, port = https_server
+        ctx = client_context(TLSConfig(skip_verify=True))
+        assert Client("127.0.0.1", port,
+                      ssl_context=ctx).version()
+
+
+class TestMutualTLS:
+    @pytest.fixture
+    def mtls_server(self, pki, tmp_path):
+        cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                     mesh=False, **_tls_kwargs(pki, client_auth=True))
+        srv = PilosaTPUServer(cfg).open()
+        yield srv, srv.http.address[1]
+        srv.close()
+
+    def test_client_cert_required(self, pki, mtls_server):
+        _, port = mtls_server
+        no_cert = client_context(TLSConfig(ca_certificate=pki["ca_cert"]))
+        with pytest.raises(ClientError):
+            Client("127.0.0.1", port, ssl_context=no_cert).status()
+        with_cert = client_context(TLSConfig(
+            certificate=pki["cert"], key=pki["key"],
+            ca_certificate=pki["ca_cert"]))
+        assert Client("127.0.0.1", port,
+                      ssl_context=with_cert).status()
+
+
+class TestClusterTLS:
+    def test_two_node_cluster_over_mtls(self, pki, tmp_path):
+        """Heartbeats, schema broadcast, and the distributed query
+        fan-out all ride mTLS: every internode call presents the node
+        cert and verifies the peer against the CA."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+
+        with run_cluster(2, str(tmp_path),
+                         **_tls_kwargs(pki, client_auth=True)) as tc:
+            c = tc.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            far = 3 * SHARD_WIDTH + 11  # lands on a non-coordinator shard
+            c.query("i", f"Set(1, f=1) Set({far}, f=1)")
+            for cl in tc.clients:  # both nodes answer the full query
+                assert cl.query("i", "Count(Row(f=1))") == [2]
+
+
+class TestGrpcTLS:
+    def test_grpc_over_tls(self, pki, tmp_path):
+        grpc = pytest.importorskip("grpc")
+        from pilosa_tpu.api import proto
+        from pilosa_tpu.api.grpc import SERVICE
+
+        cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                     grpc_bind="127.0.0.1:0", mesh=False,
+                     **_tls_kwargs(pki))
+        srv = PilosaTPUServer(cfg).open()
+        try:
+            srv.api.create_index("i")
+            srv.api.create_field("i", "f")
+            with open(pki["ca_cert"], "rb") as f:
+                creds = grpc.ssl_channel_credentials(f.read())
+            chan = grpc.secure_channel(f"localhost:{srv.grpc.port}", creds)
+            ident = lambda b: b  # noqa: E731 — raw-bytes (de)serializers
+            query = chan.unary_unary(f"/{SERVICE}/Query",
+                                     request_serializer=ident,
+                                     response_deserializer=ident)
+            imp = chan.unary_unary(f"/{SERVICE}/Import",
+                                   request_serializer=ident,
+                                   response_deserializer=ident)
+            out = proto.decode_import_response(imp(
+                proto.encode_import_request(index="i", field="f",
+                                            row_ids=[1, 1], col_ids=[2, 9])))
+            assert out == {"changed": 2}
+            resp = proto.decode_query_response(query(
+                proto.encode_query_request("Count(Row(f=1))", index="i")))
+            assert resp["results"] == [2]
+            # plaintext channel at the TLS port fails
+            bad = grpc.insecure_channel(f"127.0.0.1:{srv.grpc.port}")
+            bad_q = bad.unary_unary(f"/{SERVICE}/Query",
+                                    request_serializer=ident,
+                                    response_deserializer=ident)
+            with pytest.raises(grpc.RpcError):
+                bad_q(proto.encode_query_request("Count(Row(f=1))",
+                                                 index="i"), timeout=5)
+        finally:
+            srv.close()
