@@ -321,29 +321,62 @@ class DistributedExecutor:
         if eff.name in ("Set", "Clear"):
             col = int(eff.args["_col"])
             owners = self.cluster.shard_owners(index, col // SHARD_WIDTH)
-            results = self._run_on(index, call, owners, shards=None)
+            # Set is best-effort over reachable owners: a down replica
+            # is repaired by AAE's union-merge when it rejoins.  Clear
+            # stays strict — a clear missed by a dead replica would be
+            # RESURRECTED by union-merge AAE (no deletion tombstones on
+            # bit data), so failing loudly is the only sound behavior.
+            results = self._run_on(index, call, owners, shards=None,
+                                   best_effort=eff.name == "Set")
             return bool(results[0])
-        # ClearRow / Store touch every shard: run on every node for its
-        # owned shards
+        # ClearRow / Store touch every shard, and every REPLICA of each
+        # shard must apply them: both clear bits, and a replica that
+        # missed a clear would diverge — then union-merge AAE would
+        # resurrect the cleared bits cluster-wide.  (Strict: any owner
+        # down fails the op, same rationale as Clear above.)
         all_shards = self.cluster.index_shards(index)
-        groups = self.cluster.group_shards_by_node(index, all_shards)
-        changed = False
-        for node_id, node_shards in groups.items():
-            r = self._run_on(index, call, [node_id], shards=node_shards)
-            changed = changed or bool(r[0])
-        return changed
+        groups: dict[str, list[int]] = {}
+        for s in all_shards:
+            for o in self.cluster.shard_owners(index, s):
+                groups.setdefault(o, []).append(s)
+        # fail fast BEFORE mutating anything: discovering a dead owner
+        # mid-loop would leave the clear half-applied (and the halves
+        # on dead-owner shards later resurrected by AAE)
+        alive = set(self.cluster.alive_ids())
+        dead = sorted(set(groups) - alive)
+        if dead:
+            raise ExecutionError(
+                f"replica {dead[0]} unreachable for {eff.name}: this op "
+                "requires every replica (a copy missed by a down node "
+                "would be resurrected by anti-entropy union merge)")
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+            results = list(pool.map(
+                lambda kv: self._run_on(index, call, [kv[0]],
+                                        shards=tuple(kv[1]))[0],
+                groups.items()))
+        return any(bool(r) for r in results)
 
     def _attr_write(self, index: str, call: Call):
         """SetRowAttrs/SetColumnAttrs apply on every alive node — attr
         stores are fully replicated, AAE repairs missed nodes."""
         call = self._translate_input(index, call, create=True)
-        self._run_on(index, call, self.cluster.alive_ids(), shards=None)
+        self._run_on(index, call, self.cluster.alive_ids(), shards=None,
+                     best_effort=True)
         return None
 
-    def _run_on(self, index: str, call: Call, node_ids, shards):
+    def _run_on(self, index: str, call: Call, node_ids, shards,
+                best_effort: bool = False):
         """Execute one call on each named node (replica-synchronous for
-        writes, replicas in parallel); returns the primary's (first)
-        result."""
+        writes, replicas in parallel); returns the successful results,
+        primary's first.
+
+        ``best_effort``: an unreachable node (ClientError — dead or not
+        yet past the suspect horizon) is skipped as long as at least
+        one owner accepts; AAE repairs it on rejoin.  Execution errors
+        (validation etc.) always propagate."""
+        from pilosa_tpu.api.client import ClientError
+
         pql = str(call)
 
         def one(node_id):
@@ -356,12 +389,42 @@ class DistributedExecutor:
             return self.cluster.internal_query(node_id, index, pql,
                                                shards)[0]
 
+        def guarded(node_id):
+            try:
+                return ("ok", one(node_id))
+            except ClientError as e:
+                # only transport-level failures (no HTTP status) or an
+                # explicit 503 mean "node down"; a 5xx from an alive
+                # peer is a real failed write and must propagate, not
+                # be waved off as AAE-repairable
+                if e.status in (0, 503):
+                    return ("down", (node_id, e))
+                raise
+
         node_ids = list(node_ids)
         if len(node_ids) == 1:
-            return [one(node_ids[0])]
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(node_ids)) as pool:
-            return list(pool.map(one, node_ids))
+            outs = [guarded(node_ids[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(node_ids)) as pool:
+                outs = list(pool.map(guarded, node_ids))
+        oks = [r for tag, r in outs if tag == "ok"]
+        downs = [r for tag, r in outs if tag == "down"]
+        if downs and (not best_effort or not oks):
+            nid, err = downs[0]
+            raise ExecutionError(
+                f"replica {nid} unreachable for {_call_of(call).name}: "
+                f"{err}" + ("" if best_effort else
+                            " (this op requires every replica: a copy "
+                            "missed by a down node would be resurrected "
+                            "by anti-entropy union merge)"))
+        if downs:
+            self.cluster.stats.count("write_replicas_missed", len(downs))
+            self.cluster.logger.warning(
+                "%s applied on %d/%d owners; missed %s (AAE repairs on "
+                "rejoin)", _call_of(call).name, len(oks), len(node_ids),
+                [nid for nid, _ in downs])
+        return oks
 
     # -- key translation at the edge ---------------------------------------
 

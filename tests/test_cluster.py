@@ -351,6 +351,82 @@ class TestReplicationAndFailover:
             assert after == 6
 
 
+class TestWriteSemanticsUnderNodeLoss:
+    """Set is best-effort over reachable owners (AAE repairs a dead
+    replica on rejoin); Clear-family ops are strict — a clear missed by
+    a down replica would be resurrected by union-merge AAE."""
+
+    @staticmethod
+    def _kill_non_coordinator(c):
+        import time
+        coord = c.servers[0].cluster.coordinator_id()
+        victim = next(s for s in c.servers
+                      if s.cluster.node_id != coord)
+        victim_id = victim.cluster.node_id
+        victim.close()
+        survivor = next(s for s in c.servers if s is not victim)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(survivor.cluster.alive_ids()) == 2:
+                return victim_id
+            time.sleep(0.05)
+        raise TimeoutError("node loss never detected")
+
+    def test_set_best_effort_clear_strict(self, tmp_path):
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=0.1) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6,
+                                    columnIDs=cols)
+            victim_id = self._kill_non_coordinator(c)
+            alive = [s for s in c.servers
+                     if s.cluster.node_id != victim_id]
+            from pilosa_tpu.api.client import Client
+            cl = Client("127.0.0.1", alive[0].http.address[1])
+            # Sets succeed on every shard, including ones the dead
+            # node owns (with 6 shards x replicas=2 over 3 nodes the
+            # victim owns some)
+            for s in range(6):
+                assert cl.query(
+                    "i", f"Set({s * SHARD_WIDTH + 7}, f=1)") == [True]
+            assert cl.query("i", "Count(Row(f=1))") == [12]
+            # Clear on a shard the dead node owns is rejected loudly
+            victim_shards = [
+                s for s in range(6) if victim_id in
+                alive[0].cluster.shard_owners("i", s)]
+            assert victim_shards, "victim owns no shard — test invalid"
+            col = victim_shards[0] * SHARD_WIDTH + 7
+            with pytest.raises(ClientError, match="resurrected"):
+                cl.query("i", f"Clear({col}, f=1)")
+            # on a fully-alive owner set, Clear still works
+            healthy = [s for s in range(6) if s not in victim_shards]
+            if healthy:
+                hcol = healthy[0] * SHARD_WIDTH + 7
+                assert cl.query("i", f"Clear({hcol}, f=1)") == [True]
+
+    def test_clearrow_applies_on_every_replica(self, tmp_path):
+        with run_cluster(3, str(tmp_path), replicas=2) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).import_bits("i", "f", rowIDs=[1, 1],
+                                    columnIDs=[3, 9])
+            assert c.client(0).query("i", "ClearRow(f=1)") == [True]
+            # no replica retains the row (previously only one owner
+            # applied it and AAE would have resurrected the bits)
+            for s in c.servers:
+                idx = s.holder.index("i")
+                f = idx.field("f") if idx else None
+                v = f.standard_view() if f else None
+                frag = v.fragment(0) if v else None
+                if frag is not None:
+                    assert not frag.row(1).contains(3)
+                    assert not frag.row(1).contains(9)
+
+
 class TestExtractLimitCluster:
     def test_extract_distributed(self, three_nodes):
         c = three_nodes
