@@ -1,0 +1,240 @@
+"""Config #11: BULK INGEST at the 1B-column serving condition
+(VERDICT r3 #2 — "ingest is half of what a bitmap index is for").
+
+Measures, through the product path on the real on-disk index:
+
+  1. import throughput (bits/s sustained) via ``API.import_bits``
+     batches — the path client JSON/proto imports land on — and via
+     ImportRoaring (pre-serialized shard blobs, ``api.import_roaring``)
+  2. REST wire variants at one batch size: JSON vs application/x-protobuf
+  3. time-to-queryability: latency of the first Count after a batch
+     lands on a RESIDENT device plane (journal-driven incremental
+     scatter, planes._incremental) vs the cold full-rebuild path
+  4. serving degradation: 32-Count qps with and without a concurrent
+     importer hammering the same field
+
+Scale via PILOSA_BENCH_SHARDS (default 954 = 1B cols).  Every count is
+oracle-checked against a numpy bit matrix of the imported positions."""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+N_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 32
+WORDS = 32768
+BATCH = 100_000
+INDEX = "bench"
+
+
+def main():
+    from pilosa_tpu.api import API, Server
+    from pilosa_tpu.engine.words import SHARD_WIDTH
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder, roaring
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(11)
+    total_cols = N_SHARDS * SHARD_WIDTH
+    results = {}
+
+    # base index: the 1B-col 32-row dense field (same shape as the
+    # headline bench), written as fragment snapshots
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    data_dir = tempfile.mkdtemp(prefix="pilosa_ingest_")
+    t0 = time.perf_counter()
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field("f")
+    idx.create_field("inc")  # import target
+    h.close()
+    fdir = os.path.join(data_dir, INDEX, "f", "views", "standard",
+                        "fragments")
+    os.makedirs(fdir, exist_ok=True)
+    for s in range(N_SHARDS):
+        with open(os.path.join(fdir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+    log(f"base index written: {time.perf_counter() - t0:.1f}s")
+    counts_oracle = np.bitwise_count(plane).sum(axis=(0, 2),
+                                                dtype=np.int64)
+    del plane
+
+    holder = Holder(data_dir).open()
+    api = API(holder, Executor(holder))
+
+    # ---- 1. import throughput ------------------------------------------
+    def batches(n_batches, seed):
+        r = np.random.default_rng(seed)
+        for _ in range(n_batches):
+            yield (r.integers(0, N_ROWS, size=BATCH).astype(np.uint64),
+                   r.integers(0, total_cols, size=BATCH).astype(np.uint64))
+
+    n_batches = 50
+    t0 = time.perf_counter()
+    for rows, cols in batches(n_batches, 100):
+        api.import_bits(INDEX, "inc", row_ids=rows, col_ids=cols)
+    dt = time.perf_counter() - t0
+    bits_s = n_batches * BATCH / dt
+    results["import_bits_per_s"] = round(bits_s)
+    log(f"API.import_bits: {n_batches}x{BATCH // 1000}k pairs in "
+        f"{dt:.1f}s -> {bits_s / 1e6:.2f}M bits/s sustained")
+
+    # ImportRoaring: pre-serialized single-shard blobs (the bulk-load
+    # fast path; reference: fragment.importRoaring)
+    r = np.random.default_rng(101)
+    blobs = []
+    for i in range(20):
+        rows = r.integers(0, N_ROWS, size=BATCH).astype(np.uint64)
+        offs = r.integers(0, SHARD_WIDTH, size=BATCH).astype(np.uint64)
+        pos = np.unique(rows * np.uint64(SHARD_WIDTH) + offs)
+        blobs.append((i % N_SHARDS, roaring.serialize(pos), len(pos)))
+    t0 = time.perf_counter()
+    nbits = 0
+    for shard, blob, n in blobs:
+        api.import_roaring(INDEX, "inc", shard, blob)
+        nbits += n
+    dt = time.perf_counter() - t0
+    results["import_roaring_bits_per_s"] = round(nbits / dt)
+    log(f"ImportRoaring: {nbits / 1e6:.1f}M bits in {dt:.1f}s -> "
+        f"{nbits / dt / 1e6:.2f}M bits/s")
+
+    # ---- 2. REST wire: JSON vs proto at one batch ----------------------
+    import urllib.request
+
+    from pilosa_tpu.api import proto
+
+    srv = Server(api, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.address[1]}"
+    rows = r.integers(0, N_ROWS, size=BATCH).astype(np.uint64)
+    cols = r.integers(0, total_cols, size=BATCH).astype(np.uint64)
+
+    def rest_import(body, ctype):
+        req = urllib.request.Request(
+            f"{base}/index/{INDEX}/field/inc/import", data=body,
+            method="POST", headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req) as resp:
+            json.loads(resp.read())
+
+    jbody = json.dumps({"rowIDs": rows.tolist(),
+                        "columnIDs": cols.tolist()}).encode()
+    pbody = proto.encode_import_request(row_ids=rows, col_ids=cols)
+    for name, body, ctype in (
+            ("json", jbody, "application/json"),
+            ("proto", pbody, proto.CONTENT_TYPE)):
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            rest_import(body, ctype)
+            lat.append(time.perf_counter() - t0)
+        p50 = float(np.median(lat))
+        results[f"rest_import_{name}_ms"] = round(p50 * 1e3, 1)
+        log(f"REST import {name}: {len(body) / 1e6:.2f} MB body, "
+            f"{p50 * 1e3:.0f} ms / {BATCH // 1000}k pairs "
+            f"({BATCH / p50 / 1e6:.2f}M bits/s)")
+
+    # ---- 3. time-to-queryability ---------------------------------------
+    # warm the f plane, then measure query latency right after a write
+    # to f (journal-driven incremental refresh of the RESIDENT plane)
+    pql32 = "".join(f"Count(Row(f={r_}))" for r_ in range(N_ROWS))
+    got = api.query(INDEX, pql32)["results"]
+    assert got == [int(c) for c in counts_oracle], "oracle mismatch"
+    warm = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        api.query(INDEX, pql32)
+        warm.append(time.perf_counter() - t0)
+    t_warm = float(np.median(warm))
+
+    inc_lat = []
+    add_cols = r.choice(total_cols, size=40, replace=False)
+    expect = [int(c) for c in counts_oracle]
+    for i in range(8):
+        cs = add_cols[i * 5:(i + 1) * 5]
+        new = api.import_bits(INDEX, "f", row_ids=np.zeros(5, np.uint64),
+                              col_ids=cs.astype(np.uint64))
+        expect[0] += new
+        t0 = time.perf_counter()
+        got = api.query(INDEX, pql32)["results"]
+        inc_lat.append(time.perf_counter() - t0)
+        assert got == expect, "post-import count diverged from oracle"
+    t_inc = float(np.median(inc_lat))
+    results["query_warm_ms"] = round(t_warm * 1e3, 1)
+    results["query_after_import_ms"] = round(t_inc * 1e3, 1)
+    log(f"time-to-queryability: warm query {t_warm * 1e3:.0f} ms; "
+        f"first query after an import batch {t_inc * 1e3:.0f} ms "
+        f"(incremental plane scatter, no rebuild)")
+
+    # ---- 4. serving degradation under concurrent ingest ----------------
+    def burst(n_threads=8, iters=4):
+        barrier = threading.Barrier(n_threads + 1)
+        errs = []
+
+        def worker():
+            barrier.wait()
+            for _ in range(iters):
+                try:
+                    if api.query(INDEX, pql32)["results"] != expect:
+                        errs.append("wrong")
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert not errs, errs[:3]
+        return n_threads * iters * N_ROWS / dt
+
+    qps_quiet = burst()
+    stop = threading.Event()
+
+    def importer():
+        g = batches(10 ** 6, 999)
+        while not stop.is_set():
+            rows, cols = next(g)
+            api.import_bits(INDEX, "inc", row_ids=rows, col_ids=cols)
+
+    it = threading.Thread(target=importer)
+    it.start()
+    time.sleep(0.5)
+    try:
+        qps_load = burst()
+    finally:
+        stop.set()
+        it.join()
+    results["serving_qps_quiet"] = round(qps_quiet, 1)
+    results["serving_qps_under_ingest"] = round(qps_load, 1)
+    log(f"serving: {qps_quiet:,.0f} qps quiet vs {qps_load:,.0f} qps "
+        f"under continuous {BATCH // 1000}k-pair ingest "
+        f"({qps_load / qps_quiet * 100:.0f}% retained)")
+
+    srv.close()
+    holder.close()
+    import shutil
+    shutil.rmtree(data_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": f"ingest_bits_per_s_{platform}",
+        "value": results["import_bits_per_s"],
+        "unit": "bits/s", "vs_baseline": 1.0, "detail": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,13 @@ _SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
                   "==": "eq", "!=": "ne"}
 
 
+def _is_device_oom(e: Exception) -> bool:
+    """XLA device-memory exhaustion, by message: jax wraps it as
+    XlaRuntimeError/JaxRuntimeError with a RESOURCE_EXHAUSTED status."""
+    return ("RESOURCE_EXHAUSTED" in str(e)
+            and type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"))
+
+
 def _lex_gt(mat: np.ndarray, prev: tuple) -> np.ndarray:
     """Rows of ``mat`` strictly greater than ``prev`` in lexicographic
     order (GroupBy ``previous=`` paging, vectorized)."""
@@ -170,7 +177,8 @@ class Executor:
                                  index=index_name, calls=run_end - i,
                                  shards=len(ctx.shards)):
                     t0 = time.perf_counter()
-                    batched = self._count_batch(ctx, calls[i:run_end])
+                    batched = self._with_oom_retry(
+                        lambda: self._count_batch(ctx, calls[i:run_end]))
                     self.stats.timing("query_seconds",
                                       time.perf_counter() - t0,
                                       call="CountBatch")
@@ -185,7 +193,8 @@ class Executor:
                              index=index_name,
                              shards=len(ctx.shards)):
                 t0 = time.perf_counter()
-                results.append(self._call(ctx, call))
+                results.append(self._with_oom_retry(
+                    lambda: self._call(ctx, call)))
                 self.stats.timing("query_seconds",
                                   time.perf_counter() - t0, call=call.name)
             i += 1
@@ -346,6 +355,29 @@ class Executor:
         if handler is None:
             raise ExecutionError(f"unknown call {call.name!r}")
         return handler(ctx, call)
+
+    def _with_oom_retry(self, fn):
+        """Run ``fn``; on device RESOURCE_EXHAUSTED, drop every cached
+        plane and retry once.
+
+        HBM pressure: the plane cache budget bounds its own entries,
+        but in-flight queries hold plane references that eviction
+        cannot free, so a mixed workload (dense + BSI + sparse
+        residency) can exhaust device memory on a valid query.  Product
+        behavior: a slow rebuild beats a 500 (found via config10: REST
+        filtered TopN after the BSI+sparse phases at 1B cols).  Covers
+        EVERY execute path — fused count batches and bitmap fast paths
+        included, not just per-call handlers."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — filtered below
+            if not _is_device_oom(e):
+                raise
+            import gc
+            self.planes.invalidate()
+            gc.collect()
+            self.stats.count("device_oom_retries", 1)
+            return fn()
 
     def _attach_row_attrs(self, ctx: _Ctx, call: Call,
                           result: "RowResult") -> None:

@@ -648,20 +648,29 @@ class Fragment:
         elif op in (OP_SET_BITS, OP_CLEAR_BITS):
             assert positions is not None
             self._check_rows(positions)
-            for r, chunk in _split_by_row(positions):
+            # ONE global sort+dedup; per-row chunks are then sorted-
+            # unique, so row.add/remove skip their per-chunk np.unique
+            # (a 100k-pair import touching every shard makes ~30 tiny
+            # per-row calls per fragment — per-call work dominates)
+            positions = np.unique(np.asarray(positions, np.uint64))
+            for r, chunk in _split_by_row(positions, presorted=True):
                 self._ensure_row(r)
                 if op == OP_SET_BITS:
                     row = self.rows.get(r)
                     if row is None:
                         row = self.rows[r] = RowBits()
-                    changed += row.add(chunk)
+                    changed += row.add(chunk, presorted=True)
                 else:
                     row = self.rows.get(r)
                     if row is not None:
-                        changed += row.remove(chunk)
+                        changed += row.remove(chunk, presorted=True)
                         if not row.any():
                             del self.rows[r]
-                delta[r] = np.unique(chunk >> np.uint32(5))
+                # dedup without a re-sort (chunk is sorted): delta
+                # cells count against RECENT_CELL_CAP, and one entry
+                # per POSITION would inflate a dense-clustered batch
+                # ~32x, tripping the journal-gap full-rebuild path
+                delta[r] = _dedup_sorted(chunk >> np.uint32(5))
         else:
             raise ValueError(f"fragment: unknown op {op}")
         if changed:
@@ -695,14 +704,23 @@ class Fragment:
             self.rows[r] = RowBits.from_columns(cols)
 
 
-def _split_by_row(positions: np.ndarray) -> list[tuple[int, np.ndarray]]:
+def _dedup_sorted(a: np.ndarray) -> np.ndarray:
+    """Unique values of an already-sorted array, no re-sort."""
+    if len(a) < 2:
+        return a
+    return a[np.concatenate(([True], a[1:] != a[:-1]))]
+
+
+def _split_by_row(positions: np.ndarray,
+                  presorted: bool = False) -> list[tuple[int, np.ndarray]]:
     """Split positions (any order, duplicates OK) into per-row column
     chunks: [(row_id, uint32 cols), ...].  The single place that owns the
     position→(row, col) segmentation invariant."""
     positions = np.asarray(positions, dtype=np.uint64)
     if len(positions) == 0:
         return []
-    positions = np.sort(positions)
+    if not presorted:
+        positions = np.sort(positions)
     row_ids = positions // _SW
     cols = (positions % _SW).astype(np.uint32)
     uniq, starts = np.unique(row_ids, return_index=True)

@@ -114,9 +114,12 @@ class RowBits:
 
     # -- mutation -----------------------------------------------------------
 
-    def add(self, cols: np.ndarray) -> int:
-        """Set columns; returns how many were newly set."""
-        cols = np.unique(np.asarray(cols, dtype=np.uint32))
+    def add(self, cols: np.ndarray, presorted: bool = False) -> int:
+        """Set columns; returns how many were newly set.  ``presorted``
+        promises sorted-unique uint32 input (the bulk-import path dedups
+        a whole fragment batch once instead of per row)."""
+        if not presorted:
+            cols = np.unique(np.asarray(cols, dtype=np.uint32))
         if len(cols) == 0:
             return 0
         if int(cols[-1]) >= SHARD_WIDTH:
@@ -135,9 +138,10 @@ class RowBits:
         self._maybe_densify()
         return added
 
-    def remove(self, cols: np.ndarray) -> int:
+    def remove(self, cols: np.ndarray, presorted: bool = False) -> int:
         """Clear columns; returns how many were previously set."""
-        cols = np.unique(np.asarray(cols, dtype=np.uint32))
+        if not presorted:
+            cols = np.unique(np.asarray(cols, dtype=np.uint32))
         if len(cols) == 0 or self._card == 0:
             return 0
         if self._words is not None:

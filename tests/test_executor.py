@@ -25,6 +25,55 @@ def q(ex, pql, index="i", shards=None):
     return ex.execute(index, pql, shards=shards)
 
 
+class TestDeviceOomRetry:
+    def test_oom_evicts_planes_and_retries(self, env, monkeypatch):
+        """Device RESOURCE_EXHAUSTED on a call must evict the plane
+        cache and retry once, not surface a 500 (regression: REST
+        filtered TopN OOM'd at 1B cols after BSI+sparse residency
+        filled HBM — bench/config10)."""
+        _, _, ex = env
+        q(ex, "Set(1, f=1) Set(2, f=1)")
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        calls = {"n": 0}
+        invalidated = {"n": 0}
+        real = ex._execute_count
+
+        def flaky(ctx, call):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise XlaRuntimeError(
+                    "RESOURCE_EXHAUSTED: TPU backend error")
+            return real(ctx, call)
+
+        real_inval = ex.planes.invalidate
+
+        def spy_invalidate(index=None):
+            invalidated["n"] += 1
+            return real_inval(index)
+
+        monkeypatch.setattr(ex, "_execute_count", flaky)
+        monkeypatch.setattr(ex.planes, "invalidate", spy_invalidate)
+        assert q(ex, "Count(Row(f=1))") == [2]
+        assert calls["n"] == 2 and invalidated["n"] == 1
+
+    def test_non_oom_errors_propagate_without_retry(self, env,
+                                                    monkeypatch):
+        _, _, ex = env
+        calls = {"n": 0}
+
+        def boom(ctx, call):
+            calls["n"] += 1
+            raise RuntimeError("INTERNAL: something else")
+
+        monkeypatch.setattr(ex, "_execute_count", boom)
+        with pytest.raises(RuntimeError, match="something else"):
+            q(ex, "Count(Row(f=1))")
+        assert calls["n"] == 1
+
+
 class TestBitmapCalls:
     def test_row_and_set(self, env):
         _, _, ex = env
