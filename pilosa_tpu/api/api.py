@@ -45,10 +45,11 @@ def field_options_from_json(o: dict) -> FieldOptions:
 
 class API:
     def __init__(self, holder: Holder, executor: Executor | None = None,
-                 cluster=None):
+                 cluster=None, query_timeout: float = 0.0):
         self.holder = holder
         self.executor = executor or Executor(holder)
         self.cluster = cluster  # set by the cluster layer when distributed
+        self.query_timeout = query_timeout  # seconds; 0 = unlimited
 
     # -- schema -------------------------------------------------------------
 
@@ -110,12 +111,23 @@ class API:
 
     def query(self, index: str, pql: str,
               shards: list[int] | None = None,
-              profile: bool = False) -> dict:
+              profile: bool = False,
+              timeout: float | None = None) -> dict:
         """``profile=True`` attaches the per-call span tree to the
-        response (reference: query ``profile`` option, SURVEY.md §6)."""
-        from pilosa_tpu.exec.executor import ExecutionError
+        response (reference: query ``profile`` option, SURVEY.md §6).
+        ``timeout`` (seconds; falls back to the server's
+        ``query_timeout`` config, 0 = unlimited) bounds execution —
+        the deadline analogue of upstream's request-context
+        cancellation; expiry answers HTTP 408."""
+        import time as _time
+
+        from pilosa_tpu.exec.executor import (ExecutionError,
+                                              QueryTimeoutError)
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
+        if timeout is None:
+            timeout = self.query_timeout
+        deadline = (_time.monotonic() + timeout) if timeout else None
         tracer = None
         if profile:
             from pilosa_tpu.obs import Tracer
@@ -123,11 +135,15 @@ class API:
         try:
             if self.cluster is not None:
                 out = {"results": self.cluster.dist.execute_json(
-                    index, pql, shards=shards, tracer=tracer)}
+                    index, pql, shards=shards, tracer=tracer,
+                    deadline=deadline)}
             else:
                 results = self.executor.execute(index, pql, shards=shards,
-                                                tracer=tracer)
+                                                tracer=tracer,
+                                                deadline=deadline)
                 out = {"results": [result_to_json(r) for r in results]}
+        except QueryTimeoutError as e:
+            raise ApiError(str(e), 408)
         except (ParseError, ExecutionError) as e:
             raise ApiError(str(e), 400)
         if tracer is not None:

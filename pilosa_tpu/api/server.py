@@ -159,9 +159,23 @@ class Handler(BaseHTTPRequestHandler):
                 raise ApiError(f"bad shards param "
                                f"{self.query['shards'][0]!r}")
         profile = "profile" in self.query
+        timeout = None
+        if "timeout" in self.query:
+            import math
+            try:
+                timeout = float(self.query["timeout"][0])
+            except ValueError:
+                timeout = None
+            # NaN would poison every deadline comparison into False
+            # (silently unlimited); negatives are nonsense — reject
+            # both.  0 means explicitly unlimited, like the config.
+            if timeout is None or not math.isfinite(timeout) or timeout < 0:
+                raise ApiError(
+                    f"bad timeout param {self.query['timeout'][0]!r}")
         if not want_proto:
             self._reply(self.server.api.query(index, pql, shards=shards,
-                                              profile=profile))
+                                              profile=profile,
+                                              timeout=timeout))
             return
         if profile:
             # QueryResponse has no profile field; fail loudly rather
@@ -173,7 +187,8 @@ class Handler(BaseHTTPRequestHandler):
         # behavior must not diverge by content type
         status = 200
         try:
-            res = self.server.api.query(index, pql, shards=shards)
+            res = self.server.api.query(index, pql, shards=shards,
+                                        timeout=timeout)
         except ApiError as e:
             raw = proto.encode_query_response(err=str(e))
             status = e.status

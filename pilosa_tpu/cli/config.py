@@ -33,6 +33,8 @@ class Config:
     # device
     count_batch_window: float = 0.0    # seconds; >0 coalesces concurrent
                                        # Count queries into one dispatch
+    query_timeout: float = 0.0         # seconds per query; 0 = unlimited
+                                       # (?timeout= overrides per request)
     plane_budget_bytes: int = 4 << 30
     max_map_count: int = 32768          # live snapshot mmaps before LRU
                                         # heap demotion (syswrap parity)

@@ -95,11 +95,21 @@ class DistributedExecutor:
     # -- public -------------------------------------------------------------
 
     def execute_json(self, index: str, pql: str,
-                     shards: list[int] | None = None, tracer=None) -> list:
+                     shards: list[int] | None = None, tracer=None,
+                     deadline: float | None = None) -> list:
+        """``deadline`` is checked between top-level calls; the local
+        partial execution inside each fan-out also honors it (remote
+        nodes are bounded by the internode client timeout)."""
+        import time as _time
+
         from contextlib import nullcontext
+
+        from pilosa_tpu.exec.executor import QueryTimeoutError
         query = parse_cached(pql)
         out = []
         for call in query.calls:
+            if deadline is not None and _time.monotonic() > deadline:
+                raise QueryTimeoutError("query timeout exceeded")
             name = _call_of(call).name
             span = (tracer.span("cluster." + name, index=index)
                     if tracer is not None else nullcontext())
@@ -109,9 +119,11 @@ class DistributedExecutor:
                 elif name in WRITE_CALLS:
                     out.append(self._write(index, call))
                 elif name == "Percentile":
-                    out.append(self._percentile(index, call, shards))
+                    out.append(self._percentile(index, call, shards,
+                                                deadline=deadline))
                 else:
-                    out.append(self._read(index, call, shards))
+                    out.append(self._read(index, call, shards,
+                                          deadline=deadline))
         return out
 
     # k-ary search fan-out width: one round ships K Counts per node in
@@ -120,7 +132,8 @@ class DistributedExecutor:
     # log_2 — a 21-bit field resolves in ~6 fan-outs, not ~42
     PERCENTILE_FANOUT = 16
 
-    def _percentile(self, index: str, call: Call, shards):
+    def _percentile(self, index: str, call: Call, shards,
+                    deadline: float | None = None):
         """Percentile cannot merge from per-node partials (a median of
         medians is not a median): run a k-ary search HERE with
         cluster-wide counts — each round one batched multi-Count
@@ -158,7 +171,8 @@ class DistributedExecutor:
 
         def dist_counts(offsets: list[int]) -> list[int]:
             return self._read_many(index,
-                                   [count_call(o) for o in offsets], shards)
+                                   [count_call(o) for o in offsets],
+                                   shards, deadline=deadline)
 
         (total,) = dist_counts([bound])
         if total == 0:
@@ -189,7 +203,8 @@ class DistributedExecutor:
             (at,), below = dist_counts([lo]), 0
         return {"value": field.from_stored(lo + base), "count": at - below}
 
-    def _read_many(self, index: str, calls: list[Call], shards):
+    def _read_many(self, index: str, calls: list[Call], shards,
+                   deadline: float | None = None):
         """Fan out SEVERAL Count calls as one query per node (each node
         fuses the run into one program + read); returns merged ints."""
         all_shards = (tuple(shards) if shards is not None
@@ -213,7 +228,7 @@ class DistributedExecutor:
             rs = self.cluster.api.executor.execute(
                 index, Query(list(calls)),
                 shards=list(groups[self.cluster.node_id]),
-                translate_output=False)
+                translate_output=False, deadline=deadline)
             per_node.append([result_to_json(r) for r in rs])
         if pool is not None:
             try:
@@ -223,8 +238,8 @@ class DistributedExecutor:
         return [sum(node_counts[i] for node_counts in per_node)
                 for i in range(len(calls))]
 
-    def _resolve_nested_limits(self, index: str, call: Call,
-                               shards) -> Call:
+    def _resolve_nested_limits(self, index: str, call: Call, shards,
+                               *, deadline: float | None = None) -> Call:
         """Rewrite non-top-level Limit subtrees into resolved ConstRow
         literals, bottom-up (inner Limits resolve first, so a Limit
         whose child contains another Limit also works)."""
@@ -234,7 +249,8 @@ class DistributedExecutor:
                     for k, v in node.args.items()}
             node = Call(node.name, args, kids)
             if node.name == "Limit":
-                cols = self._read(index, node, shards)
+                cols = self._read(index, node, shards,
+                                  deadline=deadline)
                 return Call("ConstRow",
                             {"columns": (cols.get("columns")
                                          or cols.get("keys") or [])})
@@ -253,7 +269,8 @@ class DistributedExecutor:
 
     # -- reads --------------------------------------------------------------
 
-    def _read(self, index: str, call: Call, shards: list[int] | None):
+    def _read(self, index: str, call: Call, shards: list[int] | None,
+              deadline: float | None = None):
         if call.name == "Options" and call.args.get("shards") is not None:
             # apply the shard override BEFORE any rewrite that issues
             # its own distributed reads (Extract(Limit) / nested-Limit
@@ -268,7 +285,8 @@ class DistributedExecutor:
             # globally merged ascending column list) and substitute the
             # result as a ConstRow literal — one extra fan-out round
             # per nested Limit, exactness preserved.
-            call = self._resolve_nested_limits(index, call, shards)
+            call = self._resolve_nested_limits(index, call, shards,
+                                               deadline=deadline)
         call = self._translate_input(index, call)
         if call.name == "Options" and call.args.get("shards") is not None:
             # Options(shards=[...]) overrides, as in single-node
@@ -300,7 +318,7 @@ class DistributedExecutor:
             rs = local_api.executor.execute(
                 index, Query([sub_call]),
                 shards=list(groups[self.cluster.node_id]),
-                translate_output=False)
+                translate_output=False, deadline=deadline)
             partials.append(result_to_json(rs[0]))
         if pool is not None:
             try:

@@ -103,11 +103,23 @@ class ExecutionError(Exception):
     pass
 
 
+class QueryTimeoutError(ExecutionError):
+    """Query deadline exceeded (reference: upstream threads request
+    context cancellation through the executor; deadlines are the
+    equivalent for a compiled-dispatch engine — checked at block
+    boundaries, between calls, and before each streamed row block)."""
+
+
 @dataclass
 class _Ctx:
     index: Index
     shards: tuple[int, ...]
     translate_output: bool = True
+    deadline: float | None = None  # time.monotonic() cutoff
+
+    def check_deadline(self) -> None:
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise QueryTimeoutError("query timeout exceeded")
 
 
 class Executor:
@@ -140,7 +152,8 @@ class Executor:
 
     def execute(self, index_name: str, query: str | Query,
                 shards: list[int] | None = None,
-                translate_output: bool = True, tracer=None) -> list:
+                translate_output: bool = True, tracer=None,
+                deadline: float | None = None) -> list:
         """Run every top-level call; returns one result per call
         (reference: ``Executor.Execute`` → ``QueryResponse.Results``).
 
@@ -148,7 +161,9 @@ class Executor:
         the cluster layer, which merges partials from many nodes first
         and key-translates once at the edge.  ``tracer`` overrides the
         shared tracer (the ``profile=true`` path uses a per-request one
-        so concurrent queries' spans don't interleave)."""
+        so concurrent queries' spans don't interleave).  ``deadline``
+        (``time.monotonic()`` cutoff) aborts with
+        :class:`QueryTimeoutError` at call/block boundaries."""
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index {index_name!r} not found")
@@ -172,7 +187,8 @@ class Executor:
                 run_end += 1
             if run_end - i > 1:
                 ctx = _Ctx(index, self._shards_for(index, shards, calls[i]),
-                           translate_output)
+                           translate_output, deadline=deadline)
+                ctx.check_deadline()
                 with tracer.span("executor.CountBatch",
                                  index=index_name, calls=run_end - i,
                                  shards=len(ctx.shards)):
@@ -188,7 +204,8 @@ class Executor:
                     continue
             call = calls[i]
             ctx = _Ctx(index, self._shards_for(index, shards, call),
-                       translate_output)
+                       translate_output, deadline=deadline)
+            ctx.check_deadline()
             with tracer.span("executor." + call.name,
                              index=index_name,
                              shards=len(ctx.shards)):
@@ -1106,6 +1123,7 @@ class Executor:
             parts_rows, parts_totals, parts_row_totals = [], [], []
             for chunk_rows, chunk_plane in self.planes.iter_row_blocks(
                     field, VIEW_STANDARD, ctx.shards, block):
+                ctx.check_deadline()  # streaming can run for minutes
                 counts = kernels.row_counts(chunk_plane, filter_words)
                 parts_totals.append(
                     kernels.shard_totals(counts)[:len(chunk_rows)])
@@ -1528,6 +1546,7 @@ class Executor:
                 specs, filter_words, agg_plane,
                 self._GROUPBY_AGGS.get(agg_name),
                 limited=limit is not None):
+            ctx.check_deadline()  # large combination trees stream
             counts = np.asarray(out["counts"])  # (C, slots)
             slots = np.asarray(last_slots, np.int64)
             sub = counts[:, slots].astype(np.int64)  # (C, L)

@@ -56,7 +56,8 @@ class PilosaTPUServer:
             self.holder, placement=placement, stats=self.stats,
             plane_budget=self.cfg.plane_budget_bytes,
             count_batch_window=self.cfg.count_batch_window)
-        self.api = API(self.holder, self.executor)
+        self.api = API(self.holder, self.executor,
+                       query_timeout=self.cfg.query_timeout)
         from pilosa_tpu.api import tls as tlsmod
         from pilosa_tpu.cli.config import tls_of
         tls_cfg = tls_of(self.cfg)

@@ -3,6 +3,7 @@
 holder, end to end."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -188,6 +189,69 @@ class TestRawHttp:
             data=b"Count(Row(f=1))", method="POST")
         with urllib.request.urlopen(req) as resp:
             assert json.loads(resp.read()) == {"results": [1]}
+
+
+class TestQueryTimeout:
+    """Query deadlines (reference: upstream threads request-context
+    cancellation through the executor; here a monotonic deadline is
+    checked at call/block boundaries, HTTP 408 on expiry)."""
+
+    def test_expired_deadline_aborts(self, srv):
+        import time
+
+        from pilosa_tpu.exec.executor import QueryTimeoutError
+
+        _, api, _, c = srv
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(1, f=1)")
+        with pytest.raises(QueryTimeoutError):
+            api.executor.execute("i", "Count(Row(f=1))",
+                                 deadline=time.monotonic() - 1)
+        # no deadline / generous deadline: unaffected
+        assert api.query("i", "Count(Row(f=1))",
+                         timeout=60)["results"] == [1]
+
+    def test_rest_timeout_param_returns_408(self, srv):
+        # a 1 us budget expires during parse/dispatch setup, so the
+        # first boundary check fires deterministically
+        _, api, server, c = srv
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(1, f=1)")
+        port = server.address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/i/query?timeout=0.000001",
+            data=b"Count(Row(f=1))", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 408
+        assert "timeout" in json.loads(ei.value.read())["error"]
+
+    def test_bad_timeout_param(self, srv):
+        _, _, server, _ = srv
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.address[1]}"
+            "/index/i/query?timeout=nope",
+            data=b"Count(Row(f=1))", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+
+    def test_config_default_applies(self, tmp_path):
+        from pilosa_tpu.exec import Executor
+
+        holder = Holder(str(tmp_path / "d")).open()
+        api = API(holder, Executor(holder), query_timeout=1e-9)
+        api.create_index("i")
+        api.create_field("i", "f")
+        with pytest.raises(ApiError) as ei:
+            api.query("i", "Count(Row(f=1))")
+        assert ei.value.status == 408
+        # explicit per-request timeout overrides the tiny default
+        assert api.query("i", "Count(Row(f=1))",
+                         timeout=60)["results"] == [0]
+        holder.close()
 
 
 class TestInfoEndpoints:

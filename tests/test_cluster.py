@@ -351,6 +351,24 @@ class TestReplicationAndFailover:
             assert after == 6
 
 
+class TestClusterQueryTimeout:
+    def test_timeout_enforced_through_fanout(self, tmp_path):
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).query("i", "Set(1, f=1)")
+            with pytest.raises(ClientError) as ei:
+                c.client(0)._do(
+                    "POST", "/index/i/query?timeout=0.000001",
+                    b"Count(Row(f=1))")
+            assert ei.value.status == 408
+            assert c.client(0)._do(
+                "POST", "/index/i/query?timeout=30",
+                b"Count(Row(f=1))")["results"] == [1]
+
+
 class TestWriteSemanticsUnderNodeLoss:
     """Set is best-effort over reachable owners (AAE repairs a dead
     replica on rejoin); Clear-family ops are strict — a clear missed by
