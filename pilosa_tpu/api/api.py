@@ -115,18 +115,23 @@ class API:
               timeout: float | None = None) -> dict:
         """``profile=True`` attaches the per-call span tree to the
         response (reference: query ``profile`` option, SURVEY.md §6).
-        ``timeout`` (seconds; falls back to the server's
-        ``query_timeout`` config, 0 = unlimited) bounds execution —
-        the deadline analogue of upstream's request-context
-        cancellation; expiry answers HTTP 408."""
+        ``timeout`` (seconds) bounds execution — the deadline analogue
+        of upstream's request-context cancellation; expiry answers
+        HTTP 408.  The server's ``query_timeout`` config is a CAP, not
+        just a default: per-request values clamp to it (otherwise any
+        caller could disable the operator's protection with
+        ?timeout=0)."""
         import time as _time
 
         from pilosa_tpu.exec.executor import (ExecutionError,
                                               QueryTimeoutError)
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
-        if timeout is None:
-            timeout = self.query_timeout
+        cap = self.query_timeout
+        if timeout is None or timeout == 0:
+            timeout = cap
+        elif cap:
+            timeout = min(timeout, cap)
         deadline = (_time.monotonic() + timeout) if timeout else None
         tracer = None
         if profile:
@@ -303,6 +308,13 @@ class API:
         f = idx.field(field)
         if f is None:
             raise ApiError(f"field {field!r} not found", 404)
+        if f.options.type not in ("set", "time"):
+            # raw fragment unions skip field-type semantics (mutex
+            # last-write-wins, bool row validation, BSI encoding) —
+            # same restriction as upstream API.ImportRoaring
+            raise ApiError(
+                "import-roaring supports set/time fields, not "
+                f"{f.options.type!r}; use the pair import", 400)
         if self.cluster is not None and not direct:
             qs = f"?view={view}" + ("&clear=1" if clear else "")
             return self._route_to_owners(

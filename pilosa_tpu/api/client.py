@@ -94,18 +94,40 @@ class Client:
 
     def create_field(self, index: str, name: str,
                      options: dict | None = None):
+        getattr(self, "_field_type_cache", {}).pop((index, name), None)
         return self._json("POST", f"/index/{index}/field/{name}",
                           {"options": options or {}})
 
     def delete_field(self, index: str, name: str):
+        getattr(self, "_field_type_cache", {}).pop((index, name), None)
         return self._json("DELETE", f"/index/{index}/field/{name}")
 
+    # auto-roaring import: ID-form batches whose pairs concentrate per
+    # shard serialize client-side and ride the ImportRoaring fast path
+    # (~120× the per-pair path per bit — BASELINE.md r4); scattered
+    # batches keep the pair wire, where per-shard HTTP round trips
+    # would dominate
+    ROARING_MIN_PER_SHARD = 4096
+
     def import_bits(self, index: str, field: str, **body):
-        """Bulk bit import; batches ride the protobuf wire when the
-        codec accepts them (2.5× smaller, less CPU than JSON at 100k
-        pairs — BASELINE.md r3), falling back to JSON otherwise
-        (heterogeneous timestamp lists, out-of-range ints)."""
+        """Bulk bit import; dense ID-form batches ride the roaring
+        bulk path (see ROARING_MIN_PER_SHARD), other batches the
+        protobuf wire when the codec accepts them (2.5× smaller, less
+        CPU than JSON at 100k pairs — BASELINE.md r3), falling back to
+        JSON otherwise (heterogeneous timestamp lists, out-of-range
+        ints)."""
         from pilosa_tpu.api import proto
+
+        if (body.get("rowIDs") is not None
+                and body.get("columnIDs") is not None
+                and not body.get("rowKeys")
+                and not body.get("columnKeys")
+                and body.get("timestamps") is None
+                and not body.get("clear", False)):
+            out = self._try_import_roaring(index, field, body["rowIDs"],
+                                           body["columnIDs"])
+            if out is not None:
+                return out
         try:
             raw = proto.encode_import_request(
                 row_ids=body.get("rowIDs"), col_ids=body.get("columnIDs"),
@@ -134,6 +156,75 @@ class Client:
         return self._do("POST",
                         f"/index/{index}/field/{field}/importValue",
                         raw, content_type=proto.CONTENT_TYPE)["changed"]
+
+    def _try_import_roaring(self, index: str, field: str, row_ids,
+                            col_ids) -> int | None:
+        """Serialize an ID-form batch into per-shard roaring blobs and
+        import each — or return None to fall through to the pair wire:
+        when the batch is too scattered (per-shard HTTP round trips
+        would cost more than the wire saves), when ids don't fit
+        uint64, or when the target is not a set/time field (raw
+        fragment unions skip mutex/bool/BSI semantics — the server
+        rejects those too)."""
+        import numpy as np
+
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        from pilosa_tpu.store import roaring
+
+        if self._field_type(index, field) not in ("set", "time"):
+            return None
+        try:
+            rows = np.asarray(row_ids, dtype=np.uint64)
+            cols = np.asarray(col_ids, dtype=np.uint64)
+        except (OverflowError, ValueError, TypeError):
+            return None  # out-of-range ids: the JSON fallback's case
+        if len(rows) != len(cols) or len(rows) == 0:
+            return None
+        shard_of = cols // np.uint64(SHARD_WIDTH)
+        shards = np.unique(shard_of)
+        if len(rows) < self.ROARING_MIN_PER_SHARD * len(shards):
+            return None
+        positions = rows * np.uint64(SHARD_WIDTH) \
+            + (cols % np.uint64(SHARD_WIDTH))
+        # one sort, then boundary slices — a per-shard boolean mask
+        # would rescan the whole batch n_shards times
+        order = np.argsort(shard_of, kind="stable")
+        positions = positions[order]
+        bounds = np.searchsorted(shard_of[order], shards)
+        bounds = np.append(bounds, len(positions))
+        changed = 0
+        for i, s in enumerate(shards):
+            blob = roaring.serialize(positions[bounds[i]:bounds[i + 1]])
+            try:
+                changed += self.import_roaring(index, field, int(s), blob)
+            except ClientError as e:
+                if e.status == 400 and i == 0:
+                    # stale cached field type (field recreated with a
+                    # different type): the server's type check fires
+                    # before anything imports — refresh and fall back
+                    self._field_type_cache.pop((index, field), None)
+                    return None
+                raise
+        return changed
+
+    def _field_type(self, index: str, field: str) -> str | None:
+        """Field type from the server schema, cached per (index,
+        field).  Transient transport failures are NOT cached (a single
+        connection blip must not pin this client to the slow pair wire
+        for its lifetime); create/delete_field invalidate."""
+        cache = getattr(self, "_field_type_cache", None)
+        if cache is None:
+            cache = self._field_type_cache = {}
+        key = (index, field)
+        if key not in cache:
+            try:
+                info = self._json("GET", f"/index/{index}/field/{field}")
+                cache[key] = info.get("options", {}).get("type")
+            except ClientError as e:
+                if not 400 <= e.status < 500:
+                    return None  # transport/5xx: don't cache
+                cache[key] = None
+        return cache[key]
 
     def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
                        view: str = "standard"):

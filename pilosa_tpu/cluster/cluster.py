@@ -380,16 +380,28 @@ class Cluster:
         return out
 
     def internal_query(self, node_id: str, index: str, pql: str,
-                       shards) -> list:
+                       shards, deadline: float | None = None) -> list:
         from pilosa_tpu.api.client import ClientError
-        from pilosa_tpu.exec.executor import ExecutionError
+        from pilosa_tpu.exec.executor import (ExecutionError,
+                                              QueryTimeoutError)
         path = f"/internal/query?index={index}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
+        if deadline is not None:
+            # ship the REMAINING budget: the peer re-anchors it on its
+            # own monotonic clock (wall clocks may disagree; budgets
+            # don't).  An already-expired budget fails here.
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise QueryTimeoutError("query timeout exceeded")
+            path += f"&timeout={remaining:.6f}"
         try:
             return self._client(node_id)._do(
                 "POST", path, pql.encode())["results"]
         except ClientError as e:
+            if e.status == 408:
+                # peer's share of the budget expired
+                raise QueryTimeoutError(str(e)) from e
             if e.status == 400:
                 # peer rejected the query itself: surface as a query
                 # error (HTTP 400 at the public edge), not a node fault

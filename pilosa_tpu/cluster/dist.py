@@ -97,9 +97,11 @@ class DistributedExecutor:
     def execute_json(self, index: str, pql: str,
                      shards: list[int] | None = None, tracer=None,
                      deadline: float | None = None) -> list:
-        """``deadline`` is checked between top-level calls; the local
-        partial execution inside each fan-out also honors it (remote
-        nodes are bounded by the internode client timeout)."""
+        """``deadline`` is checked between top-level calls, honored by
+        the local partial execution inside each fan-out, and shipped to
+        remote nodes as their remaining budget (re-anchored on the
+        peer's monotonic clock; a peer's expiry comes back as 408 and
+        re-raises as QueryTimeoutError here)."""
         import time as _time
 
         from contextlib import nullcontext
@@ -214,7 +216,8 @@ class DistributedExecutor:
 
         def remote(node_id, node_shards):
             return self.cluster.internal_query(node_id, index, pql,
-                                               node_shards)
+                                               node_shards,
+                                               deadline=deadline)
 
         from concurrent.futures import ThreadPoolExecutor
         remote_items = [(n, s) for n, s in groups.items()
@@ -303,7 +306,8 @@ class DistributedExecutor:
         # executes on this thread while peers work
         def remote(node_id, node_shards):
             return self.cluster.internal_query(node_id, index, pql,
-                                               node_shards)[0]
+                                               node_shards,
+                                               deadline=deadline)[0]
 
         from concurrent.futures import ThreadPoolExecutor
         remote_items = [(n, s) for n, s in groups.items()

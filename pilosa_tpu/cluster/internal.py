@@ -66,17 +66,28 @@ def h_internal_query(self: Handler) -> None:
     """Execute locally only (no re-fan-out) with raw-ID results —
     reference: ``/internal/query`` remote execution."""
     from pilosa_tpu.exec import result_to_json
-    from pilosa_tpu.exec.executor import ExecutionError
+    from pilosa_tpu.exec.executor import (ExecutionError,
+                                          QueryTimeoutError)
     from pilosa_tpu.pql.parser import ParseError
+    import time
+
     api = self.server.api
     index = _qs(self, "index")
     shards = None
     if "shards" in self.query:
         shards = [int(s) for s in self.query["shards"][0].split(",") if s]
+    deadline = None
+    if "timeout" in self.query:
+        # remaining budget shipped by the coordinator, re-anchored on
+        # THIS node's monotonic clock
+        deadline = time.monotonic() + float(self.query["timeout"][0])
     pql = self._body().decode()
     try:
         results = api.executor.execute(index, pql, shards=shards,
-                                       translate_output=False)
+                                       translate_output=False,
+                                       deadline=deadline)
+    except QueryTimeoutError as e:
+        raise ApiError(str(e), 408)
     except (ParseError, ExecutionError) as e:
         raise ApiError(str(e), 400)
     self._reply({"results": [result_to_json(r) for r in results]})

@@ -106,6 +106,62 @@ class TestImports:
         (r,) = c.query("i", "Row(f=0)")
         assert r == {"columns": [7, 9]}
 
+    def test_auto_roaring_import_equivalence(self, srv):
+        """Dense ID-form batches ride the roaring bulk path; results,
+        changed counts, and existence tracking must match the pair
+        wire exactly."""
+        _, api, _, c = srv
+        c.create_index("i")  # track_existence on by default
+        c.create_field("i", "f")
+        c.create_field("i", "g")
+        rows = [r % 7 for r in range(9000)]
+        cols = [(r * 13) % 20000 for r in range(9000)]
+        n_unique = len({(a, b) for a, b in zip(rows, cols)})
+        c.ROARING_MIN_PER_SHARD = 100  # force the fast path
+        assert c.import_bits("i", "f", rowIDs=rows,
+                             columnIDs=cols) == n_unique
+        # same data through the pair wire into a second field
+        c.ROARING_MIN_PER_SHARD = 10 ** 9  # force the pair wire
+        assert c.import_bits("i", "g", rowIDs=rows,
+                             columnIDs=cols) == n_unique
+        for r in range(7):
+            assert c.query("i", f"Count(Row(f={r}))") == \
+                c.query("i", f"Count(Row(g={r}))")
+        # existence tracked on the roaring path too
+        (a,) = c.query("i", "Count(All())")
+        assert a == len(set(cols))
+        # re-import is idempotent
+        c.ROARING_MIN_PER_SHARD = 100
+        assert c.import_bits("i", "f", rowIDs=rows, columnIDs=cols) == 0
+
+    def test_auto_roaring_respects_field_semantics(self, srv):
+        """mutex/bool/BSI fields must NOT take the raw roaring path
+        (it unions fragment bits with no field-type semantics): the
+        client detects the type, and the server rejects import-roaring
+        on such fields outright (upstream restricts ImportRoaring to
+        set/time the same way)."""
+        _, api, _, c = srv
+        c.create_index("i")
+        c.create_field("i", "m", {"type": "mutex"})
+        c.ROARING_MIN_PER_SHARD = 1  # roaring path would trigger if
+        #                              the type gate were missing
+        rows = [1] * 5000 + [2] * 5000
+        cols = list(range(5000)) * 2
+        c.import_bits("i", "m", rowIDs=rows, columnIDs=cols)
+        # mutex last-write-wins: row 2 displaced row 1 everywhere
+        assert c.query("i", "Count(Row(m=1))") == [0]
+        assert c.query("i", "Count(Row(m=2))") == [5000]
+        # server-side rejection, independent of the client gate
+        from pilosa_tpu.store import roaring
+        blob = roaring.serialize(np.arange(10, dtype=np.uint64))
+        with pytest.raises(ClientError) as ei:
+            c.import_roaring("i", "m", 0, blob)
+        assert ei.value.status == 400
+        # out-of-range ids fall through without OverflowError
+        c.create_field("i", "f")
+        with pytest.raises(ClientError):
+            c.import_bits("i", "f", rowIDs=[1], columnIDs=[-5])
+
     def test_export_csv(self, srv):
         _, _, _, c = srv
         c.create_index("i")
@@ -238,7 +294,7 @@ class TestQueryTimeout:
             urllib.request.urlopen(req)
         assert ei.value.code == 400
 
-    def test_config_default_applies(self, tmp_path):
+    def test_config_timeout_is_a_cap(self, tmp_path):
         from pilosa_tpu.exec import Executor
 
         holder = Holder(str(tmp_path / "d")).open()
@@ -248,10 +304,22 @@ class TestQueryTimeout:
         with pytest.raises(ApiError) as ei:
             api.query("i", "Count(Row(f=1))")
         assert ei.value.status == 408
-        # explicit per-request timeout overrides the tiny default
-        assert api.query("i", "Count(Row(f=1))",
-                         timeout=60)["results"] == [0]
+        # per-request values CLAMP to the server cap (otherwise any
+        # caller could disable the operator's protection): a generous
+        # timeout and an explicit 0 both stay bounded by the config
+        for t in (60, 0):
+            with pytest.raises(ApiError) as ei:
+                api.query("i", "Count(Row(f=1))", timeout=t)
+            assert ei.value.status == 408
         holder.close()
+        # with no cap configured, per-request values apply as-is
+        holder2 = Holder(str(tmp_path / "e")).open()
+        api2 = API(holder2, Executor(holder2))
+        api2.create_index("i")
+        api2.create_field("i", "f")
+        assert api2.query("i", "Count(Row(f=1))",
+                          timeout=60)["results"] == [0]
+        holder2.close()
 
 
 class TestInfoEndpoints:

@@ -368,6 +368,50 @@ class TestClusterQueryTimeout:
                 "POST", "/index/i/query?timeout=30",
                 b"Count(Row(f=1))")["results"] == [1]
 
+    def test_deadline_ships_to_remote_nodes(self, tmp_path):
+        """The remaining budget rides /internal/query and is enforced
+        by the PEER's executor — not just by the coordinator's
+        between-call checks (r4 review: the 1us test above expires
+        before the first fan-out and proved nothing about peers)."""
+        import time
+
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path)) as c:
+            coord = c.servers[0]
+            peer = c.servers[1]
+            cl = c.clients[0]
+            cl.create_index("i")
+            cl.create_field("i", "f")
+            # a bit on a shard the PEER owns, so the read fans out
+            shard = next(
+                s for s in range(32)
+                if coord.cluster.shard_owners("i", s)[0]
+                == peer.cluster.node_id)
+            from pilosa_tpu.engine.words import SHARD_WIDTH
+            cl.query("i", f"Set({shard * SHARD_WIDTH + 1}, f=1)")
+
+            slept = []
+            real = peer.executor.execute
+
+            def slow(*a, **kw):
+                slept.append(1)
+                time.sleep(0.4)
+                return real(*a, **kw)
+
+            peer.executor.execute = slow
+            try:
+                with pytest.raises(ClientError) as ei:
+                    cl._do("POST",
+                           "/index/i/query?timeout=0.2",
+                           f"Count(Row(f=1))".encode())
+                assert ei.value.status == 408
+                assert slept, "query never reached the peer"
+            finally:
+                peer.executor.execute = real
+            assert cl._do("POST", "/index/i/query?timeout=30",
+                          b"Count(Row(f=1))")["results"] == [1]
+
 
 class TestWriteSemanticsUnderNodeLoss:
     """Set is best-effort over reachable owners (AAE repairs a dead

@@ -94,10 +94,10 @@ def _free_port() -> int:
 # writes, distributed query fan-out) while sharing one jax.distributed
 # runtime whose mesh spans both processes' devices.
 CHILD_CLUSTER = PSUM_SNIPPET + r"""
-import sys, time
-pid, coord, data_dir, p0, p1 = (int(sys.argv[1]), sys.argv[2],
-                                sys.argv[3], int(sys.argv[4]),
-                                int(sys.argv[5]))
+import os, sys, time
+pid, coord, data_dir, p0, p1, barrier_dir = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], int(sys.argv[4]),
+    int(sys.argv[5]), sys.argv[6])
 
 from pilosa_tpu.cli.config import Config
 from pilosa_tpu.server import PilosaTPUServer
@@ -108,13 +108,23 @@ cfg = Config(bind=f"127.0.0.1:{p0 if pid == 0 else p1}",
              jax_process_id=pid, mesh=False,
              cluster_enabled=True,
              seeds=[] if pid == 0 else [f"127.0.0.1:{p0}"],
-             heartbeat_interval=0.2, anti_entropy_interval=0.0)
+             # generous beats: two jax processes share ONE core here,
+             # and a several-second XLA compile on a peer's main thread
+             # starves its heartbeat loop past a tight suspect horizon
+             heartbeat_interval=2.0, anti_entropy_interval=0.0)
 srv = PilosaTPUServer(cfg).open()
 try:
     import jax
     import numpy as np
 
     assert jax.process_count() == 2
+    # psum FIRST, straight after jax.distributed init while both
+    # processes are at the same point: the first collective builds the
+    # Gloo context with a 30s rendezvous window, and running it after
+    # the (single-core, wall-clock-heavy) cluster phase made the two
+    # processes arrive far enough apart to flake the timeout
+    got_c = psum_check(pid, seed=1, width=128)
+
     from pilosa_tpu.api.client import Client
     from pilosa_tpu.engine.words import SHARD_WIDTH
 
@@ -147,8 +157,16 @@ try:
             last_err = e
         time.sleep(0.2)
     assert got == want, (got, want, repr(last_err))
-    # and the pod-slice axis still works under the cluster
-    got_c = psum_check(pid, seed=1, width=128)
+    # exit barrier: this node's server must stay up until the PEER'S
+    # checks pass too (the fast child exiting first tears down half
+    # the cluster under the slow child's queries)
+    open(os.path.join(barrier_dir, f"done-{pid}"), "w").close()
+    other = os.path.join(barrier_dir, f"done-{1 - pid}")
+    deadline = time.monotonic() + 120
+    while not os.path.exists(other):
+        if time.monotonic() > deadline:
+            raise TimeoutError("peer never finished")
+        time.sleep(0.1)
     print(f"MULTIHOST_CLUSTER_OK {pid} {got[0]} {got_c}", flush=True)
 finally:
     srv.close()
@@ -169,7 +187,7 @@ def test_cluster_layer_over_multiprocess_jax(tmp_path):
         data.mkdir()
         procs.append(subprocess.Popen(
             [sys.executable, "-c", CHILD_CLUSTER, str(pid), coord,
-             str(data), str(p0), str(p1)],
+             str(data), str(p0), str(p1), str(tmp_path)],
             env=env, cwd=ROOT, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
     outs = []
