@@ -348,6 +348,15 @@ def main():
     raw_gb()
     family("groupby_4x4", prod_gb, median_lat(raw_gb, n=5))
 
+    # raw tiers are done: DROP this process's plane references.  The
+    # bench is an unusual client — holding ps/vps/specs pins ~6.5 GB
+    # that the executor's OOM evict-and-retry cannot free, which is the
+    # bench's leak, not the server's (a real server's in-flight queries
+    # release their planes when they return).
+    import gc
+    del ps, vps, specs, rp, rows  # rp still pins the last rows_plane
+    gc.collect()
+
     # ---- sparse filtered TopN ------------------------------------------
     want_stop = oracle_sparse_topn(plane, sparse, 0, 5)
     t0 = time.perf_counter()

@@ -184,6 +184,19 @@ class Fragment:
             live = {r for r, b in self.rows.items() if b.any()}
             return sorted(live | self._snap_pending)
 
+    def row_ids_array(self) -> np.ndarray:
+        """Live row ids as an UNSORTED uint64 array — the vectorized
+        form for cross-shard unions (a 5M-row field's per-query
+        set-union/sort through ``row_ids`` measured ~7 s across 954
+        shards; callers np.unique the concatenation instead)."""
+        with self.lock:
+            live = [r for r, b in self.rows.items() if b.any()]
+            n = len(live) + len(self._snap_pending)
+            out = np.empty(n, np.uint64)
+            out[:len(live)] = live
+            out[len(live):] = list(self._snap_pending)
+            return out
+
     @property
     def present(self) -> bool:
         """Cheap row-presence check WITHOUT expanding snapshot bits:
