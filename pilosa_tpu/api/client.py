@@ -15,9 +15,24 @@ import urllib.request
 
 
 class ClientError(Exception):
-    def __init__(self, msg: str, status: int = 0):
+    """Transport or HTTP failure.
+
+    ``kind`` distinguishes failure classes that demand different
+    handling at write-replication time (ADVICE r4):
+
+    - ``"http"``       — the peer answered with an error status
+    - ``"unreachable"`` — connection refused/reset/DNS: the peer never
+      saw the request, so a write definitely did NOT apply
+    - ``"timeout"``    — the socket timed out AFTER the request was
+      sent: the peer may still apply it → replica state is UNKNOWN,
+      which is NOT the same as "down"
+    - ``"transport"``  — other transport faults (TLS alerts, …)
+    """
+
+    def __init__(self, msg: str, status: int = 0, kind: str = "transport"):
         super().__init__(msg)
         self.status = status
+        self.kind = kind if status == 0 else "http"
 
 
 class Client:
@@ -32,23 +47,26 @@ class Client:
 
     def _do(self, method: str, path: str, body: bytes | None = None,
             content_type: str = "application/json",
-            headers: dict | None = None, _retried: bool = False):
+            headers: dict | None = None, _retried: bool = False,
+            timeout: float | None = None):
         hdrs = dict(headers or {})
         if body:
             hdrs["Content-Type"] = content_type
         req = urllib.request.Request(
             self.base + path, data=body, method=method, headers=hdrs)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ssl) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout if timeout is None else timeout,
+                    context=self._ssl) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
         except ConnectionResetError:
             # transient under connection churn; one retry
             if _retried:
-                raise ClientError(f"connection reset by {self.base}")
+                raise ClientError(f"connection reset by {self.base}",
+                                  kind="unreachable")
             return self._do(method, path, body, content_type, headers,
-                            _retried=True)
+                            _retried=True, timeout=timeout)
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             try:
@@ -57,11 +75,19 @@ class Client:
                 pass
             raise ClientError(detail, e.code) from e
         except urllib.error.URLError as e:
-            if isinstance(getattr(e, "reason", None), ConnectionResetError) \
-                    and not _retried:
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, ConnectionResetError) and not _retried:
                 return self._do(method, path, body, content_type, headers,
-                                _retried=True)
-            raise ClientError(f"cannot reach {self.base}: {e.reason}") from e
+                                _retried=True, timeout=timeout)
+            kind = ("timeout" if isinstance(reason, TimeoutError)
+                    else "unreachable")
+            raise ClientError(f"cannot reach {self.base}: {reason}",
+                              kind=kind) from e
+        except TimeoutError as e:
+            # read timeout after the request was sent (socket.timeout is
+            # TimeoutError since 3.10): the peer may still apply a write
+            raise ClientError(
+                f"request to {self.base} timed out", kind="timeout") from e
         except OSError as e:
             # TLS alerts (e.g. mTLS 'certificate required') can surface
             # as raw ssl.SSLError during getresponse(), outside
@@ -165,7 +191,13 @@ class Client:
         would cost more than the wire saves), when ids don't fit
         uint64, or when the target is not a set/time field (raw
         fragment unions skip mutex/bool/BSI semantics — the server
-        rejects those too)."""
+        rejects those too).
+
+        Unlike the single-request pair/proto wire, this path commits
+        one request PER SHARD: a failure partway leaves earlier shards
+        applied.  The raised ClientError carries the bits already
+        committed as ``partial_changed`` — set-bit imports are
+        idempotent, so retrying the whole batch is always safe."""
         import numpy as np
 
         from pilosa_tpu.engine.words import SHARD_WIDTH
@@ -204,6 +236,7 @@ class Client:
                     # before anything imports — refresh and fall back
                     self._field_type_cache.pop((index, field), None)
                     return None
+                e.partial_changed = changed  # earlier shards committed
                 raise
         return changed
 

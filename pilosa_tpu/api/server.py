@@ -33,6 +33,23 @@ from pilosa_tpu import __version__
 from pilosa_tpu.api.api import API, ApiError
 
 
+def parse_timeout_param(raw: str) -> float:
+    """Validate a ``?timeout=`` value (public and internal handlers
+    share one rule set): NaN would poison every deadline comparison
+    into False (silently unlimited); negatives are nonsense — 400 on
+    both.  0 falls back to the server's query-timeout cap (unlimited
+    only when no cap is configured) — API.query clamps every request
+    to the cap by design."""
+    import math
+    try:
+        timeout = float(raw)
+    except ValueError:
+        timeout = None
+    if timeout is None or not math.isfinite(timeout) or timeout < 0:
+        raise ApiError(f"bad timeout param {raw!r}")
+    return timeout
+
+
 class Router:
     def __init__(self):
         self.routes: list[tuple[str, re.Pattern, object]] = []
@@ -161,17 +178,7 @@ class Handler(BaseHTTPRequestHandler):
         profile = "profile" in self.query
         timeout = None
         if "timeout" in self.query:
-            import math
-            try:
-                timeout = float(self.query["timeout"][0])
-            except ValueError:
-                timeout = None
-            # NaN would poison every deadline comparison into False
-            # (silently unlimited); negatives are nonsense — reject
-            # both.  0 means explicitly unlimited, like the config.
-            if timeout is None or not math.isfinite(timeout) or timeout < 0:
-                raise ApiError(
-                    f"bad timeout param {self.query['timeout'][0]!r}")
+            timeout = parse_timeout_param(self.query["timeout"][0])
         if not want_proto:
             self._reply(self.server.api.query(index, pql, shards=shards,
                                               profile=profile,

@@ -380,24 +380,41 @@ class Cluster:
         return out
 
     def internal_query(self, node_id: str, index: str, pql: str,
-                       shards, deadline: float | None = None) -> list:
+                       shards, deadline: float | None = None,
+                       map_unreachable: bool = True) -> list:
+        """Run ``pql`` on ``node_id`` via ``/internal/query``.
+
+        Error mapping (ADVICE r4): every failure leaves here as an
+        executor exception the API layer answers with 4xx/408 — except
+        kind=="unreachable" when ``map_unreachable=False``, which write
+        replication (`dist._run_on`) needs verbatim to distinguish
+        "peer never saw the write" (safe to skip best-effort) from
+        "peer may have applied it" (state unknown — never skippable).
+        """
         from pilosa_tpu.api.client import ClientError
         from pilosa_tpu.exec.executor import (ExecutionError,
                                               QueryTimeoutError)
         path = f"/internal/query?index={index}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
+        socket_timeout = None
         if deadline is not None:
             # ship the REMAINING budget: the peer re-anchors it on its
             # own monotonic clock (wall clocks may disagree; budgets
-            # don't).  An already-expired budget fails here.
+            # don't).  An already-expired budget fails here.  The
+            # socket timeout follows the budget (+slack for transfer
+            # and the peer's own 408 answer) — the Client default would
+            # otherwise cap every remote leg at 60 s regardless of the
+            # query's deadline.
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise QueryTimeoutError("query timeout exceeded")
             path += f"&timeout={remaining:.6f}"
+            socket_timeout = remaining + 10.0
         try:
             return self._client(node_id)._do(
-                "POST", path, pql.encode())["results"]
+                "POST", path, pql.encode(),
+                timeout=socket_timeout)["results"]
         except ClientError as e:
             if e.status == 408:
                 # peer's share of the budget expired
@@ -406,6 +423,20 @@ class Cluster:
                 # peer rejected the query itself: surface as a query
                 # error (HTTP 400 at the public edge), not a node fault
                 raise ExecutionError(str(e)) from e
+            if e.kind == "timeout":
+                # the request was SENT; the peer may still be working
+                # (or may yet apply a write) — state unknown, never
+                # classed as "node down"
+                if deadline is not None:
+                    raise QueryTimeoutError(
+                        f"remote leg on {node_id} outran the query "
+                        f"deadline: {e}") from e
+                raise ExecutionError(
+                    f"request to {node_id} timed out; state unknown "
+                    f"on that node: {e}") from e
+            if map_unreachable and e.kind != "http":
+                raise ExecutionError(
+                    f"node {node_id} unreachable: {e}") from e
             raise
 
     # -- key translation (coordinator-assigned, replicated logs) ------------

@@ -396,7 +396,11 @@ class DistributedExecutor:
         ``best_effort``: an unreachable node (ClientError — dead or not
         yet past the suspect horizon) is skipped as long as at least
         one owner accepts; AAE repairs it on rejoin.  Execution errors
-        (validation etc.) always propagate."""
+        (validation etc.) always propagate.  A socket TIMEOUT is not
+        "unreachable": the peer saw the request and may still apply
+        the write after we give up, so it propagates as a hard
+        failure ("state unknown") on every path — skipping it would
+        undercount a write that likely applied (ADVICE r4)."""
         from pilosa_tpu.api.client import ClientError
 
         pql = str(call)
@@ -408,18 +412,25 @@ class DistributedExecutor:
                     shards=list(shards) if shards else None,
                     translate_output=False)
                 return result_to_json(rs[0])
+            # map_unreachable=False: "down" classification below needs
+            # the raw transport error; timeouts still arrive mapped as
+            # ExecutionError("state unknown…") and propagate hard
             return self.cluster.internal_query(node_id, index, pql,
-                                               shards)[0]
+                                               shards,
+                                               map_unreachable=False)[0]
 
         def guarded(node_id):
             try:
                 return ("ok", one(node_id))
             except ClientError as e:
-                # only transport-level failures (no HTTP status) or an
-                # explicit 503 mean "node down"; a 5xx from an alive
-                # peer is a real failed write and must propagate, not
-                # be waved off as AAE-repairable
-                if e.status in (0, 503):
+                # only never-delivered failures mean "node down":
+                # connection refused/reset, TLS handshake alerts
+                # ("transport" — the handshake precedes any request
+                # processing), or an explicit 503.  A 5xx from an
+                # alive peer is a real failed write and must
+                # propagate, not be waved off as AAE-repairable
+                if e.status == 503 or (e.status == 0
+                                       and e.kind != "timeout"):
                     return ("down", (node_id, e))
                 raise
 

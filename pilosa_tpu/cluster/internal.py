@@ -79,8 +79,12 @@ def h_internal_query(self: Handler) -> None:
     deadline = None
     if "timeout" in self.query:
         # remaining budget shipped by the coordinator, re-anchored on
-        # THIS node's monotonic clock
-        deadline = time.monotonic() + float(self.query["timeout"][0])
+        # THIS node's monotonic clock.  Validated exactly like the
+        # public ?timeout= (ADVICE r4) — this endpoint is reachable by
+        # any peer.
+        from pilosa_tpu.api.server import parse_timeout_param
+        deadline = time.monotonic() + parse_timeout_param(
+            self.query["timeout"][0])
     pql = self._body().decode()
     try:
         results = api.executor.execute(index, pql, shards=shards,

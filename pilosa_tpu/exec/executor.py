@@ -15,6 +15,7 @@ the reference (``executor.Execute`` translate steps).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 
@@ -147,6 +148,44 @@ class Executor:
             from pilosa_tpu.exec.batcher import CountBatcher
             self.batcher = CountBatcher(self.fused,
                                         window_s=count_batch_window)
+        # cross-query OOM recovery (r4 → r5): one recovery at a time
+        # through the gate; the in-flight count lets the exclusive
+        # stage drain concurrent queries instead of evicting the
+        # planes under them
+        self._oom_gate = threading.Lock()
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._tls = threading.local()
+        # closed (cleared) only while a stage-2 recovery drains to
+        # exclusivity: new arrivals park here instead of entering the
+        # in-flight count and starving the drain forever
+        self._recovery_open = threading.Event()
+        self._recovery_open.set()
+
+    # -- in-flight accounting (OOM recovery) --------------------------------
+
+    def _enter_inflight(self) -> None:
+        with self._inflight_cv:
+            self._inflight += 1
+
+    def _leave_inflight(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
+
+    def _drain_to_exclusive(self, timeout: float = 120.0) -> bool:
+        """Wait until this query is the only one in flight (other
+        queries finish or park at the OOM gate).  Bounded: a hung peer
+        must not pin recovery forever — on timeout the retry proceeds
+        anyway and may still fail, which is then an honest answer."""
+        with self._inflight_cv:
+            end = time.monotonic() + timeout
+            while self._inflight > 1:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cv.wait(remaining)
+        return True
 
     # ------------------------------------------------------------------ api
 
@@ -171,6 +210,31 @@ class Executor:
             # memoized: repeated serving shapes skip the parser (the AST
             # is never mutated in place — rewriters copy first)
             query = parse_cached(query)
+        # outermost call only (nested execute — e.g. resolved Limit
+        # subtrees — shares the outer query's lease set and in-flight
+        # slot): register for OOM-recovery coordination
+        depth = getattr(self._tls, "depth", 0)
+        self._tls.depth = depth + 1
+        if depth == 0:
+            # park while a stage-2 OOM recovery drains to exclusivity —
+            # without this, steady arrivals keep the in-flight count
+            # above 1 and the drain can never finish.  Bounded: a
+            # wedged recovery must not refuse service forever
+            self._recovery_open.wait(timeout=180.0)
+            self._enter_inflight()
+            self.planes.begin_query()
+        try:
+            return self._execute_calls(index, index_name, query, shards,
+                                       translate_output, tracer, deadline)
+        finally:
+            self._tls.depth = depth
+            if depth == 0:
+                self.planes.end_query()
+                self._leave_inflight()
+
+    def _execute_calls(self, index, index_name: str, query: Query,
+                       shards, translate_output: bool, tracer,
+                       deadline: float | None) -> list:
         tracer = tracer or self.tracer
         results = []
         # spans per call + per-call-type latency counters (reference:
@@ -374,27 +438,61 @@ class Executor:
         return handler(ctx, call)
 
     def _with_oom_retry(self, fn):
-        """Run ``fn``; on device RESOURCE_EXHAUSTED, drop every cached
-        plane and retry once.
+        """Run ``fn``; on device RESOURCE_EXHAUSTED, recover in stages
+        that coordinate across concurrent queries (r5 redesign of the
+        r4 evict-all-and-retry, which thrashed under concurrent
+        over-budget load: two queries needing disjoint residency would
+        ping-pong global eviction, and a second OOM propagated as 500).
 
-        HBM pressure: the plane cache budget bounds its own entries,
-        but in-flight queries hold plane references that eviction
-        cannot free, so a mixed workload (dense + BSI + sparse
-        residency) can exhaust device memory on a valid query.  Product
-        behavior: a slow rebuild beats a 500 (found via config10: REST
-        filtered TopN after the BSI+sparse phases at 1B cols).  Covers
-        EVERY execute path — fused count batches and bitmap fast paths
-        included, not just per-call handlers."""
+        Stage 1 (serialized by the gate): evict only UNPINNED planes —
+        entries no in-flight query leases — and retry.  Evicting leased
+        planes frees no HBM (the queries' frames hold live refs) and
+        forces mid-flight rebuilds, so they stay.
+
+        Stage 2 (still under the gate): drain to exclusivity — wait for
+        every other query to finish or park at the gate (parked queries
+        leave the in-flight count, so this cannot deadlock; their OOM
+        unwound their device refs already), then drop ALL residency and
+        run alone.  At most 3 attempts per query, one recovery at a
+        time: no retry storm.
+
+        Covers EVERY execute path — fused count batches and bitmap fast
+        paths included, not just per-call handlers."""
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — filtered below
             if not _is_device_oom(e):
                 raise
-            import gc
-            self.planes.invalidate()
-            gc.collect()
-            self.stats.count("device_oom_retries", 1)
-            return fn()
+        import gc
+        self.stats.count("device_oom_retries", 1)
+        # park OUTSIDE the in-flight count while waiting for the gate:
+        # the active recovery may need to drain to exclusivity, and a
+        # queue of OOM'd queries still counted in-flight would wedge it
+        self._leave_inflight()
+        try:
+            with self._oom_gate:
+                self._enter_inflight()
+                try:
+                    self.planes.evict_unpinned()
+                    gc.collect()
+                    try:
+                        return fn()
+                    except Exception as e:  # noqa: BLE001
+                        if not _is_device_oom(e):
+                            raise
+                    self.stats.count("device_oom_exclusive_retries", 1)
+                    self._recovery_open.clear()  # park new arrivals
+                    try:
+                        self._drain_to_exclusive()
+                        self.planes.invalidate()
+                        gc.collect()
+                        return fn()
+                    finally:
+                        self._recovery_open.set()
+                finally:
+                    self._leave_inflight()
+        finally:
+            self._enter_inflight()
 
     def _attach_row_attrs(self, ctx: _Ctx, call: Call,
                           result: "RowResult") -> None:

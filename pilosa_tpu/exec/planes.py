@@ -148,6 +148,42 @@ class PlaneCache:
         self._bytes = 0
         self._lock = threading.RLock()
         self.incremental_applied = 0  # delta-scatter refreshes (stats)
+        # keys leased to in-flight queries, per serving thread: eviction
+        # must skip these — the query's frames hold live device refs, so
+        # evicting frees no HBM and only forces a rebuild on next use
+        # (the r4 OOM-retry thrash class)
+        self._leases: dict[int, set] = {}
+
+    # -- in-flight leases ----------------------------------------------------
+
+    def begin_query(self) -> None:
+        """Open a lease set for this thread; every plane `_get` hands
+        out until `end_query` stays pinned against eviction."""
+        with self._lock:
+            self._leases[threading.get_ident()] = set()
+
+    def end_query(self) -> None:
+        with self._lock:
+            self._leases.pop(threading.get_ident(), None)
+
+    def _pinned(self) -> set:
+        # caller holds self._lock
+        if not self._leases:
+            return set()
+        return set().union(*self._leases.values())
+
+    def evict_unpinned(self) -> None:
+        """Free every entry NOT leased by an in-flight query — the
+        memory that eviction can actually reclaim.  OOM recovery uses
+        this instead of `invalidate`: dropping leased entries under
+        concurrent load evicts planes whose HBM cannot be freed and
+        makes every other in-flight query rebuild from scratch."""
+        with self._lock:
+            self._bytes_cache.clear()
+            pinned = self._pinned()
+            for key in [k for k in self._entries if k not in pinned]:
+                _, _, nbytes = self._entries.pop(key)
+                self._bytes -= nbytes
 
     # -- public -------------------------------------------------------------
 
@@ -433,6 +469,7 @@ class PlaneCache:
         with self._lock:
             return {"bytes": self._bytes, "budgetBytes": self.budget,
                     "entries": len(self._entries),
+                    "pinnedEntries": len(self._pinned()),
                     "incrementalRefreshes": self.incremental_applied}
 
     def invalidate(self, index: str | None = None) -> None:
@@ -461,6 +498,12 @@ class PlaneCache:
         # like any absent shard
         return view.generations(shards)
 
+    def _lease(self, key) -> None:
+        # caller holds self._lock
+        lease = self._leases.get(threading.get_ident())
+        if lease is not None:
+            lease.add(key)
+
     def _get(self, key, field: Field, view_name: str,
              shards: tuple[int, ...], build) -> PlaneSet:
         gens = self._gens(field, view_name, shards)
@@ -468,10 +511,13 @@ class PlaneCache:
             hit = self._entries.get(key)
             if hit is not None and hit[0] == gens:
                 self._entries.move_to_end(key)
+                self._lease(key)
                 return hit[1]
         if hit is not None and key[0] in ("plane", "bsi", "rows", "row"):
             ps = self._incremental(key, field, view_name, shards, hit)
             if ps is not None:
+                with self._lock:
+                    self._lease(key)
                 return ps
         ps = build(field, view_name, shards)
         nbytes = getattr(ps, "nbytes", None)
@@ -484,9 +530,22 @@ class PlaneCache:
                     self._bytes -= old[2]
                 self._entries[key] = (gens, ps, nbytes)
                 self._bytes += nbytes
-                while self._bytes > self.budget and len(self._entries) > 1:
-                    _, (_, _, old_bytes) = self._entries.popitem(last=False)
-                    self._bytes -= old_bytes
+                self._lease(key)
+                # LRU eviction skips leased entries: their device refs
+                # are alive in query frames, so popping them frees no
+                # HBM and forces the other query to rebuild mid-flight.
+                # (_pinned() unions every lease set — only pay for it
+                # when an eviction pass actually runs)
+                if self._bytes > self.budget and len(self._entries) > 1:
+                    pinned = self._pinned()
+                    for k in list(self._entries):
+                        if (self._bytes <= self.budget
+                                or len(self._entries) <= 1):
+                            break
+                        if k == key or k in pinned:
+                            continue
+                        _, _, old_bytes = self._entries.pop(k)
+                        self._bytes -= old_bytes
         return ps
 
     # Incremental cap: beyond this many changed (row, word) cells a

@@ -412,6 +412,117 @@ class TestClusterQueryTimeout:
             assert cl._do("POST", "/index/i/query?timeout=30",
                           b"Count(Row(f=1))")["results"] == [1]
 
+    def test_internal_timeout_param_validated(self, tmp_path):
+        """/internal/query validates ?timeout= like the public handler
+        (ADVICE r4): malformed values answer 400, and NaN — which would
+        silently disable the deadline — is rejected too."""
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            for bad in ("bogus", "nan", "-1", "inf"):
+                with pytest.raises(ClientError) as ei:
+                    c.client(0)._do(
+                        "POST", f"/internal/query?index=i&timeout={bad}",
+                        b"Count(Row(f=1))")
+                assert ei.value.status == 400, bad
+
+    def test_internal_socket_timeout_follows_budget(self, tmp_path):
+        """A shipped deadline also drives the per-call SOCKET timeout:
+        the Client's fixed 60s default must not cut off a remote leg
+        whose query budget is longer (ADVICE r4 medium)."""
+        import time
+
+        with run_cluster(2, str(tmp_path)) as c:
+            coord, peer = c.servers
+            cl = c.clients[0]
+            cl.create_index("i")
+            cl.create_field("i", "f")
+            cl.query("i", "Set(1, f=1)")
+            client = coord.cluster._client(peer.cluster.node_id)
+            seen = {}
+            real = client._do
+
+            def spy(method, path, body=None, **kw):
+                if path.startswith("/internal/query"):
+                    seen["timeout"] = kw.get("timeout")
+                return real(method, path, body, **kw)
+
+            client._do = spy
+            try:
+                coord.cluster.internal_query(
+                    peer.cluster.node_id, "i", "Count(Row(f=1))", None,
+                    deadline=time.monotonic() + 120)
+            finally:
+                client._do = real
+            assert seen["timeout"] is not None
+            assert 120 < seen["timeout"] < 140
+
+
+class TestTransportErrorClassification:
+    """ClientError.kind separates 'peer never saw it' from 'peer may
+    still apply it' — write replication must not count a timed-out
+    write as cleanly missed (ADVICE r4)."""
+
+    def test_kinds_from_real_sockets(self):
+        import socket
+        import threading
+
+        from pilosa_tpu.api.client import Client, ClientError
+
+        # a server that accepts and never answers -> read timeout
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        conns = []
+        t = threading.Thread(
+            target=lambda: conns.append(srv.accept()), daemon=True)
+        t.start()
+        try:
+            with pytest.raises(ClientError) as ei:
+                Client("127.0.0.1", port, timeout=0.3)._json("GET", "/status")
+            assert ei.value.kind == "timeout"
+        finally:
+            srv.close()
+        # a closed port -> connection refused -> unreachable
+        with pytest.raises(ClientError) as ei:
+            Client("127.0.0.1", port, timeout=0.3)._json("GET", "/status")
+        assert ei.value.kind == "unreachable"
+
+    def test_write_timeout_propagates_state_unknown(self, tmp_path):
+        """A best-effort Set that TIMES OUT on a replica must not be
+        waved off as 'node down, AAE repairs it' — the replica may
+        still apply the write; the op fails loudly with the replica
+        named (ADVICE r4)."""
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=2) as c:
+            coord, peer = c.servers
+            cl = c.clients[0]
+            cl.create_index("i")
+            cl.create_field("i", "f")
+            client = coord.cluster._client(peer.cluster.node_id)
+            real = client._do
+
+            def timeout_on_query(method, path, body=None, **kw):
+                if path.startswith("/internal/query"):
+                    raise ClientError("request timed out", kind="timeout")
+                return real(method, path, body, **kw)
+
+            client._do = timeout_on_query
+            try:
+                with pytest.raises(ClientError) as ei:
+                    # route through the coordinator so the peer leg is
+                    # the patched client
+                    c.client(0).query("i", "Set(1, f=1)")
+            finally:
+                client._do = real
+            assert ei.value.status == 400
+            assert "state unknown" in str(ei.value)
+            assert peer.cluster.node_id in str(ei.value)
+
 
 class TestWriteSemanticsUnderNodeLoss:
     """Set is best-effort over reachable owners (AAE repairs a dead

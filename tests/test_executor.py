@@ -27,10 +27,11 @@ def q(ex, pql, index="i", shards=None):
 
 class TestDeviceOomRetry:
     def test_oom_evicts_planes_and_retries(self, env, monkeypatch):
-        """Device RESOURCE_EXHAUSTED on a call must evict the plane
-        cache and retry once, not surface a 500 (regression: REST
-        filtered TopN OOM'd at 1B cols after BSI+sparse residency
-        filled HBM — bench/config10)."""
+        """Device RESOURCE_EXHAUSTED on a call must evict unpinned
+        planes and retry, not surface a 500 (regression: REST filtered
+        TopN OOM'd at 1B cols after BSI+sparse residency filled HBM —
+        bench/config10; r5 narrows the eviction to unpinned entries so
+        concurrent queries' planes stay resident)."""
         _, _, ex = env
         q(ex, "Set(1, f=1) Set(2, f=1)")
 
@@ -38,7 +39,7 @@ class TestDeviceOomRetry:
             pass
 
         calls = {"n": 0}
-        invalidated = {"n": 0}
+        evicted = {"n": 0}
         real = ex._execute_count
 
         def flaky(ctx, call):
@@ -48,16 +49,16 @@ class TestDeviceOomRetry:
                     "RESOURCE_EXHAUSTED: TPU backend error")
             return real(ctx, call)
 
-        real_inval = ex.planes.invalidate
+        real_evict = ex.planes.evict_unpinned
 
-        def spy_invalidate(index=None):
-            invalidated["n"] += 1
-            return real_inval(index)
+        def spy_evict():
+            evicted["n"] += 1
+            return real_evict()
 
         monkeypatch.setattr(ex, "_execute_count", flaky)
-        monkeypatch.setattr(ex.planes, "invalidate", spy_invalidate)
+        monkeypatch.setattr(ex.planes, "evict_unpinned", spy_evict)
         assert q(ex, "Count(Row(f=1))") == [2]
-        assert calls["n"] == 2 and invalidated["n"] == 1
+        assert calls["n"] == 2 and evicted["n"] == 1
 
     def test_non_oom_errors_propagate_without_retry(self, env,
                                                     monkeypatch):
