@@ -149,8 +149,17 @@ def main():
     # warm the f plane, then measure query latency right after a write
     # to f (journal-driven incremental refresh of the RESIDENT plane)
     pql32 = "".join(f"Count(Row(f={r_}))" for r_ in range(N_ROWS))
+    t0 = time.perf_counter()
     got = api.query(INDEX, pql32)["results"]
+    t_first = time.perf_counter() - t0
     assert got == [int(c) for c in counts_oracle], "oracle mismatch"
+    # r5 serve-while-build: the first query answers via the per-row /
+    # streaming path while the resident plane assembles in background —
+    # t_first is time-to-first-correct-answer; wait for the flip before
+    # measuring warm (resident-plane) latency
+    api.executor.planes.wait_builds()
+    results["first_query_after_open_ms"] = round(t_first * 1e3, 1)
+    log(f"first query after open (serve-while-build): {t_first * 1e3:.0f} ms")
     warm = []
     for _ in range(3):
         t0 = time.perf_counter()

@@ -220,17 +220,25 @@ class Field:
             raise ValueError("bool field rows must be 0 or 1")
         shards = cols // np.uint64(SHARD_WIDTH)
         offs = cols % np.uint64(SHARD_WIDTH)
+        # one sort + boundary slices, not a boolean mask per shard (an
+        # O(batch × n_shards) rescan that dominated the 954-shard
+        # spread — BASELINE.md r4 ingest profile)
+        order = np.argsort(shards, kind="stable")
+        shards_s, rows_s, offs_s = shards[order], row_ids[order], offs[order]
+        uniq = np.unique(shards_s)
+        bounds = np.searchsorted(shards_s, uniq)
+        bounds = np.append(bounds, len(shards_s))
         changed = 0
-        for shard in np.unique(shards):
-            m = shards == shard
-            r, c = row_ids[m], offs[m]
+        for i, shard in enumerate(uniq):
+            lo, hi = bounds[i], bounds[i + 1]
+            r, c = rows_s[lo:hi], offs_s[lo:hi]
             if opts.type in (TYPE_MUTEX, TYPE_BOOL):
                 changed += self._set_mutex(int(shard), r, c)
             else:
                 frag = self.standard_view(create=True).fragment(int(shard), create=True)
                 changed += frag.set_bits(r, c)
             if opts.type == TYPE_TIME and timestamps is not None and opts.time_quantum:
-                idx = np.nonzero(m)[0]
+                idx = order[lo:hi]
                 for j, (rr, cc) in enumerate(zip(r, c)):
                     ts = timestamps[idx[j]] if idx[j] < len(timestamps) else None
                     if ts is None:

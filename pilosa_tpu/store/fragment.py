@@ -71,12 +71,33 @@ class Fragment:
         # None = whole row changed.  Bounded; a gap means "rebuild".
         from collections import deque
         self._recent: deque = deque(maxlen=self.RECENT_MAX)
+        # LSM-style pending tier (r5; reference: the amortization
+        # ``fragment.bulkImport`` gets from one bulk union, SURVEY.md
+        # §4.5): OP_SET_BITS batches append their genuinely-new
+        # positions to one sorted array instead of paying a
+        # sorted-union per (row, fragment) micro-chunk — the cost that
+        # bounded spread ingest at ~0.17M bits/s (BASELINE.md r4).
+        # ``_probe_cache`` is the merged tier's sorted positions for
+        # O(log n) exact-changed probes; invariant: pending non-empty
+        # ⇒ probe cache valid.  The op-log write still precedes all of
+        # this, so crash replay re-derives pending — durability
+        # semantics unchanged.
+        self._pend_pos: np.ndarray = np.empty(0, np.uint64)
+        self._probe_cache: np.ndarray | None = None
 
     # journal bounds: entries beyond RECENT_MAX or ops touching more
     # cells than RECENT_CELL_CAP evict history (planes falls back to a
     # full rebuild — bulk imports SHOULD rebuild)
     RECENT_MAX = 128
     RECENT_CELL_CAP = 8192
+
+    # pending tier: flush to per-row RowBits at this many buffered bits
+    # (bounds pending memory at 8 B/bit and keeps the per-batch sorted
+    # insert cheap); probe caches beyond this bit count are not built
+    # (8 B/bit of extra host memory — huge fragments keep the classic
+    # per-row path)
+    PEND_FLUSH_N = 65536
+    PROBE_CACHE_MAX_BITS = 8 << 20
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -158,6 +179,55 @@ class Fragment:
         for r in sorted(self._snap_pending):
             self._ensure_row(r)
 
+    # -- pending tier -------------------------------------------------------
+
+    def _flush_pending(self) -> None:
+        """Merge the pending tier into per-row RowBits: ONE presorted
+        union per touched row per flush, however many batches
+        accumulated.  Callers hold the lock."""
+        if not len(self._pend_pos):
+            return
+        pend = self._pend_pos
+        self._pend_pos = np.empty(0, np.uint64)
+        self._probe_cache = None
+        for r, chunk in _split_by_row(pend, presorted=True):
+            self._ensure_row(r)
+            row = self.rows.get(r)
+            if row is None:
+                row = self.rows[r] = RowBits()
+            row.add(chunk, presorted=True)
+
+    def _pend_add(self, positions: np.ndarray) -> np.ndarray | None:
+        """Append the genuinely-new subset of sorted-unique
+        ``positions`` to the pending tier; returns that subset (exact
+        changed count = its length), or None when the tier can't serve
+        this fragment (probe cache would exceed its bit cap — caller
+        falls back to the classic per-row path)."""
+        if self._probe_cache is None:
+            # pending is empty whenever the cache is absent, so
+            # positions() here is merged-tier truth
+            if self.cardinality() > self.PROBE_CACHE_MAX_BITS:
+                return None
+            self._probe_cache = self.positions()
+        cache = self._probe_cache
+        if len(cache):
+            i = np.searchsorted(cache, positions)
+            ic = np.minimum(i, len(cache) - 1)
+            new = positions[~((i < len(cache)) & (cache[ic] == positions))]
+        else:
+            new = positions
+        pend = self._pend_pos
+        if len(pend) and len(new):
+            j = np.searchsorted(pend, new)
+            jc = np.minimum(j, len(pend) - 1)
+            new = new[~((j < len(pend)) & (pend[jc] == new))]
+        if len(new):
+            self._pend_pos = np.insert(pend, np.searchsorted(pend, new),
+                                       new)
+            if len(self._pend_pos) >= self.PEND_FLUSH_N:
+                self._flush_pending()
+        return new
+
     def close(self) -> None:
         with self.lock:
             if self.op_n > 0:
@@ -176,35 +246,45 @@ class Fragment:
     def row(self, row_id: int) -> RowBits:
         with self.lock:
             self._touch_map()
+            self._flush_pending()
             self._ensure_row(row_id)
             return self.rows.get(row_id) or RowBits()
 
     def row_ids(self) -> list[int]:
         with self.lock:
             live = {r for r, b in self.rows.items() if b.any()}
+            if len(self._pend_pos):
+                live |= set((self._pend_pos // _SW).tolist())
             return sorted(live | self._snap_pending)
 
     def row_ids_array(self) -> np.ndarray:
-        """Live row ids as an UNSORTED uint64 array — the vectorized
+        """Live row ids as an UNSORTED uint64 array, duplicates
+        possible across tiers (callers np.unique) — the vectorized
         form for cross-shard unions (a 5M-row field's per-query
         set-union/sort through ``row_ids`` measured ~7 s across 954
-        shards; callers np.unique the concatenation instead)."""
+        shards)."""
         with self.lock:
             live = [r for r, b in self.rows.items() if b.any()]
-            n = len(live) + len(self._snap_pending)
+            pend = (_dedup_sorted(self._pend_pos // _SW)
+                    if len(self._pend_pos) else ())
+            n = len(live) + len(self._snap_pending) + len(pend)
             out = np.empty(n, np.uint64)
             out[:len(live)] = live
-            out[len(live):] = list(self._snap_pending)
+            out[len(live):len(live) + len(self._snap_pending)] = \
+                list(self._snap_pending)
+            out[len(live) + len(self._snap_pending):] = pend
             return out
 
     @property
     def present(self) -> bool:
         """Cheap row-presence check WITHOUT expanding snapshot bits:
-        overlay rows or rows still resident in the mmap'd snapshot.
-        (``rows`` alone misses lazily-opened snapshot fragments — a
-        cold-reopened multi-shard index would report no shards and
-        queries would silently cover only shard 0.)"""
-        return bool(self.rows) or bool(self._snap_pending)
+        overlay rows, rows still resident in the mmap'd snapshot, or
+        pending-tier bits.  (``rows`` alone misses lazily-opened
+        snapshot fragments — a cold-reopened multi-shard index would
+        report no shards and queries would silently cover only
+        shard 0.)"""
+        return (bool(self.rows) or bool(self._snap_pending)
+                or len(self._pend_pos) > 0)
 
     def max_row_id(self) -> int:
         ids = self.row_ids()
@@ -246,6 +326,9 @@ class Fragment:
                 for r, b in sorted(self.rows.items())
                 if b.any()
             ]
+            if len(self._pend_pos):
+                # disjoint from both other tiers by construction
+                parts.append(self._pend_pos)
         if not parts:
             return np.empty(0, dtype=np.uint64)
         if len(parts) == 1:
@@ -273,14 +356,28 @@ class Fragment:
                 live.sort()
                 ids.append(np.array([r for r, _ in live], np.uint64))
                 cards.append(np.array([c for _, c in live], np.int64))
+            if len(self._pend_pos):
+                # pending rows may ALSO exist in the overlay/snapshot —
+                # sum-merge below folds the duplicates
+                pr = self._pend_pos // _SW
+                uniq = _dedup_sorted(pr)
+                bounds = np.searchsorted(pr, uniq)
+                ids.append(uniq)
+                cards.append(np.diff(np.append(bounds, len(pr)))
+                             .astype(np.int64))
         if not ids:
             return np.empty(0, np.uint64), np.empty(0, np.int64)
         if len(ids) == 1:
             return ids[0], cards[0]
         all_ids = np.concatenate(ids)
         all_cards = np.concatenate(cards)
-        order = np.argsort(all_ids, kind="stable")
-        return all_ids[order], all_cards[order]
+        uniq = np.unique(all_ids)
+        if len(uniq) == len(all_ids):
+            order = np.argsort(all_ids, kind="stable")
+            return all_ids[order], all_cards[order]
+        sums = np.zeros(len(uniq), np.int64)
+        np.add.at(sums, np.searchsorted(uniq, all_ids), all_cards)
+        return uniq, sums
 
     def plane_rows(self, row_ids, out: np.ndarray, slots=None) -> None:
         """Fill ``out[slots[i]] = words of row_ids[i]`` (uint32[.., W]).
@@ -297,6 +394,7 @@ class Fragment:
             slots = range(len(row_ids))
         with self.lock:
             self._touch_map()
+            self._flush_pending()
             pend, pend_slots = [], []
             for r, s in zip(row_ids, slots):
                 r = int(r)
@@ -387,6 +485,7 @@ class Fragment:
         cached = getattr(self, "_colindex_cache", None)
         if cached is not None and cached[0] == self.generation:
             return cached[1]
+        self._flush_pending()
         self._materialize_all()
         sp_parts, sp_ids, dense = [], [], []
         for r, b in self.rows.items():
@@ -451,6 +550,8 @@ class Fragment:
     def _apply_grouped(self, groups, clear: bool) -> int:
         op = OP_CLEAR_BITS if clear else OP_SET_BITS
         with self.lock:
+            self._probe_cache = None  # mutates merged truth directly
+            self._flush_pending()
             changed = 0
             parts = []
             delta: dict = {}
@@ -495,6 +596,7 @@ class Fragment:
         row's complete new contents, so a crash mid-call can never replay
         a cleared row without its replacement bits."""
         with self.lock:
+            self._flush_pending()     # equality check needs merged truth
             self._ensure_row(row_id)  # no-op check needs snapshot truth
             before = self.rows.get(row_id)
             new = RowBits.from_columns(cols)
@@ -527,7 +629,9 @@ class Fragment:
         (positions() composes from the old blob + overlay without
         materializing, so rows must not be left half-resident)."""
         with self.lock:
-            blob = roaring.serialize(self.positions())
+            blob = roaring.serialize(self.positions())  # includes pending
+            self._pend_pos = np.empty(0, np.uint64)
+            self._probe_cache = None
             tmp = self.path + ".tmp"
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             with open(tmp, "wb") as f:
@@ -601,6 +705,17 @@ class Fragment:
         else:
             self._recent.append((self.generation, rows_words))
 
+    def _note_delta_positions(self, positions: np.ndarray) -> None:
+        """Positions-form journal entry (pending-tier writes): the
+        {row: words} dict is derived lazily in changed_cells_since —
+        per-row dict assembly at write time cost more than the whole
+        pending append."""
+        if len(positions) > self.RECENT_CELL_CAP:
+            self._recent.clear()
+            self._recent.append((self.generation, None))
+        else:
+            self._recent.append((self.generation, ("pos", positions)))
+
     def changed_cells_since(self, gen: int):
         """Merged {row: word idx set | None} covering generations
         (gen, current], or None if the journal has gaps (caller must
@@ -620,6 +735,15 @@ class Fragment:
             for _, rw in entries:
                 if rw is None:
                     return None  # oversized op: rebuild
+                if isinstance(rw, tuple):  # ("pos", positions) form
+                    arr = rw[1]
+                    rws = (arr // _SW).tolist()
+                    wds = ((arr % _SW) >> np.uint64(5)).tolist()
+                    for r, w in zip(rws, wds):
+                        if merged.get(r, 0) is None:
+                            continue
+                        merged.setdefault(r, set()).add(int(w))
+                    continue
                 for r, words in rw.items():
                     if words is None or merged.get(r, 0) is None:
                         merged[r] = None
@@ -633,6 +757,33 @@ class Fragment:
         mutation API and op-log replay."""
         changed = 0
         delta: dict = {}
+        if op == OP_SET_BITS and positions is not None \
+                and len(positions) < self.PEND_FLUSH_N:
+            # pending-tier fast path: probe + append, no per-row
+            # unions.  Batches at/over the flush size skip it — they
+            # are already amortized, and staging them through the
+            # pending tier costs an extra probe+insert pass (measured
+            # 2× on ImportRoaring blobs)
+            if not len(positions):
+                return 0
+            self._check_rows(positions)
+            positions = np.unique(np.asarray(positions, np.uint64))
+            new = self._pend_add(positions)
+            if new is not None:
+                if len(new):
+                    self.generation += 1
+                    self._note_delta_positions(new)
+                return len(new)
+            # probe cache over cap: classic per-row path below
+        # every classic path below mutates merged truth: a probe cache
+        # built earlier is stale the moment rows change — even when
+        # pending is empty and the flush below is a no-op (a stale
+        # cache would silently drop re-sets of cleared bits)
+        self._probe_cache = None
+        if len(self._pend_pos):
+            # row-level ops, clears, and big batches need merged
+            # per-row truth
+            self._flush_pending()
         if op == OP_CLEAR_ROW:
             if aux in self._snap_pending:
                 # whole row drops: count from the directory, never expand

@@ -9,13 +9,22 @@ write truncates cleanly on replay instead of corrupting the fragment.
 Record layout (little-endian):
 
     u32 crc32 (of everything after this field)
-    u8  op     (1=SET_BITS, 2=CLEAR_BITS, 3=CLEAR_ROW, 4=SET_ROW)
+    u8  op     (1=SET_BITS, 2=CLEAR_BITS, 3=CLEAR_ROW, 4=SET_ROW;
+                high bit 0x80 = raw payload, see below)
     u64 aux    (row id for CLEAR_ROW/SET_ROW, else 0)
     u32 len    payload byte length
     payload    roaring-serialized bit positions (SET/CLEAR_BITS; for
                SET_ROW the row's complete new contents — one atomic
                record, so a crash can never replay the clear half of a
                row replacement without its set half)
+
+Small batches (r5): records whose position count is under
+``RAW_MAX_POSITIONS`` set the 0x80 flag on the op byte and carry raw
+little-endian u64 positions instead of roaring — the roaring encoder's
+fixed cost (~70 µs) dominated per-record time at the many-fragment
+ingest spread (BASELINE.md r4), and at ~100 positions raw bytes are no
+larger than a one-container roaring blob.  Replay handles both forms;
+old logs (no flag) read unchanged.
 """
 
 from __future__ import annotations
@@ -36,6 +45,9 @@ OP_SET_ROW = 4
 
 _HEADER = struct.Struct("<IBQI")
 
+RAW_FLAG = 0x80           # op-byte flag: payload is raw <u8 positions
+RAW_MAX_POSITIONS = 4096  # beyond this, roaring wins on size
+
 
 class OpLog:
     """One fragment's op log.  Not thread-safe; the fragment serializes."""
@@ -51,7 +63,13 @@ class OpLog:
         return self._f
 
     def append(self, op: int, aux: int = 0, positions: np.ndarray | None = None) -> None:
-        payload = b"" if positions is None else roaring.serialize(positions)
+        if positions is None:
+            payload = b""
+        elif len(positions) <= RAW_MAX_POSITIONS:
+            payload = np.asarray(positions, "<u8").tobytes()
+            op |= RAW_FLAG
+        else:
+            payload = roaring.serialize(positions)
         body = struct.pack("<BQI", op, aux, len(payload)) + payload
         f = self._file()
         f.write(struct.pack("<I", zlib.crc32(body)) + body)
@@ -77,7 +95,11 @@ class OpLog:
             if zlib.crc32(body) != crc:
                 break
             payload = buf[pos + _HEADER.size:end]
-            positions = roaring.deserialize(payload) if plen else None
+            if op & RAW_FLAG:
+                positions = np.frombuffer(payload, "<u8").astype(np.uint64)
+                op &= ~RAW_FLAG
+            else:
+                positions = roaring.deserialize(payload) if plen else None
             yield op, aux, positions
             pos = end
             good_end = end

@@ -47,7 +47,14 @@ def _diff_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 class RowBits:
     """Bits of one (row, shard) pair.  Not thread-safe; the owning
-    fragment serializes access."""
+    fragment serializes access.
+
+    Dense adds/removes count changed bits by PROBING the touched words
+    before the OR/ANDNOT (r5) — not by re-popcounting all 32768 words
+    per call, which made every micro-chunk import O(shard width).
+    Micro-chunk WRITE amortization lives one level up, in the
+    fragment's pending tier (``Fragment._pend_*``) — by the time bits
+    reach ``add`` they arrive as large presorted chunks."""
 
     __slots__ = ("_cols", "_words", "_card")
 
@@ -109,8 +116,8 @@ class RowBits:
     def contains(self, col: int) -> bool:
         if self._words is not None:
             return bool((int(self._words[col >> 5]) >> (col & 31)) & 1)
-        return bool(np.searchsorted(self._cols, np.uint32(col)) < len(self._cols)
-                    and self._cols[np.searchsorted(self._cols, np.uint32(col))] == col)
+        i = np.searchsorted(self._cols, np.uint32(col))
+        return bool(i < len(self._cols) and self._cols[i] == col)
 
     # -- mutation -----------------------------------------------------------
 
@@ -127,10 +134,13 @@ class RowBits:
         if self._words is not None:
             idx = (cols >> np.uint32(5)).astype(np.int64)
             bit = np.uint32(1) << (cols & np.uint32(31))
-            before = self._card
+            # exact new-bit count by probing BEFORE the OR — not by
+            # re-popcounting all 32768 words per call (cols are unique,
+            # so (idx, bit) pairs are distinct)
+            newly = int(np.count_nonzero(self._words[idx] & bit == 0))
             np.bitwise_or.at(self._words, idx, bit)
-            self._card = popcount_words(self._words)
-            return self._card - before
+            self._card += newly
+            return newly
         merged = _union_sorted(self._cols, cols)
         added = len(merged) - self._card
         self._cols = merged
@@ -147,10 +157,10 @@ class RowBits:
         if self._words is not None:
             idx = (cols >> np.uint32(5)).astype(np.int64)
             bit = np.uint32(1) << (cols & np.uint32(31))
-            before = self._card
+            removed = int(np.count_nonzero(self._words[idx] & bit != 0))
             np.bitwise_and.at(self._words, idx, ~bit)
-            self._card = popcount_words(self._words)
-            return before - self._card
+            self._card -= removed
+            return removed
         kept = _diff_sorted(self._cols, cols)
         removed = self._card - len(kept)
         self._cols = kept

@@ -132,7 +132,7 @@ class TestFragment:
                    rng.choice(SHARD_WIDTH, 6000, replace=False).astype(np.uint64))
         for col in [int(cols[0]), int(cols[7]), 12345, 0]:
             expect = sorted(r for r in f.row_ids()
-                            if f.rows[r].contains(col))
+                            if f.row(r).contains(col))
             np.testing.assert_array_equal(
                 f.rows_containing(col), np.array(expect, np.uint64),
                 err_msg=f"col {col}")
@@ -563,6 +563,81 @@ class TestReviewRegressions:
         h2 = Holder(str(tmp_path)).open()
         frag = h2.index("i").field("f").standard_view().fragment(0)
         assert frag is not None and frag.row(1).contains(10)
+
+    def test_pending_tier_semantics(self, tmp_path, rng):
+        """The r5 pending tier (fragment LSM buffer) must be invisible:
+        exact changed counts including duplicate probes, pending-aware
+        reads, and crash replay of un-flushed pending (the op-log write
+        precedes the buffer append)."""
+        from pilosa_tpu.store.fragment import Fragment
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        rows = rng.integers(0, 40, size=2000).astype(np.uint64)
+        cols = rng.integers(0, SHARD_WIDTH, size=2000).astype(np.uint64)
+        uniq = len({(int(r), int(c)) for r, c in zip(rows, cols)})
+        assert f.set_bits(rows, cols) == uniq
+        # re-setting the same bits: exact zero changed, all from probes
+        assert f.set_bits(rows, cols) == 0
+        assert len(f._pend_pos) > 0, "bits should still be pending"
+        # pending-aware reads without flushing
+        assert f.cardinality() == uniq
+        ids, cards = f.row_cardinalities()
+        assert int(cards.sum()) == uniq
+        assert f.present
+        # crash now (no close/flush): replay must rebuild everything
+        g = Fragment(str(tmp_path / "0"), 0).open()
+        assert g.cardinality() == uniq
+        np.testing.assert_array_equal(g.positions(), f.positions())
+        # reads flush; post-flush truth identical
+        probe_row = int(rows[0])
+        np.testing.assert_array_equal(
+            g.row(probe_row).columns(), f.row(probe_row).columns())
+        assert len(f._pend_pos) == 0, "row() read must flush"
+
+    def test_reset_after_clear_with_stale_probe_cache(self, tmp_path):
+        """Regression (r5 review): a duplicates-only batch leaves the
+        probe cache built with EMPTY pending; a clear through the
+        classic path must invalidate that cache or the following re-set
+        is silently dropped as 'already present' — a lost acknowledged
+        write."""
+        from pilosa_tpu.store.fragment import Fragment
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        r = np.array([3], np.uint64)
+        c = np.array([77], np.uint64)
+        assert f.set_bits(r, c) == 1
+        assert f.set_bits(r, c) == 0   # builds probe cache, pending empty
+        assert f.clear_bits(r, c) == 1  # classic path mutates merged truth
+        assert f.set_bits(r, c) == 1, "re-set after clear must land"
+        assert f.row(3).contains(77)
+        # same for row-level ops
+        assert f.set_bits(r, c) == 0
+        f.clear_row(3)
+        assert f.set_bits(r, c) == 1
+        assert f.cardinality() == 1
+
+    def test_pending_tier_interleaved_with_clears(self, tmp_path, rng):
+        """Clears and row ops force a flush and stay exact against a
+        position-set oracle under interleaving."""
+        from pilosa_tpu.store.fragment import Fragment
+        f = Fragment(str(tmp_path / "0"), 0).open()
+        oracle: set[tuple[int, int]] = set()
+        for step in range(30):
+            r = int(rng.integers(0, 8))
+            cs = rng.integers(0, 4096, size=50).astype(np.uint64)
+            if step % 3 == 2:
+                got = f.clear_bits(np.full(50, r, np.uint64), cs)
+                want = len({(r, int(c)) for c in cs} & oracle)
+                oracle -= {(r, int(c)) for c in cs}
+            else:
+                got = f.set_bits(np.full(50, r, np.uint64), cs)
+                want = len({(r, int(c)) for c in cs} - oracle)
+                oracle |= {(r, int(c)) for c in cs}
+            assert got == want, f"step {step}"
+        expect = np.array(sorted(r * SHARD_WIDTH + c for r, c in oracle),
+                          np.uint64)
+        np.testing.assert_array_equal(f.positions(), expect)
+        # crash replay of the interleaved log
+        g = Fragment(str(tmp_path / "0"), 0).open()
+        np.testing.assert_array_equal(g.positions(), expect)
 
     def test_crash_replay_bsi_grouped(self, tmp_path):
         h = Holder(str(tmp_path)).open()
