@@ -1,0 +1,214 @@
+"""Config #15: KEYED-INDEX SCALE (VERDICT r4 #3 — "no datum anywhere
+for a keyed index beyond toy scale").
+
+Measures the persistent sqlite translate store (store/translate.py,
+reference: v2 per-partition BoltDB stores, SURVEY.md §3.3) at high
+cardinality, plus the keyed end-to-end API path:
+
+  1. key-create throughput at N_KEYS (default 10M) string column keys,
+     batches of 100k — keys/s, host RSS delta, on-disk size
+  2. reopen cost: open seconds (O(1) — no replay) + post-open RSS
+  3. lookup throughput: 100k random key→id cold (sqlite) and warm (LRU)
+  4. reverse id→key (``keys_of``, the Extract/TopN result path)
+  5. the round-4 design's cost for comparison: a generated legacy
+     ``.keys`` log of the same N_KEYS replayed into a dict — open time
+     and resident RSS (what every open used to pay)
+  6. end-to-end keyed import + query latency through ``API`` on a
+     1M-column-key / 10k-row-key index
+
+Prints ONE JSON line: keyed_translate_create_keys_per_s, with
+vs_baseline = new create rate / legacy append-log create rate (the
+create path trades some throughput for persistence; the wins are open
+time and RSS, reported on stderr and in BASELINE.md)."""
+
+import os
+import struct
+import sys
+import tempfile
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import emit, log
+
+N_KEYS = int(os.environ.get("PILOSA_BENCH_KEYS", "10000000"))
+BATCH = 100_000
+LOOKUPS = 100_000
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def main():
+    from pilosa_tpu.store.translate import KeyStore
+
+    rng = np.random.default_rng(15)
+    tmp = tempfile.mkdtemp(prefix="pilosa_keyed_")
+    results = {}
+
+    # -- 1. create throughput at N_KEYS --------------------------------
+    # realistic keys: fixed prefix + random-order numeric suffix
+    order = rng.permutation(N_KEYS)
+    store = KeyStore(os.path.join(tmp, "cols.sqlite"))
+    rss0 = rss_mb()
+    t0 = time.perf_counter()
+    for lo in range(0, N_KEYS, BATCH):
+        batch = [f"user-{i:09d}" for i in order[lo:lo + BATCH]]
+        store.translate(batch, create=True)
+    create_s = time.perf_counter() - t0
+    create_rate = N_KEYS / create_s
+    rss_after_create = rss_mb()
+    db_mb = os.path.getsize(os.path.join(tmp, "cols.sqlite")) / 2**20
+    wal = os.path.join(tmp, "cols.sqlite-wal")
+    if os.path.exists(wal):
+        db_mb += os.path.getsize(wal) / 2**20
+    results["create"] = dict(keys=N_KEYS, s=round(create_s, 2),
+                             keys_per_s=round(create_rate),
+                             rss_delta_mb=round(rss_after_create - rss0, 1),
+                             db_mb=round(db_mb, 1))
+    log("create:", results["create"])
+    store.close()
+
+    # -- 2. reopen: no replay ------------------------------------------
+    rss_reopen0 = rss_mb()
+    t0 = time.perf_counter()
+    store = KeyStore(os.path.join(tmp, "cols.sqlite"))
+    open_s = time.perf_counter() - t0
+    assert len(store) == N_KEYS
+    results["reopen"] = dict(s=round(open_s, 4),
+                             rss_delta_mb=round(rss_mb() - rss_reopen0, 1))
+    log("reopen:", results["reopen"])
+
+    # -- 3. lookups: cold (sqlite) then warm (LRU) ---------------------
+    probe_ids = rng.integers(0, N_KEYS, LOOKUPS)
+    probes = [f"user-{i:09d}" for i in order[probe_ids]]
+    t0 = time.perf_counter()
+    ids = store.translate(probes)
+    cold_s = time.perf_counter() - t0
+    assert None not in ids
+    t0 = time.perf_counter()
+    ids2 = store.translate(probes)
+    warm_s = time.perf_counter() - t0
+    assert ids2 == ids
+    results["lookup"] = dict(
+        n=LOOKUPS, cold_keys_per_s=round(LOOKUPS / cold_s),
+        warm_keys_per_s=round(LOOKUPS / warm_s))
+    log("lookup:", results["lookup"])
+
+    # -- 4. reverse id->key (Extract/TopN result translation) ----------
+    rev_ids = np.asarray(ids[:LOOKUPS], np.uint64)
+    t0 = time.perf_counter()
+    keys = store.keys_of(rev_ids)
+    rev_cold_s = time.perf_counter() - t0
+    assert keys == probes[:len(rev_ids)]
+    t0 = time.perf_counter()
+    store.keys_of(rev_ids)
+    rev_warm_s = time.perf_counter() - t0
+    results["reverse"] = dict(
+        n=len(rev_ids), cold_keys_per_s=round(len(rev_ids) / rev_cold_s),
+        warm_keys_per_s=round(len(rev_ids) / rev_warm_s))
+    log("reverse:", results["reverse"])
+    rss_serving = rss_mb()
+    store.close()
+
+    # -- 5. the round-4 design at the same scale -----------------------
+    # write a legacy CRC-framed .keys log of N_KEYS, then do what every
+    # open used to do: replay it all into an in-memory dict
+    legacy = os.path.join(tmp, "legacy.keys")
+    t0 = time.perf_counter()
+    with open(legacy, "wb") as f:
+        chunks = []
+        for lo in range(0, N_KEYS, BATCH):
+            for i in order[lo:lo + BATCH]:
+                key = f"user-{i:09d}".encode()
+                body = struct.pack("<I", len(key)) + key
+                chunks.append(struct.pack("<I", zlib.crc32(body)) + body)
+            f.write(b"".join(chunks))
+            chunks.clear()
+    legacy_write_s = time.perf_counter() - t0
+    rss0 = rss_mb()
+    t0 = time.perf_counter()
+    keys_list, ids_map = [], {}
+    with open(legacy, "rb") as f:
+        buf = f.read()
+    pos = 0
+    while pos + 8 <= len(buf):
+        crc, ln = struct.unpack_from("<II", buf, pos)
+        end = pos + 8 + ln
+        if end > len(buf) or zlib.crc32(buf[pos + 4:end]) != crc:
+            break
+        k = buf[pos + 8:end].decode()
+        ids_map[k] = len(keys_list) + 1
+        keys_list.append(k)
+        pos = end
+    legacy_open_s = time.perf_counter() - t0
+    legacy_rss_mb = rss_mb() - rss0
+    assert len(keys_list) == N_KEYS
+    del buf, keys_list, ids_map
+    legacy_create_rate = N_KEYS / legacy_write_s
+    results["legacy"] = dict(
+        append_keys_per_s=round(legacy_create_rate),
+        open_replay_s=round(legacy_open_s, 2),
+        open_rss_mb=round(legacy_rss_mb, 1))
+    log("legacy (r4 design):", results["legacy"])
+    results["open_speedup"] = round(legacy_open_s / max(open_s, 1e-9))
+    log(f"open speedup {results['open_speedup']}x; serving RSS after "
+        f"{LOOKUPS} lookups each way: {rss_serving - rss_reopen0:.0f} MB "
+        f"resident vs legacy always-resident {legacy_rss_mb:.0f} MB")
+    os.remove(legacy)
+
+    # -- 6. end-to-end keyed API ---------------------------------------
+    from pilosa_tpu.api import API
+    from pilosa_tpu.store import Holder
+    from pilosa_tpu.store.field import FieldOptions
+
+    n_cols, n_rows_keyed, per_batch = 1_000_000, 10_000, 100_000
+    h = Holder(os.path.join(tmp, "data")).open()
+    h.create_index("k", keys=True)
+    h.index("k").create_field("f", FieldOptions(keys=True))
+    api = API(h)
+    col_keys = [f"user-{i:09d}" for i in range(n_cols)]
+    row_keys = [f"seg-{i % n_rows_keyed:05d}" for i in range(n_cols)]
+    t0 = time.perf_counter()
+    for lo in range(0, n_cols, per_batch):
+        api.import_bits("k", "f", row_keys=row_keys[lo:lo + per_batch],
+                        col_keys=col_keys[lo:lo + per_batch])
+    import_s = time.perf_counter() - t0
+    results["api_import"] = dict(pairs=n_cols, s=round(import_s, 2),
+                                 pairs_per_s=round(n_cols / import_s))
+    log("keyed api import:", results["api_import"])
+
+    lat = []
+    for i in rng.integers(0, n_rows_keyed, 20):
+        t0 = time.perf_counter()
+        r = api.query("k", f'Count(Row(f="seg-{i:05d}"))')
+        lat.append(time.perf_counter() - t0)
+        assert r["results"][0] == n_cols // n_rows_keyed
+    results["api_query_ms"] = round(float(np.median(lat)) * 1000, 2)
+    log(f"keyed Count(Row) p50: {results['api_query_ms']} ms")
+
+    # keyed TopN: results come back as keys (reverse translate path)
+    t0 = time.perf_counter()
+    r = api.query("k", "TopN(f, n=5)")
+    topn_ms = (time.perf_counter() - t0) * 1000
+    top = r["results"][0]
+    assert len(top) == 5 and all(isinstance(e["key"], str) for e in top)
+    results["api_topn_ms"] = round(topn_ms, 2)
+    log(f"keyed TopN(n=5): {results['api_topn_ms']} ms")
+    api.executor.translate.close()
+    h.close()
+
+    log("ALL:", results)
+    emit("keyed_translate_create_keys_per_s", create_rate, "keys/s",
+         create_rate / legacy_create_rate)
+
+
+if __name__ == "__main__":
+    main()

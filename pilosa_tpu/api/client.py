@@ -8,10 +8,11 @@ deps.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
+import ssl
+import threading
 import urllib.parse
-import urllib.request
 
 
 class ClientError(Exception):
@@ -36,14 +37,72 @@ class ClientError(Exception):
 
 
 class Client:
+    """Persistent-connection HTTP client.  Each request checks a
+    keep-alive connection out of a small idle pool (concurrent callers
+    each get their own; at most ``MAX_IDLE`` are kept) — the cluster
+    fan-out previously paid a fresh TCP handshake per internode RPC
+    (config12 r4 measured ~1.2 ms/node; connection reuse is the first
+    lever the r4 verdict named)."""
+
+    MAX_IDLE = 8
+
     def __init__(self, host: str = "127.0.0.1", port: int = 10101,
                  timeout: float = 60.0, ssl_context=None):
         scheme = "https" if ssl_context is not None else "http"
         self.base = f"{scheme}://{host}:{port}"
+        self.host, self.port = host, port
         self.timeout = timeout
         self._ssl = ssl_context
+        self._idle: list[http.client.HTTPConnection] = []
+        self._plock = threading.Lock()
 
     # -- transport ----------------------------------------------------------
+
+    def _checkout(self, timeout: float, fresh: bool = False):
+        """An idle keep-alive connection, or a freshly-connected one.
+        A pooled socket may be stale (server restarted / idle-closed),
+        so ``_do`` retries stale errors once with ``fresh=True``, which
+        bypasses and drains the pool — every idle socket predates the
+        failure and is equally suspect."""
+        if fresh:
+            self.close()
+        else:
+            with self._plock:
+                if self._idle:
+                    conn = self._idle.pop()
+                    if conn.sock is not None:
+                        conn.sock.settimeout(timeout)
+                    return conn
+        cls = http.client.HTTPConnection
+        kw = {}
+        if self._ssl is not None:
+            cls, kw = http.client.HTTPSConnection, {"context": self._ssl}
+        conn = cls(self.host, self.port, timeout=timeout, **kw)
+        try:
+            conn.connect()
+        except TimeoutError as e:
+            raise ClientError(f"cannot reach {self.base}: {e}",
+                              kind="timeout") from e
+        except OSError as e:
+            # refused / DNS / TLS-handshake rejection: the request was
+            # never delivered — a write definitely did not apply
+            raise ClientError(f"cannot reach {self.base}: {e}",
+                              kind="unreachable") from e
+        return conn
+
+    def _checkin(self, conn) -> None:
+        with self._plock:
+            if len(self._idle) < self.MAX_IDLE:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        """Drop idle pooled connections (new requests reconnect)."""
+        with self._plock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
 
     def _do(self, method: str, path: str, body: bytes | None = None,
             content_type: str = "application/json",
@@ -52,48 +111,51 @@ class Client:
         hdrs = dict(headers or {})
         if body:
             hdrs["Content-Type"] = content_type
-        req = urllib.request.Request(
-            self.base + path, data=body, method=method, headers=hdrs)
+        t = self.timeout if timeout is None else timeout
+        conn = self._checkout(t, fresh=_retried)
         try:
-            with urllib.request.urlopen(
-                    req, timeout=self.timeout if timeout is None else timeout,
-                    context=self._ssl) as resp:
-                data = resp.read()
-                ctype = resp.headers.get("Content-Type", "")
-        except ConnectionResetError:
-            # transient under connection churn; one retry
-            if _retried:
-                raise ClientError(f"connection reset by {self.base}",
-                                  kind="unreachable")
-            return self._do(method, path, body, content_type, headers,
-                            _retried=True, timeout=timeout)
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
+            conn.request(method, path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.BadStatusLine, http.client.CannotSendRequest,
+                ConnectionResetError, BrokenPipeError) as e:
+            # stale keep-alive socket or transient reset; one retry on
+            # a fresh connection
+            conn.close()
+            if not _retried:
+                return self._do(method, path, body, content_type, headers,
+                                _retried=True, timeout=timeout)
+            raise ClientError(f"connection reset by {self.base}",
+                              kind="unreachable") from e
+        except TimeoutError as e:
+            # read timeout after the request was sent (socket.timeout is
+            # TimeoutError since 3.10): the peer may still apply a write
+            conn.close()
+            raise ClientError(
+                f"request to {self.base} timed out", kind="timeout") from e
+        except ssl.SSLError as e:
+            # TLS alerts (e.g. mTLS 'certificate required') surfacing
+            # mid-request, after the handshake
+            conn.close()
+            raise ClientError(f"transport error from {self.base}: {e}") \
+                from e
+        except OSError as e:
+            conn.close()
+            raise ClientError(f"cannot reach {self.base}: {e}",
+                              kind="unreachable") from e
+        status = resp.status
+        ctype = resp.headers.get("Content-Type", "")
+        if resp.will_close:
+            conn.close()
+        else:
+            self._checkin(conn)
+        if status >= 400:
+            detail = data.decode(errors="replace")
             try:
                 detail = json.loads(detail).get("error", detail)
             except json.JSONDecodeError:
                 pass
-            raise ClientError(detail, e.code) from e
-        except urllib.error.URLError as e:
-            reason = getattr(e, "reason", None)
-            if isinstance(reason, ConnectionResetError) and not _retried:
-                return self._do(method, path, body, content_type, headers,
-                                _retried=True, timeout=timeout)
-            kind = ("timeout" if isinstance(reason, TimeoutError)
-                    else "unreachable")
-            raise ClientError(f"cannot reach {self.base}: {reason}",
-                              kind=kind) from e
-        except TimeoutError as e:
-            # read timeout after the request was sent (socket.timeout is
-            # TimeoutError since 3.10): the peer may still apply a write
-            raise ClientError(
-                f"request to {self.base} timed out", kind="timeout") from e
-        except OSError as e:
-            # TLS alerts (e.g. mTLS 'certificate required') can surface
-            # as raw ssl.SSLError during getresponse(), outside
-            # urllib's URLError wrapping — same contract: ClientError
-            raise ClientError(f"transport error from {self.base}: {e}") \
-                from e
+            raise ClientError(detail, status)
         if ctype.startswith("application/json"):
             return json.loads(data)
         return data

@@ -428,6 +428,40 @@ def build_router() -> Router:
 
 
 class _HTTPServer(ThreadingHTTPServer):
+    """Tracks live connections so ``close`` can sever them: with
+    HTTP/1.1 keep-alive clients, ``shutdown()`` only stops the accept
+    loop — handler threads parked on persistent connections would keep
+    answering (a "closed" node would still heartbeat as alive)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
     def handle_error(self, request, client_address):
         # failed TLS handshakes (plaintext probes, port scanners) and
         # client disconnects are per-connection noise, not server
@@ -483,5 +517,6 @@ class Server:
     def close(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.httpd.close_all_connections()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -470,9 +470,13 @@ class Cluster:
             self._replicate_keys(index, field, log)
         return ids
 
+    # Keys per page when streaming translate tails between nodes — a
+    # 10M-key store syncs as ~100 bounded responses, not one giant one.
+    TRANSLATE_PAGE = 100_000
+
     def _replicate_keys(self, index: str, field: str | None, log) -> None:
-        """Best-effort synchronous replication of the full tail each
-        batch (logs are append-only; peers dedupe)."""
+        """Best-effort synchronous replication of the tail each batch,
+        paged (logs are append-only; peers dedupe)."""
         f = field or ""
         for nid in self.alive_ids():
             if nid == self.node_id:
@@ -481,20 +485,37 @@ class Cluster:
                 peer_len = self._client(nid)._json(
                     "GET", f"/internal/translate/len?index={index}"
                     f"&field={f}")["len"]
-                tail = log.tail(peer_len)
-                if tail:
+                while True:
+                    tail = log.tail(peer_len, limit=self.TRANSLATE_PAGE)
+                    if not tail:
+                        break
                     self._client(nid)._json(
                         "POST", "/internal/translate/replicate",
                         {"index": index, "field": field,
                          "start_id": peer_len + 1, "keys": tail})
+                    peer_len += len(tail)
+                    if len(tail) < self.TRANSLATE_PAGE:
+                        break
             except Exception as e:  # noqa: BLE001 — repaired by pull later
                 self.logger.warning("translate replicate to %s failed: %s",
                                     nid, e)
 
-    @staticmethod
-    def _tail_path(index: str, field: str | None, after: int) -> str:
+    def _tail_path(self, index: str, field: str | None, after: int) -> str:
         f = field or ""
-        return f"/internal/translate/tail?index={index}&field={f}&after={after}"
+        return (f"/internal/translate/tail?index={index}&field={f}"
+                f"&after={after}&limit={self.TRANSLATE_PAGE}")
+
+    def _pull_log_tail(self, source: str, index: str, field: str | None,
+                       log) -> None:
+        """Pull a peer's tail into ``log``, paged until caught up."""
+        while True:
+            resp = self._client(source)._json(
+                "GET", self._tail_path(index, field, len(log)))
+            if not resp["keys"]:
+                break
+            log.append_replicated(len(log) + 1, resp["keys"])
+            if len(log) >= resp.get("len", 0):
+                break
 
     def _sync_log_from_coordinator(self, index: str, field: str | None,
                                    log) -> None:
@@ -502,10 +523,7 @@ class Cluster:
         if coord == self.node_id:
             return
         try:
-            resp = self._client(coord)._json(
-                "GET", self._tail_path(index, field, len(log)))
-            if resp["keys"]:
-                log.append_replicated(len(log) + 1, resp["keys"])
+            self._pull_log_tail(coord, index, field, log)
         except Exception as e:  # noqa: BLE001
             self.logger.warning("translate tail pull failed: %s", e)
 
@@ -521,10 +539,7 @@ class Cluster:
                    if field is None
                    else self.api.executor.translate.rows(index, field))
             try:
-                resp = self._client(seed)._json(
-                    "GET", self._tail_path(index, field, len(log)))
-                if resp["keys"]:
-                    log.append_replicated(len(log) + 1, resp["keys"])
+                self._pull_log_tail(seed, index, field, log)
             except Exception as e:  # noqa: BLE001
                 self.logger.warning("translate pull %s/%s failed: %s",
                                     index, field, e)

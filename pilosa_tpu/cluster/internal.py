@@ -166,7 +166,9 @@ def _translate_log(self: Handler):
 def h_translate_tail(self: Handler) -> None:
     log = _translate_log(self)
     after = int(self.query.get("after", ["0"])[0])
-    self._reply({"keys": log.tail(after), "len": len(log)})
+    limit = self.query.get("limit", [None])[0]
+    limit = int(limit) if limit else None
+    self._reply({"keys": log.tail(after, limit=limit), "len": len(log)})
 
 
 def h_translate_len(self: Handler) -> None:
@@ -174,12 +176,8 @@ def h_translate_len(self: Handler) -> None:
 
 
 def h_translate_logs(self: Handler) -> None:
-    store = self.server.api.executor.translate
-    logs = []
-    with store._lock:
-        for (index, field) in store._logs:
-            logs.append({"index": index, "field": field})
-    self._reply({"logs": logs})
+    stores = self.server.api.executor.translate.list_stores()
+    self._reply({"logs": [{"index": i, "field": f} for i, f in stores]})
 
 
 def h_fragment_blocks(self: Handler) -> None:

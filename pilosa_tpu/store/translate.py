@@ -1,29 +1,58 @@
 """String key ↔ uint64 ID translation stores.
 
 Reference: ``translate.go`` (SURVEY.md §3.3) — per-index column-key store
-and per-field row-key store; v1 used an append-only translate log
-replicated from the coordinator.  This rebuild keeps the append-only log
-(CRC-framed, replayed into memory on open); IDs are assigned
-sequentially from 1 (0 never maps to a key, so a zero result can't be
-mistranslated).
+and per-field row-key store.  v1 used an append-only translate log
+replicated from the coordinator and replayed into memory on open; v2
+moved to persistent per-partition BoltDB stores because the in-memory
+map does not scale to high-cardinality keyed indexes.
+
+This rebuild keeps the v1 *replication protocol* (sequential IDs from 1,
+coordinator-assigned batches, ``tail``/``append_replicated`` streaming —
+the cluster layer is unchanged) but replaces the replay-into-dict
+storage with the v2-style persistent store: one sqlite database per key
+log (sqlite is the same role BoltDB plays upstream), with
+
+- O(1) open — no replay; ``max(id)`` is read from the index, so a
+  10M-key store opens in milliseconds with flat memory;
+- bounded host RAM — two LRU read caches (key→id, id→key) in front of
+  the database instead of the whole mapping resident;
+- batched transactions — ``translate``/``append_replicated`` write a
+  whole batch in one fsynced commit, lookups run chunked ``IN`` queries.
+
+IDs are assigned sequentially from 1 (0 never maps to a key, so a zero
+result can't be mistranslated).
 
 Cluster note: upstream v2 partitions column keys over 256 hash
 partitions with per-partition primaries; here partition assignment
 (``partition_of``) is computed the same way for placement parity, while
 ID allocation stays sequential per store — the cluster layer routes
-keyed writes through the partition owner.
+keyed writes through the partition owner and replicates the single
+sequential log (v1 protocol over v2 storage).
+
+Legacy migration: pre-round-5 stores wrote a CRC-framed append-only
+``.keys`` log.  On first open of an empty sqlite store next to such a
+log, the log is replayed once into sqlite (same IDs) and renamed to
+``.keys.migrated``; nothing is deleted.
 """
 
 from __future__ import annotations
 
 import os
+import sqlite3
 import struct
 import threading
 import zlib
+from collections import OrderedDict
 
 import numpy as np
 
 PARTITION_N = 256  # reference: cluster-wide constant
+
+# Default per-direction LRU capacity (entries).  ~50-150 MB combined at
+# typical key lengths; override per-store via the cache_size ctor arg.
+DEFAULT_CACHE_SIZE = 1 << 19
+
+_SQL_CHUNK = 3000  # max bound variables per IN query (sqlite cap 32766)
 
 
 def fnv1a64(data: bytes) -> int:
@@ -39,60 +68,169 @@ def partition_of(key: str, n: int = PARTITION_N) -> int:
     return fnv1a64(key.encode()) % n
 
 
-class KeyLog:
-    """One append-only key log: record = u32 crc | u32 len | utf8 key.
-    ID of the i-th appended key is ``i + 1``."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._keys: list[str] = []
-        self._ids: dict[str, int] = {}
-        self._lock = threading.RLock()
-        self._f = None
-        self._load()
-
-    def _load(self) -> None:
-        if not os.path.exists(self.path):
+def _read_legacy_log(path: str):
+    """Yield keys from a pre-round-5 CRC-framed ``.keys`` log, stopping
+    at the first torn/corrupt record (same recovery rule the old replay
+    used: everything before the tear is good)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    pos = 0
+    while pos + 8 <= len(buf):
+        crc, ln = struct.unpack_from("<II", buf, pos)
+        end = pos + 8 + ln
+        if end > len(buf) or zlib.crc32(buf[pos + 4:end]) != crc:
             return
-        with open(self.path, "rb") as f:
-            buf = f.read()
-        pos, good = 0, 0
-        while pos + 8 <= len(buf):
-            crc, ln = struct.unpack_from("<II", buf, pos)
-            end = pos + 8 + ln
-            if end > len(buf) or zlib.crc32(buf[pos + 4:end]) != crc:
-                break
-            key = buf[pos + 8:end].decode()
-            self._ids[key] = len(self._keys) + 1
-            self._keys.append(key)
-            pos = good = end
-        if good < len(buf):
-            with open(self.path, "r+b") as f:
-                f.truncate(good)
+        yield buf[pos + 8:end].decode()
+        pos = end
 
-    def _append(self, key: str) -> None:
-        if self._f is None:
-            os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            self._f = open(self.path, "ab")
-        data = key.encode()
-        body = struct.pack("<I", len(data)) + data
-        self._f.write(struct.pack("<I", zlib.crc32(body)) + body)
-        self._f.flush()
+
+class _LRU:
+    """Tiny bounded LRU map (OrderedDict move-to-end)."""
+
+    __slots__ = ("cap", "_d")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: OrderedDict = OrderedDict()
+
+    def get(self, k):
+        d = self._d
+        v = d.get(k)
+        if v is not None:
+            d.move_to_end(k)
+        return v
+
+    def put(self, k, v) -> None:
+        d = self._d
+        d[k] = v
+        d.move_to_end(k)
+        if len(d) > self.cap:
+            d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class KeyStore:
+    """One persistent key store: sqlite table ``keys(id PRIMARY KEY,
+    key UNIQUE)`` with sequential IDs.  The ID of the i-th created key
+    is ``i + 1``; ``len(store)`` is the high-water ID.
+
+    All methods are safe under concurrent callers (one RLock, one
+    connection); writes commit per batch, one fsync each.
+    """
+
+    def __init__(self, path: str, cache_size: int = DEFAULT_CACHE_SIZE):
+        self.path = path
+        self._lock = threading.RLock()
+        self._key2id = _LRU(cache_size)
+        self._id2key = _LRU(cache_size)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=FULL")
+        # ceiling, not allocation: random-order key inserts churn the
+        # UNIQUE btree; the 2MB default cache collapses create
+        # throughput ~3x once the tree outgrows it (measured at 10M)
+        self._db.execute("PRAGMA cache_size=-131072")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS keys"
+            "(id INTEGER PRIMARY KEY, key TEXT NOT NULL UNIQUE)")
+        self._db.commit()
+        row = self._db.execute("SELECT max(id) FROM keys").fetchone()
+        self._n = int(row[0] or 0)
+        self._migrate_legacy()
+
+    def _migrate_legacy(self) -> None:
+        legacy = self.path[:-len(".sqlite")] + ".keys" \
+            if self.path.endswith(".sqlite") else self.path + ".keys"
+        if self._n or not os.path.exists(legacy):
+            return
+        batch: list[tuple[int, str]] = []
+        for key in _read_legacy_log(legacy):
+            self._n += 1
+            batch.append((self._n, key))
+            if len(batch) >= 65536:
+                self._db.executemany(
+                    "INSERT INTO keys(id, key) VALUES(?, ?)", batch)
+                batch.clear()
+        if batch:
+            self._db.executemany(
+                "INSERT INTO keys(id, key) VALUES(?, ?)", batch)
+        self._db.commit()
+        os.rename(legacy, legacy + ".migrated")
+
+    # -- lookup helpers -----------------------------------------------------
+
+    def _fetch_ids(self, keys: list[str]) -> dict[str, int]:
+        """DB lookup for keys (no cache check); fills the cache."""
+        found: dict[str, int] = {}
+        for i in range(0, len(keys), _SQL_CHUNK):
+            chunk = keys[i:i + _SQL_CHUNK]
+            q = ("SELECT key, id FROM keys WHERE key IN (%s)"
+                 % ",".join("?" * len(chunk)))
+            for k, kid in self._db.execute(q, chunk):
+                found[k] = kid
+                self._key2id.put(k, kid)
+        return found
+
+    def _fetch_keys(self, ids: list[int]) -> dict[int, str]:
+        found: dict[int, str] = {}
+        for i in range(0, len(ids), _SQL_CHUNK):
+            chunk = ids[i:i + _SQL_CHUNK]
+            q = ("SELECT id, key FROM keys WHERE id IN (%s)"
+                 % ",".join("?" * len(chunk)))
+            for kid, k in self._db.execute(q, chunk):
+                found[kid] = k
+                self._id2key.put(kid, k)
+        return found
 
     # -- api ----------------------------------------------------------------
 
     def translate(self, keys: list[str], create: bool = False) -> list[int | None]:
-        """Keys → IDs; unknown keys get new IDs if ``create`` else None."""
-        out: list[int | None] = []
+        """Keys → IDs; unknown keys get new IDs if ``create`` else None.
+        A key repeated within the batch gets one ID.  The whole created
+        tail commits in one transaction (one fsync per batch)."""
+        out: list[int | None] = [None] * len(keys)
         with self._lock:
-            for k in keys:
-                kid = self._ids.get(k)
-                if kid is None and create:
-                    self._append(k)
-                    kid = len(self._keys) + 1
-                    self._ids[k] = kid
-                    self._keys.append(k)
-                out.append(kid)
+            misses: list[int] = []
+            for i, k in enumerate(keys):
+                kid = self._key2id.get(k)
+                if kid is None:
+                    misses.append(i)
+                else:
+                    out[i] = kid
+            if misses:
+                found = self._fetch_ids(
+                    list({keys[i]: None for i in misses}))
+                new: dict[str, int] = {}
+                rows: list[tuple[int, str]] = []
+                n0 = self._n
+                for i in misses:
+                    k = keys[i]
+                    kid = found.get(k)
+                    if kid is None:
+                        kid = new.get(k)
+                        if kid is None and create:
+                            self._n += 1
+                            kid = new[k] = self._n
+                            rows.append((kid, k))
+                    out[i] = kid
+                if rows:
+                    try:
+                        self._db.executemany(
+                            "INSERT INTO keys(id, key) VALUES(?, ?)", rows)
+                        self._db.commit()
+                    except sqlite3.Error:
+                        # a failed commit must not advance the ID
+                        # high-water mark: replication arithmetic uses
+                        # len(store), and a divergent counter would remap
+                        # keys to different IDs on coordinator vs replica
+                        self._db.rollback()
+                        self._n = n0
+                        raise
+                    for kid, k in rows:
+                        self._key2id.put(k, kid)
         return out
 
     def append_replicated(self, start_id: int, keys: list[str]) -> None:
@@ -101,86 +239,162 @@ class KeyLog:
         may overlap what we have (idempotent); a gap means we missed a
         batch and must pull the tail first."""
         with self._lock:
-            have = len(self._keys)
-            if start_id > have + 1:
+            if start_id > self._n + 1:
                 raise KeyError(
-                    f"translate gap: have {have} keys, batch starts at "
+                    f"translate gap: have {self._n} keys, batch starts at "
                     f"{start_id}")
-            skip = have + 1 - start_id
+            skip = self._n + 1 - start_id
+            rows = []
+            n0 = self._n
             for k in keys[skip:]:
-                self._append(k)
-                self._ids[k] = len(self._keys) + 1
-                self._keys.append(k)
+                self._n += 1
+                rows.append((self._n, k))
+            if rows:
+                try:
+                    self._db.executemany(
+                        "INSERT INTO keys(id, key) VALUES(?, ?)", rows)
+                    self._db.commit()
+                except sqlite3.Error:
+                    self._db.rollback()
+                    self._n = n0
+                    raise
+                for kid, k in rows:
+                    self._key2id.put(k, kid)
 
-    def tail(self, after_id: int) -> list[str]:
-        """Keys with IDs > after_id, in ID order."""
+    def tail(self, after_id: int, limit: int | None = None) -> list[str]:
+        """Keys with IDs > after_id, in ID order; at most ``limit`` when
+        given (peers page large tails instead of one giant response)."""
         with self._lock:
-            return list(self._keys[after_id:])
+            if limit is None:
+                cur = self._db.execute(
+                    "SELECT key FROM keys WHERE id > ? ORDER BY id",
+                    (after_id,))
+            else:
+                cur = self._db.execute(
+                    "SELECT key FROM keys WHERE id > ? ORDER BY id "
+                    "LIMIT ?", (after_id, limit))
+            return [r[0] for r in cur]
 
     def key_of(self, kid: int) -> str | None:
         with self._lock:
-            if 1 <= kid <= len(self._keys):
-                return self._keys[kid - 1]
-            return None
+            if not 1 <= kid <= self._n:
+                return None
+            k = self._id2key.get(kid)
+            if k is None:
+                row = self._db.execute(
+                    "SELECT key FROM keys WHERE id = ?", (kid,)).fetchone()
+                if row is None:
+                    return None
+                k = row[0]
+                self._id2key.put(kid, k)
+            return k
 
     def keys_of(self, ids: np.ndarray, strict: bool = True) -> list[str]:
         """Batched id→key lookup under ONE lock acquisition.  ``strict``
         raises on an unknown id; otherwise unknown ids yield ``None``
         (the per-id ``key_of`` semantics)."""
         with self._lock:
-            keys = self._keys
-            n = len(keys)
-            out: list[str | None] = []
-            for kid in ids:
+            out: list[str | None] = [None] * len(ids)
+            misses: list[int] = []
+            for i, kid in enumerate(ids):
                 kid = int(kid)
-                if 1 <= kid <= n:
-                    out.append(keys[kid - 1])
-                elif strict:
-                    raise KeyError(f"no key for id {kid}")
-                else:
-                    out.append(None)
+                k = self._id2key.get(kid)
+                if k is None:
+                    misses.append(i)
+                out[i] = k
+            if misses:
+                found = self._fetch_keys(
+                    list({int(ids[i]): None for i in misses}))
+                for i in misses:
+                    out[i] = found.get(int(ids[i]))
+            if strict:
+                for i, k in enumerate(out):
+                    if k is None:
+                        raise KeyError(f"no key for id {int(ids[i])}")
             return out
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._keys)
+            return self._n
+
+    def cache_info(self) -> dict:
+        """Diagnostic: resident cache entries per direction."""
+        with self._lock:
+            return {"key2id": len(self._key2id), "id2key": len(self._id2key),
+                    "cap": self._key2id.cap, "n": self._n}
 
     def close(self) -> None:
         with self._lock:
-            if self._f is not None:
-                self._f.close()
-                self._f = None
+            if self._db is not None:
+                self._db.commit()
+                self._db.close()
+                self._db = None
+
+
+# Pre-round-5 name; same interface, storage moved from replay-log to sqlite.
+KeyLog = KeyStore
 
 
 class TranslateStore:
-    """All key logs of one holder: ``<data>/<index>/_keys/_columns.keys``
-    for column keys, ``<data>/<index>/_keys/<field>.keys`` per field."""
+    """All key stores of one holder:
+    ``<data>/<index>/_keys/_columns.sqlite`` for column keys,
+    ``<data>/<index>/_keys/<field>.sqlite`` per field."""
 
-    def __init__(self, holder_path: str):
+    def __init__(self, holder_path: str, cache_size: int = DEFAULT_CACHE_SIZE):
         self.holder_path = holder_path
-        self._logs: dict[tuple[str, str | None], KeyLog] = {}
+        self.cache_size = cache_size
+        self._logs: dict[tuple[str, str | None], KeyStore] = {}
         self._lock = threading.Lock()
 
-    def _log(self, index: str, field: str | None) -> KeyLog:
+    def _log(self, index: str, field: str | None) -> KeyStore:
         with self._lock:
             log = self._logs.get((index, field))
             if log is None:
                 name = "_columns" if field is None else field
                 path = os.path.join(self.holder_path, index, "_keys",
-                                    f"{name}.keys")
-                log = self._logs[(index, field)] = KeyLog(path)
+                                    f"{name}.sqlite")
+                log = self._logs[(index, field)] = KeyStore(
+                    path, cache_size=self.cache_size)
             return log
 
-    def columns(self, index: str) -> KeyLog:
+    def columns(self, index: str) -> KeyStore:
         return self._log(index, None)
 
-    def rows(self, index: str, field: str) -> KeyLog:
+    def rows(self, index: str, field: str) -> KeyStore:
         return self._log(index, field)
+
+    def list_stores(self) -> list[tuple[str, str | None]]:
+        """Every ``(index, field|None)`` key store this holder has —
+        opened in-process or persisted on disk from a previous run
+        (sqlite stores survive restarts, so a rebooted node must still
+        advertise them to cluster joiners)."""
+        seen: set[tuple[str, str | None]] = set()
+        with self._lock:
+            seen.update(self._logs)
+        try:
+            for index in os.listdir(self.holder_path):
+                kdir = os.path.join(self.holder_path, index, "_keys")
+                if not os.path.isdir(kdir):
+                    continue
+                for fn in os.listdir(kdir):
+                    if fn.endswith(".sqlite"):
+                        name = fn[:-len(".sqlite")]
+                        seen.add((index,
+                                  None if name == "_columns" else name))
+        except OSError:
+            pass
+        return sorted(seen, key=lambda t: (t[0], t[1] or ""))
+
+    def _paths(self, index: str, name: str) -> list[str]:
+        base = os.path.join(self.holder_path, index, "_keys", name)
+        return [base + s for s in
+                (".sqlite", ".sqlite-wal", ".sqlite-shm",
+                 ".keys", ".keys.migrated")]
 
     def drop(self, index: str, field: str | None = None,
              remove_files: bool = False) -> None:
-        """Forget cached key logs for a deleted index (all its logs) or
-        one field — a recreated index/field must start from empty key
+        """Forget cached key stores for a deleted index (all its stores)
+        or one field — a recreated index/field must start from empty key
         state, not inherit the dead one's mappings."""
         with self._lock:
             if field is not None:
@@ -188,12 +402,11 @@ class TranslateStore:
                 if log is not None:
                     log.close()
                 if remove_files:
-                    path = os.path.join(self.holder_path, index, "_keys",
-                                        f"{field}.keys")
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
+                    for path in self._paths(index, field):
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
                 return
             for key in [k for k in self._logs if k[0] == index]:
                 self._logs.pop(key).close()

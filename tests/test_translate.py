@@ -1,0 +1,175 @@
+"""Persistent sqlite key-translation stores (reference: v2 per-partition
+BoltDB translate stores, SURVEY.md §3.3 — here one sqlite store per key
+log with LRU read caches, keeping the v1 sequential-ID replication
+protocol)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.store.translate import (
+    DEFAULT_CACHE_SIZE, KeyStore, TranslateStore, partition_of)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = KeyStore(str(tmp_path / "k.sqlite"))
+    yield s
+    s.close()
+
+
+class TestKeyStore:
+    def test_sequential_ids_and_lookup(self, store):
+        assert store.translate(["a", "b", "a", "c"], create=True) == \
+            [1, 2, 1, 3]
+        assert store.translate(["c", "zz", "b"]) == [3, None, 2]
+        assert len(store) == 3
+        assert store.key_of(2) == "b"
+        assert store.key_of(0) is None
+        assert store.key_of(4) is None
+
+    def test_keys_of_batched(self, store):
+        store.translate([f"u{i}" for i in range(100)], create=True)
+        ids = np.array([7, 3, 99], np.uint64) + 1
+        assert store.keys_of(ids) == ["u7", "u3", "u99"]
+        with pytest.raises(KeyError):
+            store.keys_of(np.array([1000]))
+        assert store.keys_of(np.array([1, 1000]), strict=False) == \
+            ["u0", None]
+
+    def test_persistent_reopen_no_replay(self, tmp_path):
+        path = str(tmp_path / "k.sqlite")
+        s = KeyStore(path)
+        s.translate([f"u{i}" for i in range(1000)], create=True)
+        s.close()
+        s2 = KeyStore(path)
+        try:
+            # no replay: nothing enters the cache until it is read
+            assert s2.cache_info()["key2id"] == 0
+            assert len(s2) == 1000
+            assert s2.translate(["u500"]) == [501]
+            assert s2.translate(["new"], create=True) == [1001]
+        finally:
+            s2.close()
+
+    def test_cache_bounded(self, tmp_path):
+        s = KeyStore(str(tmp_path / "k.sqlite"), cache_size=64)
+        try:
+            s.translate([f"u{i}" for i in range(1000)], create=True)
+            info = s.cache_info()
+            assert info["key2id"] <= 64
+            s.keys_of(np.arange(1, 1001))
+            assert s.cache_info()["id2key"] <= 64
+            # evicted entries still resolve (from sqlite, not the cache)
+            assert s.translate(["u0"]) == [1]
+            assert s.key_of(1) == "u0"
+        finally:
+            s.close()
+
+    def test_tail_paged(self, store):
+        store.translate([f"u{i}" for i in range(10)], create=True)
+        assert store.tail(0, limit=4) == ["u0", "u1", "u2", "u3"]
+        assert store.tail(4, limit=4) == ["u4", "u5", "u6", "u7"]
+        assert store.tail(8) == ["u8", "u9"]
+        assert store.tail(10) == []
+
+    def test_append_replicated_overlap_and_gap(self, store):
+        store.append_replicated(1, ["a", "b"])
+        # overlapping batches dedupe by position
+        store.append_replicated(1, ["a", "b", "c"])
+        assert store.translate(["a", "b", "c"]) == [1, 2, 3]
+        with pytest.raises(KeyError):
+            store.append_replicated(10, ["z"])
+
+    def test_legacy_log_migration(self, tmp_path):
+        # write a pre-round-5 CRC-framed .keys log, open the sqlite
+        # store next to it: same IDs, log renamed, nothing lost
+        legacy = str(tmp_path / "f.keys")
+        with open(legacy, "wb") as f:
+            for key in ["alice", "bob", "carol"]:
+                body = struct.pack("<I", len(key)) + key.encode()
+                f.write(struct.pack("<I", zlib.crc32(body)) + body)
+            f.write(b"\x01\x02")  # torn tail record — ignored
+        s = KeyStore(str(tmp_path / "f.sqlite"))
+        try:
+            assert s.translate(["alice", "bob", "carol"]) == [1, 2, 3]
+            assert len(s) == 3
+            assert not os.path.exists(legacy)
+            assert os.path.exists(legacy + ".migrated")
+            # migration runs once — a reopen must not re-apply
+            s.translate(["dave"], create=True)
+        finally:
+            s.close()
+        s2 = KeyStore(str(tmp_path / "f.sqlite"))
+        try:
+            assert len(s2) == 4
+        finally:
+            s2.close()
+
+    def test_concurrent_translate(self, store):
+        import threading
+        errs = []
+
+        def worker(base):
+            try:
+                for i in range(50):
+                    store.translate([f"k{base}-{i}", "shared"], create=True)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(b,)) for b in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        # 4*50 unique keys + 1 shared, dense sequential IDs
+        assert len(store) == 201
+        ids = store.translate([f"k{b}-{i}" for b in range(4)
+                               for i in range(50)])
+        assert sorted(ids + store.translate(["shared"])) == \
+            list(range(1, 202))
+
+
+class TestTranslateStore:
+    def test_paths_and_drop(self, tmp_path):
+        ts = TranslateStore(str(tmp_path))
+        ts.columns("i").translate(["c1"], create=True)
+        ts.rows("i", "f").translate(["r1"], create=True)
+        assert os.path.exists(tmp_path / "i" / "_keys" / "_columns.sqlite")
+        assert os.path.exists(tmp_path / "i" / "_keys" / "f.sqlite")
+        ts.drop("i", "f", remove_files=True)
+        assert not os.path.exists(tmp_path / "i" / "_keys" / "f.sqlite")
+        # recreated field starts fresh
+        assert ts.rows("i", "f").translate(["r1"]) == [None]
+        ts.close()
+
+    def test_cache_size_flows_through(self, tmp_path):
+        ts = TranslateStore(str(tmp_path), cache_size=16)
+        assert ts.columns("i").cache_info()["cap"] == 16
+        ts.close()
+
+    def test_default_cache_cap(self, tmp_path):
+        ts = TranslateStore(str(tmp_path))
+        assert ts.columns("i").cache_info()["cap"] == DEFAULT_CACHE_SIZE
+        ts.close()
+
+
+def test_partition_stable():
+    # placement parity: FNV-1a over the key, mod 256 — pinned values so
+    # a refactor can't silently re-partition existing clusters
+    assert partition_of("") == fnv_expected("")
+    assert partition_of("alice") == fnv_expected("alice")
+
+
+def fnv_expected(key: str) -> int:
+    h = 0xCBF29CE484222325
+    for b in key.encode():
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h % 256
