@@ -88,13 +88,16 @@ def _workload():
     return rows, cols
 
 
-def measure_one(n_nodes: int) -> dict:
+def measure_one(n_nodes: int, proc: bool = False) -> dict:
     """One cluster size, in a FRESH process (threads/caches left by a
     previous in-process cluster measured a ~1 ms loopback RPC as
-    ~100 ms on this one-core host)."""
+    ~100 ms on this one-core host).  ``proc=True`` boots each node as
+    a separate OS process (VERDICT r4 #6: in-process nodes share one
+    GIL, so node-side work could not genuinely overlap; OS processes
+    overlap everything but this host's single core)."""
     import tempfile
 
-    from pilosa_tpu.testing import run_cluster
+    from pilosa_tpu.testing import run_cluster, run_process_cluster
 
     rows, cols = _workload()
     oracle_counts = np.bincount(rows.astype(np.int64), minlength=N_ROWS)
@@ -104,9 +107,10 @@ def measure_one(n_nodes: int) -> dict:
     pql32 = "".join(f"Count(Row(f={r}))" for r in range(N_ROWS))
     want_counts = [int(c) for c in oracle_counts]
 
+    harness = run_process_cluster if proc else run_cluster
     with tempfile.TemporaryDirectory() as td, \
-            run_cluster(n_nodes, td, replicas=1,
-                        anti_entropy=0.0) as tc:
+            harness(n_nodes, td, replicas=1,
+                    anti_entropy=0.0) as tc:
         c = tc.client(0)
         c.create_index(INDEX)
         c.create_field(INDEX, "f")
@@ -122,7 +126,7 @@ def measure_one(n_nodes: int) -> dict:
         # this one-core host
         time.sleep(2.0)
         rpc = rpc_null = None
-        if n_nodes > 1:
+        if n_nodes > 1 and not proc:
             cl = tc.servers[0].cluster
             peer = next(nid for nid in cl.alive_ids()
                         if nid != cl.node_id)
@@ -130,6 +134,16 @@ def measure_one(n_nodes: int) -> dict:
                 peer, INDEX, "Count(Row(f=0))", [0]))
             rpc_null = median_lat(lambda: cl.internal_query(
                 peer, INDEX, "Count(Row(f=999999999))", [0]))
+        elif n_nodes > 1:
+            # raw /internal/query RPC against a real peer PROCESS,
+            # keep-alive connection (the fan-out's unit cost)
+            peer_client = tc.client(1)
+            rpc = median_lat(lambda: peer_client._do(
+                "POST", f"/internal/query?index={INDEX}&shards=0",
+                b"Count(Row(f=0))"))
+            rpc_null = median_lat(lambda: peer_client._do(
+                "POST", f"/internal/query?index={INDEX}&shards=0",
+                b"Count(Row(f=999999999))"))
         lat_count = median_lat(lambda: c.query(INDEX, pql32))
         qps = concurrent_qps(lambda: c.query(INDEX, pql32),
                              per_call=N_ROWS)
@@ -164,25 +178,32 @@ def measure_one(n_nodes: int) -> dict:
 def main():
     import subprocess
 
-    if len(sys.argv) > 1 and sys.argv[1] == "--one":
-        print(json.dumps(measure_one(int(sys.argv[2]))))
+    if len(sys.argv) > 1 and sys.argv[1] in ("--one", "--one-proc"):
+        print(json.dumps(measure_one(int(sys.argv[2]),
+                                     proc=sys.argv[1] == "--one-proc")))
         return
 
     rng = np.random.default_rng(12)
     results = {}
-    for n_nodes in (1, 2, 4):
-        env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
-                   JAX_PLATFORMS="cpu")
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one",
-             str(n_nodes)],
-            capture_output=True, env=env, timeout=900)
-        sys.stderr.buffer.write(proc.stderr)
-        if proc.returncode != 0:
-            raise RuntimeError(f"{n_nodes}-node child rc="
-                               f"{proc.returncode}")
-        results[n_nodes] = json.loads(
-            proc.stdout.decode().strip().splitlines()[-1])
+    proc_results = {}
+    for flag, sink in (("--one", results), ("--one-proc", proc_results)):
+        for n_nodes in (1, 2, 4):
+            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+                       JAX_PLATFORMS="cpu")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag,
+                 str(n_nodes)],
+                capture_output=True, env=env, timeout=900)
+            sys.stderr.buffer.write(proc.stderr)
+            if proc.returncode != 0:
+                raise RuntimeError(f"{n_nodes}-node {flag} child rc="
+                                   f"{proc.returncode}")
+            sink[n_nodes] = json.loads(
+                proc.stdout.decode().strip().splitlines()[-1])
+        log(("in-process" if flag == "--one" else "OS-process")
+            + " mode done: "
+            + ", ".join(f"{n}n count32 {d['count32_ms']}ms"
+                        for n, d in sink.items()))
 
     # merge cost in isolation: synthesize per-node TopN/GroupBy partials
     # and time merge_results (pure host work, no sockets)
@@ -209,18 +230,18 @@ def main():
         f"{t_merge_topn * 1e3:.1f} ms; GroupBy 20k groups/node "
         f"{t_merge_gb * 1e3:.1f} ms")
 
-    d1, d4 = results[1], results[4]
+    d1, d4 = proc_results[1], proc_results[4]
     overhead_ms = d4["count32_ms"] - d1["count32_ms"]
-    log(f"fan-out overhead (4 nodes vs 1, same one-core host, same "
-        f"device work): +{overhead_ms:.1f} ms per 32-Count request; "
-        f"single-core caveat applies")
+    log(f"fan-out overhead, OS-process nodes (4 vs 1, one-core host, "
+        f"same device work): +{overhead_ms:.1f} ms per 32-Count request")
     print(json.dumps({
         "metric": "cluster_fanout_overhead_ms_4n_vs_1n_cpu",
         "value": round(overhead_ms, 2), "unit": "ms",
         "vs_baseline": 1.0,
-        "detail": {str(k): v for k, v in results.items()} | {
-            "merge_topn_ms": round(t_merge_topn * 1e3, 2),
-            "merge_groupby_20k_ms": round(t_merge_gb * 1e3, 2)}}))
+        "detail": {str(k): v for k, v in results.items()}
+        | {f"proc_{k}": v for k, v in proc_results.items()}
+        | {"merge_topn_ms": round(t_merge_topn * 1e3, 2),
+           "merge_groupby_20k_ms": round(t_merge_gb * 1e3, 2)}}))
 
 
 if __name__ == "__main__":

@@ -88,6 +88,10 @@ class Client:
             # never delivered — a write definitely did not apply
             raise ClientError(f"cannot reach {self.base}: {e}",
                               kind="unreachable") from e
+        # no Nagle: request writes on a kept-alive socket must not wait
+        # out the server's delayed ACK (mirror of the server setting)
+        import socket
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return conn
 
     def _checkin(self, conn) -> None:

@@ -78,6 +78,12 @@ class Handler(BaseHTTPRequestHandler):
     # connection): reclaims handler threads from clients that stall
     # mid-handshake or idle forever without closing
     timeout = 120
+    # TCP_NODELAY on every accepted connection (StreamRequestHandler
+    # applies it in setup()): with keep-alive clients the response's
+    # small writes otherwise collide with Nagle + the peer's delayed
+    # ACK — a measured ~40 ms stall per RPC on loopback (one-shot
+    # connections never showed it because close() flushes immediately)
+    disable_nagle_algorithm = True
 
     # -- plumbing ------------------------------------------------------------
 
@@ -87,8 +93,16 @@ class Handler(BaseHTTPRequestHandler):
             logger.debug("http: " + fmt % args)
 
     def _body(self) -> bytes:
-        n = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(n) if n else b""
+        # read-once, cached: _dispatch drains the body for EVERY
+        # request — a handler that replies without reading it would
+        # otherwise leave the bytes in the keep-alive stream, where
+        # they prefix the NEXT request's method line (seen in r5 as
+        # 501 "Unsupported method ('{}GET')" corrupting the peer's
+        # shard-universe fetch; one-shot connections masked the class)
+        if not hasattr(self, "_body_cache"):
+            n = int(self.headers.get("Content-Length") or 0)
+            self._body_cache = self.rfile.read(n) if n else b""
+        return self._body_cache
 
     def _json_body(self) -> dict:
         raw = self._body()
@@ -112,6 +126,19 @@ class Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
         self.query = urllib.parse.parse_qs(parsed.query)
+        # one handler instance serves every request on a keep-alive
+        # connection: reset, then always drain (see _body)
+        self.__dict__.pop("_body_cache", None)
+        if "chunked" in (self.headers.get("Transfer-Encoding")
+                         or "").lower():
+            # the drain below only understands Content-Length; an
+            # undrained chunked payload would corrupt the keep-alive
+            # stream, so refuse and drop the connection
+            self.close_connection = True
+            self._reply({"error": "chunked transfer encoding not "
+                                  "supported; send Content-Length"}, 411)
+            return
+        self._body()
         fn, params = self.server.router.match(method, parsed.path)
         srv = self.server
         t0 = time.perf_counter()

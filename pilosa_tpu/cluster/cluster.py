@@ -40,6 +40,10 @@ STATE_DEGRADED = "DEGRADED"
 SUSPECT_AFTER = 3  # missed heartbeat intervals
 
 _SHARD_CACHE_TTL = 2.0
+# cache lifetime for a shard universe built while a peer fetch failed:
+# long enough to stop per-query hammering of a sick peer, short enough
+# that the complete view returns quickly once the peer answers
+_SHARD_NEG_TTL = 0.25
 
 
 class Cluster:
@@ -355,12 +359,16 @@ class Cluster:
         return {k: tuple(v) for k, v in groups.items()}
 
     def index_shards(self, index: str) -> tuple[int, ...]:
-        """Cluster-wide shard universe for an index (short-TTL cache)."""
+        """Cluster-wide shard universe for an index (short-TTL cache).
+        A peer fetch failure leaves the result usable but UNCACHED (the
+        next call retries) — r5 flake: a cached degraded universe made
+        a distributed Count silently undercount until the TTL expired."""
         now = time.monotonic()
         with self._lock:
             hit = self._shard_cache.get(index)
             if hit is not None and now - hit[0] < _SHARD_CACHE_TTL:
                 return hit[1]
+        incomplete = False
         shards: set[int] = set()
         idx = self.api.holder.index(index)
         if idx is not None:
@@ -372,11 +380,22 @@ class Cluster:
                 resp = self._client(nid)._json(
                     "GET", f"/internal/shards?index={index}")
                 shards.update(resp["shards"])
-            except Exception:  # noqa: BLE001 — degraded view is fine
-                pass
+            except Exception as e:  # noqa: BLE001
+                # an ALIVE peer whose shard list can't be read leaves
+                # the universe incomplete — queries over it would
+                # silently undercount.  Don't cache; surface to callers.
+                self.logger.warning(
+                    "shard list from %s failed: %r", nid, e)
+                incomplete = True
         out = tuple(sorted(shards)) if shards else (0,)
         with self._lock:
-            self._shard_cache[index] = (now, out)
+            if incomplete:
+                # short negative TTL: retry soon, but don't let every
+                # query hammer a stalled-but-alive peer in the meantime
+                self._shard_cache[index] = (
+                    now - _SHARD_CACHE_TTL + _SHARD_NEG_TTL, out)
+            else:
+                self._shard_cache[index] = (now, out)
         return out
 
     def internal_query(self, node_id: str, index: str, pql: str,

@@ -1,13 +1,23 @@
-"""In-process multi-node cluster harness for tests.
+"""Multi-node cluster harnesses for tests and benches.
 
 Reference: ``test/cluster.go#MustRunCluster`` (SURVEY.md §5) — the most
 load-bearing fixture upstream: n real servers in one process, real
 executors/holders, loopback HTTP between them.  Heartbeat intervals are
 cranked down so liveness converges inside test timeouts.
+
+:func:`run_process_cluster` is the OS-process variant (reference: the
+v2 ``clustertests`` docker harness) — each node is a separate
+``python -m pilosa_tpu.cli server`` process, so node work genuinely
+overlaps (no shared GIL) and kill -9 is a real crash.
 """
 
 from __future__ import annotations
 
+import os
+import signal
+import socket
+import subprocess
+import sys
 import time
 from contextlib import contextmanager
 
@@ -118,3 +128,169 @@ def run_cluster(n: int, base_dir: str, replicas: int = 1,
                 s.close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
+
+
+def free_ports(n: int) -> list[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ProcessNode:
+    """One cluster node as a real OS process (CPU platform, TPU-grant
+    bypass env)."""
+
+    def __init__(self, port: int, data_dir: str, seed_port: int | None,
+                 replicas: int, heartbeat: float, anti_entropy: float):
+        self.port = port
+        self.data_dir = data_dir
+        self.seed_port = seed_port
+        self.replicas = replicas
+        self.heartbeat = heartbeat
+        self.anti_entropy = anti_entropy
+        self.proc: subprocess.Popen | None = None
+        self._log = None
+
+    def start(self) -> "ProcessNode":
+        env = dict(
+            os.environ,
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            PILOSA_CLUSTER_ENABLED="1",
+            PILOSA_REPLICAS=str(self.replicas),
+            PILOSA_HEARTBEAT_INTERVAL=str(self.heartbeat),
+            PILOSA_ANTI_ENTROPY_INTERVAL=str(self.anti_entropy),
+            PILOSA_MESH="0",
+        )
+        if self.seed_port is not None:
+            env["PILOSA_SEEDS"] = f"127.0.0.1:{self.seed_port}"
+        self._log = open(self.data_dir + ".log", "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "--bind", f"127.0.0.1:{self.port}",
+             "--data-dir", self.data_dir, "--verbose"],
+            env=env, stdout=self._log, stderr=self._log)
+        return self
+
+    def await_up(self, timeout: float = 60.0) -> "ProcessNode":
+        client = Client("127.0.0.1", self.port, timeout=5.0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"node :{self.port} exited rc={self.proc.returncode}")
+            try:
+                client._do("GET", "/status")
+                return self
+            except Exception:  # noqa: BLE001 — still booting
+                time.sleep(0.25)
+        raise TimeoutError(f"node :{self.port} never served /status")
+
+    def kill9(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+
+class ProcessCluster:
+    __test__ = False
+
+    def __init__(self, nodes: list[ProcessNode]):
+        self.nodes = nodes
+        self._clients: dict[int, Client] = {}
+
+    def client(self, i: int = 0) -> Client:
+        if i not in self._clients:
+            self._clients[i] = Client("127.0.0.1", self.nodes[i].port,
+                                      timeout=60.0)
+        return self._clients[i]
+
+    def await_membership(self, n: int, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            dead = [node for node in self.nodes
+                    if node.proc.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    "node(s) died awaiting membership: " + ", ".join(
+                        f":{d.port} rc={d.proc.returncode} "
+                        f"(log {d.data_dir}.log)" for d in dead))
+            try:
+                states = [self.client(i)._do("GET", "/status")
+                          for i in range(len(self.nodes))]
+                if all(s["state"] == "NORMAL"
+                       and len([nd for nd in s["nodes"]
+                                if nd["state"] == "NORMAL"]) == n
+                       for s in states):
+                    return
+            except Exception:  # noqa: BLE001 — node still joining
+                pass
+            time.sleep(0.3)
+        raise TimeoutError(f"cluster never reached {n} NORMAL members")
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        for node in self.nodes:
+            node.stop()
+
+
+@contextmanager
+def run_process_cluster(n: int, base_dir: str, replicas: int = 1,
+                        heartbeat: float = 0.3,
+                        anti_entropy: float = 0.0):
+    """Boot an n-node cluster of separate OS processes; yields a
+    :class:`ProcessCluster` once all members are NORMAL."""
+    nodes: list[ProcessNode] = []
+    cluster = None
+    try:
+        for attempt in (0, 1):
+            ports = free_ports(n)
+            nodes = []
+            try:
+                for i, port in enumerate(ports):
+                    node = ProcessNode(port, f"{base_dir}/node{i}",
+                                       seed_port=ports[0] if i else None,
+                                       replicas=replicas,
+                                       heartbeat=heartbeat,
+                                       anti_entropy=anti_entropy)
+                    nodes.append(node.start())
+                    node.await_up()
+                break
+            except RuntimeError:
+                # free_ports probes then closes — another process can
+                # steal a port before the node binds it.  One re-roll.
+                for node in nodes:
+                    node.stop()
+                if attempt:
+                    raise
+        cluster = ProcessCluster(nodes)
+        cluster.await_membership(n)
+        yield cluster
+    finally:
+        if cluster is not None:
+            try:
+                cluster.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        else:
+            for node in nodes:
+                try:
+                    node.stop()
+                except Exception:  # noqa: BLE001
+                    pass

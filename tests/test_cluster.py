@@ -1339,3 +1339,98 @@ class TestClusterSingleNodeEquivalence:
                 for cl in c.clients:
                     (b,) = cl.query("i", pql)
                     assert a == b, f"{pql}: solo={a} cluster={b}"
+
+
+class TestInternodeRpcLatency:
+    def test_no_delayed_ack_stall(self, tmp_path):
+        """Regression: keep-alive internode sockets without TCP_NODELAY
+        hit the classic Nagle + delayed-ACK interaction — a
+        deterministic ~40 ms stall on EVERY persistent-connection RPC
+        (found by bench/config12 in r5; the whole suite passed with it).
+        0.5 ms is typical on loopback; 20 ms leaves slack for a loaded
+        host while still catching the 40 ms stall class."""
+        import time
+
+        import numpy as np
+
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path), replicas=2) as tc:
+            c = tc.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.import_bits("i", "f", rowIDs=[0] * 10,
+                          columnIDs=list(range(10)))
+            cl = tc.servers[0].cluster
+            peer = next(n for n in cl.alive_ids() if n != cl.node_id)
+            cl.internal_query(peer, "i", "Count(Row(f=0))", [0])  # warm
+            lat = []
+            for _ in range(20):
+                t0 = time.perf_counter()
+                (n,) = cl.internal_query(peer, "i", "Count(Row(f=0))", [0])
+                lat.append(time.perf_counter() - t0)
+                assert n == 10
+            assert float(np.median(lat)) < 0.020, \
+                f"internode RPC p50 {np.median(lat) * 1e3:.1f} ms"
+
+
+class TestBatchedReadFanout:
+    """The r5 batched read fan-out (dist._read_group): consecutive
+    plain reads of MIXED call families ship as one multi-call query per
+    node — per-call partial indexing, strip/merge, and write barriers
+    must all survive the batching."""
+
+    def test_heterogeneous_batch_matches_single_node(self, tmp_path):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.store import Holder
+
+        rng = np.random.default_rng(55)
+        solo_holder = Holder(str(tmp_path / "solo")).open()
+        solo = API(solo_holder, Executor(solo_holder))
+
+        with run_cluster(3, str(tmp_path / "cluster")) as c:
+            for api_like in (solo, None):
+                mk = (solo if api_like is solo else c.client(0))
+                mk.create_index("i")
+                mk.create_field("i", "f")
+                mk.create_field("i", "amount",
+                                {"type": "int", "min": -100, "max": 100})
+            rows = rng.integers(1, 8, 400).astype(np.uint64)
+            cols = (rng.integers(0, 5, 400) * SHARD_WIDTH
+                    + rng.integers(0, 64, 400)).astype(np.uint64)
+            vals = rng.integers(-100, 100, 60)
+            vcols = (rng.integers(0, 5, 60) * SHARD_WIDTH
+                     + rng.integers(0, 64, 60)).astype(np.uint64)
+            solo.import_bits("i", "f", row_ids=rows, col_ids=cols)
+            solo.import_values("i", "amount", col_ids=vcols,
+                               values=np.asarray(vals))
+            c.client(0).import_bits("i", "f", rowIDs=rows.tolist(),
+                                    columnIDs=cols.tolist())
+            c.client(0)._json("POST", "/index/i/field/amount/importValue",
+                              {"columnIDs": vcols.tolist(),
+                               "values": vals.tolist()})
+
+            # one query string per node: mixed read families, a write
+            # in the middle (splits the batch, must keep relative
+            # order), and a repeat read proving the write landed — the
+            # written column differs per node so reruns stay comparable
+            def pql(wcol: int) -> str:
+                return ("Count(Row(f=1))"
+                        "TopN(f, n=3)"
+                        "Rows(f)"
+                        "Sum(field=amount)"
+                        "Count(Union(Row(f=1), Row(f=2)))"
+                        "Min(field=amount)"
+                        f"Set({wcol}, f=1)"
+                        "Count(Row(f=1))"
+                        "GroupBy(Rows(f, limit=3))")
+
+            base = 3 * SHARD_WIDTH + 100_000
+            for ci in range(3):
+                q = pql(base + ci)
+                want = solo.query("i", q)["results"]
+                got = c.clients[ci].query("i", q)
+                assert got == want, (
+                    f"node {ci} diverged: {str(got)[:120]} != "
+                    f"{str(want)[:120]}")
