@@ -38,12 +38,7 @@ BATCH = 100_000
 LOOKUPS = 100_000
 
 
-def rss_mb() -> float:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS"):
-                return int(line.split()[1]) / 1024.0
-    return 0.0
+from pilosa_tpu.testing import rss_mb  # noqa: E402
 
 
 def main():

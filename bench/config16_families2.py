@@ -119,6 +119,23 @@ def oracle_percentile(nth: float):
     return idx - 125, int(counts[idx]), total
 
 
+def warm_query(api, pql, attempts=5, wait=45.0):
+    """First (residency-building) query of a family, with patience:
+    the tunneled chip intermittently refuses GB-scale device_put while
+    standalone probes minutes later succeed (shared-tenancy HBM, r5) —
+    back off and retry instead of failing the whole bench."""
+    for attempt in range(attempts):
+        try:
+            return api.query(INDEX, pql)["results"]
+        except Exception as e:  # noqa: BLE001
+            if "RESOURCE_EXHAUSTED" not in repr(e) or \
+                    attempt == attempts - 1:
+                raise
+            log(f"device OOM warming {pql[:40]!r} (attempt "
+                f"{attempt + 1}/{attempts}); waiting {wait:.0f}s")
+            time.sleep(wait)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -141,8 +158,12 @@ def main():
         tsp &= rng.integers(0, 1 << 32, size=tsp.shape, dtype=np.uint32)
         tsp &= rng.integers(0, 1 << 32, size=tsp.shape, dtype=np.uint32)
         ts_planes[hour] = tsp
-    data_dir = tempfile.mkdtemp(prefix="pilosa_fam2_")
-    build_index(data_dir, plane, ts_planes, rng)
+    data_dir = os.environ.get("PILOSA_BENCH_DATADIR")
+    if data_dir and os.path.isdir(os.path.join(data_dir, INDEX)):
+        log(f"reusing prebuilt index at {data_dir}")
+    else:
+        data_dir = data_dir or tempfile.mkdtemp(prefix="pilosa_fam2_")
+        build_index(data_dir, plane, ts_planes, rng)
 
     holder = Holder(data_dir).open()
     api = API(holder, Executor(holder, plane_budget=8 << 30))
@@ -163,8 +184,8 @@ def main():
 
     # ---- Distinct -------------------------------------------------------
     want = [v for v in range(-125, 126)]
-    got = api.query(INDEX, "Distinct(field=v)")["results"][0]
-    assert got == want, f"Distinct: {got[:5]}... != {want[:5]}..."
+    got = warm_query(api, "Distinct(field=v)")[0]
+    assert got == {"values": want}, f"Distinct: {str(got)[:60]}..."
     t0 = time.perf_counter()
     api.query(INDEX, "Distinct(field=v)")
     log(f"distinct first (BSI plane build + transfer): "
@@ -181,8 +202,8 @@ def main():
 
     # filtered Distinct: values among row-0 columns — row 0 is a ~25%
     # random mask over 1B columns, so all 251 values survive
-    got = api.query(INDEX, "Distinct(Row(f=0), field=v)")["results"][0]
-    assert got == want, "filtered Distinct diverged"
+    got = warm_query(api, "Distinct(Row(f=0), field=v)")[0]
+    assert got == {"values": want}, "filtered Distinct diverged"
     prod_fd = median_lat(
         lambda: api.query(INDEX, "Distinct(Row(f=0), field=v)"))
     results["distinct_filtered"] = {"product_ms": round(prod_fd * 1e3, 1)}
@@ -212,7 +233,7 @@ def main():
                          if (plane[0, r, c >> 5] >> (c & 31)) & 1]
                 for c in cols1k}
     pql_ext = "Extract(Limit(Row(f=0), limit=1000), Rows(f))"
-    got = api.query(INDEX, pql_ext)["results"][0]
+    got = warm_query(api, pql_ext)[0]
     got_map = {c["column"]: c["rows"][0] for c in got["columns"]}
     assert got_map == want_ext, "Extract diverged"
     prod = median_lat(lambda: api.query(INDEX, pql_ext))
@@ -243,7 +264,7 @@ def main():
     want_t = int(np.bitwise_count(union2[:, 1, :]).sum(dtype=np.int64))
     pql_t = ("Count(Row(ts=1, from=2017-01-02T00:00, "
              "to=2017-01-02T02:00))")
-    got = api.query(INDEX, pql_t)["results"][0]
+    got = warm_query(api, pql_t)[0]
     assert got == want_t, f"time Range: {got} != {want_t}"
     prod = median_lat(lambda: api.query(INDEX, pql_t))
 
@@ -261,7 +282,8 @@ def main():
 
     holder.close()
     import shutil
-    shutil.rmtree(data_dir, ignore_errors=True)
+    if not os.environ.get("PILOSA_BENCH_DATADIR"):
+        shutil.rmtree(data_dir, ignore_errors=True)
 
     worst = min((f["raw_over_product"] for f in results.values()
                  if f.get("raw_over_product")), default=0.0)

@@ -66,7 +66,10 @@ class Cluster:
         self.state = STATE_STARTING
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
-        self._shard_cache: dict[str, tuple[float, tuple[int, ...]]] = {}
+        # index -> (fetched_at, shards, incomplete): `incomplete` rides
+        # the cache so strict callers reject degraded hits too
+        self._shard_cache: dict[
+            str, tuple[float, tuple[int, ...], bool]] = {}
         self._lock = threading.RLock()
         self._status_ts = 0.0
         self._removed: dict[str, float] = {}  # tombstones: explicit removals
@@ -358,15 +361,27 @@ class Cluster:
             groups.setdefault(target, []).append(s)
         return {k: tuple(v) for k, v in groups.items()}
 
-    def index_shards(self, index: str) -> tuple[int, ...]:
+    def index_shards(self, index: str,
+                     strict: bool = False) -> tuple[int, ...]:
         """Cluster-wide shard universe for an index (short-TTL cache).
-        A peer fetch failure leaves the result usable but UNCACHED (the
-        next call retries) — r5 flake: a cached degraded universe made
-        a distributed Count silently undercount until the TTL expired."""
+
+        When an ALIVE peer's shard list can't be fetched (one retry),
+        the universe is INCOMPLETE: with ``strict`` that raises — a
+        query served over it silently undercounts, and a ClearRow/Store
+        that misses the sick peer's exclusive shards would later be
+        resurrected cluster-wide by union-merge AAE (r5 review).
+        Non-strict callers (AAE sweeps, resize planning) get the
+        degraded view, cached only for ``_SHARD_NEG_TTL`` so recovery
+        is quick but a sick peer isn't hammered per query."""
         now = time.monotonic()
         with self._lock:
             hit = self._shard_cache.get(index)
             if hit is not None and now - hit[0] < _SHARD_CACHE_TTL:
+                if hit[2] and strict:
+                    raise RuntimeError(
+                        f"shard universe for {index!r} is incomplete "
+                        "(an alive peer's shard list is unreadable); "
+                        "refusing to serve a silent partial answer")
                 return hit[1]
         incomplete = False
         shards: set[int] = set()
@@ -377,25 +392,31 @@ class Cluster:
             if nid == self.node_id:
                 continue
             try:
-                resp = self._client(nid)._json(
-                    "GET", f"/internal/shards?index={index}")
+                try:
+                    resp = self._client(nid)._json(
+                        "GET", f"/internal/shards?index={index}")
+                except Exception:  # noqa: BLE001 — one retry
+                    resp = self._client(nid)._json(
+                        "GET", f"/internal/shards?index={index}")
                 shards.update(resp["shards"])
             except Exception as e:  # noqa: BLE001
-                # an ALIVE peer whose shard list can't be read leaves
-                # the universe incomplete — queries over it would
-                # silently undercount.  Don't cache; surface to callers.
                 self.logger.warning(
                     "shard list from %s failed: %r", nid, e)
                 incomplete = True
         out = tuple(sorted(shards)) if shards else (0,)
         with self._lock:
             if incomplete:
-                # short negative TTL: retry soon, but don't let every
-                # query hammer a stalled-but-alive peer in the meantime
+                # short negative TTL: retry soon, but don't let
+                # non-strict callers hammer a sick peer in the meantime
                 self._shard_cache[index] = (
-                    now - _SHARD_CACHE_TTL + _SHARD_NEG_TTL, out)
+                    now - _SHARD_CACHE_TTL + _SHARD_NEG_TTL, out, True)
             else:
-                self._shard_cache[index] = (now, out)
+                self._shard_cache[index] = (now, out, False)
+        if incomplete and strict:
+            raise RuntimeError(
+                f"shard universe for {index!r} is incomplete (an alive "
+                "peer's shard list is unreadable); refusing to serve a "
+                "silent partial answer")
         return out
 
     def internal_query(self, node_id: str, index: str, pql: str,

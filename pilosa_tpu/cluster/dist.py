@@ -268,8 +268,13 @@ class DistributedExecutor:
         query concurrently.  Returns one ``[per-call JSON partial]``
         list per participating node.  The pool is torn down on EVERY
         exit path (a local raise must not strand worker threads)."""
-        all_shards = (tuple(shards) if shards is not None
-                      else self.cluster.index_shards(index))
+        try:
+            all_shards = (tuple(shards) if shards is not None
+                          else self.cluster.index_shards(index,
+                                                         strict=True))
+        except RuntimeError as e:
+            # an incomplete universe would silently undercount
+            raise ExecutionError(str(e)) from e
         groups = self.cluster.group_shards_by_node(index, all_shards)
         pql = "\n".join(str(s) for s in subs)
 
@@ -395,8 +400,13 @@ class DistributedExecutor:
         # shard must apply them: both clear bits, and a replica that
         # missed a clear would diverge — then union-merge AAE would
         # resurrect the cleared bits cluster-wide.  (Strict: any owner
-        # down fails the op, same rationale as Clear above.)
-        all_shards = self.cluster.index_shards(index)
+        # down fails the op, same rationale as Clear above — and the
+        # shard UNIVERSE itself must be complete, or shards only the
+        # unreadable peer knows about would miss the clear.)
+        try:
+            all_shards = self.cluster.index_shards(index, strict=True)
+        except RuntimeError as e:
+            raise ExecutionError(str(e)) from e
         groups: dict[str, list[int]] = {}
         for s in all_shards:
             for o in self.cluster.shard_owners(index, s):

@@ -130,6 +130,16 @@ def run_cluster(n: int, base_dir: str, replicas: int = 1,
                 pass
 
 
+def rss_mb() -> float:
+    """Current process resident set (MB) — the bench/soak probes'
+    shared helper."""
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
 def free_ports(n: int) -> list[int]:
     socks = [socket.socket() for _ in range(n)]
     for s in socks:

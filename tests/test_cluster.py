@@ -1370,8 +1370,11 @@ class TestInternodeRpcLatency:
                 (n,) = cl.internal_query(peer, "i", "Count(Row(f=0))", [0])
                 lat.append(time.perf_counter() - t0)
                 assert n == 10
-            assert float(np.median(lat)) < 0.020, \
-                f"internode RPC p50 {np.median(lat) * 1e3:.1f} ms"
+            # min, not median: host-load spikes only ADD latency, while
+            # the Nagle stall is deterministic on EVERY rpc — the
+            # fastest of 20 stays honest on a contended CI box
+            assert min(lat) < 0.020, \
+                f"internode RPC min {min(lat) * 1e3:.1f} ms"
 
 
 class TestBatchedReadFanout:

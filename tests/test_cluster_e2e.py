@@ -23,14 +23,7 @@ import urllib.request
 from pilosa_tpu.engine.words import SHARD_WIDTH
 
 
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+from pilosa_tpu.testing import free_ports as _free_ports
 
 
 def _get(port, path):

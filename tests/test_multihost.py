@@ -71,17 +71,7 @@ finally:
 """
 
 
-def _free_ports(n: int) -> list[int]:
-    # bind all probes simultaneously so the returned ports are at least
-    # mutually distinct; the close-then-rebind TOCTOU vs OTHER processes
-    # remains (same accepted pattern as tests/test_cluster_e2e.py)
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+from pilosa_tpu.testing import free_ports as _free_ports
 
 
 def _free_port() -> int:

@@ -37,12 +37,7 @@ N_SHARDS = 32
 N_ROWS = 16
 
 
-def rss_mb() -> float:
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS"):
-                return int(line.split()[1]) / 1024.0
-    return 0.0
+from pilosa_tpu.testing import rss_mb  # noqa: E402
 
 
 def fd_count() -> int:
