@@ -373,15 +373,18 @@ class Cluster:
         Non-strict callers (AAE sweeps, resize planning) get the
         degraded view, cached only for ``_SHARD_NEG_TTL`` so recovery
         is quick but a sick peer isn't hammered per query."""
+        def raise_incomplete():
+            raise RuntimeError(
+                f"shard universe for {index!r} is incomplete (an alive "
+                "peer's shard list is unreadable); refusing to serve a "
+                "silent partial answer")
+
         now = time.monotonic()
         with self._lock:
             hit = self._shard_cache.get(index)
             if hit is not None and now - hit[0] < _SHARD_CACHE_TTL:
                 if hit[2] and strict:
-                    raise RuntimeError(
-                        f"shard universe for {index!r} is incomplete "
-                        "(an alive peer's shard list is unreadable); "
-                        "refusing to serve a silent partial answer")
+                    raise_incomplete()
                 return hit[1]
         incomplete = False
         shards: set[int] = set()
@@ -413,10 +416,7 @@ class Cluster:
             else:
                 self._shard_cache[index] = (now, out, False)
         if incomplete and strict:
-            raise RuntimeError(
-                f"shard universe for {index!r} is incomplete (an alive "
-                "peer's shard list is unreadable); refusing to serve a "
-                "silent partial answer")
+            raise_incomplete()
         return out
 
     def internal_query(self, node_id: str, index: str, pql: str,

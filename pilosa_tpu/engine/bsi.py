@@ -268,42 +268,111 @@ def combine_min_max(out: dict) -> list[tuple[int, int, int, int]]:
     return res
 
 
+# Shards per distinct_presence scan step: bounds the program's scratch
+# (per-column decoded values are 4 B/col — an UNBLOCKED expansion of a
+# 1B-col field materialized ~4 GB values + ~9 GB masks/indices and
+# OOM'd a 16 GB chip; found by bench/config16 r5).  32 shards ≈ 0.5 GB
+# peak per step.
+DISTINCT_BLOCK = 32
+
+# Value-space cutover: at depth <= this, presence is computed per VALUE
+# on packed words (bit-plane XNOR-AND algebra — no per-column decode,
+# no scatter; work ∝ 2^depth × plane, 14 s → sub-second at depth 7 /
+# 1B cols).  Deeper fields keep the column-scatter scan (work ∝ cols).
+DISTINCT_VALUE_DEPTH = 10
+_DISTINCT_VALUE_BLOCK = 8  # values per scan step (scratch ∝ block×plane)
+
+
 def distinct_presence(
     plane: jax.Array, filter_words: jax.Array | None = None
 ) -> tuple[jax.Array, jax.Array]:
     """Presence bitmaps over the value space: which offsets occur among
     the (filtered) columns — the device core of ``Distinct`` (v2 PQL).
 
-    Expands each column's magnitude from the bit planes, then scatters
-    into boolean presence arrays of size ``2^depth`` (positive and
-    negative offsets separately).  Requires ``depth <= 24`` (a 16M-entry
-    presence array); the executor enforces the cap.
+    Scans shard blocks (``DISTINCT_BLOCK`` per step): each step expands
+    its block's magnitudes from the bit planes and scatters into the
+    carried boolean presence arrays of size ``2^depth`` (positive and
+    negative offsets separately), so scratch stays per-block no matter
+    the field size.  Requires ``depth <= 24`` (a 16M-entry presence
+    array); the executor enforces the cap.
 
     plane: uint32[S, depth+2, W] -> (pos bool[2^depth], neg bool[2^depth]).
     """
     depth = depth_of(plane)
+    if depth <= DISTINCT_VALUE_DEPTH:
+        return _distinct_by_value(plane, filter_words)
+    size = 1 << depth
+    s, rows, w = plane.shape
+    block = min(DISTINCT_BLOCK, s)
+    pad = (-s) % block
+    if pad:
+        # zero shards: exists=0 -> every column maps to the dropped
+        # sentinel, so padding never adds presence
+        plane = jnp.concatenate(
+            [plane, jnp.zeros((pad, rows, w), plane.dtype)])
+        if filter_words is not None:
+            filter_words = jnp.concatenate(
+                [filter_words, jnp.zeros((pad, w), filter_words.dtype)])
+    n_blocks = plane.shape[0] // block
+    plane_blocks = plane.reshape(n_blocks, block, rows, w)
+    fw_blocks = (jnp.zeros((n_blocks, 0), plane.dtype)
+                 if filter_words is None
+                 else filter_words.reshape(n_blocks, block, w))
+
+    def expand(words: jax.Array) -> jax.Array:
+        # uint32[..., W] -> uint32[..., W*32] (column-major LSB-first)
+        bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        return bits.reshape(*words.shape[:-1], -1)
+
+    def step(carry, inputs):
+        pos, neg = carry
+        pl, fw = inputs
+        exists = not_null(pl, fw if fw.size else None)
+        sign = pl[..., SIGN_ROW, :] & exists
+        mag = pl[..., OFFSET_ROW:, :]
+        values = jnp.zeros((block, w * 32), dtype=jnp.uint32)
+        for b in range(depth):
+            values = values | (expand(mag[..., b, :]) << b)
+        exists_b = expand(exists).astype(bool)
+        sign_b = expand(sign).astype(bool)
+        # out-of-range sentinel drops non-participating columns
+        pos_idx = jnp.where(exists_b & ~sign_b, values, size)
+        neg_idx = jnp.where(exists_b & sign_b, values, size)
+        pos = pos.at[pos_idx.reshape(-1)].set(True, mode="drop")
+        neg = neg.at[neg_idx.reshape(-1)].set(True, mode="drop")
+        return (pos, neg), None
+
+    init = (jnp.zeros(size, bool), jnp.zeros(size, bool))
+    (pos, neg), _ = jax.lax.scan(step, init, (plane_blocks, fw_blocks))
+    return pos, neg
+
+
+def _distinct_by_value(plane: jax.Array,
+                       filter_words: jax.Array | None):
+    """Small-value-space Distinct: for each magnitude ``v`` the match
+    words are ``AND_b (bit_b(v) ? mag_b : ~mag_b) & exists`` — packed
+    32-cols-per-word algebra, scanned ``_DISTINCT_VALUE_BLOCK`` values
+    per step.  presence[v] = any match word nonzero, split by sign."""
+    depth = depth_of(plane)
+    size = 1 << depth
     exists = not_null(plane, filter_words)
     sign = plane[..., SIGN_ROW, :] & exists
     mag = plane[..., OFFSET_ROW:, :]
+    vb = min(_DISTINCT_VALUE_BLOCK, size)
+    vals = jnp.arange(size, dtype=jnp.uint32).reshape(-1, vb)
 
-    def expand(words: jax.Array) -> jax.Array:
-        # uint32[S, W] -> bool[S, W*32] (column-major LSB-first bits)
-        bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
-        return bits.reshape(*words.shape[:-1], -1).astype(jnp.uint32)
+    def step(_, block_vals):
+        m = jnp.broadcast_to(exists, (vb,) + exists.shape)
+        for b in range(depth):
+            pb = mag[..., b, :]
+            bit = ((block_vals >> b) & 1).astype(bool)
+            m = m & jnp.where(bit[:, None, None], pb, ~pb)
+        pos = jnp.any((m & ~sign).astype(bool), axis=(1, 2))
+        neg = jnp.any((m & sign).astype(bool), axis=(1, 2))
+        return None, (pos, neg)
 
-    values = jnp.zeros(exists.shape[:-1] + (exists.shape[-1] * 32,),
-                       dtype=jnp.uint32)
-    for b in range(depth):
-        values = values | (expand(mag[..., b, :]) << b)
-    exists_b = expand(exists).astype(bool)
-    sign_b = expand(sign).astype(bool)
-    size = 1 << depth
-    # out-of-range sentinel drops non-participating columns
-    pos_idx = jnp.where(exists_b & ~sign_b, values, size)
-    neg_idx = jnp.where(exists_b & sign_b, values, size)
-    pos = jnp.zeros(size, bool).at[pos_idx.reshape(-1)].set(True, mode="drop")
-    neg = jnp.zeros(size, bool).at[neg_idx.reshape(-1)].set(True, mode="drop")
-    return pos, neg
+    _, (pos, neg) = jax.lax.scan(step, None, vals)
+    return pos.reshape(-1), neg.reshape(-1)
 
 
 def min_max(
