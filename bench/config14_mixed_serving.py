@@ -181,8 +181,14 @@ def main():
 
     holder = Holder(data_dir).open()
     # scenario A budget: dense f (~3.7G) + BSI v (~1.1G) + sparse CSR +
-    # filter/rows planes all resident with room to spare
-    api = API(holder, Executor(holder, plane_budget=8 << 30))
+    # filter/rows planes all resident (~8.5 GB of a ~15.4 GB chip).
+    # Execution slots bound concurrent scratch: residency + slots ×
+    # per-query scratch must fit HBM (32 unbounded streams OOM'd every
+    # thread; 16 still did — ~0.5 GB scratch each).  The chip runs one
+    # program at a time, so few slots cost no device throughput.
+    slots = int(os.environ.get("PILOSA_BENCH_SLOTS", "6"))
+    api = API(holder, Executor(holder, plane_budget=8 << 30,
+                               max_concurrent=slots))
     results = {}
 
     # -- oracles (once) + warm every family's residency -----------------

@@ -36,6 +36,12 @@ class Config:
     query_timeout: float = 0.0         # seconds per query; 0 = unlimited
                                        # (?timeout= overrides per request)
     plane_budget_bytes: int = 4 << 30
+    # Queries EXECUTING at once; extras queue at the executor (bounds
+    # concurrent device scratch; 0 = off).  Size against HBM headroom:
+    # resident planes (plane_budget_bytes) + slots × ~0.5 GB scratch
+    # must fit the chip — at an 8 GB budget on a 16 GB chip, 16 slots
+    # measurably OOM'd and 6 served cleanly (bench/config14 r5).
+    max_concurrent_queries: int = 8
     max_map_count: int = 32768          # live snapshot mmaps before LRU
                                         # heap demotion (syswrap parity)
     grpc_bind: str = ""                 # host:port; "" disables gRPC

@@ -87,10 +87,17 @@ _SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
 
 
 def _is_device_oom(e: Exception) -> bool:
-    """XLA device-memory exhaustion, by message: jax wraps it as
-    XlaRuntimeError/JaxRuntimeError with a RESOURCE_EXHAUSTED status."""
+    """XLA device-memory exhaustion, by status string.  jax wraps the
+    status as XlaRuntimeError/JaxRuntimeError on direct dispatch, but
+    an async execution that fails on device surfaces at the host READ
+    as a plain ValueError carrying the same RESOURCE_EXHAUSTED text
+    (axon backend under 32-way concurrency, config14 r5).  The type
+    gate stays: an ExecutionError merely QUOTING user input (e.g. PQL
+    ``RESOURCE_EXHAUSTED()``) must not trigger a cache-dropping
+    recovery."""
     return ("RESOURCE_EXHAUSTED" in str(e)
-            and type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"))
+            and type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError",
+                                     "ValueError"))
 
 
 def _lex_gt(mat: np.ndarray, prev: tuple) -> np.ndarray:
@@ -131,11 +138,14 @@ class _Ctx:
 class Executor:
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
                  place=None, plane_budget: int | None = None, placement=None,
-                 stats=None, tracer=None, count_batch_window: float = 0.0):
+                 stats=None, tracer=None, count_batch_window: float = 0.0,
+                 max_concurrent: int = 8):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
-        default device."""
+        default device.  ``max_concurrent`` bounds simultaneously
+        EXECUTING queries (scratch admission; 0 disables) — excess
+        clients queue at the executor, not in device memory."""
         self.holder = holder
         self.translate = translate or TranslateStore(holder.path)
         self.placement = placement
@@ -166,6 +176,8 @@ class Executor:
         # in-flight count and starving the drain forever
         self._recovery_open = threading.Event()
         self._recovery_open.set()
+        self._exec_slots = (threading.BoundedSemaphore(max_concurrent)
+                            if max_concurrent else None)
 
     # -- in-flight accounting (OOM recovery) --------------------------------
 
@@ -219,15 +231,31 @@ class Executor:
         # subtrees — shares the outer query's lease set and in-flight
         # slot): register for OOM-recovery coordination
         depth = getattr(self._tls, "depth", 0)
-        self._tls.depth = depth + 1
         if depth == 0:
+            # bounded concurrency FIRST: each executing query holds
+            # live device scratch (program temps, per-query outputs);
+            # with residency near budget, unbounded client threads
+            # multiply scratch past HBM headroom (32 streams OOM'd
+            # every thread at 8.5 GB resident, config14 r5).  Queries
+            # queue here — the chip serializes execution anyway, so a
+            # bounded pool costs no throughput.  Timed: a wedged
+            # recovery holding every slot must not refuse service
+            # silently forever
+            if self._exec_slots is not None:
+                if not self._exec_slots.acquire(timeout=180.0):
+                    raise ExecutionError(
+                        "executor at max concurrent queries for 180s; "
+                        "retry later")
             # park while a stage-2 OOM recovery drains to exclusivity —
             # without this, steady arrivals keep the in-flight count
-            # above 1 and the drain can never finish.  Bounded: a
-            # wedged recovery must not refuse service forever
+            # above 1 and the drain can never finish.  AFTER the slot:
+            # a thread that waited out a long acquire must still honor
+            # a recovery that started meanwhile.  Bounded: a wedged
+            # recovery must not refuse service forever
             self._recovery_open.wait(timeout=180.0)
             self._enter_inflight()
             self.planes.begin_query()
+        self._tls.depth = depth + 1
         try:
             return self._execute_calls(index, index_name, query, shards,
                                        translate_output, tracer, deadline)
@@ -236,6 +264,8 @@ class Executor:
             if depth == 0:
                 self.planes.end_query()
                 self._leave_inflight()
+                if self._exec_slots is not None:
+                    self._exec_slots.release()
 
     def _execute_calls(self, index, index_name: str, query: Query,
                        shards, translate_output: bool, tracer,

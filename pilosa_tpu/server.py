@@ -55,7 +55,8 @@ class PilosaTPUServer:
         self.executor = Executor(
             self.holder, placement=placement, stats=self.stats,
             plane_budget=self.cfg.plane_budget_bytes,
-            count_batch_window=self.cfg.count_batch_window)
+            count_batch_window=self.cfg.count_batch_window,
+            max_concurrent=self.cfg.max_concurrent_queries)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout)
         from pilosa_tpu.api import tls as tlsmod
