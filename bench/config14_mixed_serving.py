@@ -46,6 +46,44 @@ PQL_GB = "GroupBy(Rows(f, limit=4), Rows(f, previous=3, limit=4))"
 PQL_SPARSE = "TopN(tags, n=5, filter=Row(f=0))"
 
 
+def probe_free_hbm(limit_gb: float) -> float:
+    """Allocate-then-free device probe: how much HBM is grabbable right
+    now, up to ``limit_gb`` (the chip is time-shared; see await_hbm)."""
+    import gc
+
+    import jax
+
+    held, got = [], 0.0
+    try:
+        while got < limit_gb:
+            held.append(jax.device_put(
+                np.zeros((512, 1 << 20), np.uint8)))
+            held[-1].block_until_ready()
+            got += 0.5
+    except Exception:  # noqa: BLE001 — RESOURCE_EXHAUSTED probe edge
+        pass
+    del held
+    gc.collect()
+    return got
+
+
+def await_hbm(need_gb: float, attempts: int = 20, wait: float = 60.0):
+    """Free-HBM gate: the tunneled chip is time-shared — measured free
+    memory swung 16.4 GB → <4.5 GB → 16.4 GB within an hour (r5).  A
+    run that starts into a low window wastes 20 minutes and dies; probe
+    until the window is big enough."""
+    for attempt in range(attempts):
+        got = probe_free_hbm(need_gb)
+        if got >= need_gb:
+            log(f"HBM gate: >= {need_gb:.0f} GB free (attempt "
+                f"{attempt + 1})")
+            return
+        log(f"HBM gate: only ~{got:.1f} GB free (need {need_gb:.0f}); "
+            f"waiting {wait:.0f}s")
+        time.sleep(wait)
+    raise SystemExit(f"chip never had {need_gb} GB free")
+
+
 def build_deck():
     """One client's work unit: weighted toward the cheap/common ops the
     way real traffic is, but every family present."""
@@ -83,6 +121,11 @@ def run_mixed(api, deck, oracles, n_threads, iters=1):
                             f"{fam} diverged under contention: "
                             f"{str(got)[:80]} != {str(want)[:80]}")
         except Exception as e:  # noqa: BLE001
+            if not errors:
+                import traceback
+                log(f"FIRST ERROR in {fam}:\n"
+                    + traceback.format_exc()[-1800:])
+                log(f"free HBM at failure: ~{probe_free_hbm(4.0):.1f} GB")
             errors.append((tid, e))
 
     threads = [threading.Thread(target=worker, args=(t,))
@@ -173,11 +216,27 @@ def main():
 
     platform = jax.devices()[0].platform
     rng = np.random.default_rng(42)
-    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, 32768),
+
+    def gen_plane():
+        p = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, 32768),
                          dtype=np.uint32)
-    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
-    data_dir = tempfile.mkdtemp(prefix="pilosa_mix_")
-    sparse = build_index(data_dir, plane, rng)
+        p &= rng.integers(0, 1 << 32, size=p.shape, dtype=np.uint32)
+        return p
+
+    plane = None  # ~8 GB of rng work: generated only on cache misses
+    data_dir = os.environ.get("PILOSA_BENCH_DATADIR")
+    if data_dir and os.path.isdir(os.path.join(data_dir, INDEX)):
+        log(f"reusing prebuilt index at {data_dir}")
+        import pickle
+        with open(os.path.join(data_dir, "sparse.pkl"), "rb") as fh:
+            sparse = pickle.load(fh)
+    else:
+        data_dir = data_dir or tempfile.mkdtemp(prefix="pilosa_mix_")
+        plane = gen_plane()
+        sparse = build_index(data_dir, plane, rng)
+        import pickle
+        with open(os.path.join(data_dir, "sparse.pkl"), "wb") as fh:
+            pickle.dump(sparse, fh)
 
     holder = Holder(data_dir).open()
     # scenario A budget: dense f (~3.7G) + BSI v (~1.1G) + sparse CSR +
@@ -192,31 +251,46 @@ def main():
     results = {}
 
     # -- oracles (once) + warm every family's residency -----------------
-    log("computing oracles...")
-    want_counts = [int(c) for c in oracle_counts(plane)]
-    want_ftop = [{"id": r, "count": c}
-                 for r, c in oracle_filtered_topn(plane, 0, 8)]
-    want_sum, want_cnt, want_gt50 = oracle_bsi()
-    want_gb = oracle_groupby(plane, range(4), range(4, 8))
-    want_stop = [{"id": r, "count": c}
-                 for r, c in oracle_sparse_topn(plane, sparse, 0, 5)]
+    import pickle
+    ocache = os.path.join(data_dir, "oracles.pkl")
+    if os.path.exists(ocache):
+        log("reusing cached oracles")
+        with open(ocache, "rb") as fh:
+            (want_counts, want_ftop, want_sum, want_cnt, want_gt50,
+             want_gb, want_stop) = pickle.load(fh)
+    else:
+        log("computing oracles (~25 min at this host's memcpy)...")
+        if plane is None:
+            plane = gen_plane()
+        want_counts = [int(c) for c in oracle_counts(plane)]
+        want_ftop = [{"id": r, "count": c}
+                     for r, c in oracle_filtered_topn(plane, 0, 8)]
+        want_sum, want_cnt, want_gt50 = oracle_bsi()
+        want_gb = oracle_groupby(plane, range(4), range(4, 8))
+        want_stop = [{"id": r, "count": c}
+                     for r, c in oracle_sparse_topn(plane, sparse, 0, 5)]
+        with open(ocache, "wb") as fh:
+            pickle.dump((want_counts, want_ftop, want_sum, want_cnt,
+                         want_gt50, want_gb, want_stop), fh)
     pql32 = "".join(f"Count(Row(f={r}))" for r in range(N_ROWS))
 
+    from bench.config16_families2 import warm_query
+
+    await_hbm(12.0)
     t0 = time.perf_counter()
-    assert api.query(INDEX, pql32)["results"] == want_counts
+    assert warm_query(api, pql32) == want_counts
     log(f"warm count32 (dense plane build): {time.perf_counter() - t0:.1f}s")
-    assert api.query(INDEX, "TopN(f, n=8, filter=Row(f=0))")["results"] \
-        == [want_ftop]
-    assert api.query(INDEX, "Sum(field=v)")["results"] == \
+    assert warm_query(api, "TopN(f, n=8, filter=Row(f=0))") == [want_ftop]
+    assert warm_query(api, "Sum(field=v)") == \
         [{"value": want_sum, "count": want_cnt}]
-    assert api.query(INDEX, "Count(Row(v > 50))")["results"] == [want_gt50]
-    got_gb = api.query(INDEX, PQL_GB)["results"][0]
+    assert warm_query(api, "Count(Row(v > 50))") == [want_gt50]
+    got_gb = warm_query(api, PQL_GB)[0]
     want_gb_json = got_gb  # shape-checked below against the oracle map
     got_map = {(g["group"][0]["rowID"], g["group"][1]["rowID"]): g["count"]
                for g in got_gb}
     assert got_map == {k: v for k, v in want_gb.items() if v}, "GroupBy"
     t0 = time.perf_counter()
-    assert api.query(INDEX, PQL_SPARSE)["results"] == [want_stop]
+    assert warm_query(api, PQL_SPARSE) == [want_stop]
     log(f"warm sparse (CSR build): {time.perf_counter() - t0:.1f}s")
     log(f"residency after warm: {api.executor.planes.stats()}")
 
@@ -236,11 +310,26 @@ def main():
         + f"; serial deck = {deck_serial_s:.2f}s")
 
     # -- the measurement: N_THREADS concurrent mixed decks --------------
-    wall, samples, errors = run_mixed(api, deck, oracles, N_THREADS)
-    if errors:
-        for tid, e in errors[:5]:
+    # the burst races the chip's co-tenant (free HBM swings ~7 GB on
+    # minute timescales): gate on headroom, and on an all-OOM burst
+    # re-gate, re-warm evicted planes, and retry
+    for attempt in range(3):
+        await_hbm(13.0)
+        if attempt:
+            for fam, pql in dict(deck).items():
+                warm_query(api, pql)
+        wall, samples, errors = run_mixed(api, deck, oracles, N_THREADS)
+        if not errors:
+            break
+        all_oom = all("RESOURCE_EXHAUSTED" in repr(e)
+                      for _, e in errors)
+        for tid, e in errors[:3]:
             log(f"thread {tid} FAILED: {e!r}")
-        raise SystemExit(f"{len(errors)} of {N_THREADS} threads errored")
+        if not all_oom or attempt == 2:
+            raise SystemExit(
+                f"{len(errors)} of {N_THREADS} threads errored")
+        log(f"burst hit a low-HBM window (attempt {attempt + 1}/3); "
+            "re-gating and retrying")
     qps = len(samples) / wall
     fam_stats = pctiles(samples)
     results["mixed"] = {"threads": N_THREADS, "queries": len(samples),

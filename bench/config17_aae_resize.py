@@ -96,19 +96,30 @@ def main():
 
     with run_cluster(2, td, replicas=2, anti_entropy=0.0) as tc:
         c = tc.client(0)
+        # first count builds the 4 GB host plane at ~110 MB/s memcpy —
+        # far past the default 60 s client timeout
+        c.timeout = 900.0
         assert c.query(INDEX, pql32) == want_counts
         node0 = tc.servers[0].cluster
 
-        # -- 1. no-op AAE round ----------------------------------------
+        # -- 1. no-op AAE rounds: cold (checksum everything) then warm
+        # (generation-cached — the steady-state sweep cost) -------------
         t0 = time.perf_counter()
         repaired = node0.sync_once()
         noop_s = time.perf_counter() - t0
         assert repaired == 0, f"clean replicas repaired {repaired}"
+        t0 = time.perf_counter()
+        assert node0.sync_once() == 0
+        noop_warm_s = time.perf_counter() - t0
         results["aae_noop"] = dict(
-            s=round(noop_s, 1), fragments=N_SHARDS,
-            ms_per_fragment=round(noop_s / N_SHARDS * 1e3, 2))
-        log(f"no-op AAE round ({N_SHARDS} fragments x 1 peer): "
-            f"{noop_s:.1f}s = {noop_s / N_SHARDS * 1e3:.1f} ms/fragment")
+            cold_s=round(noop_s, 1), warm_s=round(noop_warm_s, 2),
+            fragments=N_SHARDS,
+            cold_ms_per_fragment=round(noop_s / N_SHARDS * 1e3, 2),
+            warm_ms_per_fragment=round(noop_warm_s / N_SHARDS * 1e3, 2))
+        log(f"no-op AAE round ({N_SHARDS} fragments x 1 peer): cold "
+            f"{noop_s:.1f}s ({noop_s / N_SHARDS * 1e3:.0f} ms/frag), "
+            f"warm {noop_warm_s:.2f}s "
+            f"({noop_warm_s / N_SHARDS * 1e3:.1f} ms/frag)")
 
         # -- 2. serving impact during AAE ------------------------------
         def qps_for(seconds: float) -> float:
@@ -138,7 +149,8 @@ def main():
             f"{during_qps:,.0f} ({during_qps / idle_qps:.2f}x)")
 
         # -- 3. repair round -------------------------------------------
-        dirty = rng.choice(N_SHARDS, size=DIRTY, replace=False)
+        dirty = rng.choice(N_SHARDS, size=min(DIRTY, N_SHARDS // 2),
+                           replace=False)
         holder1 = tc.servers[1].api.holder
         idx1 = holder1.index(INDEX)
         f1 = idx1.field("f")
@@ -148,23 +160,26 @@ def main():
             if frag is not None:
                 frag.close()
             os.remove(frag_path(td, 1, int(s)))
-        moved = DIRTY * frag_bytes // N_SHARDS
+        n_dirty = len(dirty)
+        moved = n_dirty * frag_bytes // N_SHARDS
         t0 = time.perf_counter()
         repaired = node0.sync_once()
         repair_s = time.perf_counter() - t0
         assert repaired > 0, "dirty replicas repaired nothing"
+        stream_s = max(repair_s - noop_warm_s, 1e-3)
         results["aae_repair"] = dict(
-            s=round(repair_s, 1), dirty_fragments=DIRTY,
+            s=round(repair_s, 1), dirty_fragments=n_dirty,
             blocks=repaired, mb_streamed=round(moved / 2**20, 1),
-            mb_per_s=round(moved / 2**20 / max(repair_s - noop_s, 1e-9), 1))
-        log(f"repair round ({DIRTY} missing fragments, {repaired} "
-            f"blocks): {repair_s:.1f}s — "
-            f"~{moved / 2**20 / max(repair_s - noop_s, 1e-9):.0f} MB/s "
-            "stream (above the no-op sweep)")
-        for s in dirty[:4]:  # byte-identical convergence spot check
-            with open(frag_path(td, 0, int(s)), "rb") as fa, \
-                    open(frag_path(td, 1, int(s)), "rb") as fb:
-                assert fa.read() == fb.read(), f"shard {s} diverged"
+            mb_per_s=round(moved / 2**20 / stream_s, 1))
+        log(f"repair round ({n_dirty} missing fragments, {repaired} "
+            f"blocks): {repair_s:.1f}s — ~{moved / 2**20 / stream_s:.0f} "
+            "MB/s stream (above the warm sweep)")
+        view0 = tc.servers[0].api.holder.index(INDEX).field("f") \
+            .views["standard"]
+        for s in dirty[:4]:  # logical convergence spot check
+            pa = view0.fragment(int(s)).positions()
+            pb = f1.view("standard").fragment(int(s)).positions()
+            assert np.array_equal(pa, pb), f"shard {s} diverged"
         assert c.query(INDEX, pql32) == want_counts
 
         # -- 4. node-add resize ----------------------------------------
@@ -222,7 +237,7 @@ def main():
     shutil.rmtree(td, ignore_errors=True)
     print(json.dumps({
         "metric": "aae_noop_round_s_954_shards_cpu",
-        "value": results["aae_noop"]["s"], "unit": "s",
+        "value": results["aae_noop"]["cold_s"], "unit": "s",
         "vs_baseline": 1.0, "detail": results}))
 
 

@@ -64,6 +64,14 @@ class Cluster:
         from pilosa_tpu.cli.config import client_ssl_of
         self._client_ssl_ctx = client_ssl_of(cfg)
         self.state = STATE_STARTING
+        # ACTIVE placement topology: the node set shard_owners routes
+        # by.  Joins/removals change MEMBERSHIP immediately but the
+        # placement only advances when a resize job has finished
+        # streaming fragments for the new set — otherwise a joining
+        # node instantly "owns" shards whose data hasn't arrived and
+        # queries silently undercount (config17 r5).
+        self.placement_ids: list[str] = [self.node_id]
+        self._load_placement()
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
         # index -> (fetched_at, shards, incomplete): `incomplete` rides
@@ -80,6 +88,39 @@ class Cluster:
         self._resize_abort = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+
+    # -- placement persistence ----------------------------------------------
+
+    def _placement_path(self) -> str:
+        import os
+        return os.path.join(self.api.holder.path, "_cluster.json")
+
+    def _load_placement(self) -> None:
+        """Last activated topology survives restarts: a coordinator
+        that cold-restarts alone must NOT serve with placement=[self]
+        (it would silently route every shard to itself and undercount)
+        — it keeps routing by the persisted topology, failing loudly
+        for shards whose owners haven't rejoined yet."""
+        import json as _json
+        import os
+        try:
+            with open(self._placement_path()) as f:
+                saved = _json.load(f).get("placement") or []
+        except (OSError, ValueError):
+            return
+        if saved and self.node_id in saved:
+            self.placement_ids = sorted(saved)
+
+    def _save_placement(self) -> None:
+        import json as _json
+        try:
+            tmp = self._placement_path() + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump({"placement": self.placement_ids}, f)
+            import os
+            os.replace(tmp, self._placement_path())
+        except OSError as e:
+            self.logger.warning("placement persist failed: %s", e)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -98,6 +139,9 @@ class Cluster:
                     for nid in self.nodes:
                         self._last_seen.setdefault(nid, now)
                     self.state = resp.get("state", STATE_NORMAL)
+                    self.placement_ids = sorted(
+                        resp.get("placement") or self.nodes)
+                    self._save_placement()
                 for t in resp.get("schemaTombstones", []):
                     self.record_schema_tombstone(t["index"], t.get("field"),
                                                  t.get("ts", 0.0))
@@ -194,6 +238,7 @@ class Cluster:
             tombs = [{"index": i, "field": f, "ts": ts}
                      for (i, f), ts in self._schema_tombstones.items()]
         return {"nodes": list(self.nodes.values()), "state": self.state,
+                "placement": list(self.placement_ids),
                 "schema": self.api.schema(), "schemaTombstones": tombs}
 
     def handle_heartbeat(self, node_id: str, state: str) -> dict:
@@ -229,9 +274,13 @@ class Cluster:
                 self.nodes[n["id"]] = n
                 self._last_seen.setdefault(n["id"], now)
             self.state = payload["state"]
+            if payload.get("placement"):
+                self.placement_ids = sorted(payload["placement"])
+                self._save_placement()
 
     def _broadcast_status(self, cleared: list[str] | None = None) -> None:
         payload = {"nodes": list(self.nodes.values()), "state": self.state,
+                   "placement": list(self.placement_ids),
                    "ts": time.time()}
         if cleared:
             payload["cleared"] = cleared
@@ -341,11 +390,14 @@ class Cluster:
     # -- placement / routing -------------------------------------------------
 
     def shard_owners(self, index: str, shard: int) -> list[str]:
-        """Replica owner node ids, primary first.  Placement uses the
-        full member list (stability); callers fail over with
+        """Replica owner node ids, primary first — computed over the
+        ACTIVE placement topology (NOT raw membership: a just-joined
+        node owns nothing until its resize finishes and the new
+        topology is activated + broadcast).  Callers fail over with
         ``alive_ids``."""
-        return shard_nodes(index, shard, self.member_ids(),
-                           self.cfg.replicas)
+        with self._lock:
+            plist = list(self.placement_ids)
+        return shard_nodes(index, shard, plist, self.cfg.replicas)
 
     def group_shards_by_node(self, index: str,
                              shards: tuple[int, ...]) -> dict[str, tuple]:
@@ -620,6 +672,15 @@ class Cluster:
                     for shard, frag in list(v.fragments.items()):
                         owners = self.shard_owners(iname, shard)
                         if self.node_id not in owners:
+                            # ORPHAN: we hold a fragment the active
+                            # topology doesn't assign us (e.g. a Set
+                            # that landed here mid-resize, just before
+                            # the placement flipped — r5 review).  Hand
+                            # the bits to every alive owner, then drop
+                            # our copy so the handoff is one-time.
+                            repaired += self._handoff_orphan(
+                                iname, fname, vname, shard, frag, v,
+                                owners)
                             continue
                         for peer in owners:
                             if peer == self.node_id:
@@ -631,6 +692,38 @@ class Cluster:
             self.logger.info("anti-entropy repaired %d blocks", repaired)
             self.stats.count("aae_blocks_repaired", repaired)
         return repaired
+
+    def _handoff_orphan(self, index: str, field: str, view: str,
+                        shard: int, frag, view_obj, owners) -> int:
+        """Union-merge an un-owned local fragment into EVERY alive
+        owner, then delete the local copy (only if all owners took it —
+        a failed push keeps the orphan for the next round)."""
+        import os
+        if self.state != STATE_NORMAL:
+            return 0  # mid-resize: the job itself is moving fragments
+        if not frag.row_ids():
+            return 0
+        alive = set(self.alive_ids())
+        if not all(o in alive for o in owners):
+            return 0  # can't guarantee full handoff; retry next round
+        try:
+            for dest in owners:
+                self.push_fragment(index, field, view, shard, dest)
+        except Exception as e:  # noqa: BLE001 — keep orphan, retry
+            self.logger.warning("orphan handoff %s/%s/%s/%d: %s",
+                                index, field, view, shard, e)
+            return 0
+        view_obj.fragments.pop(shard, None)
+        path = frag.path
+        frag.close()
+        for suffix in ("", ".oplog"):
+            try:
+                os.remove(path + suffix)
+            except OSError:
+                pass
+        self.logger.info("orphan fragment %s/%s/%s/%d handed to %s",
+                         index, field, view, shard, owners)
+        return 1
 
     def _sync_attrs(self) -> int:
         """AAE for attribute stores (reference: AttrStore block sync,
@@ -682,11 +775,21 @@ class Cluster:
 
     def _sync_fragment(self, peer: str, index: str, field: str, view: str,
                        shard: int, frag) -> int:
+        from pilosa_tpu.api.client import ClientError
         from pilosa_tpu.store import roaring
         qs = f"index={index}&field={field}&view={view}&shard={shard}"
         try:
             theirs = self._client(peer)._json(
                 "GET", f"/internal/fragment/blocks?{qs}")["blocks"]
+        except ClientError as e:
+            if e.status == 404:
+                # peer lost the whole fragment (or never had it): that
+                # is maximal divergence, not "peer down" — diff against
+                # empty so every block streams over (config17 r5: the
+                # swallowed 404 left deleted replicas unrepaired)
+                theirs = {}
+            else:
+                return 0  # transport trouble; next round
         except Exception:  # noqa: BLE001 — peer down; next round
             return 0
         theirs = {int(k): v for k, v in theirs.items()}
@@ -696,9 +799,11 @@ class Cluster:
         repaired = 0
         for block in sorted(diff):
             try:
-                blob = self._client(peer)._do(
-                    "GET", f"/internal/fragment/data?{qs}&block={block}")
-                frag.merge_positions(roaring.deserialize(blob))
+                if block in theirs:
+                    blob = self._client(peer)._do(
+                        "GET",
+                        f"/internal/fragment/data?{qs}&block={block}")
+                    frag.merge_positions(roaring.deserialize(blob))
                 mine = roaring.serialize(frag.block_positions(block))
                 self._client(peer)._do(
                     "POST", f"/internal/fragment/merge?{qs}", mine,
@@ -773,7 +878,9 @@ class Cluster:
     def _resize_once(self) -> None:
         with self._lock:
             self.state = STATE_RESIZING
+            target = self.member_ids()
         self._broadcast_status()
+        completed = False
         try:
             inventory: dict[tuple, list[str]] = {}
             for nid in self.alive_ids():
@@ -796,7 +903,8 @@ class Cluster:
                         "resize aborted after %d copies (superseded)",
                         moved)
                     return
-                owners = self.shard_owners(index, shard)
+                owners = shard_nodes(index, shard, target,
+                                     self.cfg.replicas)
                 for dest in owners:
                     if dest in holders:
                         continue
@@ -818,9 +926,16 @@ class Cluster:
                                             dest, e)
             self.logger.info("resize complete: %d fragment copies moved",
                              moved)
+            completed = True
         finally:
             with self._lock:
                 self.state = STATE_NORMAL
+                if completed:
+                    # every copy for the target topology is streamed:
+                    # activate it (and broadcast) so reads start
+                    # routing to the new owners
+                    self.placement_ids = list(target)
+                    self._save_placement()
             self._broadcast_status()
 
     def _local_inventory(self) -> list[dict]:

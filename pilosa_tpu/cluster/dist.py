@@ -381,6 +381,16 @@ class DistributedExecutor:
 
     def _write(self, index: str, call: Call):
         from pilosa_tpu.engine.words import SHARD_WIDTH
+        if (_call_of(call).name in ("Clear", "ClearRow", "Store")
+                and self.cluster.state == "RESIZING"):
+            # a clear routed to the OLD owners while their fragments
+            # stream to the incoming topology would be resurrected the
+            # moment the new placement activates; refuse loudly until
+            # the resize lands (Set stays allowed — union-merge AAE
+            # repairs additive divergence)
+            raise ExecutionError(
+                f"{_call_of(call).name} refused during cluster resize; "
+                "retry when the cluster returns to NORMAL")
         # Set/Store create missing keys; Clear/ClearRow must not
         create = _call_of(call).name in ("Set", "Store")
         call = self._translate_input(index, call, create=create)

@@ -658,22 +658,35 @@ class Fragment:
     def blocks(self) -> dict[int, int]:
         """Per-block checksums: block = ``row_id // HASH_BLOCK_SIZE``;
         checksum = crc32 over the block's sorted positions (reference:
-        ``fragment.Blocks``, SURVEY.md §4.6)."""
-        out: dict[int, int] = {}
+        ``fragment.Blocks``, SURVEY.md §4.6).
+
+        Generation-cached: decoding every position of a dense fragment
+        is ~0.9 s on the bench host (config17 r5 — a no-op AAE round at
+        954 fragments cost 14 minutes, recomputed on BOTH ends).  An
+        unchanged fragment answers from the cache, so steady-state
+        sweeps only pay for fragments that actually mutated."""
         with self.lock:
+            cached = getattr(self, "_blocks_cache", None)
+            if cached is not None and cached[0] == self.generation:
+                return cached[1]
+            gen = self.generation
             # one vectorized pass over positions() (snapshot rows decode
             # from the blob — no RowBits materialization, so AAE stays
             # cheap on multi-million-row sparse fragments)
             pos = self.positions()
-        if not len(pos):
-            return out
-        blocks = (pos // _SW // np.uint64(HASH_BLOCK_SIZE)).astype(np.int64)
-        uniq, starts = np.unique(blocks, return_index=True)
-        bounds = np.append(starts, len(pos))
-        data = pos.astype("<u8")
-        for i, blk in enumerate(uniq):
-            out[int(blk)] = zlib.crc32(
-                data[bounds[i]:bounds[i + 1]].tobytes())
+        out: dict[int, int] = {}
+        if len(pos):
+            blocks = (pos // _SW
+                      // np.uint64(HASH_BLOCK_SIZE)).astype(np.int64)
+            uniq, starts = np.unique(blocks, return_index=True)
+            bounds = np.append(starts, len(pos))
+            data = pos.astype("<u8")
+            for i, blk in enumerate(uniq):
+                out[int(blk)] = zlib.crc32(
+                    data[bounds[i]:bounds[i + 1]].tobytes())
+        with self.lock:
+            if self.generation == gen:
+                self._blocks_cache = (gen, out)
         return out
 
     def block_positions(self, block: int) -> np.ndarray:

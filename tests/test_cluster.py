@@ -763,11 +763,19 @@ class TestResize:
             try:
                 c.servers.append(late)
                 c.await_membership(2)
+                # placement is VERSIONED (r5): the join changes
+                # membership at once, but shard_owners only routes to
+                # the late node after its resize completes and the new
+                # topology activates — poll for that
+                import time
                 moved = []
-                for s in range(8):
-                    owners = late.cluster.shard_owners("i", s)
-                    if late.cluster.node_id in owners:
-                        moved.append(s)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and not moved:
+                    moved = [s for s in range(8)
+                             if late.cluster.node_id
+                             in late.cluster.shard_owners("i", s)]
+                    if not moved:
+                        time.sleep(0.05)
                 assert moved, "placement should assign some shards to node 2"
 
                 def migrated() -> bool:
@@ -1437,3 +1445,37 @@ class TestBatchedReadFanout:
                 assert got == want, (
                     f"node {ci} diverged: {str(got)[:120]} != "
                     f"{str(want)[:120]}")
+
+
+class TestAaeRepairsMissingFragment:
+    def test_deleted_replica_fragment_restreams(self, tmp_path):
+        """A replica that LOST a whole fragment (disk wipe, partial
+        restore) must get it back from AAE: the peer's 404 means
+        maximal divergence, not 'peer down' (config17 r5 — the
+        swallowed 404 left deleted replicas unrepaired forever)."""
+        import os
+
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path), replicas=2) as tc:
+            c = tc.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.import_bits("i", "f", rowIDs=[1] * 50,
+                          columnIDs=list(range(50)))
+            # drop shard 0 entirely on node 1
+            holder1 = tc.servers[1].api.holder
+            view1 = holder1.index("i").field("f").views["standard"]
+            frag = view1.fragments.pop(0, None)
+            path = frag.path
+            frag.close()
+            for suffix in ("", ".oplog"):
+                try:
+                    os.remove(path + suffix)
+                except OSError:
+                    pass
+            repaired = tc.servers[0].cluster.sync_once()
+            assert repaired > 0
+            restored = view1.fragment(0)
+            assert restored is not None
+            assert restored.row(1).cardinality == 50
