@@ -314,7 +314,11 @@ def main():
     # minute timescales): gate on headroom, and on an all-OOM burst
     # re-gate, re-warm evicted planes, and retry
     for attempt in range(3):
-        await_hbm(13.0)
+        # headroom gate, not total: on attempt 0 this process already
+        # holds ~8.5 GB of planes and the burst needs ~3.5 GB of
+        # scratch; after an all-OOM burst the recovery EVICTED those
+        # planes, so a retry must re-warm ~8.5 GB + scratch
+        await_hbm(3.5 if attempt == 0 else 12.0)
         if attempt:
             for fam, pql in dict(deck).items():
                 warm_query(api, pql)

@@ -94,12 +94,18 @@ def main():
     log(f"two byte-identical {frag_bytes / 1e9:.2f} GB node trees: "
         f"{time.perf_counter() - t0:.1f}s")
 
+    def cquery(client, pql):
+        """Query with a 900s deadline SHIPPED in the request: cold
+        planes take minutes to build on this host, and the internode
+        fan-out leg derives its socket timeout from the shipped budget
+        (without it, remote legs cap at the 60s client default)."""
+        return client._do(
+            "POST", f"/index/{INDEX}/query?timeout=900",
+            pql.encode(), timeout=900.0)["results"]
+
     with run_cluster(2, td, replicas=2, anti_entropy=0.0) as tc:
         c = tc.client(0)
-        # first count builds the 4 GB host plane at ~110 MB/s memcpy —
-        # far past the default 60 s client timeout
-        c.timeout = 900.0
-        assert c.query(INDEX, pql32) == want_counts
+        assert cquery(c, pql32) == want_counts
         node0 = tc.servers[0].cluster
 
         # -- 1. no-op AAE rounds: cold (checksum everything) then warm
@@ -127,7 +133,7 @@ def main():
             done = [0] * 8
             def worker(i):
                 while time.monotonic() < stop:
-                    assert c.query(INDEX, pql32) == want_counts
+                    assert cquery(c, pql32) == want_counts
                     done[i] += 1
             ts = [threading.Thread(target=worker, args=(i,))
                   for i in range(8)]
@@ -180,7 +186,7 @@ def main():
             pa = view0.fragment(int(s)).positions()
             pb = f1.view("standard").fragment(int(s)).positions()
             assert np.array_equal(pa, pb), f"shard {s} diverged"
-        assert c.query(INDEX, pql32) == want_counts
+        assert cquery(c, pql32) == want_counts
 
         # -- 4. node-add resize ----------------------------------------
         from pilosa_tpu.cli.config import Config
@@ -193,7 +199,7 @@ def main():
         def poll_queries():
             while not stop_poll.is_set():
                 try:
-                    if c.query(INDEX, pql32) != want_counts:
+                    if cquery(c, pql32) != want_counts:
                         err.append("wrong counts mid-resize")
                 except Exception as e:  # noqa: BLE001
                     err.append(repr(e))
@@ -229,7 +235,7 @@ def main():
                 f"({moved_mb:.0f} MB) to the new node = "
                 f"{moved_mb / resize_s:.0f} MB/s; {polls[0]} correct "
                 "32-Count queries served during")
-            assert c.query(INDEX, pql32) == want_counts
+            assert cquery(c, pql32) == want_counts
         finally:
             stop_poll.set()
             srv2.close()

@@ -157,7 +157,7 @@ def test_soak_serving_ingest_aae(tmp_path):
 
         # -- quiescent exact oracle ------------------------------------
         time.sleep(3.0)  # let AAE + compaction settle
-        (n,) = c.query("i", "Count(Union(" + "".join(
+        (n,) = c.query("i", "Count(Union(" + ", ".join(
             f"Row(f={r})" for r in range(N_ROWS)) + "))")
         # total_bits counts (row, col) pairs; union counts distinct
         # cols — compare pair total via per-row counts instead
