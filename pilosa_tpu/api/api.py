@@ -71,6 +71,7 @@ class API:
         except KeyError:
             raise ApiError(f"index {name!r} not found", 404)
         self.executor.planes.invalidate(name)
+        self.executor.invalidate_plans(name)
         # the index dir (incl. _keys/) is gone; cached logs must go too
         self.executor.translate.drop(name)
         if self.cluster is not None and not direct:
@@ -95,6 +96,7 @@ class API:
         except KeyError:
             raise ApiError(f"field {name!r} not found", 404)
         self.executor.planes.invalidate(index)
+        self.executor.invalidate_plans(index)
         # field delete leaves <index>/_keys/<field>.keys behind: remove
         # it so a recreated field starts with fresh key state
         self.executor.translate.drop(index, name, remove_files=True)
@@ -440,6 +442,7 @@ class API:
         self.holder.close()
         self.holder.open()
         self.executor.planes.invalidate()
+        self.executor.invalidate_plans()
         self.executor.translate.close()
 
     # -- introspection ------------------------------------------------------
@@ -459,7 +462,12 @@ class API:
                 "devices": devices,
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
-                "planeCache": self.executor.planes.stats()}
+                "planeCache": self.executor.planes.stats(),
+                # per-stage overhead attribution (parse/plan/admit/
+                # dispatch/read/assemble) — the diagnostics dump behind
+                # bench/config18's concurrency-gap breakdown
+                "queryStages": self.executor.stats.histogram_summary(
+                    "query_stage_seconds")}
 
     def info(self) -> dict:
         import os

@@ -4,6 +4,19 @@ Reference: ``http/client.go`` (SURVEY.md §3.3) — the same client serves
 external callers (CLI import/export/backup) and, in the cluster layer,
 node-to-node calls (``InternalClient``).  stdlib urllib; no external
 deps.
+
+Retry policy (ADVICE r5): a failure in the SEND phase
+(``CannotSendRequest`` — the request never left this process) always
+retries once on a fresh connection.  A failure AFTER the request was
+sent (``BadStatusLine`` / connection reset / broken pipe — the response
+was lost, but the peer may already have processed the request) retries
+only when the request is idempotent: safe methods (GET/HEAD/PUT/
+DELETE), or POSTs on a client constructed with
+``idempotent_posts=True`` — the cluster's internode client, whose
+``/internal/*`` POST surface is idempotent by contract (see
+:mod:`pilosa_tpu.cluster.internal`).  Default clients never auto-retry
+a possibly-delivered POST: ``query`` can carry writes (``Set(...)``)
+and imports are not exactly-once.
 """
 
 from __future__ import annotations
@@ -46,12 +59,21 @@ class Client:
 
     MAX_IDLE = 8
 
+    # methods whose retry after a lost response cannot double-apply
+    IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
     def __init__(self, host: str = "127.0.0.1", port: int = 10101,
-                 timeout: float = 60.0, ssl_context=None):
+                 timeout: float = 60.0, ssl_context=None,
+                 idempotent_posts: bool = False):
         scheme = "https" if ssl_context is not None else "http"
         self.base = f"{scheme}://{host}:{port}"
         self.host, self.port = host, port
         self.timeout = timeout
+        # True ONLY when every POST this client sends is idempotent
+        # (the cluster's /internal/* contract) — enables the stale-
+        # socket retry for POSTs whose response was lost after the
+        # peer may have processed them (module docstring)
+        self.idempotent_posts = idempotent_posts
         self._ssl = ssl_context
         self._idle: list[http.client.HTTPConnection] = []
         self._plock = threading.Lock()
@@ -121,12 +143,27 @@ class Client:
             conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
             data = resp.read()
-        except (http.client.BadStatusLine, http.client.CannotSendRequest,
-                ConnectionResetError, BrokenPipeError) as e:
-            # stale keep-alive socket or transient reset; one retry on
-            # a fresh connection
+        except http.client.CannotSendRequest as e:
+            # SEND-phase failure: the request never left this process —
+            # always safe to retry once on a fresh connection
             conn.close()
             if not _retried:
+                return self._do(method, path, body, content_type, headers,
+                                _retried=True, timeout=timeout)
+            raise ClientError(f"connection reset by {self.base}",
+                              kind="unreachable") from e
+        except (http.client.BadStatusLine, ConnectionResetError,
+                BrokenPipeError) as e:
+            # the response was lost AFTER the request was sent: the
+            # peer may already have processed it, so an automatic retry
+            # is at-least-once.  Retry only idempotent requests (safe
+            # methods, or POSTs under the cluster's idempotency
+            # contract) — a default client surfaces the error and lets
+            # the caller decide (module docstring, ADVICE r5)
+            conn.close()
+            idempotent = (method in self.IDEMPOTENT_METHODS
+                          or self.idempotent_posts)
+            if idempotent and not _retried:
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",

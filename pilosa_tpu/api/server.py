@@ -359,12 +359,19 @@ class Handler(BaseHTTPRequestHandler):
         stats = getattr(self.server, "stats", None)
         if stats is not None:
             # refresh device working-set gauges at scrape time
-            pc = self.server.api.executor.planes.stats()
+            ex = self.server.api.executor
+            pc = ex.planes.stats()
             stats.gauge("plane_cache_bytes", pc["bytes"])
             stats.gauge("plane_cache_budget_bytes", pc["budgetBytes"])
             stats.gauge("plane_cache_entries", pc["entries"])
             stats.gauge("plane_cache_incremental_refreshes",
                         pc["incrementalRefreshes"])
+            # serving-spine gauges (r6): plan-cache occupancy and the
+            # batcher's current adaptive window
+            stats.gauge("plan_cache_entries", len(ex._plans))
+            if ex.batcher is not None:
+                stats.gauge("count_batcher_window_seconds",
+                            ex.batcher.current_window)
         text = stats.prometheus_text() if stats is not None else ""
         self._reply(text.encode(),
                     content_type="text/plain; version=0.0.4")

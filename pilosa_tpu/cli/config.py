@@ -31,8 +31,12 @@ class Config:
     heartbeat_interval: float = 2.0
     diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
     # device
-    count_batch_window: float = 0.0    # seconds; >0 coalesces concurrent
-                                       # Count queries into one dispatch
+    # Cross-request coalescing window for concurrent dense reads
+    # (Count, BSI aggregates, dense TopN, Distinct): "adaptive"
+    # (default) grows the window under queue pressure and shrinks it to
+    # 0 when traffic is solo; a number fixes the window in seconds;
+    # 0/"off" disables coalescing entirely.
+    count_batch_window: str = "adaptive"
     query_timeout: float = 0.0         # seconds per query; 0 = unlimited
                                        # (?timeout= overrides per request)
     plane_budget_bytes: int = 4 << 30

@@ -71,7 +71,14 @@ class Cluster:
         # node instantly "owns" shards whose data hasn't arrived and
         # queries silently undercount (config17 r5).
         self.placement_ids: list[str] = [self.node_id]
+        # monotonic (wall-clock) version of the ACTIVE placement: rides
+        # every heartbeat both ways, so a node that missed the one
+        # best-effort resize-completion broadcast detects the mismatch
+        # within a heartbeat interval and PULLS the newer topology
+        # instead of routing by the stale one forever (ADVICE r5)
+        self.placement_version: float = 0.0
         self._load_placement()
+        self._placement_pull = threading.Lock()  # one pull at a time
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
         # index -> (fetched_at, shards, incomplete): `incomplete` rides
@@ -105,18 +112,21 @@ class Cluster:
         import os
         try:
             with open(self._placement_path()) as f:
-                saved = _json.load(f).get("placement") or []
+                data = _json.load(f)
+                saved = data.get("placement") or []
         except (OSError, ValueError):
             return
         if saved and self.node_id in saved:
             self.placement_ids = sorted(saved)
+            self.placement_version = float(data.get("version", 0.0))
 
     def _save_placement(self) -> None:
         import json as _json
         try:
             tmp = self._placement_path() + ".tmp"
             with open(tmp, "w") as f:
-                _json.dump({"placement": self.placement_ids}, f)
+                _json.dump({"placement": self.placement_ids,
+                            "version": self.placement_version}, f)
             import os
             os.replace(tmp, self._placement_path())
         except OSError as e:
@@ -141,6 +151,8 @@ class Cluster:
                     self.state = resp.get("state", STATE_NORMAL)
                     self.placement_ids = sorted(
                         resp.get("placement") or self.nodes)
+                    self.placement_version = float(
+                        resp.get("placementVersion", 0.0))
                     self._save_placement()
                 for t in resp.get("schemaTombstones", []):
                     self.record_schema_tombstone(t["index"], t.get("field"),
@@ -197,8 +209,12 @@ class Cluster:
             c = self._clients.get(node_id)
             if c is None:
                 host, port = node_id.rsplit(":", 1)
+                # idempotent_posts: every /internal/* POST is idempotent
+                # by contract (cluster/internal.py module docstring), so
+                # the lost-response retry is safe for internode calls
                 c = self._clients[node_id] = Client(
-                    host, int(port), ssl_context=self._client_ssl_ctx)
+                    host, int(port), ssl_context=self._client_ssl_ctx,
+                    idempotent_posts=True)
             return c
 
     def member_ids(self) -> list[str]:
@@ -239,9 +255,11 @@ class Cluster:
                      for (i, f), ts in self._schema_tombstones.items()]
         return {"nodes": list(self.nodes.values()), "state": self.state,
                 "placement": list(self.placement_ids),
+                "placementVersion": self.placement_version,
                 "schema": self.api.schema(), "schemaTombstones": tombs}
 
-    def handle_heartbeat(self, node_id: str, state: str) -> dict:
+    def handle_heartbeat(self, node_id: str, state: str,
+                         placement_version: float = 0.0) -> dict:
         with self._lock:
             if node_id in self._removed:
                 # tombstoned: tell the sender it was removed; it must
@@ -253,7 +271,44 @@ class Cluster:
                 # node knows us but we lost it (e.g. restarted): re-add
                 self.nodes[node_id] = {"id": node_id, "uri": node_id,
                                        "state": state}
-        return {"id": self.node_id, "state": self.state}
+            ours = self.placement_version
+        if placement_version > ours:
+            # the SENDER has a newer activated placement than us: pull
+            # it off-thread (this runs in an HTTP handler; the pull is
+            # its own round trip)
+            threading.Thread(target=self._pull_cluster_state,
+                             args=(node_id,),
+                             name="pilosa-placement-pull",
+                             daemon=True).start()
+        return {"id": self.node_id, "state": self.state,
+                "placementVersion": ours}
+
+    def status_payload(self) -> dict:
+        """The full cluster-state snapshot served at
+        ``/internal/cluster/state`` and broadcast after membership /
+        placement changes."""
+        with self._lock:
+            return {"nodes": list(self.nodes.values()),
+                    "state": self.state,
+                    "placement": list(self.placement_ids),
+                    "placementVersion": self.placement_version,
+                    "ts": time.time()}
+
+    def _pull_cluster_state(self, node_id: str) -> None:
+        """Fetch a peer's cluster state and apply it (pull-on-mismatch
+        convergence for missed broadcasts).  Single-flight: heartbeats
+        from several newer peers must not stack redundant pulls."""
+        if not self._placement_pull.acquire(blocking=False):
+            return
+        try:
+            payload = self._client(node_id)._json(
+                "GET", "/internal/cluster/state")
+            self.handle_status(payload)
+        except Exception as e:  # noqa: BLE001 — retried next heartbeat
+            self.logger.warning("placement pull from %s failed: %s",
+                                node_id, e)
+        finally:
+            self._placement_pull.release()
 
     def handle_status(self, payload: dict) -> None:
         now = time.monotonic()
@@ -274,14 +329,18 @@ class Cluster:
                 self.nodes[n["id"]] = n
                 self._last_seen.setdefault(n["id"], now)
             self.state = payload["state"]
-            if payload.get("placement"):
+            pv = float(payload.get("placementVersion",
+                                   payload.get("ts", 0.0)))
+            if payload.get("placement") and pv >= self.placement_version:
+                # version-gated: a stale peer's snapshot (e.g. a pull
+                # answered from an even older node) must not regress an
+                # already-activated topology
                 self.placement_ids = sorted(payload["placement"])
+                self.placement_version = pv
                 self._save_placement()
 
     def _broadcast_status(self, cleared: list[str] | None = None) -> None:
-        payload = {"nodes": list(self.nodes.values()), "state": self.state,
-                   "placement": list(self.placement_ids),
-                   "ts": time.time()}
+        payload = self.status_payload()
         if cleared:
             payload["cleared"] = cleared
         for nid in self.member_ids():
@@ -296,40 +355,52 @@ class Cluster:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.cfg.heartbeat_interval):
-            for nid in self.member_ids():
-                if nid == self.node_id:
-                    continue
-                try:
-                    resp = self._client(nid)._json(
-                        "POST", "/internal/heartbeat",
-                        {"id": self.node_id, "state": self.state})
-                    if resp.get("removed"):
-                        # we were explicitly removed: drop to single-node
-                        # membership (an operator rejoin brings us back)
-                        self.logger.warning(
-                            "this node was removed from the cluster by %s",
-                            nid)
-                        with self._lock:
-                            self.nodes = {self.node_id:
-                                          self.nodes.get(self.node_id,
-                                                         {"id": self.node_id,
-                                                          "uri": self.node_id,
-                                                          "state": self.state})}
-                        break
+            self._heartbeat_once()
+
+    def _heartbeat_once(self) -> None:
+        """One heartbeat round (split out so tests can drive rounds
+        deterministically)."""
+        for nid in self.member_ids():
+            if nid == self.node_id:
+                continue
+            try:
+                resp = self._client(nid)._json(
+                    "POST", "/internal/heartbeat",
+                    {"id": self.node_id, "state": self.state,
+                     "placementVersion": self.placement_version})
+                if resp.get("removed"):
+                    # we were explicitly removed: drop to single-node
+                    # membership (an operator rejoin brings us back)
+                    self.logger.warning(
+                        "this node was removed from the cluster by %s",
+                        nid)
                     with self._lock:
-                        self._last_seen[nid] = time.monotonic()
-                except Exception:  # noqa: BLE001 — peer down
-                    pass
-            alive = set(self.alive_ids())
-            with self._lock:
-                dead = set(self.nodes) - alive
-                new_state = (STATE_DEGRADED if dead and
-                             self.state == STATE_NORMAL else self.state)
-                if new_state != self.state:
-                    self.logger.warning("nodes suspect: %s", sorted(dead))
-                    self.state = new_state
-                if not dead and self.state == STATE_DEGRADED:
-                    self.state = STATE_NORMAL
+                        self.nodes = {self.node_id:
+                                      self.nodes.get(self.node_id,
+                                                     {"id": self.node_id,
+                                                      "uri": self.node_id,
+                                                      "state": self.state})}
+                    break
+                with self._lock:
+                    self._last_seen[nid] = time.monotonic()
+                if (resp.get("placementVersion", 0.0)
+                        > self.placement_version):
+                    # the PEER activated a placement we missed (its
+                    # broadcast is best-effort): pull it now — inline,
+                    # this loop is already a background thread
+                    self._pull_cluster_state(nid)
+            except Exception:  # noqa: BLE001 — peer down
+                pass
+        alive = set(self.alive_ids())
+        with self._lock:
+            dead = set(self.nodes) - alive
+            new_state = (STATE_DEGRADED if dead and
+                         self.state == STATE_NORMAL else self.state)
+            if new_state != self.state:
+                self.logger.warning("nodes suspect: %s", sorted(dead))
+                self.state = new_state
+            if not dead and self.state == STATE_DEGRADED:
+                self.state = STATE_NORMAL
 
     # -- schema broadcast ---------------------------------------------------
 
@@ -697,33 +768,70 @@ class Cluster:
                         shard: int, frag, view_obj, owners) -> int:
         """Union-merge an un-owned local fragment into EVERY alive
         owner, then delete the local copy (only if all owners took it —
-        a failed push keeps the orphan for the next round)."""
+        a failed push keeps the orphan for the next round).
+
+        Two ADVICE r5 fixes: (1) the fragment's generation is
+        re-checked UNDER ITS LOCK before the delete — bits written
+        between the push snapshot and the delete (a Set routed here by
+        a peer with stale placement) trigger a re-push instead of
+        being permanently lost; (2) EMPTY orphans are deleted instead
+        of being re-scanned every AAE round forever.
+
+        Deletion runs under the VIEW lock (then the fragment lock —
+        the same view→fragment order the store uses): pop, close, AND
+        unlink together, so a concurrent ``view.fragment(create=True)``
+        cannot recreate the fragment at the same path between the pop
+        and the unlink and have its fresh files unlinked from under it
+        (that write would vanish on restart with no AAE record)."""
         import os
+
+        def _delete_local(check) -> bool:
+            """Atomically (view lock → frag lock) re-check ``check``,
+            then pop + close + unlink.  False = re-check failed."""
+            with view_obj._lock:
+                with frag.lock:
+                    if not check():
+                        return False
+                    view_obj.fragments.pop(shard, None)
+                    path = frag.path
+                    frag.close()
+                    for suffix in ("", ".oplog"):
+                        try:
+                            os.remove(path + suffix)
+                        except OSError:
+                            pass
+            return True
+
         if self.state != STATE_NORMAL:
             return 0  # mid-resize: the job itself is moving fragments
         if not frag.row_ids():
+            # empty orphan: drop it now (emptiness re-checked under the
+            # locks — a write may have landed since the check above)
+            if _delete_local(lambda: not frag.row_ids()):
+                self.logger.info(
+                    "empty orphan fragment %s/%s/%s/%d deleted",
+                    index, field, view, shard)
             return 0
         alive = set(self.alive_ids())
         if not all(o in alive for o in owners):
             return 0  # can't guarantee full handoff; retry next round
-        try:
-            for dest in owners:
-                self.push_fragment(index, field, view, shard, dest)
-        except Exception as e:  # noqa: BLE001 — keep orphan, retry
-            self.logger.warning("orphan handoff %s/%s/%s/%d: %s",
-                                index, field, view, shard, e)
-            return 0
-        view_obj.fragments.pop(shard, None)
-        path = frag.path
-        frag.close()
-        for suffix in ("", ".oplog"):
+        for _attempt in range(3):
+            gen = frag.generation
             try:
-                os.remove(path + suffix)
-            except OSError:
-                pass
-        self.logger.info("orphan fragment %s/%s/%s/%d handed to %s",
-                         index, field, view, shard, owners)
-        return 1
+                for dest in owners:
+                    self.push_fragment(index, field, view, shard, dest)
+            except Exception as e:  # noqa: BLE001 — keep orphan, retry
+                self.logger.warning("orphan handoff %s/%s/%s/%d: %s",
+                                    index, field, view, shard, e)
+                return 0
+            if _delete_local(lambda: frag.generation == gen):
+                self.logger.info(
+                    "orphan fragment %s/%s/%s/%d handed to %s",
+                    index, field, view, shard, owners)
+                return 1
+            # mutated during the push: those bits are not in the
+            # snapshot we shipped — push again before deleting
+        return 0  # kept hot by writers; next AAE round retries
 
     def _sync_attrs(self) -> int:
         """AAE for attribute stores (reference: AttrStore block sync,
@@ -933,8 +1041,16 @@ class Cluster:
                 if completed:
                     # every copy for the target topology is streamed:
                     # activate it (and broadcast) so reads start
-                    # routing to the new owners
+                    # routing to the new owners.  The version rides
+                    # every heartbeat, so a peer that misses this
+                    # broadcast still converges (pull-on-mismatch).
+                    # max(now, prev+1): a coordinator whose wall clock
+                    # trails the previous coordinator's must still mint
+                    # a STRICTLY newer version, or peers would reject
+                    # (and pull back over) the new topology
                     self.placement_ids = list(target)
+                    self.placement_version = max(
+                        time.time(), self.placement_version + 1.0)
                     self._save_placement()
             self._broadcast_status()
 

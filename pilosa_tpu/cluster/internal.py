@@ -4,7 +4,19 @@ Reference: the ``/internal/*`` surface of ``http/handler.go`` —
 query fan-out, fragment block/data exchange for AAE + resize, translate
 streaming, cluster messages (SURVEY.md §3.3).  Registered into the main
 router; every handler 503s when the node is not clustered.
-"""
+
+**Idempotency contract**: every POST endpoint on this surface MUST be
+idempotent.  The cluster layer's internode :class:`~pilosa_tpu.api
+.client.Client` is constructed with ``idempotent_posts=True``, which
+re-sends a request whose response was lost after the peer may already
+have processed it (stale keep-alive socket, connection reset) —
+at-least-once delivery.  The current endpoints all qualify: fragment
+``merge`` is a union (∪ is idempotent), translate ``replicate`` dedupes
+by log offset, ``heartbeat``/``status``/``schema`` apply last-writer
+state merges, ``resize/push`` re-streams a union-merge.  A future
+non-idempotent endpoint must NOT ride this client — give it a dedicated
+``Client()`` (default: no retry after a possibly-delivered request) or
+add request IDs."""
 
 from __future__ import annotations
 
@@ -53,8 +65,15 @@ def h_join(self: Handler) -> None:
 
 def h_heartbeat(self: Handler) -> None:
     b = self._json_body()
-    self._reply(_cluster(self).handle_heartbeat(b["id"],
-                                                b.get("state", "NORMAL")))
+    self._reply(_cluster(self).handle_heartbeat(
+        b["id"], b.get("state", "NORMAL"),
+        float(b.get("placementVersion", 0.0))))
+
+
+def h_cluster_state(self: Handler) -> None:
+    """Full cluster-state snapshot (pull-on-mismatch convergence for
+    peers whose placement version trails the sender's)."""
+    self._reply(_cluster(self).status_payload())
 
 
 def h_cluster_status(self: Handler) -> None:
@@ -281,6 +300,7 @@ def register_internal_routes(router: Router) -> None:
     router.add("POST", "/internal/join", h_join)
     router.add("POST", "/internal/heartbeat", h_heartbeat)
     router.add("POST", "/internal/cluster/status", h_cluster_status)
+    router.add("GET", "/internal/cluster/state", h_cluster_state)
     router.add("POST", "/internal/query", h_internal_query)
     router.add("GET", "/internal/shards", h_shards)
     router.add("GET", "/internal/fragments", h_fragments)

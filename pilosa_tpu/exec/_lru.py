@@ -1,0 +1,59 @@
+"""Shared lock-free recency stamps for the serving caches.
+
+The fused-program cache and the device plane cache both keep their HIT
+path lock-free (a plain-dict read plus a recency-stamp write, both
+GIL-atomic) and take their own lock only to insert and evict.  The
+stamp bookkeeping — including the evict-then-touch race, where a
+``touch`` that lost the race against an eviction re-inserts an orphan
+stamp — lives here so it is handled once, identically, for both.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+
+class Stamps:
+    """Approximate-LRU recency stamps.
+
+    Thread contract: :meth:`touch` may run WITHOUT the owner's lock —
+    it only writes an existing key (no dict resize), except when it
+    loses the race against a concurrent eviction, in which case it
+    re-inserts an orphan entry (cleaned by :meth:`cleanup`).  Every
+    other method runs under the owner cache's lock."""
+
+    def __init__(self):
+        self._stamp: dict = {}
+        self._tick = itertools.count()
+
+    def touch(self, key) -> None:
+        if key in self._stamp:
+            self._stamp[key] = next(self._tick)
+
+    def insert(self, key) -> None:
+        self._stamp[key] = next(self._tick)
+
+    def pop(self, key) -> None:
+        self._stamp.pop(key, None)
+
+    def get(self, key, default: int = 0) -> int:
+        return self._stamp.get(key, default)
+
+    def snapshot(self) -> list:
+        """Items snapshot that tolerates a racing lock-free touch
+        re-inserting a key mid-iteration (retry; the window is a few
+        instructions)."""
+        while True:
+            try:
+                return list(self._stamp.items())
+            except RuntimeError:
+                continue
+
+    def cleanup(self, live) -> None:
+        """Drop orphan stamps (keys no longer in the owning cache)."""
+        for k, _ in self.snapshot():
+            if k not in live:
+                self._stamp.pop(k, None)
+
+    def clear(self) -> None:
+        self._stamp.clear()

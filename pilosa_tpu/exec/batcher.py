@@ -1,28 +1,36 @@
-"""Cross-request Count coalescing.
+"""Cross-request coalescing: the concurrent serving spine.
 
 Within-request batching (executor count runs) amortizes fixed
 per-dispatch/per-read costs across one query string; this batcher does
 the same ACROSS concurrent requests: server threads submit planned
-Count trees, a collector waits a tiny window, and one fused program
-answers the whole batch with a single device read.
+work items, a collector waits a tiny window, and one fused program per
+(kind, shape) group answers the whole batch with a single device read.
 
 Motivation (BASELINE.md): transports can impose a fixed cost per
 synchronous device read (~100ms on this image's tunnel; ~10us on local
-hardware).  When reads SERIALIZE, N coalesced Counts pay that cost once
-instead of N times.  Measured on this image's tunnel: neutral at
-low concurrency (~130 count-qps either way, its reads overlap across
-threads), but it becomes the scaling lever past the tunnel's device-
-stream limit: unbatched serving crashes the tunnel outright beyond 8
-concurrent streams, while the batcher funnels any number of HTTP
-clients through ONE device stream — 32 clients reached 148 qps e2e
-where unbatched tops out at 80.  Off by default
-(``count_batch_window`` in the server config) — a solo request would
-only gain latency.
+hardware).  When reads SERIALIZE, N coalesced items pay that cost once
+instead of N times — and past the tunnel's device-stream limit the
+batcher funnels any number of HTTP clients through ONE device stream.
+
+r6 changes (the concurrency-gap work, ISSUE 1):
+
+- **default-on with an ADAPTIVE window**: the window grows under queue
+  pressure (concurrent submitters pile into one dispatch) and shrinks
+  to zero when traffic is solo, so a lone request pays no collection
+  wait.  ``count_batch_window=adaptive`` is the server default; a
+  numeric value keeps the old fixed-window behavior, 0 disables.
+- **every one-dispatch-one-read dense family coalesces**: Counts (any
+  fusable tree, BSI conditions included), BSI Sum/Min/Max, whole-plane
+  row counts (same-field Count batches and dense TopN — deduplicated:
+  N concurrent requests over the SAME resident plane share one
+  program and one read instead of stacking N copies of a multi-GB
+  popcount), and Distinct presence scans (deduplicated likewise).
 """
 
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -30,30 +38,59 @@ from pilosa_tpu.engine import kernels
 
 
 class _Pending:
-    __slots__ = ("kind", "node", "leaves", "event", "result", "error")
+    __slots__ = ("kind", "nodes", "leaves", "event", "result", "error")
 
-    def __init__(self, kind, node, leaves):
-        self.kind = kind      # "count" | "sum" | "minmax"
-        self.node = node      # count: plan tree; aggregates: None
-        self.leaves = leaves  # count: plan leaves; agg: (plane[, filter])
+    def __init__(self, kind, nodes, leaves):
+        self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
+        #                       | "distinct"
+        self.nodes = nodes    # count: tuple of plan trees; others: None
+        self.leaves = leaves  # count: plan leaves; others: plane[, filter]
         self.event = threading.Event()
         self.result = None
         self.error: Exception | None = None
 
 
 class CountBatcher:
-    """Cross-request coalescing for Count AND the BSI aggregates
-    (Sum/Min/Max join the same collection window; each kind/shape group
-    runs as one fused program + one read)."""
+    """Cross-request coalescing for Count, the BSI aggregates
+    (Sum/Min/Max), whole-plane row counts, and Distinct — each
+    kind/shape group in one collection window runs as one fused
+    program + one read."""
 
-    def __init__(self, fused, window_s: float = 0.002, max_batch: int = 64):
+    # adaptive-window bounds: MIN is the smallest non-zero window (below
+    # it the window snaps to 0 — solo traffic must not wait at all);
+    # MAX bounds queue-pressure growth so a burst can't add visible
+    # latency to its own tail
+    ADAPT_MIN = 0.0005
+    ADAPT_MAX = 0.005
+
+    def __init__(self, fused, window_s="adaptive", max_batch: int = 64,
+                 stats=None):
+        from pilosa_tpu.obs import NopStats
         self.fused = fused
-        self.window_s = window_s
+        self.adaptive = window_s == "adaptive"
+        self.window_s = 0.0 if self.adaptive else float(window_s)
+        self._win = 0.0 if self.adaptive else self.window_s
         self.max_batch = max_batch
+        self.stats = stats or NopStats()
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._kick = threading.Event()
         self._thread: threading.Thread | None = None
+        self._pool = None  # persistent group-dispatch pool (lazy)
+
+    def _group_pool(self):
+        # persistent: a pool built and torn down per collection window
+        # would put thread churn back on the very hot loop this
+        # batcher exists to strip of per-request overhead
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="pilosa-batch-group")
+        return self._pool
+
+    @property
+    def current_window(self) -> float:
+        return self._win
 
     def _ensure_worker(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -62,20 +99,36 @@ class CountBatcher:
                                             daemon=True)
             self._thread.start()
 
-    def _submit(self, p: _Pending):
+    def _enqueue(self, p: _Pending) -> _Pending:
         with self._lock:
             self._queue.append(p)
             self._ensure_worker()
         self._kick.set()
+        return p
+
+    def wait(self, p: _Pending):
+        """Block on an enqueued item's result (pairs with the
+        ``enqueue_*`` methods — a caller that needs several items can
+        enqueue them ALL into one collection window before waiting on
+        any, instead of serializing one window per item)."""
         p.event.wait()
         if p.error is not None:
             raise p.error
         return p.result
 
+    def _submit(self, p: _Pending):
+        return self.wait(self._enqueue(p))
+
     def submit(self, node, leaves) -> int:
         """Block until the coalesced batch containing this Count runs;
         returns the host-finished int64 total."""
-        return self._submit(_Pending("count", node, tuple(leaves)))
+        return self._submit(_Pending("count", (node,), tuple(leaves)))[0]
+
+    def submit_many(self, nodes, leaves) -> list[int]:
+        """A whole request's Count run as ONE batch item (the nodes
+        share one leaf list); N concurrent requests coalesce into one
+        program regardless of how many Counts each carries."""
+        return self._submit(_Pending("count", tuple(nodes), tuple(leaves)))
 
     def submit_sum(self, plane, filter_words) -> tuple[int, int]:
         """BSI Sum: (sum of offsets, non-null count), host-finished."""
@@ -87,24 +140,65 @@ class CountBatcher:
         leaves = (plane,) if filter_words is None else (plane, filter_words)
         return self._submit(_Pending("minmax", None, leaves))
 
+    def submit_rowcounts(self, plane, filter_words=None) -> np.ndarray:
+        """Whole-plane per-row totals int64[R_pad] (cross-shard reduce
+        on device — callers gate on the int32-exact shard bound).
+        Identical concurrent items (same plane/filter objects) share
+        one computation."""
+        return self.wait(self.enqueue_rowcounts(plane, filter_words))
+
+    def enqueue_rowcounts(self, plane, filter_words=None) -> _Pending:
+        """Non-blocking variant: returns a handle for :meth:`wait`, so
+        a request needing several row-count reads (filtered TopN with
+        tanimoto) lands them all in ONE collection window."""
+        leaves = (plane,) if filter_words is None else (plane, filter_words)
+        return self._enqueue(_Pending("rowcounts", None, leaves))
+
+    def submit_distinct(self, plane, filter_words):
+        """BSI Distinct presence: host (pos bool[2^d], neg bool[2^d]).
+        Coalescing here is DEDUPLICATION only — the presence scan is a
+        multi-dispatch block loop, so stacking would multiply compute;
+        identical concurrent requests share one scan."""
+        leaves = (plane,) if filter_words is None else (plane, filter_words)
+        return self._submit(_Pending("distinct", None, leaves))
+
     def _loop(self) -> None:
         while True:
             self._kick.wait()
-            # collection window: let concurrent submitters pile in
-            threading.Event().wait(self.window_s)
+            # collection window: let concurrent submitters pile in.
+            # Adaptive mode keeps it at 0 for solo traffic and grows it
+            # only while batches actually coalesce.
+            win = self._win if self.adaptive else self.window_s
+            if win > 0:
+                time.sleep(win)
             with self._lock:
+                backlog = len(self._queue)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
                 if not self._queue:
                     self._kick.clear()
             if not batch:
                 continue
+            if self.adaptive:
+                if len(batch) > 1 or backlog > len(batch):
+                    self._win = min(max(self._win * 2, self.ADAPT_MIN),
+                                    self.ADAPT_MAX)
+                elif self._win:
+                    nxt = self._win / 2
+                    self._win = 0.0 if nxt < self.ADAPT_MIN else nxt
+            self.stats.count("batcher_batches", 1)
+            self.stats.count("batcher_items", len(batch))
+            self.stats.gauge("batcher_window_seconds", self._win)
             # stacked outputs need uniform shapes: group by kind + the
-            # leaves' n_shards (+ depth via the plane shape for
-            # aggregates — differs across indexes / fields / shard sets)
+            # output-shaping leaf dimension (counts: n_shards — mixed
+            # row/plane leaf ranks fuse fine, only the int32[S] outputs
+            # must stack; aggregates/rowcounts: the full plane shape)
             groups: dict[tuple, list[_Pending]] = {}
             for p in batch:
-                key = (p.kind, p.leaves[0].shape)
+                if p.kind == "count":
+                    key = ("count", p.leaves[0].shape[0])
+                else:
+                    key = (p.kind, p.leaves[0].shape)
                 groups.setdefault(key, []).append(p)
             # one program per group, but dispatch groups CONCURRENTLY:
             # transports that overlap reads across threads (the axon
@@ -114,47 +208,145 @@ class CountBatcher:
             if len(items) == 1:
                 self._run_one(*items[0])
             else:
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(max_workers=len(items)) as pool:
-                    list(pool.map(lambda kv: self._run_one(*kv), items))
+                list(self._group_pool().map(
+                    lambda kv: self._run_one(*kv), items))
 
     def _run_one(self, key, group):
         if key[0] == "count":
             self._run_counts(group)
+        elif key[0] == "rowcounts":
+            self._run_rowcounts(group)
+        elif key[0] == "distinct":
+            self._run_distinct(group)
         else:
             self._run_aggs(key[0], group)
 
     def _run_counts(self, group: list[_Pending]) -> None:
         from pilosa_tpu.exec.fused import shift_leaves
         try:
-            # pad to a pow2 bucket by repeating item 0 — without it,
-            # every distinct batch SIZE compiles a fresh program and the
-            # compiles land on serving latency (measured: 32 concurrent
-            # HTTP clients collapsed to ~23 qps from the recompile storm)
-            n = len(group)
+            all_nodes, all_leaves, spans = [], [], []
+            for p in group:
+                start = len(all_nodes)
+                for node in p.nodes:
+                    all_nodes.append(shift_leaves(node, len(all_leaves)))
+                all_leaves.extend(p.leaves)
+                spans.append((start, len(all_nodes)))
+            # pad the NODE count to a pow2 bucket by repeating node 0
+            # (already leaf-shifted) — without it, every distinct batch
+            # size compiles a fresh program and the compiles land on
+            # serving latency (measured: 32 concurrent HTTP clients
+            # collapsed to ~23 qps from the recompile storm)
+            n = len(all_nodes)
             bucket = 1
             while bucket < n:
                 bucket *= 2
-            items = group + [group[0]] * (bucket - n)
-            nodes, all_leaves = [], []
-            for p in items:
-                nodes.append(shift_leaves(p.node, len(all_leaves)))
-                all_leaves.extend(p.leaves)
+            all_nodes.extend([all_nodes[0]] * (bucket - n))
             per_shard = self.fused.run_count_batch(
-                tuple(nodes), tuple(all_leaves))
+                tuple(all_nodes), tuple(all_leaves))
             host = np.asarray(per_shard).astype(np.int64)
-            for p, row in zip(group, host):
-                p.result = int(row.sum())
+            for p, (a, b) in zip(group, spans):
+                p.result = [int(row.sum()) for row in host[a:b]]
                 p.event.set()
         except Exception:  # noqa: BLE001 — per-item fallback
             for p in group:
                 try:
-                    p.result = int(kernels.shard_totals(
-                        self.fused.run(p.node, p.leaves, "count")))
+                    p.result = [
+                        int(kernels.shard_totals(
+                            self.fused.run(node, p.leaves, "count")))
+                        for node in p.nodes]
                 except Exception as e2:  # noqa: BLE001
                     p.error = e2
                 finally:
                     p.event.set()
+
+    @staticmethod
+    def _dedupe(group: list[_Pending]):
+        """Unique items by leaf identity + the caller index of each
+        item's unique representative — N requests over the same
+        resident plane compute once and share the read."""
+        uniq: dict[tuple, int] = {}
+        items: list[_Pending] = []
+        assign: list[int] = []
+        for p in group:
+            k = tuple(id(a) for a in p.leaves)
+            slot = uniq.get(k)
+            if slot is None:
+                slot = uniq[k] = len(items)
+                items.append(p)
+            assign.append(slot)
+        return items, assign
+
+    def _run_rowcounts(self, group: list[_Pending]) -> None:
+        items, assign = self._dedupe(group)
+        # canonical flag order + pow2 pad (repeating item 0): bounded
+        # program set per plane shape, like the aggregate batches
+        order = sorted(range(len(items)), key=lambda i: len(items[i].leaves))
+        items = [items[i] for i in order]
+        back = {old: new for new, old in enumerate(order)}
+        assign = [back[a] for a in assign]
+        n = len(items)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        padded = items + [items[0]] * (bucket - n)
+        flags = tuple(len(p.leaves) == 2 for p in padded)
+        leaves = tuple(a for p in padded for a in p.leaves)
+        try:
+            out = np.asarray(
+                self.fused.run_rowcounts_batch(flags, leaves)
+            ).astype(np.int64)
+            for p, slot in zip(group, assign):
+                p.result = out[slot]
+                p.event.set()
+        except Exception:  # noqa: BLE001 — per-item fallback
+            for p in group:
+                try:
+                    flt = p.leaves[1] if len(p.leaves) == 2 else None
+                    p.result = kernels.shard_totals(
+                        kernels.row_counts(p.leaves[0], flt))
+                except Exception as e2:  # noqa: BLE001
+                    p.error = e2
+                finally:
+                    p.event.set()
+
+    def _run_distinct(self, group: list[_Pending]) -> None:
+        from pilosa_tpu.engine import bsi as bsik
+        items, assign = self._dedupe(group)
+        results: list = [None] * len(items)
+        errors: list = [None] * len(items)
+
+        def scan(i: int) -> None:
+            p = items[i]
+            try:
+                flt = p.leaves[1] if len(p.leaves) == 2 else None
+                pos, neg = bsik.distinct_presence(p.leaves[0], flt)
+                results[i] = (np.asarray(pos), np.asarray(neg))
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        if len(items) == 1:
+            scan(0)
+        else:
+            # NON-identical items (different planes/filters) keep the
+            # pre-batcher concurrency: the scans are multi-dispatch
+            # block loops, so running them serially in this worker
+            # would make the last caller wait out every other scan.
+            # Plain threads, NOT _group_pool: this method itself runs
+            # inside that pool, and a nested map could deadlock with
+            # every pool worker occupied by group runs; thread spawn
+            # is noise next to a presence scan.
+            ts = [threading.Thread(target=scan, args=(i,))
+                  for i in range(len(items))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        for p, slot in zip(group, assign):
+            if errors[slot] is not None:
+                p.error = errors[slot]
+            else:
+                p.result = results[slot]
+            p.event.set()
 
     def _run_aggs(self, kind: str, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik

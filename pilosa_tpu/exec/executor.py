@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -116,6 +117,48 @@ class ExecutionError(Exception):
     pass
 
 
+# negative plan-cache entry: this query shape is structurally outside
+# the plan cache (not all-Count, time ranges, …) — skip re-walking it
+_UNPLANNABLE = object()
+
+
+@dataclass
+class _PlanEntry:
+    """One cached serving plan for an all-Count query (r6 tentpole).
+
+    ``kind``:
+
+    - ``"plane"`` — same-field plain-row Count batch: answered by ONE
+      whole-plane ``row_counts`` program over the resident plane
+      (``row_ids`` are the per-call resolved rows; slots come fresh
+      from the PlaneSet each hit).
+    - ``"generic"`` — arbitrary fusable Count trees: ``nodes`` (leaf
+      indices local to ``leaf_specs``) re-materialize through the
+      plane cache each hit.
+
+    Validity: ``shards`` must equal the current shard set and ``gens``
+    must equal the dependency views' generations — a write to any
+    source fragment (including creating a row key that planned as a
+    zeros leaf) invalidates on the next hit.  Leaf ARRAYS are never
+    cached here; they come from the PlaneCache, which revalidates
+    independently."""
+
+    kind: str
+    shards: tuple
+    deps: tuple            # ((field_name | "\x00exists", view_name), ...)
+    gens: tuple            # per-dep generation tuples (None = view absent)
+    n_calls: int
+    nodes: tuple = ()
+    leaf_specs: tuple = ()
+    field_name: str | None = None
+    row_ids: tuple = ()
+    # (field_name, bit_depth) per BSI field whose predicate masks /
+    # saturation verdicts the plan baked: depth can GROW via a write
+    # OUTSIDE this entry's shard subset (generations over entry.shards
+    # won't see it), so validity must check the depth itself
+    bsi_depths: tuple = ()
+
+
 class QueryTimeoutError(ExecutionError):
     """Query deadline exceeded (reference: upstream threads request
     context cancellation through the executor; deadlines are the
@@ -136,16 +179,23 @@ class _Ctx:
 
 
 class Executor:
+    MAX_PLANS = 512  # plan-cache entries (user-controlled keys: bounded)
+
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
                  place=None, plane_budget: int | None = None, placement=None,
-                 stats=None, tracer=None, count_batch_window: float = 0.0,
+                 stats=None, tracer=None,
+                 count_batch_window: float | str = "adaptive",
                  max_concurrent: int = 8):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
         default device.  ``max_concurrent`` bounds simultaneously
         EXECUTING queries (scratch admission; 0 disables) — excess
-        clients queue at the executor, not in device memory."""
+        clients queue at the executor, not in device memory.
+        ``count_batch_window``: ``"adaptive"`` (default) coalesces
+        concurrent dense reads with a window that grows under queue
+        pressure and shrinks to 0 when solo; a float fixes the window
+        (pre-r6 behavior); 0 disables coalescing."""
         self.holder = holder
         self.translate = translate or TranslateStore(holder.path)
         self.placement = placement
@@ -158,11 +208,36 @@ class Executor:
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache()
+        # cross-request coalescing is the DEFAULT serving spine (r6):
+        # the adaptive window costs a solo request nothing, and under
+        # concurrency every dense family pays one dispatch + one read
+        # per collection window instead of one per request
         self.batcher = None
-        if count_batch_window > 0:
+        window = count_batch_window
+        if isinstance(window, str):
+            w = window.strip().lower()
+            if w == "adaptive":
+                window = "adaptive"
+            elif w in ("", "0", "off", "none", "false"):
+                window = 0.0
+            else:
+                try:
+                    window = float(w)
+                except ValueError:
+                    raise ValueError(
+                        f"count_batch_window: expected 'adaptive', a "
+                        f"number of seconds, or 'off', got {window!r}")
+        if window == "adaptive" or window > 0:
             from pilosa_tpu.exec.batcher import CountBatcher
-            self.batcher = CountBatcher(self.fused,
-                                        window_s=count_batch_window)
+            self.batcher = CountBatcher(self.fused, window_s=window,
+                                        stats=self.stats)
+        # query-plan cache (r6 tentpole): (index, normalized PQL,
+        # shards, translate flag) -> planned tree + leaf specs, so a
+        # repeated serving shape skips parse AND plan entirely (PQL
+        # parse alone measured 1.09 ms/request ≈ 2.4× the device budget
+        # at 5k qps, BENCH_r05)
+        self._plans: OrderedDict = OrderedDict()
+        self._plans_lock = threading.Lock()
         # cross-query OOM recovery (r4 → r5): one recovery at a time
         # through the gate; the in-flight count lets the exclusive
         # stage drain concurrent queries instead of evicting the
@@ -223,15 +298,14 @@ class Executor:
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index {index_name!r} not found")
-        if isinstance(query, str):
-            # memoized: repeated serving shapes skip the parser (the AST
-            # is never mutated in place — rewriters copy first)
-            query = parse_cached(query)
         # outermost call only (nested execute — e.g. resolved Limit
         # subtrees — shares the outer query's lease set and in-flight
         # slot): register for OOM-recovery coordination
         depth = getattr(self._tls, "depth", 0)
+        timer = None
         if depth == 0:
+            from pilosa_tpu.obs import StageTimer
+            timer = StageTimer(self.stats)
             # bounded concurrency FIRST: each executing query holds
             # live device scratch (program temps, per-query outputs);
             # with residency near budget, unbounded client threads
@@ -246,22 +320,53 @@ class Executor:
                     raise ExecutionError(
                         "executor at max concurrent queries for 180s; "
                         "retry later")
-            # park while a stage-2 OOM recovery drains to exclusivity —
-            # without this, steady arrivals keep the in-flight count
-            # above 1 and the drain can never finish.  AFTER the slot:
-            # a thread that waited out a long acquire must still honor
-            # a recovery that started meanwhile.  Bounded: a wedged
-            # recovery must not refuse service forever
-            self._recovery_open.wait(timeout=180.0)
-            self._enter_inflight()
-            self.planes.begin_query()
+            # slot held: from here, ANY setup failure must release it —
+            # a leaked slot is permanent, and max_concurrent leaks turn
+            # into a total outage behind the 180s-timeout error
+            # (ADVICE r5, the admission-slot leak)
+            try:
+                # park while a stage-2 OOM recovery drains to
+                # exclusivity — without this, steady arrivals keep the
+                # in-flight count above 1 and the drain can never
+                # finish.  AFTER the slot: a thread that waited out a
+                # long acquire must still honor a recovery that started
+                # meanwhile.  Bounded: a wedged recovery must not
+                # refuse service forever
+                self._recovery_open.wait(timeout=180.0)
+                self._enter_inflight()
+                try:
+                    self.planes.begin_query()
+                except BaseException:
+                    self._leave_inflight()
+                    raise
+            except BaseException:
+                if self._exec_slots is not None:
+                    self._exec_slots.release()
+                raise
+            timer.mark("admit")
+            self._tls.stage_timer = timer
         self._tls.depth = depth + 1
         try:
+            if isinstance(query, str):
+                if depth == 0:
+                    # plan-cache fast path: a repeated all-Count serving
+                    # shape skips parse AND plan (r6 tentpole)
+                    out = self._execute_planned(
+                        index, index_name, query, shards, translate_output,
+                        tracer, deadline, timer)
+                    if out is not None:
+                        return out
+                # memoized: repeated serving shapes skip the parser (the
+                # AST is never mutated in place — rewriters copy first)
+                query = parse_cached(query)
+                if timer is not None:
+                    timer.mark("parse")
             return self._execute_calls(index, index_name, query, shards,
                                        translate_output, tracer, deadline)
         finally:
             self._tls.depth = depth
             if depth == 0:
+                self._tls.stage_timer = None
                 self.planes.end_query()
                 self._leave_inflight()
                 if self._exec_slots is not None:
@@ -333,9 +438,29 @@ class Executor:
                 all_leaves.extend(leaves)
         except Unfusable:
             return None
-        per_shard = self.fused.run_count_batch(tuple(nodes),
-                                               tuple(all_leaves))
+        timer = getattr(self._tls, "stage_timer", None)
+        if timer is not None:
+            timer.mark("plan")
+        return self._dispatch_count_run(tuple(nodes), tuple(all_leaves),
+                                        timer)
+
+    def _dispatch_count_run(self, nodes: tuple, leaves: tuple,
+                            timer) -> list[int]:
+        """One request's planned Count run → per-call totals (the one
+        dispatch tail shared by the plan-cached and freshly-planned
+        paths).  With the batcher, the whole request is ONE batch item:
+        concurrent requests share a dispatch + read."""
+        if self.batcher is not None:
+            out = self.batcher.submit_many(nodes, leaves)
+            if timer is not None:
+                timer.mark("read")
+            return out
+        per_shard = self.fused.run_count_batch(nodes, leaves)
+        if timer is not None:
+            timer.mark("dispatch")
         host = np.asarray(per_shard).astype(np.int64)  # one read
+        if timer is not None:
+            timer.mark("read")
         return [int(row.sum()) for row in host]
 
     def _count_batch_plane(self, ctx: _Ctx, calls: list[Call]) \
@@ -398,31 +523,426 @@ class Executor:
                                             VIEW_STANDARD, ctx.shards)
         if ps is None:
             return None
-        # cross-shard reduce on DEVICE when int32 stays exact
-        # (n_shards * 2^20 < 2^31): the read shrinks from
-        # int32[S, R] to int32[R] — on transports with per-read costs
-        # the smaller payload is the serving hot path.  Wider shard
-        # sets keep per-shard counts and finish in int64 on host
-        # (engine int32 policy).
-        if len(ctx.shards) <= (1 << 31) // SHARD_WIDTH - 1:
-            key = (("countbatch-plane-reduced", ps.plane.shape), "count")
-            fn = self.fused._cached(
-                key, lambda: (lambda p: jnp.sum(
-                    kernels.row_counts(p), axis=0, dtype=jnp.int32)))
-            totals = np.asarray(fn(ps.plane)).astype(np.int64)  # one read
-        else:
-            key = (("countbatch-plane", ps.plane.shape), "count")
-            fn = self.fused._cached(key, lambda: kernels.row_counts)
-            host = np.asarray(fn(ps.plane)).astype(np.int64)
-            totals = host.sum(axis=0)
+        totals = self._plane_totals(
+            ps, getattr(self._tls, "stage_timer", None))
         out = []
         for rid in row_ids:
             slot = (ps.slot_of.get(int(rid)) if rid is not None else None)
             out.append(int(totals[slot]) if slot is not None else 0)
         return out
 
-    def _shards_for(self, index: Index, shards, call: Call) -> tuple[int, ...]:
-        opts = call.args.get("shards") if call.name == "Options" else None
+    # int32 cross-shard reduce stays exact while n_shards·2^20 < 2^31
+    _REDUCE_SHARD_MAX = (1 << 31) // SHARD_WIDTH - 1
+
+    def _plane_totals(self, ps, timer=None) -> np.ndarray:
+        """Whole-plane per-row totals int64[R_pad]: one program + one
+        read, coalesced ACROSS concurrent requests via the batcher
+        (identical planes dedupe to one computation per window).
+
+        Cross-shard reduce on DEVICE when int32 stays exact
+        (n_shards * 2^20 < 2^31): the read shrinks from int32[S, R] to
+        int32[R] — on transports with per-read costs the smaller
+        payload is the serving hot path.  Wider shard sets keep
+        per-shard counts and finish in int64 on host (engine int32
+        policy)."""
+        small = len(ps.shards) <= self._REDUCE_SHARD_MAX
+        if self.batcher is not None and small:
+            totals = self.batcher.submit_rowcounts(ps.plane)
+            if timer is not None:
+                timer.mark("read")  # coalesced wait: window+dispatch+read
+            return totals
+        if small:
+            key = (("countbatch-plane-reduced", ps.plane.shape), "count")
+            fn = self.fused._cached(
+                key, lambda: (lambda p: jnp.sum(
+                    kernels.row_counts(p), axis=0, dtype=jnp.int32)))
+            out = fn(ps.plane)
+            if timer is not None:
+                timer.mark("dispatch")
+            totals = np.asarray(out).astype(np.int64)  # one read
+            if timer is not None:
+                timer.mark("read")
+        else:
+            key = (("countbatch-plane", ps.plane.shape), "count")
+            fn = self.fused._cached(key, lambda: kernels.row_counts)
+            out = fn(ps.plane)
+            if timer is not None:
+                timer.mark("dispatch")
+            host = np.asarray(out).astype(np.int64)
+            if timer is not None:
+                timer.mark("read")
+            totals = host.sum(axis=0)
+        return totals
+
+    # ---------------------------------------------------------- plan cache
+
+    def invalidate_plans(self, index: str | None = None) -> None:
+        """Drop cached plans (all, or one index's) — schema deletions
+        must not leave plans resolving against a recreated namesake."""
+        with self._plans_lock:
+            if index is None:
+                self._plans.clear()
+                return
+            for key in [k for k in self._plans if k[0] == index]:
+                del self._plans[key]
+
+    def _execute_planned(self, index, index_name: str, query: str, shards,
+                         translate_output: bool, tracer,
+                         deadline: float | None, timer) -> list | None:
+        """Plan-cache fast path for all-Count queries (the dominant
+        serving family).  Returns the results list, or None to fall
+        through to the parse path (unplannable shape, stale entry, or
+        a plane that isn't resident — admission decisions stay on the
+        un-cached path)."""
+        # strip() only — whitespace INSIDE the query can be inside a
+        # quoted row key, where collapsing it would alias two distinct
+        # queries onto one plan (wrong answers, not a perf bug)
+        skey = (index_name, query.strip(),
+                tuple(shards) if shards is not None else None,
+                translate_output)
+        with self._plans_lock:
+            entry = self._plans.get(skey)
+            if entry is not None:
+                self._plans.move_to_end(skey)
+        if entry is _UNPLANNABLE:
+            return None
+        if entry is None:
+            self.stats.count("plan_cache_misses", 1)
+            # build TWICE and require identical generation snapshots:
+            # generations are monotonic, so equal snapshots bracket the
+            # second walk — a write racing the build (e.g. creating a
+            # row the first walk resolved as absent, THEN snapshotting
+            # the post-write generations) cannot produce a stale plan
+            # that validates as fresh.  Under hot writes we just don't
+            # cache this request; the normal path serves it.
+            first = self._build_plan(index, query, shards,
+                                     translate_output)
+            entry = None
+            if first is not None:
+                second = self._build_plan(index, query, shards,
+                                          translate_output)
+                if second is not None and second.gens == first.gens:
+                    entry = second
+            if first is not None and entry is None:
+                return None  # racing writes: retry on the next request
+            with self._plans_lock:
+                self._plans[skey] = (entry if entry is not None
+                                     else _UNPLANNABLE)
+                while len(self._plans) > self.MAX_PLANS:
+                    self._plans.popitem(last=False)
+            if entry is None:
+                return None
+        else:
+            self.stats.count("plan_cache_hits", 1)
+        # validity: current shard set + dependency generations must
+        # match what the plan was built against — a write to any source
+        # fragment (or a shard appearing) invalidates here, and the
+        # normal path re-plans on the next request
+        if (self._shards_for(index, shards, None) != entry.shards
+                or self._dep_gens(index, entry.deps,
+                                  entry.shards) != entry.gens
+                or any((f := index.field(fname)) is None
+                       or f.options.bit_depth != d
+                       for fname, d in entry.bsi_depths)):
+            self._drop_plan(skey, entry)
+            return None
+        return self._run_plan(index, index_name, entry, translate_output,
+                              tracer, deadline, timer)
+
+    def _drop_plan(self, skey, entry) -> None:
+        self.stats.count("plan_cache_invalidations", 1)
+        with self._plans_lock:
+            if self._plans.get(skey) is entry:
+                del self._plans[skey]
+
+    def _build_plan(self, index, query: str, shards,
+                    translate_output: bool) -> "_PlanEntry | None":
+        from pilosa_tpu.exec.fused import Unfusable
+        try:
+            query_ast = parse_cached(query)
+        except Exception:  # noqa: BLE001 — errors surface on normal path
+            return None
+        calls = query_ast.calls
+        if not calls or any(c.name != "Count" or len(c.children) != 1
+                            for c in calls):
+            return None
+        ctx = _Ctx(index, self._shards_for(index, shards, None),
+                   translate_output)
+        try:
+            entry = self._plan_plane_entry(ctx, calls)
+            if entry is not None:
+                return entry
+            specs: list = []
+            deps: dict[tuple, None] = {}
+            depths: dict[str, int] = {}
+            nodes = []
+            for call in calls:
+                nodes.append(self._plan_spec(ctx, call.children[0],
+                                             specs, deps, depths))
+        except (Unfusable, ExecutionError):
+            # execution errors re-raise identically on the normal path;
+            # a later schema change that would make the query plannable
+            # is served (correctly) by the normal path forever — a
+            # perf-only conservatism
+            return None
+        deps = tuple(deps)
+        return _PlanEntry("generic", ctx.shards, deps,
+                          self._dep_gens(index, deps, ctx.shards),
+                          len(calls), nodes=tuple(nodes),
+                          leaf_specs=tuple(specs),
+                          bsi_depths=tuple(depths.items()))
+
+    def _plan_plane_entry(self, ctx: _Ctx, calls) -> "_PlanEntry | None":
+        """Match the same-field plain-row batch shape that
+        :meth:`_count_batch_plane` serves with ONE whole-plane program
+        (the BENCH headline family)."""
+        fname = None
+        values = []
+        for call in calls:
+            child = call.children[0]
+            if child.name != "Row" or child.children:
+                return None
+            hit = _field_arg(child)
+            if hit is None:
+                return None
+            f, v = hit
+            if isinstance(v, (Condition, Call)):
+                return None
+            if ("from" in child.args or "to" in child.args
+                    or "_timestamp" in child.args):
+                return None
+            if fname is None:
+                fname = f
+            elif f != fname:
+                return None
+            values.append(v)
+        if fname is None or not ctx.shards:
+            return None
+        field = ctx.index.field(str(fname))
+        if field is None or field.options.type in BSI_TYPES:
+            return None
+        row_ids = tuple(
+            int(r) if (r := self._row_id(ctx, field, v,
+                                         create=False)) is not None else None
+            for v in values)
+        deps = ((field.name, VIEW_STANDARD),)
+        return _PlanEntry("plane", ctx.shards, deps,
+                          self._dep_gens(ctx.index, deps, ctx.shards),
+                          len(calls), field_name=field.name,
+                          row_ids=row_ids)
+
+    def _dep_gens(self, index, deps: tuple, shards: tuple) -> tuple:
+        out = []
+        for fname, vname in deps:
+            f = (index.existence_field if fname == "\x00exists"
+                 else index.field(fname))
+            view = f.views.get(vname) if f is not None else None
+            out.append(view.generations_fast(shards)
+                       if view is not None else None)
+        return tuple(out)
+
+    def _plan_spec(self, ctx: _Ctx, call: Call, specs: list,
+                   deps: dict, depths: dict):
+        """Mirror of :meth:`_plan` that records hashable LEAF SPECS
+        instead of arrays — the cached form re-materializes through
+        the plane cache on every hit (arrays are never cached here;
+        predicate masks, which are pure functions of the query text,
+        are)."""
+        from pilosa_tpu.exec.fused import Unfusable
+        name = call.name
+
+        def leaf(spec) -> tuple:
+            specs.append(spec)
+            return ("leaf", len(specs) - 1)
+
+        if name in ("Row", "Range"):
+            hit = _field_arg(call)
+            if hit is None:
+                raise ExecutionError(f"{name}: missing field argument")
+            fname, value = hit
+            field = self._field(ctx, fname)
+            if isinstance(value, Condition) \
+                    or field.options.type in BSI_TYPES:
+                cond = (value if isinstance(value, Condition)
+                        else Condition("==", value))
+                return self._plan_spec_bsi(ctx, field, cond, specs, deps,
+                                           depths, leaf)
+            if ("from" in call.args or "to" in call.args
+                    or "_timestamp" in call.args):
+                raise Unfusable("time-range rows are not plan-cached")
+            deps[(field.name, VIEW_STANDARD)] = None
+            row_id = self._row_id(ctx, field, value, create=False)
+            if row_id is None:
+                return leaf(("zeros",))
+            return leaf(("row", field.name, VIEW_STANDARD, int(row_id)))
+        if name == "All":
+            deps[("\x00exists", VIEW_STANDARD)] = None
+            return leaf(("exists",))
+        if name == "Not":
+            if len(call.children) != 1:
+                raise ExecutionError("Not: exactly one child required")
+            child = self._plan_spec(ctx, call.children[0], specs, deps,
+                                    depths)
+            deps[("\x00exists", VIEW_STANDARD)] = None
+            specs.append(("exists",))
+            return ("not", child, len(specs) - 1)
+        kids = call.children
+        if name == "Union" and not kids:
+            return leaf(("zeros",))
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            if not kids:
+                raise ExecutionError(f"{name}: at least one child required")
+            op = {"Union": "or", "Intersect": "and",
+                  "Difference": "andnot", "Xor": "xor"}[name]
+            return (op, tuple(self._plan_spec(ctx, k, specs, deps, depths)
+                              for k in kids))
+        if name == "Shift":
+            if len(call.children) != 1:
+                raise ExecutionError("Shift: exactly one child required")
+            n = self._shift_n(call)
+            return ("shift",
+                    self._plan_spec(ctx, call.children[0], specs, deps,
+                                    depths), n)
+        raise Unfusable(f"{name} is not plan-cached")
+
+    def _plan_spec_bsi(self, ctx: _Ctx, field: Field, cond: Condition,
+                       specs: list, deps: dict, depths: dict, leaf):
+        if field.options.type not in BSI_TYPES:
+            raise ExecutionError(
+                f"field {field.name!r}: condition on non-BSI field")
+        deps[(field.name, field.bsi_view_name)] = None
+        depths[field.name] = field.options.bit_depth
+        if cond.op in BETWEEN_OPS:
+            lo_op = "gt" if cond.op.startswith("<>") else "ge"
+            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo = self._plan_spec_bsi_cmp(field, lo_op, cond.value[0],
+                                         specs, leaf)
+            hi = self._plan_spec_bsi_cmp(field, hi_op, cond.value[1],
+                                         specs, leaf)
+            return ("and", (lo, hi))
+        return self._plan_spec_bsi_cmp(field, _SCALAR_TO_KEY[cond.op],
+                                       cond.value, specs, leaf)
+
+    def _plan_spec_bsi_cmp(self, field: Field, op_key: str, value,
+                           specs: list, leaf):
+        opts = field.options
+        depth = opts.bit_depth
+        offset = field.to_stored(value) - opts.base
+        bound = (1 << depth) - 1
+        if offset > bound or offset < -bound:
+            all_hit = ((op_key in ("lt", "le", "ne")) if offset > bound
+                       else (op_key in ("gt", "ge", "ne")))
+            # depth growth (which shifts the saturation bound) bumps the
+            # bsi view's generations, invalidating the entry
+            return leaf(("bsi-exists", field.name, bool(all_hit)))
+        specs.append(("bsi-plane", field.name))
+        i_plane = len(specs) - 1
+        specs.append(("const",
+                      jnp.asarray(bsik.predicate_masks(abs(offset), depth))))
+        i_masks = len(specs) - 1
+        specs.append(("const", jnp.asarray(offset < 0)))
+        i_neg = len(specs) - 1
+        return ("bsi", i_plane, i_masks, i_neg, op_key)
+
+    def _leaves_from_specs(self, ctx: _Ctx, specs: tuple) -> list | None:
+        """Materialize plan-cached leaf specs through the plane cache
+        (each fetch revalidates its own generations).  None = a spec no
+        longer resolves (field gone) — caller invalidates."""
+        out: list = []
+        bsi_cache: dict = {}
+        for spec in specs:
+            kind = spec[0]
+            if kind == "row":
+                _, fname, vname, rid = spec
+                field = ctx.index.field(fname)
+                if field is None:
+                    return None
+                out.append(self.planes.row_words(ctx.index.name, field,
+                                                 vname, rid, ctx.shards))
+            elif kind == "zeros":
+                out.append(self._zeros(ctx))
+            elif kind == "exists":
+                out.append(self._exists(ctx))
+            elif kind == "const":
+                out.append(spec[1])
+            else:  # "bsi-plane" | "bsi-exists"
+                fname = spec[1]
+                ps = bsi_cache.get(fname)
+                if ps is None:
+                    field = ctx.index.field(fname)
+                    if field is None or field.options.type not in BSI_TYPES:
+                        return None
+                    ps = self.planes.bsi_plane(ctx.index.name, field,
+                                               ctx.shards)
+                    bsi_cache[fname] = ps
+                if kind == "bsi-plane":
+                    out.append(ps.plane)
+                else:
+                    exists = ps.plane[..., bsik.EXISTS_ROW, :]
+                    out.append(exists if spec[2]
+                               else jnp.zeros_like(exists))
+        return out
+
+    def _run_plan(self, index, index_name: str, entry: "_PlanEntry",
+                  translate_output: bool, tracer,
+                  deadline: float | None, timer) -> list | None:
+        """Run a validated plan; None = not runnable right now (plane
+        not resident) — the caller falls through to the normal path,
+        keeping admission decisions there."""
+        ctx = _Ctx(index, entry.shards, translate_output,
+                   deadline=deadline)
+        ctx.check_deadline()
+        tracer = tracer or self.tracer
+        with tracer.span("executor.PlanCached", index=index_name,
+                         calls=entry.n_calls, shards=len(ctx.shards)):
+            t0 = time.perf_counter()
+            out = self._with_oom_retry(
+                lambda: self._run_plan_inner(ctx, entry, timer))
+            if out is not None:
+                self.stats.timing("query_seconds",
+                                  time.perf_counter() - t0,
+                                  call="CountBatch")
+        return out
+
+    def _run_plan_inner(self, ctx: _Ctx, entry: "_PlanEntry",
+                        timer) -> list | None:
+        if entry.kind == "plane":
+            field = ctx.index.field(entry.field_name)
+            if field is None:
+                return None
+            # residency only — admission (budget walks) stays on the
+            # un-cached path, exactly like _count_batch_plane
+            if not self.planes.has_plane(ctx.index.name, field,
+                                         VIEW_STANDARD, ctx.shards):
+                return None
+            ps = self.planes.field_plane_nowait(ctx.index.name, field,
+                                                VIEW_STANDARD, ctx.shards)
+            if ps is None:
+                return None
+            if timer is not None:
+                timer.mark("plan")
+            totals = self._plane_totals(ps, timer)
+            out = []
+            for rid in entry.row_ids:
+                slot = (ps.slot_of.get(rid) if rid is not None else None)
+                out.append(int(totals[slot]) if slot is not None else 0)
+            if timer is not None:
+                timer.mark("assemble")
+            return out
+        leaves = self._leaves_from_specs(ctx, entry.leaf_specs)
+        if leaves is None:
+            return None
+        if timer is not None:
+            timer.mark("plan")
+        out = self._dispatch_count_run(entry.nodes, tuple(leaves), timer)
+        if timer is not None:
+            timer.mark("assemble")
+        return out
+
+    def _shards_for(self, index: Index, shards,
+                    call: Call | None) -> tuple[int, ...]:
+        opts = (call.args.get("shards")
+                if call is not None and call.name == "Options" else None)
         if opts is not None:
             out = tuple(int(s) for s in opts)
         elif shards is not None:
@@ -1058,7 +1578,13 @@ class Executor:
                 "Distinct: bit depth > 24 not supported (presence array "
                 "would exceed 16M entries)")
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
-        pos, neg = bsik.distinct_presence(ps.plane, filter_words)
+        if self.batcher is not None:
+            # concurrent identical Distincts share one presence scan
+            # through the coalescing window (dedupe, not stacking —
+            # the scan is a multi-dispatch block loop)
+            pos, neg = self.batcher.submit_distinct(ps.plane, filter_words)
+        else:
+            pos, neg = bsik.distinct_presence(ps.plane, filter_words)
         pos = np.nonzero(np.asarray(pos))[0]
         neg = np.nonzero(np.asarray(neg))[0]
         base = field.options.base
@@ -1225,11 +1751,28 @@ class Executor:
             if ps.n_rows == 0:
                 return ({"pairs": [], "srcCount": src_count} if want_partial
                         else PairsResult([]))
-            counts = kernels.row_counts(ps.plane, filter_words)
-            totals = kernels.shard_totals(counts)[:ps.n_rows]
-            if need_row_counts:
-                row_totals = kernels.shard_totals(
-                    kernels.row_counts(ps.plane, None))[:ps.n_rows]
+            if (self.batcher is not None
+                    and len(ctx.shards) <= self._REDUCE_SHARD_MAX):
+                # dense TopN joins the coalescing window: concurrent
+                # requests over the same resident plane share one
+                # program and one read (unfiltered requests dedupe
+                # outright; the int32 device reduce needs the same
+                # shard bound as _plane_totals).  Both reads enqueue
+                # BEFORE either wait, so a tanimoto request pays one
+                # collection window, not two in series.
+                h1 = self.batcher.enqueue_rowcounts(ps.plane,
+                                                    filter_words)
+                h2 = (self.batcher.enqueue_rowcounts(ps.plane)
+                      if need_row_counts else None)
+                totals = self.batcher.wait(h1)[:ps.n_rows]
+                if h2 is not None:
+                    row_totals = self.batcher.wait(h2)[:ps.n_rows]
+            else:
+                counts = kernels.row_counts(ps.plane, filter_words)
+                totals = kernels.shard_totals(counts)[:ps.n_rows]
+                if need_row_counts:
+                    row_totals = kernels.shard_totals(
+                        kernels.row_counts(ps.plane, None))[:ps.n_rows]
             all_rows = ps.row_ids
         elif filter_words is None:
             # unfiltered: row cardinalities are host truth (directory

@@ -105,25 +105,68 @@ def shift_leaves(node, offset: int):
 class FusedCache:
     """structure key -> jitted program, LRU-bounded: structure keys can
     embed user-controlled constants (e.g. Shift n), so the program set
-    must not grow without bound.  One instance per executor."""
+    must not grow without bound.  One instance per executor.
+
+    Concurrency (r6): the hot path is LOCK-FREE — a plain-dict lookup
+    plus a recency-stamp write, both GIL-atomic — because the previous
+    single lock was taken on every cached-program hit by every serving
+    thread (32 streams × several programs per request).  Compilation
+    serializes PER KEY (two threads racing the same new shape compile
+    it once; different shapes compile concurrently); the global lock
+    guards only insertion and eviction."""
 
     MAX_PROGRAMS = 256
 
     def __init__(self):
         import threading
-        from collections import OrderedDict
-        self._programs: "OrderedDict" = OrderedDict()
-        self._lock = threading.Lock()
+        from pilosa_tpu.exec._lru import Stamps
+        self._programs: dict = {}     # key -> jitted fn (GIL-atomic reads)
+        self._stamps = Stamps()       # approx-LRU recency (lock-free touch)
+        self._lock = threading.Lock()       # insert / evict only
+        self._compiling: dict = {}          # key -> per-key compile lock
+        self._threading = threading
+
+    def _get_fast(self, key):
+        fn = self._programs.get(key)
+        if fn is not None:
+            self._stamps.touch(key)
+        return fn
+
+    def _insert(self, key, fn) -> None:
+        with self._lock:
+            self._programs[key] = fn
+            self._stamps.insert(key)
+            if len(self._programs) > self.MAX_PROGRAMS:
+                excess = len(self._programs) - self.MAX_PROGRAMS
+                stamps = self._stamps.snapshot()
+                for k, _ in sorted(stamps, key=lambda kv: kv[1])[:excess]:
+                    if k == key:
+                        continue
+                    self._programs.pop(k, None)
+                    self._stamps.pop(k)
+                    self._compiling.pop(k, None)
+            self._stamps.cleanup(self._programs)
+
+    def _cached(self, key, build):
+        fn = self._get_fast(key)
+        if fn is not None:
+            return fn
+        # per-structure-key compile lock: setdefault is atomic, so two
+        # racers share one lock and the loser reuses the winner's program
+        lock = self._compiling.setdefault(key, self._threading.Lock())
+        with lock:
+            fn = self._programs.get(key)
+            if fn is None:
+                fn = jax.jit(build())
+                self._insert(key, fn)
+        return fn
 
     def run(self, node, leaves, want: str):
         """Execute a planned tree: ``want`` is "words" (bitmap) or
         "count" (fused popcount-reduce scalar)."""
         key = (node, want)
-        with self._lock:
-            fn = self._programs.get(key)
-            if fn is not None:
-                self._programs.move_to_end(key)
-        if fn is None:
+
+        def build():
             if want == "count":
                 # per-shard int32 counts; the caller finishes the tiny
                 # cross-shard sum in int64 on host (engine int32 policy)
@@ -132,25 +175,9 @@ class FusedCache:
             else:
                 def program(*ls):
                     return _build(node, ls)
-            fn = jax.jit(program)
-            with self._lock:
-                self._programs[key] = fn
-                while len(self._programs) > self.MAX_PROGRAMS:
-                    self._programs.popitem(last=False)
-        return fn(*leaves)
+            return program
 
-    def _cached(self, key, build):
-        with self._lock:
-            fn = self._programs.get(key)
-            if fn is not None:
-                self._programs.move_to_end(key)
-        if fn is None:
-            fn = jax.jit(build())
-            with self._lock:
-                self._programs[key] = fn
-                while len(self._programs) > self.MAX_PROGRAMS:
-                    self._programs.popitem(last=False)
-        return fn
+        return self._cached(key, build)(*leaves)
 
     def run_count_batch(self, nodes: tuple, leaves):
         """K Count trees in ONE program: returns int32[K, n_shards] —
@@ -163,6 +190,30 @@ class FusedCache:
                                   for n in nodes])
             return program
         return self._cached((nodes, "count-batch"), build)(*leaves)
+
+    def run_rowcounts_batch(self, flags: tuple, leaves):
+        """K whole-plane row-count items (same plane shape) in ONE
+        program: per item, ``row_counts`` over the plane (AND a filter
+        bitmap when flagged) reduced over the shard axis in int32 —
+        exact while n_shards·2^20 < 2^31; callers gate on that.
+        ``flags[k]`` = item k has a filter leaf; leaves alternate
+        plane[, filter] per item.  Returns int32[K, R_pad]: one stacked
+        array = one read for the whole coalescing window (the dense
+        TopN / same-field count-batch serving spine)."""
+        def build():
+            def program(*ls):
+                rows = []
+                i = 0
+                for has_filter in flags:
+                    plane = ls[i]
+                    flt = ls[i + 1] if has_filter else None
+                    i += 2 if has_filter else 1
+                    rows.append(jnp.sum(kernels.row_counts(plane, flt),
+                                        axis=0, dtype=jnp.int32))
+                return jnp.stack(rows)
+            return program
+        return self._cached(
+            (flags, leaves[0].shape, "rowcounts-batch"), build)(*leaves)
 
     def run_sum_batch(self, flags: tuple, leaves):
         """K BSI Sum items (same bit depth) in ONE program.  ``flags[k]``

@@ -20,7 +20,6 @@ rebuilt per query rather than cached.
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -138,11 +137,18 @@ class PlaneCache:
         mesh sharding; default is plain ``jax.device_put``.
         ``placement`` (the MeshPlacement the executor runs under, if
         any) additionally drives the sparse build's device blocking."""
+        from pilosa_tpu.exec._lru import Stamps
         self.place = place or (placement.place if placement is not None
                                else jax.device_put)
         self.placement = placement
         self.budget = budget_bytes
-        self._entries: OrderedDict[tuple, tuple[tuple, object, int]] = OrderedDict()
+        # plain dict (NOT OrderedDict): the serving hot path revalidates
+        # entries lock-free (GIL-atomic dict reads + a recency-stamp
+        # write), so the one cache RLock stops serializing every
+        # concurrent plane fetch; recency for eviction lives in _stamps
+        # (shared race-handling with FusedCache — exec/_lru.py)
+        self._entries: dict[tuple, tuple[tuple, object, int]] = {}
+        self._stamps = Stamps()
         self._bytes_cache: dict[tuple, tuple[tuple, int]] = {}
         self._zeros: dict[int, jax.Array] = {}
         self._bytes = 0
@@ -187,6 +193,7 @@ class PlaneCache:
             pinned = self._pinned()
             for key in [k for k in self._entries if k not in pinned]:
                 _, _, nbytes = self._entries.pop(key)
+                self._stamps.pop(key)
                 self._bytes -= nbytes
 
     # -- public -------------------------------------------------------------
@@ -225,11 +232,18 @@ class PlaneCache:
         Upstream serves straight from mmap with no warm-up
         (``fragment.Open``, SURVEY §4.1) — availability first."""
         key = ("plane", index, field.name, view_name, shards)
+        # lock-free fast path (mirrors _get): fresh resident plane
+        hit = self._entries.get(key)
+        if hit is not None and hit[0] == self._gens_fast(field, view_name,
+                                                         shards):
+            self._touch(key)
+            self._lease_fast(key)
+            return hit[1]
         gens = self._gens(field, view_name, shards)
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None and hit[0] == gens:
-                self._entries.move_to_end(key)
+                self._touch(key)
                 self._lease(key)
                 return hit[1]
             if key in self._building:
@@ -342,10 +356,9 @@ class PlaneCache:
         past the budget since admission, and ``field_plane`` would
         rebuild it at the new size unconditionally."""
         key = ("plane", index, field.name, view_name, shards)
-        with self._lock:
-            hit = self._entries.get(key)
-        return hit is not None and hit[0] == self._gens(field, view_name,
-                                                        shards)
+        hit = self._entries.get(key)  # GIL-atomic; no lock needed
+        return hit is not None and hit[0] == self._gens_fast(
+            field, view_name, shards)
 
     def rows_plane(self, index: str, field: Field, view_name: str,
                    row_ids: np.ndarray,
@@ -614,10 +627,12 @@ class PlaneCache:
             self._bytes_cache.clear()
             if index is None:
                 self._entries.clear()
+                self._stamps.clear()
                 self._bytes = 0
                 return
             for key in [k for k in self._entries if k[1] == index]:
                 _, _, nbytes = self._entries.pop(key)
+                self._stamps.pop(key)
                 self._bytes -= nbytes
 
     # -- internal -----------------------------------------------------------
@@ -631,19 +646,55 @@ class PlaneCache:
         # like any absent shard
         return view.generations(shards)
 
+    def _gens_fast(self, field: Field, view_name: str,
+                   shards: tuple[int, ...]) -> tuple:
+        """Lock-free generation read for the revalidation fast path:
+        skips the field lock (``views`` dict read is GIL-atomic) AND
+        the view lock (:meth:`View.generations_fast`) — the two
+        per-query lock round trips the r6 concurrency work removed."""
+        view = field.views.get(view_name)
+        if view is None:
+            return ()
+        return view.generations_fast(shards)
+
+    def _touch(self, key) -> None:
+        # lock-free recency (eviction order degrades to approximate
+        # LRU, which is all the byte-budget pass ever needed)
+        self._stamps.touch(key)
+
     def _lease(self, key) -> None:
         # caller holds self._lock
         lease = self._leases.get(threading.get_ident())
         if lease is not None:
             lease.add(key)
 
+    def _lease_fast(self, key) -> None:
+        """Lock-free lease: replace this thread's lease set wholesale
+        (existing-key dict write — atomic, no resize).  ``_pinned``
+        snapshots the values under the cache lock and unions fully-
+        formed set objects, so it sees either the old or the new set,
+        never a torn one."""
+        tid = threading.get_ident()
+        lease = self._leases.get(tid)
+        if lease is not None and key not in lease:
+            self._leases[tid] = lease | {key}
+
     def _get(self, key, field: Field, view_name: str,
              shards: tuple[int, ...], build) -> PlaneSet:
+        # lock-free fast path: the common serving case is a fresh
+        # resident plane — one dict read + one generation compare,
+        # no cache lock, no view lock
+        hit = self._entries.get(key)
+        if hit is not None and hit[0] == self._gens_fast(field, view_name,
+                                                         shards):
+            self._touch(key)
+            self._lease_fast(key)
+            return hit[1]
         gens = self._gens(field, view_name, shards)
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None and hit[0] == gens:
-                self._entries.move_to_end(key)
+                self._touch(key)
                 self._lease(key)
                 return hit[1]
         if hit is not None and key[0] in ("plane", "bsi", "rows", "row"):
@@ -672,6 +723,7 @@ class PlaneCache:
             if old is not None:
                 self._bytes -= old[2]
             self._entries[key] = (gens, ps, nbytes)
+            self._stamps.insert(key)
             self._bytes += nbytes
             if lease:
                 self._lease(key)
@@ -682,14 +734,17 @@ class PlaneCache:
             # when an eviction pass actually runs)
             if self._bytes > self.budget and len(self._entries) > 1:
                 pinned = self._pinned()
-                for k in list(self._entries):
+                for k in sorted(self._entries,
+                                key=lambda k: self._stamps.get(k)):
                     if (self._bytes <= self.budget
                             or len(self._entries) <= 1):
                         break
                     if k == key or k in pinned:
                         continue
                     _, _, old_bytes = self._entries.pop(k)
+                    self._stamps.pop(k)
                     self._bytes -= old_bytes
+            self._stamps.cleanup(self._entries)
 
     # Incremental cap: beyond this many changed (row, word) cells a
     # full rebuild is cheaper than the scatter
@@ -783,7 +838,7 @@ class PlaneCache:
             cur = self._entries.get(key)
             if cur is not None and cur[1] is ps:  # not replaced meanwhile
                 self._entries[key] = (tuple(actual), new_ps, nbytes)
-                self._entries.move_to_end(key)
+                self._stamps.insert(key)
         self.incremental_applied += 1
         return new_ps
 

@@ -16,8 +16,11 @@ import time
 from pilosa_tpu import __version__
 
 
-def build_payload(holder, cluster=None) -> dict:
-    """Anonymized usage snapshot (counts only, no names/keys)."""
+def build_payload(holder, cluster=None, stats=None) -> dict:
+    """Anonymized usage snapshot (counts only, no names/keys).  With
+    ``stats``, includes the per-stage query-overhead summary
+    (``query_stage_seconds``) so a payload doubles as the serving-path
+    attribution dump."""
     n_fields = 0
     n_shards = 0
     field_types: dict[str, int] = {}
@@ -37,6 +40,12 @@ def build_payload(holder, cluster=None) -> dict:
         "fieldTypes": field_types,
         "numNodes": len(cluster.member_ids()) if cluster else 1,
     }
+    if stats is not None:
+        try:
+            payload["queryStages"] = stats.histogram_summary(
+                "query_stage_seconds")
+        except Exception:  # noqa: BLE001
+            pass
     try:
         import jax
         payload["deviceKind"] = jax.devices()[0].device_kind
@@ -51,9 +60,10 @@ class Diagnostics:
     (upstream default-on behavior deliberately inverted)."""
 
     def __init__(self, holder, cluster=None, interval: float = 0.0,
-                 send=None, logger=None):
+                 send=None, logger=None, stats=None):
         self.holder = holder
         self.cluster = cluster
+        self.stats = stats
         self.interval = interval
         self.send = send or self._log_sink
         self.logger = logger
@@ -75,7 +85,8 @@ class Diagnostics:
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
             try:
-                self.send(build_payload(self.holder, self.cluster))
+                self.send(build_payload(self.holder, self.cluster,
+                                        stats=self.stats))
             except Exception:  # noqa: BLE001
                 pass
 

@@ -10,6 +10,7 @@ reference's nop client for tests.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 
 _BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
@@ -71,6 +72,24 @@ class Stats:
 
     # -- export -------------------------------------------------------------
 
+    def histogram_summary(self, name: str) -> dict:
+        """Compact per-label view of one histogram family:
+        ``{label: {count, sum, mean}}`` — the ``diagnostics`` dump of
+        the per-stage query timers (``query_stage_seconds``), cheap
+        enough for ``/status`` consumers that don't want the full
+        Prometheus bucket text."""
+        with self._lock:
+            fam = self._hists.get(name)
+            if not fam:
+                return {}
+            out = {}
+            for key, h in sorted(fam.items()):
+                label = ",".join(f"{k}={v}" for k, v in key) or "total"
+                n = h[-1]
+                out[label] = {"count": n, "sum": round(h[-2], 6),
+                              "mean": round(h[-2] / n, 6) if n else 0.0}
+            return out
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -120,8 +139,44 @@ class NopStats:
     def timing(self, *a, **k):
         pass
 
+    def histogram_summary(self, name):
+        return {}
+
     def snapshot(self):
         return {"counters": {}, "gauges": {}}
 
     def prometheus_text(self):
         return ""
+
+
+class StageTimer:
+    """Per-request overhead attribution: ``mark(stage)`` charges the
+    monotonic time since the previous mark to that stage as one
+    ``query_stage_seconds{stage=...}`` histogram observation.
+
+    Stages on the serving path: ``admit`` (execution-slot acquisition +
+    recovery gate), ``parse`` (PQL text → AST), ``plan`` (AST → leaf
+    arrays/program structure, incl. plan-cache validation), ``dispatch``
+    (program enqueue), ``read`` (device → host; on batcher-coalesced
+    requests the whole coalesced wait — window + dispatch + read — is
+    charged here, there is no per-request dispatch to time), and
+    ``assemble`` (host result construction).  The per-stage sums are
+    the attribution bench/config18 prints — the residual product/raw
+    concurrency gap is measured per stage, not guessed."""
+
+    __slots__ = ("_stats", "_metric", "_last")
+
+    def __init__(self, stats, metric: str = "query_stage_seconds"):
+        self._stats = stats
+        self._metric = metric
+        self._last = time.perf_counter()
+
+    def mark(self, stage: str) -> None:
+        now = time.perf_counter()
+        self._stats.observe(self._metric, now - self._last, stage=stage)
+        self._last = now
+
+    def reset(self) -> None:
+        """Restart the clock without charging anything (skip a gap that
+        belongs to no stage)."""
+        self._last = time.perf_counter()

@@ -95,7 +95,7 @@ class PilosaTPUServer:
         self.diagnostics = Diagnostics(
             self.holder, self.cluster,
             interval=self.cfg.diagnostics_interval,
-            logger=self.logger).start()
+            logger=self.logger, stats=self.stats).start()
         return self
 
     def close(self) -> None:

@@ -72,6 +72,25 @@ class View:
             return tuple(
                 frags[s].generation if s in frags else -1 for s in shards)
 
+    def generations_fast(self, shards) -> tuple:
+        """Lock-free :meth:`generations`: dict lookups and int reads
+        are GIL-atomic, and the view lock never serialized against
+        fragment mutations anyway (those bump ``Fragment.generation``
+        under the FRAGMENT lock) — so the freshness semantics are
+        identical while the serving hot path stops taking the view
+        lock per plane revalidation.  A torn read across a concurrent
+        fragment creation only yields a conservative mismatch (the
+        caller rebuilds), never a stale hit."""
+        frags = self.fragments
+        out = []
+        for s in shards:
+            # .get, not membership+subscript: a fragment popped between
+            # the two (empty-orphan deletion) must read as absent, not
+            # raise on the serving hot path
+            f = frags.get(s)
+            out.append(f.generation if f is not None else -1)
+        return tuple(out)
+
     def max_row_id(self) -> int:
         with self._lock:
             return max((f.max_row_id() for f in self.fragments.values()),

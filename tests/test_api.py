@@ -395,3 +395,71 @@ class TestBsiExport:
         c.create_field("i", "d", {"type": "decimal", "scale": 1})
         c.import_values("i", "d", columnIDs=[4], values=[2.5])
         assert c.export_csv("i", "d") == "4,2.5\n"
+
+
+class TestClientRetryPolicy:
+    """ADVICE r5: the stale-socket retry used to re-send EVERY method,
+    including POSTs whose first attempt may already have been applied
+    server-side (at-least-once).  Now: send-phase failures always
+    retry; lost-response failures retry only idempotent requests."""
+
+    class _FakeResp:
+        status = 200
+        will_close = True
+
+        class headers:  # noqa: N801 — duck-typed email.Message surface
+            @staticmethod
+            def get(name, default=""):
+                return "application/json"
+
+        @staticmethod
+        def read():
+            return b'{"ok": true}'
+
+    def _client(self, fail_exc, **kw):
+        """A Client whose first connection dies with ``fail_exc`` after
+        the request was (possibly) sent; the retry connection works."""
+        from pilosa_tpu.api.client import Client
+        c = Client("127.0.0.1", 1, **kw)
+        outer = self
+
+        class FakeConn:
+            def __init__(self, fail):
+                self.fail = fail
+                self.sock = None
+
+            def request(self, *a, **k):
+                if self.fail:
+                    raise fail_exc
+
+            def getresponse(self):
+                return outer._FakeResp()
+
+            def close(self):
+                pass
+
+        c._checkout = lambda timeout, fresh=False: FakeConn(not fresh)
+        return c
+
+    def test_lost_response_post_does_not_retry(self):
+        from pilosa_tpu.api.client import ClientError
+        c = self._client(ConnectionResetError("reset"))
+        with pytest.raises(ClientError):
+            c._do("POST", "/index/i/query", b"Set(1, f=1)")
+
+    def test_lost_response_get_retries(self):
+        c = self._client(ConnectionResetError("reset"))
+        assert c._do("GET", "/status") == {"ok": True}
+
+    def test_send_phase_post_retries(self):
+        import http.client
+        c = self._client(http.client.CannotSendRequest())
+        assert c._do("POST", "/internal/heartbeat", b"{}") == {"ok": True}
+
+    def test_idempotent_posts_client_retries(self):
+        # the cluster's internode client: /internal/* POSTs are
+        # idempotent by contract (cluster/internal.py docstring)
+        c = self._client(ConnectionResetError("reset"),
+                         idempotent_posts=True)
+        assert c._do("POST", "/internal/fragment/merge", b"x") == \
+            {"ok": True}

@@ -31,3 +31,27 @@ def test_bench_child_end_to_end_toy_scale():
     # the salvage line the watchdog parent depends on must be present
     assert any(ln.startswith("BENCH-SALVAGE ")
                for ln in proc.stderr.splitlines()), "salvage line missing"
+
+
+def test_config18_concurrency_gap_smoke():
+    """bench/config18 (the product/raw concurrency-gap attribution
+    bench) in --smoke mode: tiny plane, CPU, sweep 1/2/4 — runs under
+    tier-1 so the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config18_concurrency_gap.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("concurrency_gap_ratio")
+    assert out["unit"] == "ratio" and out["value"] > 0
+    # the per-stage attribution must be present for every swept level
+    stages = out["detail"]["stages"]
+    assert set(stages) == {"1", "2", "4"}
+    assert all("read" in s for s in stages.values())

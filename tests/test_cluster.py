@@ -1479,3 +1479,123 @@ class TestAaeRepairsMissingFragment:
             restored = view1.fragment(0)
             assert restored is not None
             assert restored.row(1).cardinality == 50
+
+
+class TestPlacementHeartbeat:
+    """ADVICE r5: activated placement used to propagate only via one
+    best-effort broadcast — a node that missed it routed by stale
+    topology forever.  The placement version now rides every heartbeat
+    both ways and the trailing side pulls."""
+
+    def test_stale_node_pulls_on_heartbeat_response(self, tmp_path):
+        import time as _time
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path)) as c:
+            coord = c.server_for(
+                c.servers[0].cluster.coordinator_id()).cluster
+            other = next(s.cluster for s in c.servers
+                         if s.cluster is not coord)
+            # simulate a missed resize-completion broadcast: the
+            # coordinator activates a new placement version silently
+            with coord._lock:
+                coord.placement_version = max(
+                    _time.time(), coord.placement_version + 1.0)
+                coord._save_placement()
+            assert other.placement_version < coord.placement_version
+            # one heartbeat round from the stale node: the response
+            # carries the newer version and the stale side pulls
+            other._heartbeat_once()
+            assert other.placement_version == coord.placement_version
+            assert other.placement_ids == coord.placement_ids
+
+    def test_stale_node_pulls_when_heartbeated_at(self, tmp_path):
+        import time as _time
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path)) as c:
+            coord = c.server_for(
+                c.servers[0].cluster.coordinator_id()).cluster
+            other = next(s.cluster for s in c.servers
+                         if s.cluster is not coord)
+            with coord._lock:
+                coord.placement_version = max(
+                    _time.time(), coord.placement_version + 1.0)
+            # the NEWER node heartbeats the stale one: the handler sees
+            # the sender is ahead and pulls asynchronously
+            coord._heartbeat_once()
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                if other.placement_version == coord.placement_version:
+                    break
+                _time.sleep(0.05)
+            assert other.placement_version == coord.placement_version
+
+
+class TestOrphanHandoff:
+    """ADVICE r5 `_handoff_orphan` fixes: bits written between the
+    push snapshot and the delete are re-pushed, not lost; empty
+    orphans are deleted instead of re-scanned every round."""
+
+    def _orphan_shard(self, cluster, index="i"):
+        """A shard owned exclusively by the OTHER node (replicas=1)."""
+        for s in range(64):
+            owners = cluster.shard_owners(index, s)
+            if cluster.node_id not in owners:
+                return s, owners
+        raise AssertionError("no foreign-owned shard in 0..63")
+
+    def test_mutation_during_push_is_repushed_not_lost(self, tmp_path):
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            b = c.servers[1]
+            shard, owners = self._orphan_shard(b.cluster)
+            owner_srv = c.server_for(owners[0])
+            base = shard * SHARD_WIDTH
+            fld = b.api.holder.index("i").field("f")
+            fld.set_bit(1, base + 5)  # orphan bit on the wrong node
+
+            real_push = b.cluster.push_fragment
+            raced = []
+
+            def racy(index, field, view, shard_, dest):
+                real_push(index, field, view, shard_, dest)
+                if not raced:
+                    raced.append(1)
+                    # a Set routed here by a stale peer AFTER the push
+                    # snapshot, BEFORE the delete (the lost-write race)
+                    fld.set_bit(2, base + 7)
+
+            b.cluster.push_fragment = racy
+            b.cluster.sync_once()
+            assert raced, "handoff never pushed"
+            # the late bit reached the owner (re-push), nothing lost
+            o_fld = owner_srv.api.holder.index("i").field("f")
+            frag = o_fld.view("standard").fragment(shard)
+            assert frag is not None
+            assert list(frag.row(1).columns()) == [5]
+            assert list(frag.row(2).columns()) == [7]
+            # and the orphan is gone locally
+            view = fld.view("standard")
+            assert view is None or view.fragment(shard) is None
+
+    def test_empty_orphan_is_deleted(self, tmp_path):
+        import os
+        from pilosa_tpu.testing import run_cluster
+
+        with run_cluster(2, str(tmp_path)) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            b = c.servers[1]
+            shard, _ = self._orphan_shard(b.cluster)
+            fld = b.api.holder.index("i").field("f")
+            frag = fld.view("standard", create=True).fragment(shard,
+                                                              create=True)
+            path = frag.path
+            b.cluster.sync_once()
+            assert fld.view("standard").fragment(shard) is None, \
+                "empty orphan must be dropped, not re-scanned forever"
+            assert not os.path.exists(path)

@@ -440,3 +440,163 @@ def test_bounded_concurrency_queues_excess_queries(tmp_path):
     assert not errors, errors[:2]
     assert results == [want] * 6
     assert peak[0] <= 2, f"peak concurrent executions {peak[0]}"
+
+
+def test_admission_slot_survives_setup_failure(tmp_path):
+    """ADVICE r5: the admission semaphore used to leak its slot when
+    begin_query() raised after acquisition — max_concurrent such
+    failures turned into a permanent 180s-timeout outage.  Force the
+    failure max_concurrent times; queries must still admit."""
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex = Executor(holder, max_concurrent=2)
+    for c in range(10):
+        ex.execute("i", f"Set({c}, f=1)")
+
+    real = ex.planes.begin_query
+    failures = [0]
+
+    def flaky():
+        if failures[0] < 2:  # == max_concurrent
+            failures[0] += 1
+            raise RuntimeError("injected begin_query failure")
+        return real()
+
+    ex.planes.begin_query = flaky
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            ex.execute("i", "Count(Row(f=1))")
+    # both slots must have been released: this admits immediately
+    # (a leak would park it behind the 180s acquire timeout)
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 10
+    assert failures[0] == 2
+
+
+def test_adaptive_batcher_default_on_no_solo_window(tmp_path):
+    """The batcher is the default serving spine with an ADAPTIVE
+    window: solo traffic must never wait out a collection window (the
+    window stays 0), and sequential queries answer exactly."""
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex = Executor(holder)  # default: count_batch_window="adaptive"
+    assert ex.batcher is not None and ex.batcher.adaptive
+    for c in range(7):
+        ex.execute("i", f"Set({c}, f=1)")
+    t0 = time.perf_counter()
+    for _ in range(10):
+        assert ex.execute("i", "Count(Row(f=1))")[0] == 7
+    solo = (time.perf_counter() - t0) / 10
+    # the window never opened for solo traffic…
+    assert ex.batcher.current_window == 0.0
+    # …and per-query latency is nowhere near the max window (50ms is
+    # generous vs ADAPT_MAX=5ms: a regression that waits the window
+    # per solo query would trip this on any CI box)
+    assert solo < 0.05, f"solo count took {solo * 1e3:.1f} ms"
+
+
+def test_adaptive_batcher_window_grows_and_decays(tmp_path):
+    """Under queue pressure the window opens (requests coalesce into
+    shared batches); once traffic is solo again it decays back to 0."""
+    from pilosa_tpu.obs import Stats
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    stats = Stats()
+    ex = Executor(holder, stats=stats)
+    for r in range(1, 9):
+        for c in range(r):
+            ex.execute("i", f"Set({c}, f={r})")
+
+    coalesced = False
+    for _ in range(3):  # retry: arrival overlap is scheduler-dependent
+        start = threading.Barrier(8)
+        errors = []
+
+        def worker(r):
+            try:
+                start.wait()
+                for _ in range(4):
+                    assert ex.execute("i", f"Count(Row(f={r}))")[0] == r
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(1, 9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:2]
+        counters = stats.snapshot()["counters"]
+        items = sum(counters.get("batcher_items", {}).values())
+        batches = sum(counters.get("batcher_batches", {}).values())
+        if items > batches:
+            coalesced = True
+            break
+    assert coalesced, "concurrent counts never coalesced"
+    # solo traffic decays the window back to zero
+    for _ in range(12):
+        assert ex.execute("i", "Count(Row(f=3))")[0] == 3
+    assert ex.batcher.current_window == 0.0
+
+
+def test_topn_and_distinct_coalesce(tmp_path):
+    """The remaining one-dispatch-one-read families ride the batcher:
+    concurrent dense TopN shares a rowcounts program (identical planes
+    dedupe), Distinct shares a presence scan — all answers exact."""
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import FieldOptions, Holder
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=200))
+    ex = Executor(holder)
+    for r in range(1, 5):
+        for c in range(r * 3):
+            ex.execute("i", f"Set({c}, f={r})")
+    for c in range(12):
+        ex.execute("i", f"Set({c}, v={(c % 3) * 7})")
+
+    want_topn = ex.execute("i", "TopN(f, n=4)")[0].pairs
+    want_distinct = ex.execute("i", "Distinct(field=v)")[0].values
+    assert want_distinct == [0, 7, 14]
+
+    errors = []
+    start = threading.Barrier(8)
+
+    def worker(i):
+        try:
+            start.wait()
+            for _ in range(3):
+                if i % 2:
+                    got = ex.execute("i", "TopN(f, n=4)")[0].pairs
+                    assert got == want_topn
+                else:
+                    got = ex.execute("i", "Distinct(field=v)")[0].values
+                    assert got == want_distinct
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    # the dense TopN counts ran through the batched rowcounts program
+    assert any(isinstance(k, tuple) and k[-1] == "rowcounts-batch"
+               for k in ex.fused._programs), \
+        "TopN never used the coalesced rowcounts program"

@@ -1,0 +1,188 @@
+"""Query-plan cache (r6 tentpole): repeat serving shapes skip parse
+AND plan entirely; generation bumps invalidate; concurrent hit/miss
+races stay exact.  The zero-parse property is asserted with a counting
+lexer stub (``parse_cached``'s own memoization is cleared first, so
+the only thing that can skip tokenization is the plan cache)."""
+
+import threading
+
+import pytest
+
+import pilosa_tpu.pql.parser as parser_mod
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.pql.parser import parse_cached
+from pilosa_tpu.store import FieldOptions, Holder
+
+
+def _counters(ex, name):
+    return sum(ex.stats.snapshot()["counters"].get(name, {}).values())
+
+
+@pytest.fixture
+def ex(tmp_path):
+    from pilosa_tpu.obs import Stats
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=-100, max=100))
+    e = Executor(holder, stats=Stats())
+    for c in range(20):
+        e.execute("i", f"Set({c}, f={c % 4})")
+        e.execute("i", f"Set({c}, v={c})")
+    yield e
+    holder.close()
+
+
+def test_plan_cache_hit_skips_parsing(ex, monkeypatch):
+    """A plan-cache hit performs ZERO PQL parsing: after the first
+    request builds the plan, the lexer is never invoked again for that
+    query string."""
+    pql = "Count(Row(f=1)) Count(Row(f=2))"
+    want = ex.execute("i", pql)
+    assert want == [5, 5]
+    # second request may still fall through (plane residency) — run
+    # until the plan serves, then attach the counting stub
+    assert ex.execute("i", pql) == want
+
+    tokenize_calls = []
+    real_tokenize = parser_mod.lx.tokenize
+
+    def counting(src):
+        tokenize_calls.append(src)
+        return real_tokenize(src)
+
+    monkeypatch.setattr(parser_mod.lx, "tokenize", counting)
+    parse_cached.cache_clear()  # the lru must not mask a parse
+
+    assert ex.execute("i", pql) == want
+    assert tokenize_calls == [], \
+        "plan-cache hit must not touch the parser"
+    assert _counters(ex, "plan_cache_hits") >= 1
+
+
+def test_generation_bump_invalidates(ex):
+    pql = "Count(Row(f=0))"
+    assert ex.execute("i", pql) == [5]
+    assert ex.execute("i", pql) == [5]  # plan-cached
+    ex.execute("i", "Set(100, f=0)")    # bumps the source generation
+    assert ex.execute("i", pql) == [6], \
+        "stale plan served a stale count"
+    assert _counters(ex, "plan_cache_invalidations") >= 1
+    # the re-planned entry serves the new truth
+    assert ex.execute("i", pql) == [6]
+
+
+def test_missing_row_then_created(ex):
+    """A row that planned as a zeros leaf must surface once created —
+    the write bumps the view generation, which invalidates the plan."""
+    pql = "Count(Row(f=9))"
+    assert ex.execute("i", pql) == [0]
+    assert ex.execute("i", pql) == [0]
+    ex.execute("i", "Set(3, f=9)")
+    assert ex.execute("i", pql) == [1]
+
+
+def test_bsi_condition_plans(ex):
+    """Count over a BSI condition rides the generic plan (predicate
+    masks are cached as constants; the bit-plane leaf re-fetches)."""
+    pql = "Count(Row(v > 10))"
+    want = ex.execute("i", pql)
+    assert want == [9]  # values 11..19
+    assert ex.execute("i", pql) == want
+    ex.execute("i", "Set(50, v=99)")
+    assert ex.execute("i", pql) == [10]
+
+
+def test_composed_tree_plans(ex):
+    pql = "Count(Intersect(Row(f=1), Not(Row(f=2))))"
+    want = ex.execute("i", pql)
+    assert ex.execute("i", pql) == want
+    # still exact after an invalidating write
+    ex.execute("i", "Set(1, f=2)")
+    got = ex.execute("i", pql)
+    assert got == [want[0] - 1]
+
+
+def test_unplannable_shapes_fall_through(ex):
+    """Writes and non-Count calls negative-cache and keep serving
+    through the normal path, repeatedly and exactly — the pre-write
+    Count sees the previous total, the post-write Count sees the new
+    bit, every iteration."""
+    for i in range(3):
+        pre, changed, post = ex.execute(
+            "i", f"Count(Row(f=1)) Set({200 + i}, f=1) Count(Row(f=1))")
+        assert (pre, changed, post) == (5 + i, True, 6 + i)
+    # TopN is not plan-cached but must stay exact alongside cached Counts
+    pairs = ex.execute("i", "TopN(f, n=2)")[0].pairs
+    assert len(pairs) == 2
+
+
+def test_concurrent_hits_and_misses_are_exact(ex):
+    """Racing threads over a mix of cached/uncached shapes: every
+    answer exact, no torn plans."""
+    queries = {f"Count(Row(f={r}))": [5 if r < 4 else 0]
+               for r in range(8)}
+    errors = []
+    start = threading.Barrier(8)
+
+    def worker(wid):
+        try:
+            start.wait()
+            for pql, want in list(queries.items()):
+                for _ in range(5):
+                    assert ex.execute("i", pql) == want
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    assert _counters(ex, "plan_cache_hits") > 0
+
+
+def test_explicit_shards_key_separately(ex):
+    all_count = ex.execute("i", "Count(Row(f=0))")
+    assert ex.execute("i", "Count(Row(f=0))", shards=[0]) == all_count
+    # both keys live independently and keep answering
+    assert ex.execute("i", "Count(Row(f=0))") == all_count
+
+
+def test_index_delete_drops_plans(ex):
+    pql = "Count(Row(f=1))"
+    assert ex.execute("i", pql) == [5]
+    assert len(ex._plans) > 0
+    ex.invalidate_plans("i")
+    assert all(k[0] != "i" for k in ex._plans)
+    # and a full clear
+    ex.execute("i", pql)
+    ex.invalidate_plans()
+    assert len(ex._plans) == 0
+
+
+def test_bsi_depth_growth_outside_shard_subset(tmp_path):
+    """bit_depth can grow via a write OUTSIDE a plan's shard subset —
+    generations over the entry's shards never see it, so validity
+    checks the depth itself (a stale plan would pair old-depth
+    predicate masks with the new-depth bit plane)."""
+    from pilosa_tpu.engine.words import SHARD_WIDTH
+    from pilosa_tpu.obs import Stats
+
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("w", FieldOptions(type="int"))  # depth grows
+    e = Executor(holder, stats=Stats())
+    e.execute("i", "Set(1, w=3) Set(2, w=5)")
+    pql = "Count(Row(w > 2))"
+    assert e.execute("i", pql, shards=[0]) == [2]
+    assert e.execute("i", pql, shards=[0]) == [2]  # plan-cached
+    old_depth = idx.field("w").options.bit_depth
+    # depth-growing write in ANOTHER shard: shard-0 generations unchanged
+    e.execute("i", f"Set({SHARD_WIDTH + 1}, w=1000)")
+    assert idx.field("w").options.bit_depth > old_depth
+    assert e.execute("i", pql, shards=[0]) == [2]
+    assert e.execute("i", pql) == [3]  # full-shard query sees all
+    holder.close()
