@@ -33,7 +33,15 @@ The baseline column is the CPU stand-in for the reference's Go roaring
 executor: numpy popcount over the same packed words on this host.
 
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": qps, "unit": "qps", "vs_baseline": ratio}
+    {"metric": ..., "value": qps, "unit": "qps", "vs_baseline": ratio,
+     "regressions": [...]}
+
+``regressions`` is the regression guard: the headline is compared
+against the most recent ``BENCH_r*.json`` round artifact carrying the
+SAME metric name; a drop past REGRESSION_RATIO lands in the list (with
+the prior round's figure) so a 2.4×-class product-path slide can never
+again go unremarked in the round record.  ``PILOSA_BENCH_BASELINE_DIR``
+overrides where prior rounds are read from (the smoke test uses it).
 """
 
 from __future__ import annotations
@@ -61,9 +69,57 @@ WORDS = 32768
 INDEX = "bench"
 FIELD = "f"
 
+# headline drops below this fraction of the last recorded round flag a
+# regression in the output JSON (0.8 = tolerate tunnel wander, catch
+# the 2.4x-class slides that motivated the guard)
+REGRESSION_RATIO = 0.8
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def regression_guard(metric: str, value: float) -> list[dict]:
+    """Compare the headline against the newest prior ``BENCH_r*.json``
+    whose recorded metric matches ``metric`` exactly (a CPU smoke run
+    never judges itself against a TPU round).  Returns the (possibly
+    empty) ``regressions`` list for the output JSON; never raises — a
+    malformed artifact must not cost the round its benchmark."""
+    import glob
+    import re
+
+    base_dir = os.environ.get("PILOSA_BENCH_BASELINE_DIR") or \
+        os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(base_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+            if parsed.get("metric") != metric:
+                continue
+            prev = float(parsed.get("value") or 0)
+        except (OSError, ValueError, TypeError, AttributeError):
+            continue  # malformed artifact: try the next round
+        if prev <= 0:
+            continue
+        ratio = value / prev
+        if ratio < REGRESSION_RATIO:
+            log(f"REGRESSION: {metric} {value:,.1f} qps is "
+                f"{ratio:.2f}x of {os.path.basename(path)}'s "
+                f"{prev:,.1f} qps")
+            return [{"metric": metric, "value": round(value, 2),
+                     "previous": round(prev, 2),
+                     "previous_round": os.path.basename(path),
+                     "ratio": round(ratio, 3)}]
+        log(f"regression guard: {metric} at {ratio:.2f}x of "
+            f"{os.path.basename(path)} — OK")
+        return []
+    log(f"regression guard: no prior round carries {metric!r}; skipped")
+    return []
 
 
 def cpu_counts(plane: np.ndarray) -> np.ndarray:
@@ -465,11 +521,13 @@ def _measure() -> None:
         headline, metric = raw_qps, "concurrent_count_qps_1b_cols"
         log("product tier failed; headline falls back to raw kernel")
 
+    full_metric = f"{metric}_{platform}"
     print(json.dumps({
-        "metric": f"{metric}_{platform}",
+        "metric": full_metric,
         "value": round(headline, 2),
         "unit": "qps",
         "vs_baseline": round(headline / cpu_qps, 3),
+        "regressions": regression_guard(full_metric, headline),
     }))
 
 

@@ -24,9 +24,13 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 
 
 class ApiError(Exception):
-    def __init__(self, msg: str, status: int = 400):
+    def __init__(self, msg: str, status: int = 400,
+                 retry_after: float | None = None):
         super().__init__(msg)
         self.status = status
+        # seconds for a Retry-After response header (load shedding:
+        # a 503 should tell the client when to come back)
+        self.retry_after = retry_after
 
 
 def field_options_from_json(o: dict) -> FieldOptions:
@@ -126,6 +130,7 @@ class API:
         import time as _time
 
         from pilosa_tpu.exec.executor import (ExecutionError,
+                                              ExecutorSaturatedError,
                                               QueryTimeoutError)
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
@@ -151,6 +156,11 @@ class API:
                 out = {"results": [result_to_json(r) for r in results]}
         except QueryTimeoutError as e:
             raise ApiError(str(e), 408)
+        except ExecutorSaturatedError as e:
+            # admission shedding (VERDICT advice #6): a saturated
+            # executor is overload, not a client mistake — 503 with a
+            # Retry-After hint, never a generic 500/400
+            raise ApiError(str(e), 503, retry_after=e.retry_after)
         except (ParseError, ExecutionError) as e:
             raise ApiError(str(e), 400)
         if tracer is not None:
@@ -456,10 +466,20 @@ class API:
         if self.cluster is not None:
             nodes = self.cluster.nodes_status()
             state = self.cluster.state
+        ex = self.executor
+        shed = ex.stats.snapshot()["counters"].get("query_shed_total", {})
         return {"state": state, "nodes": nodes,
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
                 "devices": devices,
+                # admission/shedding visibility: current slot occupancy,
+                # the cap, total sheds, and the queue-wait distribution
+                "admission": {
+                    "slotsInUse": ex.slots_in_use,
+                    "maxConcurrent": ex.max_concurrent,
+                    "shedTotal": int(sum(shed.values())),
+                    "queueWait": ex.stats.histogram_summary(
+                        "query_queue_wait_seconds")},
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
                 "planeCache": self.executor.planes.stats(),

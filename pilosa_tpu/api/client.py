@@ -27,6 +27,8 @@ import ssl
 import threading
 import urllib.parse
 
+from pilosa_tpu import fault
+
 
 class ClientError(Exception):
     """Transport or HTTP failure.
@@ -137,10 +139,33 @@ class Client:
         hdrs = dict(headers or {})
         if body:
             hdrs["Content-Type"] = content_type
+        if fault.ACTIVE:
+            # failpoint BEFORE the socket: a partitioned peer is
+            # indistinguishable from connection-refused (the request
+            # was never delivered — kind="unreachable", exactly the
+            # class write replication may safely skip best-effort)
+            spec = fault.fire("client.send",
+                              peer=f"{self.host}:{self.port}",
+                              method=method, path=path)
+            if spec is not None and spec["action"] == "partition":
+                raise ClientError(
+                    f"cannot reach {self.base}: injected partition",
+                    kind="unreachable")
         t = self.timeout if timeout is None else timeout
         conn = self._checkout(t, fresh=_retried)
         try:
             conn.request(method, path, body=body, headers=hdrs)
+            if fault.ACTIVE:
+                # failpoint AFTER the request left: losing the response
+                # here exercises the at-least-once retry contract — the
+                # peer HAS processed the request (raised inside the try
+                # so the reset takes the real lost-response path below)
+                spec = fault.fire("client.recv",
+                                  peer=f"{self.host}:{self.port}",
+                                  method=method, path=path)
+                if spec is not None and spec["action"] == "drop":
+                    raise ConnectionResetError(
+                        "injected response drop (request was sent)")
             resp = conn.getresponse()
             data = resp.read()
         except http.client.CannotSendRequest as e:

@@ -29,7 +29,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from pilosa_tpu import __version__
+from pilosa_tpu import __version__, fault
 from pilosa_tpu.api.api import API, ApiError
 
 
@@ -114,11 +114,21 @@ class Handler(BaseHTTPRequestHandler):
             raise ApiError(f"invalid JSON body: {e}")
 
     def _reply(self, obj, status: int = 200,
-               content_type: str = "application/json") -> None:
+               content_type: str = "application/json",
+               headers: dict | None = None) -> None:
+        if getattr(self, "_fault_drop_response", False):
+            # drop-response failpoint: the handler RAN (state mutated,
+            # side effects happened) but the peer never hears back —
+            # its retry is a duplicate delivery.  Severing the
+            # connection makes the client see a reset, not a timeout.
+            self.close_connection = True
+            return
         data = (obj if isinstance(obj, bytes)
                 else json.dumps(obj).encode())
         self.send_response(status)
         self.send_header("Content-Type", content_type)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -141,6 +151,12 @@ class Handler(BaseHTTPRequestHandler):
         self._body()
         fn, params = self.server.router.match(method, parsed.path)
         srv = self.server
+        self._fault_drop_response = False
+        if fault.ACTIVE and fn is not None:
+            spec = fault.fire("server.response", method=method,
+                              path=parsed.path)
+            if spec is not None and spec["action"] == "drop_response":
+                self._fault_drop_response = True
         t0 = time.perf_counter()
         code = 200
         try:
@@ -151,7 +167,12 @@ class Handler(BaseHTTPRequestHandler):
             fn(self, **params)
         except ApiError as e:
             code = e.status
-            self._reply({"error": str(e)}, e.status)
+            hdrs = None
+            if e.retry_after is not None:
+                # 503 shedding: tell well-behaved clients when to come
+                # back instead of letting them hammer the queue
+                hdrs = {"Retry-After": str(max(1, int(e.retry_after)))}
+            self._reply({"error": str(e)}, e.status, headers=hdrs)
         except BrokenPipeError:
             code = 499
         except Exception as e:  # noqa: BLE001 — server must not die
@@ -372,9 +393,48 @@ class Handler(BaseHTTPRequestHandler):
             if ex.batcher is not None:
                 stats.gauge("count_batcher_window_seconds",
                             ex.batcher.current_window)
+            # admission / shedding visibility (VERDICT advice #6): how
+            # full the executor is right now, next to the shed counter
+            # and queue-wait histogram fire() maintains
+            stats.gauge("query_slots_in_use", ex.slots_in_use)
+            stats.gauge("query_slots_max", ex.max_concurrent)
         text = stats.prometheus_text() if stats is not None else ""
         self._reply(text.encode(),
                     content_type="text/plain; version=0.0.4")
+
+    # -- fault injection (live control surface) -----------------------------
+
+    def h_fault_list(self) -> None:
+        self._reply({"faults": fault.list_faults(),
+                     "triggered": [{"site": s, "action": a, "count": n}
+                                   for (s, a), n
+                                   in sorted(fault.triggered_total()
+                                             .items())]})
+
+    def h_fault_set(self) -> None:
+        """Arm a failpoint on this node:
+        ``{"site": ..., "action": ..., "nth"|"prob"|"seed"|"times"|
+        "match"|"args": ...}`` — same spec shape as ``PILOSA_FAULTS``."""
+        b = self._json_body()
+        if not b.get("site") or not b.get("action"):
+            raise ApiError("fault spec requires site and action")
+        try:
+            spec = fault.set_fault(
+                b["site"], b["action"], nth=b.get("nth"),
+                prob=b.get("prob"), seed=b.get("seed"),
+                times=b.get("times"), match=b.get("match"),
+                args=b.get("args"))
+        except ValueError as e:
+            raise ApiError(str(e))
+        logger = getattr(self.server, "logger", None)
+        if logger is not None:
+            logger.warning("fault armed via /internal/fault: %s", spec)
+        self._reply({"armed": spec})
+
+    def h_fault_clear(self) -> None:
+        """Disarm ``{"site": ...}`` (or every failpoint with no body)."""
+        b = self._json_body()
+        self._reply({"cleared": fault.clear(b.get("site"))})
 
     def h_backup(self) -> None:
         """Tar the whole data dir (reference: ``pilosa backup`` tars over
@@ -444,6 +504,9 @@ def build_router() -> Router:
     r.add("GET", "/info", Handler.h_info)
     r.add("GET", "/version", Handler.h_version)
     r.add("GET", "/metrics", Handler.h_metrics)
+    r.add("GET", "/internal/fault", Handler.h_fault_list)
+    r.add("POST", "/internal/fault", Handler.h_fault_set)
+    r.add("POST", "/internal/fault/clear", Handler.h_fault_clear)
     r.add("GET", "/internal/backup", Handler.h_backup)
     r.add("POST", "/internal/restore", Handler.h_restore)
     r.add("GET", "/internal/traces", Handler.h_traces)
@@ -533,6 +596,11 @@ class Server:
         self.httpd.router = build_router()
         self.httpd.stats = stats
         self.httpd.logger = logger
+        if stats is not None:
+            # fault triggers surface as fault_triggered_total on THIS
+            # registry's /metrics (process-global sink: one serving
+            # server per process in production)
+            fault.set_stats(stats)
         self._thread: threading.Thread | None = None
 
     @property

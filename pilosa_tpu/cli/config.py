@@ -30,6 +30,14 @@ class Config:
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
     heartbeat_interval: float = 2.0
     diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
+    # observability backends
+    stats_backend: str = ""             # "" = in-process /metrics only;
+                                        # "statsd" also emits UDP statsd
+    statsd_address: str = "127.0.0.1:8125"
+    # fault injection (chaos testing): JSON list of failpoint specs,
+    # armed at boot — see pilosa_tpu.fault.configure.  Usually set via
+    # PILOSA_FAULTS; live arming uses POST /internal/fault instead.
+    faults: str = ""
     # device
     # Cross-request coalescing window for concurrent dense reads
     # (Count, BSI aggregates, dense TopN, Distinct): "adaptive"

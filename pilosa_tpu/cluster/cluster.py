@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 import time
 
+from pilosa_tpu import fault
 from pilosa_tpu.cluster.dist import DistributedExecutor
 from pilosa_tpu.obs import NopStats, get_logger
 from pilosa_tpu.parallel.placement import shard_nodes
@@ -346,6 +347,17 @@ class Cluster:
         for nid in self.member_ids():
             if nid == self.node_id:
                 continue
+            if fault.ACTIVE:
+                spec = fault.fire("cluster.broadcast", peer=nid,
+                                  path="/internal/cluster/status")
+                # only `drop` skips the send (a triggered `delay`
+                # already slept and the broadcast must still go out):
+                # the peer must then converge via the placement
+                # version riding heartbeats (pull-on-mismatch)
+                if spec is not None and spec["action"] == "drop":
+                    self.logger.warning("fault: status broadcast to %s "
+                                        "dropped", nid)
+                    continue
             try:
                 self._client(nid)._json("POST", "/internal/cluster/status",
                                         payload)
@@ -410,6 +422,13 @@ class Cluster:
         for nid in self.member_ids():
             if nid == self.node_id:
                 continue
+            if fault.ACTIVE:
+                spec = fault.fire("cluster.broadcast", peer=nid,
+                                  path=path)
+                if spec is not None and spec["action"] == "drop":
+                    self.logger.warning("fault: %s broadcast to %s "
+                                        "dropped", what, nid)
+                    continue
             try:
                 self._client(nid)._json("POST", path, payload)
             except Exception as e:  # noqa: BLE001

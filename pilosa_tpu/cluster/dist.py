@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from pilosa_tpu import fault
 from pilosa_tpu.exec import result_to_json
 from pilosa_tpu.exec.executor import ExecutionError
 from pilosa_tpu.pql import parse_cached
@@ -279,6 +280,11 @@ class DistributedExecutor:
         pql = "\n".join(str(s) for s in subs)
 
         def remote(node_id, node_shards):
+            if fault.ACTIVE:
+                # per-leg failpoint: `error` fails ONE node's share of
+                # the fan-out (a remote leg dying mid-query), `delay`
+                # models a straggler node without touching its process
+                fault.fire("dist.fanout", peer=node_id, index=index)
             return self.cluster.internal_query(node_id, index, pql,
                                                node_shards,
                                                deadline=deadline)

@@ -86,6 +86,7 @@ def h_internal_query(self: Handler) -> None:
     reference: ``/internal/query`` remote execution."""
     from pilosa_tpu.exec import result_to_json
     from pilosa_tpu.exec.executor import (ExecutionError,
+                                          ExecutorSaturatedError,
                                           QueryTimeoutError)
     from pilosa_tpu.pql.parser import ParseError
     import time
@@ -111,6 +112,11 @@ def h_internal_query(self: Handler) -> None:
                                        deadline=deadline)
     except QueryTimeoutError as e:
         raise ApiError(str(e), 408)
+    except ExecutorSaturatedError as e:
+        # a saturated PEER is overload, not a bad query: 503 so the
+        # coordinator's fan-out classifies it like a busy node (and a
+        # best-effort write may route around it), never 400
+        raise ApiError(str(e), 503, retry_after=e.retry_after)
     except (ParseError, ExecutionError) as e:
         raise ApiError(str(e), 400)
     self._reply({"results": [result_to_json(r) for r in results]})

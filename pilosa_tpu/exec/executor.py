@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import fault
 from pilosa_tpu.engine import bsi as bsik
 from pilosa_tpu.engine import kernels
 from pilosa_tpu.engine.words import SHARD_WIDTH, WORDS_PER_SHARD, unpack_columns
@@ -117,6 +118,17 @@ class ExecutionError(Exception):
     pass
 
 
+class ExecutorSaturatedError(ExecutionError):
+    """Admission timed out: every execution slot stayed busy for the
+    whole wait budget.  The API edge maps this to HTTP 503 with a
+    ``Retry-After`` hint (load shedding, VERDICT advice #6) — overload
+    is not a client error and must not surface as 500/400."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 # negative plan-cache entry: this query shape is structurally outside
 # the plan cache (not all-Count, time ranges, …) — skip re-walking it
 _UNPLANNABLE = object()
@@ -180,6 +192,9 @@ class _Ctx:
 
 class Executor:
     MAX_PLANS = 512  # plan-cache entries (user-controlled keys: bounded)
+    # admission wait budget before shedding with 503 (class attr so
+    # saturation tests shrink it without touching live config)
+    SLOT_TIMEOUT_S = 180.0
 
     def __init__(self, holder: Holder, translate: TranslateStore | None = None,
                  place=None, plane_budget: int | None = None, placement=None,
@@ -253,6 +268,14 @@ class Executor:
         self._recovery_open.set()
         self._exec_slots = (threading.BoundedSemaphore(max_concurrent)
                             if max_concurrent else None)
+        self.max_concurrent = max_concurrent
+        self.slot_timeout_s = self.SLOT_TIMEOUT_S
+
+    @property
+    def slots_in_use(self) -> int:
+        """Admitted top-level queries currently executing (the
+        /metrics ``query_slots_in_use`` gauge)."""
+        return self._inflight
 
     # -- in-flight accounting (OOM recovery) --------------------------------
 
@@ -316,10 +339,18 @@ class Executor:
             # recovery holding every slot must not refuse service
             # silently forever
             if self._exec_slots is not None:
-                if not self._exec_slots.acquire(timeout=180.0):
-                    raise ExecutionError(
-                        "executor at max concurrent queries for 180s; "
-                        "retry later")
+                t_wait = time.perf_counter()
+                acquired = self._exec_slots.acquire(
+                    timeout=self.slot_timeout_s)
+                self.stats.observe("query_queue_wait_seconds",
+                                   time.perf_counter() - t_wait)
+                if not acquired:
+                    self.stats.count("query_shed_total", 1)
+                    raise ExecutorSaturatedError(
+                        f"executor at max concurrent queries "
+                        f"({self.max_concurrent}) for "
+                        f"{self.slot_timeout_s:.0f}s; retry later",
+                        retry_after=1.0)
             # slot held: from here, ANY setup failure must release it —
             # a leaked slot is permanent, and max_concurrent leaks turn
             # into a total outage behind the 180s-timeout error
@@ -347,6 +378,13 @@ class Executor:
             self._tls.stage_timer = timer
         self._tls.depth = depth + 1
         try:
+            if depth == 0 and fault.ACTIVE:
+                # post-admission failpoint: `delay` holds a slot open
+                # (how saturation tests wedge the executor), `error`
+                # fails the query after admission.  Inside the main
+                # try: a raise here must still release the slot.
+                fault.fire("exec.execute", index=index_name)
+                timer.reset()  # injected delay is no stage's fault
             if isinstance(query, str):
                 if depth == 0:
                     # plan-cache fast path: a repeated all-Count serving
@@ -1019,6 +1057,11 @@ class Executor:
         Covers EVERY execute path — fused count batches and bitmap fast
         paths included, not just per-call handlers."""
         try:
+            if fault.ACTIVE:
+                # `oom` raises the RESOURCE_EXHAUSTED shape this very
+                # wrapper classifies — injected device OOM drives the
+                # real staged recovery below, not a simulation of it
+                fault.fire("exec.oom")
             return fn()
         except Exception as e:  # noqa: BLE001 — filtered below
             if not _is_device_oom(e):

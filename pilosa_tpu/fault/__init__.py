@@ -1,0 +1,255 @@
+"""Deterministic fault injection: named failpoints woven through the
+stack's I/O seams.
+
+Reference shape: etcd's ``gofail`` / FoundationDB's simulation hooks —
+a registry of *sites* (``client.send``, ``oplog.append``,
+``server.response``, ``cluster.broadcast``, ``sys.write``, ``exec.oom``,
+…) that production code consults through a zero-cost guard.  When no
+fault is armed, an instrumented site costs one module-attribute load
+and a falsy branch (``if fault.ACTIVE:``) — measured ~25 ns on this
+host, invisible against any I/O it guards.
+
+A failpoint is armed per-process via config/env (``PILOSA_FAULTS`` — a
+JSON list of specs) or on a live node via the ``/internal/fault``
+endpoints.  Triggers are deterministic: fire on the Nth hit of the
+site, or with seeded-RNG probability per hit — either way a failure
+schedule reproduces exactly from ``(spec, seed)``; there is no
+wall-clock or global randomness in the trigger path.
+
+Actions:
+
+- ``error``       — raise :class:`FaultError` (an ``OSError``: looks
+                    like the disk/socket fault it stands in for)
+- ``delay``       — sleep ``seconds`` then continue
+- ``oom``         — raise ``ValueError("RESOURCE_EXHAUSTED …")``, the
+                    exact shape the executor's device-OOM recovery
+                    classifies (:func:`exec.executor._is_device_oom`)
+- ``torn_write``  — site-interpreted: write only the first ``offset``
+                    bytes of the record, then raise (a crash mid-write)
+- ``partition``   — site-interpreted at ``client.send``: the peer is
+                    unreachable (connection refused), both no-delivery
+                    directions when armed on both nodes
+- ``drop_response`` — site-interpreted at ``server.response``: the
+                    handler RUNS (the request is processed) but the
+                    response is never written and the connection drops
+                    — the peer's retry becomes a duplicate delivery
+- ``drop``        — site-interpreted: skip the guarded operation
+                    (e.g. ``cluster.broadcast`` silently not sent)
+
+:func:`fire` applies the generic actions (error/delay/oom) itself and
+returns the spec dict for site-interpreted ones, or ``None`` when the
+failpoint did not trigger.  Every trigger increments
+``fault_triggered_total{site,action}`` on the stats sink wired by the
+server (visible on ``/metrics``).
+
+Stdlib-only on purpose: this module sits below the client, store and
+cluster layers and must import from none of them.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+__all__ = ["ACTIVE", "FaultError", "set_fault", "clear", "list_faults",
+           "fire", "configure", "set_stats", "triggered_total"]
+
+# Zero-cost guard: instrumented sites check this module-level bool
+# before calling fire().  Maintained by set_fault/clear/configure.
+ACTIVE = False
+
+_lock = threading.Lock()
+_registry: dict[str, list["Failpoint"]] = {}
+_triggered: dict[tuple[str, str], int] = {}
+_stats = None  # optional metrics sink (obs.Stats duck type)
+
+
+class FaultError(OSError):
+    """An injected fault (subclasses OSError: at the store seams it
+    stands in for a disk error, at process seams for a crash)."""
+
+
+class Failpoint:
+    """One armed failpoint.  Trigger = nth-hit or seeded probability
+    (both may combine with ``times``, the max number of fires)."""
+
+    def __init__(self, site: str, action: str, nth: int | None = None,
+                 prob: float | None = None, seed: int | None = None,
+                 times: int | None = None, match: dict | None = None,
+                 args: dict | None = None):
+        if action not in ("error", "delay", "oom", "torn_write",
+                          "partition", "drop_response", "drop"):
+            raise ValueError(f"unknown fault action {action!r}")
+        if prob is not None and not 0.0 <= prob <= 1.0:
+            raise ValueError(f"fault prob must be in [0,1], got {prob}")
+        self.site = site
+        self.action = action
+        self.nth = int(nth) if nth is not None else None
+        self.prob = float(prob) if prob is not None else None
+        self.seed = seed
+        self.times = int(times) if times is not None else None
+        self.match = dict(match or {})
+        self.args = dict(args or {})
+        self._rng = random.Random(seed if seed is not None else 0)
+        self._hits = 0
+        self._fired = 0
+        self._flock = threading.Lock()
+
+    def _matches(self, ctx: dict) -> bool:
+        for key, needle in self.match.items():
+            if str(needle) not in str(ctx.get(key, "")):
+                return False
+        return True
+
+    def _eval(self, ctx: dict) -> bool:
+        """True when this hit triggers.  Counters/RNG under the
+        failpoint's own lock — concurrent hits stay deterministic in
+        COUNT (each hit consumes exactly one trigger decision)."""
+        if not self._matches(ctx):
+            return False
+        with self._flock:
+            self._hits += 1
+            if self.times is not None and self._fired >= self.times:
+                return False
+            if self.nth is not None and self._hits < self.nth:
+                return False
+            if self.prob is not None and self._rng.random() >= self.prob:
+                return False
+            if self.nth is not None and self.prob is None \
+                    and self.times is None and self._hits > self.nth:
+                return False  # bare nth= fires exactly once
+            self._fired += 1
+            return True
+
+    def to_json(self) -> dict:
+        return {"site": self.site, "action": self.action, "nth": self.nth,
+                "prob": self.prob, "seed": self.seed, "times": self.times,
+                "match": self.match, "args": self.args,
+                "hits": self._hits, "fired": self._fired}
+
+
+def set_stats(stats) -> None:
+    """Wire the metrics sink (the server's Stats registry) so triggers
+    surface as ``fault_triggered_total`` on ``/metrics``."""
+    global _stats
+    _stats = stats
+
+
+def set_fault(site: str, action: str, **kw) -> dict:
+    """Arm a failpoint at ``site``; multiple faults may stack on one
+    site (e.g. two partition pairs).  Returns the armed spec."""
+    global ACTIVE
+    fp = Failpoint(site, action, **kw)
+    with _lock:
+        _registry.setdefault(site, []).append(fp)
+        ACTIVE = True
+    return fp.to_json()
+
+
+def clear(site: str | None = None) -> int:
+    """Disarm one site's faults (or all).  Returns the count removed."""
+    global ACTIVE
+    with _lock:
+        if site is None:
+            n = sum(len(v) for v in _registry.values())
+            _registry.clear()
+        else:
+            n = len(_registry.pop(site, []))
+        ACTIVE = bool(_registry)
+    return n
+
+
+def list_faults() -> list[dict]:
+    with _lock:
+        return [fp.to_json() for fps in _registry.values() for fp in fps]
+
+
+def triggered_total() -> dict[tuple[str, str], int]:
+    with _lock:
+        return dict(_triggered)
+
+
+def reset_triggered() -> None:
+    """Zero the trigger counters (test isolation; a live node's
+    counters are cumulative and never reset)."""
+    with _lock:
+        _triggered.clear()
+
+
+def fire(site: str, **ctx) -> dict | None:
+    """Evaluate ``site``'s failpoints against this hit.  Applies generic
+    actions (error raises, delay sleeps, oom raises RESOURCE_EXHAUSTED)
+    and returns the spec dict for site-interpreted actions — ``None``
+    when nothing triggered.  Callers guard with ``if fault.ACTIVE:`` so
+    the disabled path never reaches here."""
+    with _lock:
+        fps = list(_registry.get(site, ()))
+    for fp in fps:
+        if not fp._eval(ctx):
+            continue
+        with _lock:
+            key = (site, fp.action)
+            _triggered[key] = _triggered.get(key, 0) + 1
+        if _stats is not None:
+            _stats.count("fault_triggered_total", 1, site=site,
+                         action=fp.action)
+        if fp.action == "delay":
+            time.sleep(float(fp.args.get("seconds", 0.05)))
+            return fp.to_json()
+        if fp.action == "error":
+            raise FaultError(f"injected fault at {site}")
+        if fp.action == "oom":
+            # the exact status-text + exception-type shape the
+            # executor's _is_device_oom recovery classifier accepts
+            raise ValueError(f"RESOURCE_EXHAUSTED: injected fault at {site}")
+        return fp.to_json()
+    return None
+
+
+def torn_write(f, data: bytes, spec: dict) -> None:
+    """Apply a triggered ``torn_write`` spec to an open file: persist
+    only the first ``args.offset`` bytes of ``data``, flush, and raise
+    :class:`FaultError` (the crash).  The single tear implementation
+    every write seam shares (``sys.write`` and the record-relative
+    ``oplog.append``), so tear semantics can never diverge by site."""
+    off = min(int(spec.get("args", {}).get("offset", 0)), len(data))
+    f.write(data[:off])
+    f.flush()
+    raise FaultError(
+        f"injected torn write: {off}/{len(data)} bytes persisted")
+
+
+def configure(spec: str | list | None, logger=None) -> int:
+    """Arm failpoints from a config/env value: a JSON list of spec
+    objects (the ``PILOSA_FAULTS`` format), e.g.::
+
+        [{"site": "oplog.append", "action": "torn_write",
+          "nth": 3, "args": {"offset": 7}}]
+
+    Returns the number armed.  Bad specs raise ValueError — a typo'd
+    fault config must fail loudly, not silently not-inject."""
+    if not spec:
+        return 0
+    if isinstance(spec, str):
+        try:
+            spec = json.loads(spec)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"PILOSA_FAULTS is not valid JSON: {e}")
+    if isinstance(spec, dict):
+        spec = [spec]
+    n = 0
+    for entry in spec:
+        entry = dict(entry)
+        site = entry.pop("site", None)
+        action = entry.pop("action", None)
+        if not site or not action:
+            raise ValueError(
+                f"fault spec requires site and action: {entry}")
+        set_fault(site, action, **entry)
+        n += 1
+    if n and logger is not None:
+        logger.warning("fault injection armed: %d failpoint(s) — %s",
+                       n, [f["site"] for f in list_faults()])
+    return n

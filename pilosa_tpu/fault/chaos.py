@@ -1,0 +1,423 @@
+"""Chaos harness: scripted fault schedules against the OS-process
+cluster, with an in-memory oracle and invariant checks.
+
+The distributed claims this repo reproduces (anti-entropy union-merge,
+versioned placement with pull-on-mismatch, orphan handoff, CRC-framed
+oplog replay, idempotent internode retry) are exercised HERE under
+injected failure, not just on the happy path:
+
+====================================  ==================================
+scenario                              invariant asserted after faults
+                                      clear
+====================================  ==================================
+partition_during_resize               no acked write lost; queries
+                                      oracle-exact on every node; AAE
+                                      re-converges every replica
+crash_mid_oplog_append                replay yields a clean prefix:
+                                      acked writes survive a kill -9,
+                                      the torn record never corrupts
+duplicate_delivery                    dropped internal responses ⇒
+                                      retries redeliver; bits never
+                                      double-count, replicas converge
+dropped_placement_broadcast           a dropped resize-completion
+                                      broadcast still converges via
+                                      the heartbeat placement version
+====================================  ==================================
+
+Oracle semantics are at-least-once honest: a write the harness saw FAIL
+may still have applied on some replica (lost response, torn tail after
+the memory mutation).  The standing bar — "no lost acknowledged
+writes" — is therefore checked as ``acked ⊆ observed ⊆ attempted``;
+observed bits outside ``attempted`` are corruption and fail loudly.
+
+Every schedule is reproducible: all randomness (write placement, fault
+parameters, drop probabilities) flows from one printed seed.
+
+Runbook: ``python -m pilosa_tpu.fault.chaos [--seed N] [--scenario S]``
+boots its own process clusters in a temp dir; ``tests/test_chaos.py``
+drives the same scenarios under tier-1.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from pilosa_tpu.api.client import Client, ClientError  # noqa: F401
+from pilosa_tpu.engine.words import SHARD_WIDTH
+
+
+class InvariantViolation(AssertionError):
+    """A chaos invariant failed; the message carries the seed."""
+
+
+class ChaosHarness:
+    """One scenario's state: a process cluster, a seeded RNG, and the
+    acked/attempted write oracle."""
+
+    N_ROWS = 3
+    MAX_COL = 3 * SHARD_WIDTH - 1  # spread writes over ~3 shards
+
+    def __init__(self, cluster, seed: int, index: str, field: str = "f"):
+        self.cluster = cluster
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.index, self.field = index, field
+        self.acked: dict[int, set[int]] = {}
+        self.attempted: dict[int, set[int]] = {}
+        print(f"[chaos] scenario index={index!r} seed={seed}", flush=True)
+
+    def _fail(self, msg: str) -> "InvariantViolation":
+        return InvariantViolation(
+            f"{msg} (reproduce with seed={self.seed})")
+
+    def client(self, i: int = 0) -> Client:
+        return self.cluster.client(i)
+
+    @property
+    def n(self) -> int:
+        return len(self.cluster.nodes)
+
+    # -- fault control -------------------------------------------------------
+
+    def set_fault(self, node_i: int, site: str, action: str, **kw) -> dict:
+        return self.client(node_i)._json(
+            "POST", "/internal/fault",
+            {"site": site, "action": action, **kw})
+
+    def clear_faults(self) -> None:
+        for i in range(self.n):
+            try:
+                self.client(i)._json("POST", "/internal/fault/clear", {})
+            except (ClientError, OSError):
+                pass  # node mid-restart; its registry died with it
+
+    def partition(self, i: int, j: int) -> None:
+        """Sever the (i, j) node pair in both directions — each side's
+        outbound requests to the other fail as connection-refused."""
+        peer_j = f"127.0.0.1:{self.cluster.nodes[j].port}"
+        peer_i = f"127.0.0.1:{self.cluster.nodes[i].port}"
+        self.set_fault(i, "client.send", "partition",
+                       match={"peer": peer_j})
+        self.set_fault(j, "client.send", "partition",
+                       match={"peer": peer_i})
+
+    # -- cluster introspection ----------------------------------------------
+
+    def coordinator_index(self) -> int:
+        status = self.client(0)._json("GET", "/status")
+        primary = next(nd["id"] for nd in status["nodes"]
+                       if nd.get("isPrimary"))
+        port = int(primary.rsplit(":", 1)[1])
+        for i, node in enumerate(self.cluster.nodes):
+            if node.port == port:
+                return i
+        raise self._fail(f"coordinator {primary} is not in the harness")
+
+    def placement_versions(self) -> list[float]:
+        return [float(self.client(i)._json(
+            "GET", "/internal/cluster/state")["placementVersion"])
+            for i in range(self.n)]
+
+    def await_all_normal(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if all(self.client(i)._json("GET", "/status")["state"]
+                       == "NORMAL" for i in range(self.n)):
+                    return
+            except (ClientError, OSError):
+                pass
+            time.sleep(0.3)
+        raise self._fail("cluster never returned to NORMAL")
+
+    def await_coordinator_normal(self, timeout: float = 60.0) -> None:
+        """NORMAL on the coordinator only — mid-partition, suspect
+        peers legitimately report DEGRADED."""
+        deadline = time.monotonic() + timeout
+        coord = self.coordinator_index()
+        while time.monotonic() < deadline:
+            try:
+                if (self.client(coord)._json("GET", "/status")["state"]
+                        == "NORMAL"):
+                    return
+            except (ClientError, OSError):
+                pass
+            time.sleep(0.3)
+        raise self._fail("coordinator never finished the resize")
+
+    # -- workload ------------------------------------------------------------
+
+    def setup(self) -> None:
+        c = self.client(0)
+        c.create_index(self.index)
+        c.create_field(self.index, self.field)
+
+    def write(self, row: int, col: int, via: int = 0) -> bool:
+        """One ``Set``; records the attempt, and the ack only when the
+        cluster answered 200.  A failed write may still have applied on
+        some replica (at-least-once) — that is what ``attempted``
+        captures."""
+        self.attempted.setdefault(row, set()).add(col)
+        try:
+            self.client(via).query(self.index,
+                                   f"Set({col}, {self.field}={row})")
+        except (ClientError, OSError):
+            return False
+        self.acked.setdefault(row, set()).add(col)
+        return True
+
+    def random_writes(self, count: int, via: int = 0) -> int:
+        acked = 0
+        for _ in range(count):
+            row = self.rng.randrange(self.N_ROWS)
+            col = self.rng.randrange(self.MAX_COL)
+            acked += bool(self.write(row, col, via=via))
+        return acked
+
+    # -- invariants ----------------------------------------------------------
+
+    def check_oracle(self, via: int | None = None) -> None:
+        """Every node's answer for every row satisfies
+        ``acked ⊆ observed ⊆ attempted`` (and Count agrees with Row) —
+        acked writes are never lost, and nothing appears that was never
+        written (corruption / replayed half-records)."""
+        nodes = [via] if via is not None else range(self.n)
+        for i in nodes:
+            c = self.client(i)
+            for row in range(self.N_ROWS):
+                res = c.query(
+                    self.index,
+                    f"Row({self.field}={row})"
+                    f"Count(Row({self.field}={row}))")
+                got = set(res[0]["columns"])
+                count = res[1]
+                acked = self.acked.get(row, set())
+                attempted = self.attempted.get(row, set())
+                if not acked <= got:
+                    raise self._fail(
+                        f"node {i} row {row}: LOST acked writes "
+                        f"{sorted(acked - got)[:10]}")
+                if not got <= attempted:
+                    raise self._fail(
+                        f"node {i} row {row}: phantom bits "
+                        f"{sorted(got - attempted)[:10]} never written")
+                if count != len(got):
+                    raise self._fail(
+                        f"node {i} row {row}: Count={count} but "
+                        f"Row has {len(got)} columns")
+
+    def await_oracle(self, timeout: float = 90.0) -> None:
+        """Poll until every node answers oracle-consistently (AAE has
+        repaired what the faults diverged)."""
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                self.check_oracle()
+                return
+            except (InvariantViolation, ClientError, OSError) as e:
+                last = e
+            time.sleep(0.5)
+        raise self._fail(f"oracle never converged: {last}")
+
+    def await_replica_convergence(self, expected_holders: int,
+                                  timeout: float = 90.0) -> None:
+        """AAE/handoff end state: every fragment is held by exactly
+        ``expected_holders`` nodes (orphans handed off and deleted,
+        missing replicas re-filled) and all holders' position sets are
+        byte-identical."""
+        deadline = time.monotonic() + timeout
+        last = "no fragments observed"
+        while time.monotonic() < deadline:
+            try:
+                problem = self._replica_divergence(expected_holders)
+            except (ClientError, OSError) as e:
+                problem = f"transport: {e}"
+            if problem is None:
+                return
+            last = problem
+            time.sleep(0.7)
+        raise self._fail(f"replicas never converged: {last}")
+
+    def _replica_divergence(self, expected_holders: int) -> str | None:
+        datas: dict[tuple, dict[int, bytes]] = {}
+        for i in range(self.n):
+            inv = self.client(i)._json(
+                "GET", "/internal/fragments")["fragments"]
+            for fr in inv:
+                if fr["index"] != self.index:
+                    continue  # other scenarios' data is not ours to judge
+                key = (fr["index"], fr["field"], fr["view"], fr["shard"])
+                qs = (f"index={fr['index']}&field={fr['field']}"
+                      f"&view={fr['view']}&shard={fr['shard']}")
+                blob = self.client(i)._do(
+                    "GET", f"/internal/fragment/data?{qs}")
+                datas.setdefault(key, {})[i] = blob
+        if not datas:
+            return "no fragments observed"
+        for key, per_node in datas.items():
+            if len(per_node) != expected_holders:
+                return (f"{key} held by {sorted(per_node)} "
+                        f"(want {expected_holders} holders)")
+            if len(set(per_node.values())) != 1:
+                return f"{key} differs across {sorted(per_node)}"
+        return None
+
+    def await_placement_convergence(self, min_version: float,
+                                    timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        last: object = None
+        while time.monotonic() < deadline:
+            try:
+                versions = self.placement_versions()
+                if (len(set(versions)) == 1
+                        and versions[0] > min_version):
+                    return
+                last = versions
+            except (ClientError, OSError) as e:
+                last = e
+            time.sleep(0.3)
+        raise self._fail(
+            f"placement never converged past {min_version}: {last}")
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def scenario_partition_during_resize(cluster, seed: int) -> ChaosHarness:
+    """A node pair partitions, a rebalance runs THROUGH the partition
+    (its pushes to the unreachable side fail and the data stays behind
+    as orphans), writes continue — after the partition heals, anti-
+    entropy must hand every orphan to its owners and every node must
+    answer oracle-exact."""
+    h = ChaosHarness(cluster, seed, index="chaos_part")
+    h.setup()
+    h.random_writes(30)
+    h.check_oracle()
+    h.partition(1, 2)
+    acked = h.random_writes(15)  # via node 0: reaches everyone
+    if acked == 0:
+        raise h._fail("no write acked during the partition")
+    coord = h.coordinator_index()
+    h.client(coord)._json("POST", "/internal/resize/trigger", {})
+    time.sleep(0.5)  # let the resize thread flip into RESIZING
+    h.await_coordinator_normal()
+    h.random_writes(10)  # against the (possibly stale) new placement
+    h.clear_faults()
+    h.await_all_normal()
+    h.await_oracle()
+    h.await_replica_convergence(expected_holders=2)
+    return h
+
+
+def scenario_crash_mid_oplog_append(cluster, seed: int,
+                                    tears: int = 2) -> ChaosHarness:
+    """A torn oplog tail (the write 'crashes' after persisting only the
+    first K bytes of the record), then a real kill -9 and restart:
+    replay must recover the clean prefix — every acked write survives,
+    the torn record never half-applies."""
+    h = ChaosHarness(cluster, seed, index="chaos_crash")
+    h.setup()
+    h.random_writes(12)
+    h.check_oracle()
+    node = cluster.nodes[0]
+    for _ in range(tears):
+        # tear inside the 17-byte header or into the payload — both
+        # classes must truncate cleanly on replay
+        offset = h.rng.randrange(0, 25)
+        h.set_fault(0, "oplog.append", "torn_write", nth=1,
+                    args={"offset": offset})
+        row = h.rng.randrange(h.N_ROWS)
+        col = h.rng.randrange(h.MAX_COL)
+        if h.write(row, col):
+            raise h._fail("torn-write Set unexpectedly acked")
+        node.kill9()
+        node.stop()   # close the log handle; process is already dead
+        node.start()
+        node.await_up()
+        h.await_oracle()      # replay recovered the clean prefix
+        if h.random_writes(4) == 0:  # the truncated log appends again
+            raise h._fail("no write acked after crash recovery")
+        h.check_oracle()
+    return h
+
+
+def scenario_duplicate_delivery(cluster, seed: int) -> ChaosHarness:
+    """A node processes internal POSTs but drops the responses
+    (seeded-random, bounded): the idempotent internode retry redelivers
+    every one — bits must never double-count and replicas must
+    converge exactly."""
+    h = ChaosHarness(cluster, seed, index="chaos_dup")
+    h.setup()
+    h.random_writes(10)
+    h.set_fault(1, "server.response", "drop_response",
+                prob=0.5, seed=seed, times=12,
+                match={"path": "/internal/"})
+    h.random_writes(25)
+    h.clear_faults()
+    h.await_oracle()
+    h.await_replica_convergence(expected_holders=2)
+    return h
+
+
+def scenario_dropped_placement_broadcast(cluster,
+                                         seed: int) -> ChaosHarness:
+    """The coordinator's status broadcasts all drop (the one resize-
+    completion message included): peers must still converge onto the
+    new placement via the version riding every heartbeat
+    (pull-on-mismatch), with the broadcasts STILL dropped."""
+    h = ChaosHarness(cluster, seed, index="chaos_bcast")
+    h.setup()
+    h.random_writes(10)
+    coord = h.coordinator_index()
+    before = max(h.placement_versions())
+    h.set_fault(coord, "cluster.broadcast", "drop")
+    h.client(coord)._json("POST", "/internal/resize/trigger", {})
+    # convergence must happen WHILE broadcasts are dropped — the
+    # heartbeat version pull is the only remaining channel
+    h.await_placement_convergence(min_version=before)
+    h.clear_faults()
+    h.await_all_normal()
+    h.await_oracle()
+    return h
+
+
+SCENARIOS = {
+    "partition_during_resize": (scenario_partition_during_resize, 3),
+    "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
+    "duplicate_delivery": (scenario_duplicate_delivery, 2),
+    "dropped_placement_broadcast": (scenario_dropped_placement_broadcast,
+                                    2),
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Runbook entry: boot process clusters in a temp dir and run the
+    scripted scenarios.  Exit 0 = every invariant held."""
+    import argparse
+    import tempfile
+
+    from pilosa_tpu.testing import run_process_cluster
+
+    ap = argparse.ArgumentParser(description="chaos harness")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", *SCENARIOS])
+    args = ap.parse_args(argv)
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    for name in names:
+        fn, n_nodes = SCENARIOS[name]
+        replicas = 2 if n_nodes > 1 else 1
+        with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
+            with run_process_cluster(n_nodes, tmp, replicas=replicas,
+                                     anti_entropy=1.0) as cluster:
+                fn(cluster, args.seed)
+        print(f"[chaos] {name}: OK (seed={args.seed})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,8 +2,9 @@
 logging."""
 
 from pilosa_tpu.obs.logging import get_logger
-from pilosa_tpu.obs.metrics import NopStats, StageTimer, Stats
+from pilosa_tpu.obs.metrics import (NopStats, StageTimer, Stats,
+                                    StatsdStats)
 from pilosa_tpu.obs.tracing import GLOBAL_TRACER, Tracer
 
-__all__ = ["Stats", "NopStats", "StageTimer", "get_logger", "Tracer",
-           "GLOBAL_TRACER"]
+__all__ = ["Stats", "NopStats", "StageTimer", "StatsdStats",
+           "get_logger", "Tracer", "GLOBAL_TRACER"]

@@ -124,6 +124,61 @@ class Stats:
         return "\n".join(out) + "\n"
 
 
+class StatsdStats(Stats):
+    """Stats registry that ALSO emits every observation as a statsd
+    UDP packet (reference: ``statsd.go#statsdClient`` behind the
+    StatsClient interface).  DogStatsD wire format with tag support::
+
+        pilosa.query_seconds:12.3|ms|#call:Count
+
+    Subclassing keeps the in-process registry authoritative —
+    ``/metrics`` Prometheus text and ``/status`` summaries are
+    unchanged; statsd is an additional sink.  Emission is fire-and-
+    forget UDP: a missing/slow collector can never stall the serving
+    path (send errors are counted, not raised)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pilosa"):
+        super().__init__()
+        import socket
+        self._addr = (host, port)
+        self._prefix = (prefix + ".") if prefix else ""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self.send_errors = 0
+
+    @staticmethod
+    def _tags(labels: dict) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f"{k}:{v}" for k, v in sorted(labels.items()))
+        return "|#" + inner
+
+    def _emit(self, name: str, value, kind: str, labels: dict) -> None:
+        pkt = (f"{self._prefix}{name}:{value}|{kind}"
+               f"{self._tags(labels)}").encode()
+        try:
+            self._sock.sendto(pkt, self._addr)
+        except OSError:
+            self.send_errors += 1
+
+    def count(self, name: str, value: float = 1, **labels) -> None:
+        super().count(name, value, **labels)
+        self._emit(name, value, "c", labels)
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        super().gauge(name, value, **labels)
+        self._emit(name, value, "g", labels)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        super().observe(name, value, **labels)
+        # statsd timers are milliseconds by convention
+        self._emit(name, round(value * 1000.0, 6), "ms", labels)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
 class NopStats:
     """No-op client (reference: ``nopStatsClient``)."""
 

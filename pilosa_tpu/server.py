@@ -19,7 +19,22 @@ class PilosaTPUServer:
     def __init__(self, cfg: Config):
         self.cfg = cfg
         self.logger = get_logger(verbose=cfg.verbose)
-        self.stats = Stats()
+        if cfg.stats_backend == "statsd":
+            # statsd emission rides ON TOP of the in-process registry
+            # (subclass): /metrics keeps serving Prometheus text while
+            # every count/gauge/timing also emits a UDP statsd packet
+            from pilosa_tpu.obs import StatsdStats
+            host, _, port = cfg.statsd_address.rpartition(":")
+            self.stats = StatsdStats(host or "127.0.0.1",
+                                     int(port or 8125))
+            self.logger.info("stats: statsd emission to %s",
+                             cfg.statsd_address)
+        elif cfg.stats_backend not in ("", "prometheus"):
+            raise ValueError(
+                f"unknown stats_backend {cfg.stats_backend!r} "
+                "(expected '', 'prometheus' or 'statsd')")
+        else:
+            self.stats = Stats()
         self.holder = Holder(cfg.data_dir, fsync=cfg.fsync)
         self.executor: Executor | None = None
         self.api: API | None = None
@@ -29,6 +44,12 @@ class PilosaTPUServer:
         self.diagnostics = None
 
     def open(self) -> "PilosaTPUServer":
+        if self.cfg.faults:
+            # arm configured failpoints BEFORE any subsystem opens, so
+            # boot-time seams (oplog replay, mmap registration) are
+            # already injectable; a bad spec fails the boot loudly
+            from pilosa_tpu import fault
+            fault.configure(self.cfg.faults, logger=self.logger)
         if self.cfg.jax_coordinator:
             # multi-host pod slice: one process per host joins the jax
             # runtime before any device use; jax.devices() then spans

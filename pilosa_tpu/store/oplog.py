@@ -36,7 +36,8 @@ from typing import Iterator
 
 import numpy as np
 
-from pilosa_tpu.store import roaring
+from pilosa_tpu import fault
+from pilosa_tpu.store import roaring, syswrap
 
 OP_SET_BITS = 1
 OP_CLEAR_BITS = 2
@@ -71,11 +72,19 @@ class OpLog:
         else:
             payload = roaring.serialize(positions)
         body = struct.pack("<BQI", op, aux, len(payload)) + payload
+        record = struct.pack("<I", zlib.crc32(body)) + body
         f = self._file()
-        f.write(struct.pack("<I", zlib.crc32(body)) + body)
+        if fault.ACTIVE:
+            # record-relative torn tail: persist only args.offset bytes
+            # of THIS record then "crash" — replay must recover the
+            # clean prefix (CRC framing) whatever the offset
+            spec = fault.fire("oplog.append", path=self.path, op=op)
+            if spec is not None and spec["action"] == "torn_write":
+                fault.torn_write(f, record, spec)
+        syswrap.checked_write(f, record)
         f.flush()
         if self.fsync:
-            os.fsync(f.fileno())
+            syswrap.checked_fsync(f)
 
     def replay(self) -> Iterator[tuple[int, int, np.ndarray | None]]:
         """Yield (op, aux, positions).  Stops (and truncates the file) at

@@ -19,13 +19,38 @@ over the buffer) finish — avoiding ``BufferError`` on exported views.
 
 from __future__ import annotations
 
+import os
 import threading
 import weakref
 from collections import OrderedDict
 
+from pilosa_tpu import fault
+
 # Default cap: comfortably under Linux's vm.max_map_count default
 # (65530), leaving headroom for the allocator/XLA's own mappings.
 DEFAULT_MAX_MAPS = 32768
+
+
+def checked_write(f, data: bytes) -> int:
+    """``f.write`` through the ``sys.write`` failpoint: ``error``
+    raises :class:`pilosa_tpu.fault.FaultError` (an OSError — a disk
+    write failure); ``torn_write`` persists only the first
+    ``args.offset`` bytes before raising (a crash mid-write).  Durable
+    writers (oplog, snapshot) route here so chaos schedules can tear
+    them at byte granularity."""
+    if fault.ACTIVE:
+        spec = fault.fire("sys.write", path=getattr(f, "name", ""))
+        if spec is not None and spec["action"] == "torn_write":
+            fault.torn_write(f, data, spec)
+    return f.write(data)
+
+
+def checked_fsync(f) -> None:
+    """``os.fsync`` through the ``sys.fsync`` failpoint (``error``
+    raises; ``delay`` models a stalling disk)."""
+    if fault.ACTIVE:
+        fault.fire("sys.fsync", path=getattr(f, "name", ""))
+    os.fsync(f.fileno())
 
 
 class MapPool:
@@ -48,6 +73,11 @@ class MapPool:
         demotion takes the victim fragment's own lock with a timeout;
         on contention the cap is soft for that victim rather than
         risking lock-order deadlock between two opening fragments)."""
+        if fault.ACTIVE:
+            # mmap-open seam: `error` models map-slot/fd exhaustion at
+            # registration time (the caller's own heap fallback applies
+            # only to demotion contention, so this surfaces loudly)
+            fault.fire("sys.mmap", path=getattr(frag, "path", ""))
         victims = []
         with self._lock:
             while len(self._order) >= self.max_maps:

@@ -155,13 +155,17 @@ class ProcessNode:
     bypass env)."""
 
     def __init__(self, port: int, data_dir: str, seed_port: int | None,
-                 replicas: int, heartbeat: float, anti_entropy: float):
+                 replicas: int, heartbeat: float, anti_entropy: float,
+                 extra_env: dict[str, str] | None = None):
         self.port = port
         self.data_dir = data_dir
         self.seed_port = seed_port
         self.replicas = replicas
         self.heartbeat = heartbeat
         self.anti_entropy = anti_entropy
+        # extra env for this node — e.g. PILOSA_FAULTS to arm boot-time
+        # failpoints (chaos schedules that must fire during replay/join)
+        self.extra_env = dict(extra_env or {})
         self.proc: subprocess.Popen | None = None
         self._log = None
 
@@ -178,6 +182,7 @@ class ProcessNode:
         )
         if self.seed_port is not None:
             env["PILOSA_SEEDS"] = f"127.0.0.1:{self.seed_port}"
+        env.update(self.extra_env)
         self._log = open(self.data_dir + ".log", "ab")
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "pilosa_tpu.cli", "server",
@@ -263,9 +268,11 @@ class ProcessCluster:
 @contextmanager
 def run_process_cluster(n: int, base_dir: str, replicas: int = 1,
                         heartbeat: float = 0.3,
-                        anti_entropy: float = 0.0):
+                        anti_entropy: float = 0.0,
+                        extra_env: dict[str, str] | None = None):
     """Boot an n-node cluster of separate OS processes; yields a
-    :class:`ProcessCluster` once all members are NORMAL."""
+    :class:`ProcessCluster` once all members are NORMAL.  ``extra_env``
+    applies to every node (e.g. ``PILOSA_FAULTS`` chaos schedules)."""
     nodes: list[ProcessNode] = []
     cluster = None
     try:
@@ -278,7 +285,8 @@ def run_process_cluster(n: int, base_dir: str, replicas: int = 1,
                                        seed_port=ports[0] if i else None,
                                        replicas=replicas,
                                        heartbeat=heartbeat,
-                                       anti_entropy=anti_entropy)
+                                       anti_entropy=anti_entropy,
+                                       extra_env=extra_env)
                     nodes.append(node.start())
                     node.await_up()
                 break

@@ -24,13 +24,56 @@ def test_bench_child_end_to_end_toy_scale():
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
     assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
     out = json.loads(lines[0])
-    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(out) == {"metric", "value", "unit", "vs_baseline",
+                        "regressions"}
     assert out["unit"] == "qps" and out["value"] > 0
+    assert isinstance(out["regressions"], list)
     assert out["metric"].startswith(("product_count_qps_1b_cols",
                                      "concurrent_count_qps_1b_cols"))
     # the salvage line the watchdog parent depends on must be present
     assert any(ln.startswith("BENCH-SALVAGE ")
                for ln in proc.stderr.splitlines()), "salvage line missing"
+
+
+def test_regression_guard_flags_and_clears(tmp_path, monkeypatch):
+    """The guard compares only same-metric rounds, flags drops past
+    REGRESSION_RATIO with the prior round's figure attached, and stays
+    quiet within tolerance or when no comparable round exists."""
+    # bench.py (the headline script) is shadowed by the bench/ config
+    # package on import; load the file explicitly
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    art = tmp_path / "BENCH_r07.json"
+    art.write_text(json.dumps({
+        "parsed": {"metric": "product_count_qps_1b_cols_tpu",
+                   "value": 2000.0}}))
+    # older round with a HIGHER figure: newest round must win the compare
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "parsed": {"metric": "product_count_qps_1b_cols_tpu",
+                   "value": 9999.0}}))
+    monkeypatch.setenv("PILOSA_BENCH_BASELINE_DIR", str(tmp_path))
+    flagged = bench.regression_guard("product_count_qps_1b_cols_tpu", 500.0)
+    assert len(flagged) == 1
+    assert flagged[0]["previous"] == 2000.0
+    assert flagged[0]["previous_round"] == "BENCH_r07.json"
+    assert flagged[0]["ratio"] == 0.25
+    # within tolerance: clean
+    assert bench.regression_guard("product_count_qps_1b_cols_tpu",
+                                  1900.0) == []
+    # different metric (e.g. CPU smoke vs TPU rounds): no comparison
+    assert bench.regression_guard("product_count_qps_1b_cols_cpu",
+                                  1.0) == []
+    # a malformed newest artifact must not raise — the guard falls
+    # through to the next-most-recent comparable round
+    art.write_text("not json")
+    flagged = bench.regression_guard("product_count_qps_1b_cols_tpu", 1.0)
+    assert flagged and flagged[0]["previous_round"] == "BENCH_r03.json"
+    (tmp_path / "BENCH_r03.json").write_text("also not json")
+    assert bench.regression_guard("product_count_qps_1b_cols_tpu",
+                                  1.0) == []
 
 
 def test_config18_concurrency_gap_smoke():

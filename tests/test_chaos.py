@@ -1,0 +1,47 @@
+"""Chaos scenarios under tier-1: scripted fault schedules against REAL
+OS-process clusters (pilosa_tpu.fault.chaos), each asserting its
+distributed invariant after faults clear:
+
+- partition during resize      → no lost acked writes, AAE re-converges
+- crash mid-oplog-append       → replay recovers the clean prefix
+- duplicate delivery           → idempotent redelivery never corrupts
+- dropped placement broadcast  → heartbeat pull-on-mismatch converges
+
+Every schedule reproduces from the printed seed (override with
+PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
+3-node cluster (replicas=2, fast AAE) — fault configs are cleared and
+each scenario writes its own index, so boot cost is paid once."""
+
+import os
+
+import pytest
+
+from pilosa_tpu.fault import chaos
+from pilosa_tpu.testing import run_process_cluster
+
+SEED = int(os.environ.get("PILOSA_CHAOS_SEED", "42"))
+
+
+@pytest.fixture(scope="module")
+def trio(tmp_path_factory):
+    base = tmp_path_factory.mktemp("chaos_trio")
+    with run_process_cluster(3, str(base), replicas=2,
+                             anti_entropy=1.0) as cluster:
+        yield cluster
+
+
+def test_partition_during_resize(trio):
+    chaos.scenario_partition_during_resize(trio, SEED)
+
+
+def test_duplicate_delivery_on_internal_posts(trio):
+    chaos.scenario_duplicate_delivery(trio, SEED)
+
+
+def test_dropped_placement_broadcast(trio):
+    chaos.scenario_dropped_placement_broadcast(trio, SEED)
+
+
+def test_crash_mid_oplog_append(tmp_path):
+    with run_process_cluster(1, str(tmp_path)) as cluster:
+        chaos.scenario_crash_mid_oplog_append(cluster, SEED)

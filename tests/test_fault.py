@@ -1,0 +1,350 @@
+"""Failpoint registry + instrumented-seam tests (in-process).
+
+The OS-process chaos scenarios live in tests/test_chaos.py; this file
+pins the fault subsystem's own contracts: deterministic triggers, the
+zero-cost disabled guard, each seam's action semantics, the
+/internal/fault live-control surface, and admission load shedding
+(503 + Retry-After + metrics)."""
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu import fault
+from pilosa_tpu.api import API, Client, ClientError, Server
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.store import Holder
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """The registry is process-global by design (one serving process);
+    tests must not leak armed faults into each other."""
+    fault.clear()
+    fault.reset_triggered()
+    yield
+    fault.clear()
+    fault.reset_triggered()
+    fault.set_stats(None)
+
+
+@pytest.fixture
+def srv(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder)
+    server = Server(api, "127.0.0.1", 0, stats=Stats()).start()
+    client = Client("127.0.0.1", server.address[1])
+    yield holder, api, server, client
+    server.close()
+    holder.close()
+
+
+class TestRegistry:
+    def test_disabled_guard_is_a_module_bool(self):
+        # the hot-path contract: sites check fault.ACTIVE before any
+        # call — with nothing armed it must be exactly False
+        assert fault.ACTIVE is False
+        fault.set_fault("x", "drop")
+        assert fault.ACTIVE is True
+        fault.clear()
+        assert fault.ACTIVE is False
+
+    def test_bare_nth_fires_exactly_once(self):
+        fault.set_fault("s", "drop", nth=3)
+        fired = [fault.fire("s") is not None for _ in range(6)]
+        assert fired == [False, False, True, False, False, False]
+
+    def test_nth_with_times_fires_a_window(self):
+        fault.set_fault("s", "drop", nth=2, times=2)
+        fired = [fault.fire("s") is not None for _ in range(5)]
+        assert fired == [False, True, True, False, False]
+
+    def test_seeded_probability_is_reproducible(self):
+        fault.set_fault("s", "drop", prob=0.5, seed=123)
+        first = [fault.fire("s") is not None for _ in range(30)]
+        fault.clear()
+        fault.set_fault("s", "drop", prob=0.5, seed=123)
+        second = [fault.fire("s") is not None for _ in range(30)]
+        assert first == second and any(first) and not all(first)
+
+    def test_match_filters_context(self):
+        fault.set_fault("s", "drop", match={"peer": "127.0.0.1:9"})
+        assert fault.fire("s", peer="127.0.0.1:8000") is None
+        assert fault.fire("s", peer="127.0.0.1:9000") is not None
+
+    def test_stacked_faults_on_one_site(self):
+        fault.set_fault("s", "drop", match={"peer": "a"})
+        fault.set_fault("s", "drop", match={"peer": "b"})
+        assert fault.fire("s", peer="xbx") is not None
+        assert fault.fire("s", peer="xax") is not None
+        assert fault.fire("s", peer="c") is None
+        assert fault.clear("s") == 2
+
+    def test_error_action_raises_oserror(self):
+        fault.set_fault("s", "error")
+        with pytest.raises(fault.FaultError):
+            fault.fire("s")
+        assert isinstance(fault.FaultError("x"), OSError)
+
+    def test_oom_action_matches_executor_classifier(self):
+        from pilosa_tpu.exec.executor import _is_device_oom
+        fault.set_fault("s", "oom")
+        with pytest.raises(ValueError) as ei:
+            fault.fire("s")
+        assert _is_device_oom(ei.value)
+
+    def test_delay_action_sleeps_then_continues(self):
+        fault.set_fault("s", "delay", args={"seconds": 0.05})
+        t0 = time.perf_counter()
+        assert fault.fire("s") is not None
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_configure_from_env_json(self):
+        n = fault.configure(
+            '[{"site": "a", "action": "drop", "nth": 2},'
+            ' {"site": "b", "action": "delay",'
+            '  "args": {"seconds": 0.001}}]')
+        assert n == 2 and fault.ACTIVE
+        assert {f["site"] for f in fault.list_faults()} == {"a", "b"}
+
+    def test_bad_specs_fail_loudly(self):
+        with pytest.raises(ValueError):
+            fault.set_fault("s", "no-such-action")
+        with pytest.raises(ValueError):
+            fault.set_fault("s", "drop", prob=1.5)
+        with pytest.raises(ValueError):
+            fault.configure("{not json")
+
+    def test_triggered_counter_and_stats_sink(self):
+        stats = Stats()
+        fault.set_stats(stats)
+        fault.set_fault("s", "drop")
+        fault.fire("s")
+        fault.fire("s")
+        assert fault.triggered_total()[("s", "drop")] == 2
+        counters = stats.snapshot()["counters"]["fault_triggered_total"]
+        assert sum(counters.values()) == 2
+
+
+class TestClientSeams:
+    def test_partition_is_unreachable_before_any_socket(self):
+        # no server behind this port on purpose: partition must fire
+        # BEFORE connect, classed exactly like connection-refused
+        fault.set_fault("client.send", "partition",
+                        match={"peer": "127.0.0.1:1"})
+        c = Client("127.0.0.1", 1)
+        with pytest.raises(ClientError) as ei:
+            c._do("GET", "/status")
+        assert ei.value.kind == "unreachable"
+
+    def test_recv_drop_retries_idempotent_requests(self, srv):
+        _, _, server, _ = srv
+        fault.set_fault("client.recv", "drop", nth=1)
+        c = Client("127.0.0.1", server.address[1])
+        # GET is idempotent: the injected lost response retries through
+        assert c.version()
+
+    def test_recv_drop_surfaces_on_default_posts(self, srv):
+        _, _, server, client = srv
+        client.create_index("i")
+        client.create_field("i", "f")
+        fault.set_fault("client.recv", "drop",
+                        match={"path": "/query"})
+        c = Client("127.0.0.1", server.address[1])
+        # default client: a POST whose response was lost must NOT
+        # auto-retry (query can carry writes) — the error surfaces
+        with pytest.raises(ClientError):
+            c.query("i", "Set(1, f=1)")
+        fault.clear()
+        # ... and the write DID apply server-side (at-least-once)
+        assert client.query("i", "Count(Row(f=1))") == [1]
+
+    def test_server_drop_response_processes_then_drops(self, srv):
+        _, _, server, client = srv
+        client.create_index("i")
+        client.create_field("i", "f")
+        fault.set_fault("server.response", "drop_response", nth=1,
+                        match={"path": "/query"})
+        idem = Client("127.0.0.1", server.address[1],
+                      idempotent_posts=True)
+        # response dropped after processing; the idempotent client
+        # retries and the duplicate delivery is absorbed (Set is a
+        # union) — exactly once-visible state
+        assert idem.query("i", "Set(7, f=2)") in ([True], [False])
+        assert client.query("i", "Count(Row(f=2))") == [1]
+
+
+class TestOplogSeam:
+    def test_torn_append_truncates_to_clean_prefix(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.store.oplog import OP_SET_BITS, OpLog
+        log = OpLog(str(tmp_path / "x.oplog"))
+        log.append(OP_SET_BITS, 0, np.array([1, 2, 3], np.uint64))
+        log.append(OP_SET_BITS, 0, np.array([4], np.uint64))
+        good = list(log.replay())
+        assert len(good) == 2
+        fault.set_fault("oplog.append", "torn_write", nth=1,
+                        args={"offset": 9})
+        with pytest.raises(fault.FaultError):
+            log.append(OP_SET_BITS, 0, np.array([5], np.uint64))
+        log.close()
+        replayed = list(log.replay())
+        assert len(replayed) == 2  # torn record gone, prefix intact
+        assert [list(p) for _, _, p in replayed] == [[1, 2, 3], [4]]
+        # the file was physically truncated back to the clean prefix
+        fault.clear()
+        log2 = OpLog(log.path)
+        log2.append(OP_SET_BITS, 0, np.array([6], np.uint64))
+        log2.close()
+        assert len(list(log2.replay())) == 3
+
+
+class TestExecutorSeams:
+    def test_injected_oom_drives_real_recovery(self, tmp_path):
+        from pilosa_tpu.exec import Executor
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        stats = Stats()
+        ex = Executor(holder, stats=stats)
+        ex.execute("i", "Set(3, f=1)")
+        fault.set_fault("exec.oom", "oom", nth=1, times=1)
+        assert ex.execute("i", "Count(Row(f=1))") == [1]
+        counters = stats.snapshot()["counters"]
+        assert sum(counters["device_oom_retries"].values()) == 1
+        holder.close()
+
+
+class TestDistFanoutSeam:
+    def test_failed_remote_leg_surfaces_loudly(self, tmp_path):
+        """dist.fanout `error` kills one node's share of a fan-out:
+        the query must FAIL (a silent partial answer would undercount),
+        and serve again once the fault clears."""
+        from pilosa_tpu.testing import run_cluster
+        with run_cluster(2, str(tmp_path), replicas=1) as tc:
+            c = tc.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            # pick a shard each node OWNS (jump-hash over the random
+            # test ports decides placement) so the fan-out from node 0
+            # is guaranteed to have a remote leg
+            from pilosa_tpu.engine.words import SHARD_WIDTH
+            cluster0 = tc.servers[0].cluster
+            remote_id = tc.servers[1].cluster.node_id
+            own = {}
+            for s in range(64):
+                own.setdefault(cluster0.shard_owners("i", s)[0], s)
+                if len(own) == 2:
+                    break
+            assert len(own) == 2, "placement gave node 1 no shard"
+            c.query("i", "".join(
+                f"Set({s * SHARD_WIDTH + 1}, f=1)"
+                for s in own.values()))
+            assert c.query("i", "Count(Row(f=1))") == [2]
+            # fail the remote leg only (in-process cluster: the fault
+            # registry is shared; match on the peer id)
+            fault.set_fault("dist.fanout", "error",
+                            match={"peer": remote_id})
+            with pytest.raises(ClientError):
+                c.query("i", "Count(Row(f=1))")
+            fault.clear()
+            assert c.query("i", "Count(Row(f=1))") == [2]
+
+
+class TestFaultEndpoints:
+    def test_set_list_clear_roundtrip(self, srv):
+        _, _, _, c = srv
+        armed = c._json("POST", "/internal/fault",
+                        {"site": "client.send", "action": "partition",
+                         "match": {"peer": "127.0.0.1:9"}, "times": 3})
+        assert armed["armed"]["site"] == "client.send"
+        listing = c._json("GET", "/internal/fault")
+        assert len(listing["faults"]) == 1
+        assert listing["faults"][0]["action"] == "partition"
+        assert c._json("POST", "/internal/fault/clear",
+                       {"site": "client.send"})["cleared"] == 1
+        assert c._json("GET", "/internal/fault")["faults"] == []
+
+    def test_bad_spec_is_400(self, srv):
+        _, _, _, c = srv
+        with pytest.raises(ClientError) as ei:
+            c._json("POST", "/internal/fault", {"site": "x"})
+        assert ei.value.status == 400
+        with pytest.raises(ClientError) as ei:
+            c._json("POST", "/internal/fault",
+                    {"site": "x", "action": "bogus"})
+        assert ei.value.status == 400
+
+    def test_triggered_counts_surface_on_metrics(self, srv):
+        _, _, server, c = srv
+        c._json("POST", "/internal/fault",
+                {"site": "server.response", "action": "drop_response",
+                 "nth": 1, "match": {"path": "/version"}})
+        idem = Client("127.0.0.1", server.address[1])
+        assert idem.version()  # dropped once, retried (GET)
+        listing = c._json("GET", "/internal/fault")
+        assert listing["triggered"] == [
+            {"site": "server.response", "action": "drop_response",
+             "count": 1}]
+        text = c.metrics_text()
+        assert 'fault_triggered_total{action="drop_response",' \
+               'site="server.response"} 1' in text
+
+
+class TestLoadShedding:
+    def _saturate(self, api, seconds: float) -> threading.Thread:
+        """Hold the single execution slot with an injected delay."""
+        fault.set_fault("exec.execute", "delay", nth=1,
+                        args={"seconds": seconds})
+        t = threading.Thread(
+            target=lambda: api.query("i", "Count(Row(f=1))"))
+        t.start()
+        deadline = time.monotonic() + 5
+        while api.executor.slots_in_use < 1:
+            assert time.monotonic() < deadline, "saturator never admitted"
+            time.sleep(0.005)
+        return t
+
+    def test_saturated_executor_answers_503_with_retry_after(
+            self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        from pilosa_tpu.exec import Executor
+        stats = Stats()
+        ex = Executor(holder, stats=stats, max_concurrent=1)
+        ex.slot_timeout_s = 0.1
+        api = API(holder, ex)
+        server = Server(api, "127.0.0.1", 0, stats=stats).start()
+        try:
+            ex.execute("i", "Set(1, f=1)")
+            t = self._saturate(api, seconds=1.5)
+            url = (f"http://127.0.0.1:{server.address[1]}"
+                   f"/index/i/query")
+            req = urllib.request.Request(
+                url, data=b"Count(Row(f=1))", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503, "shed must be 503, never 500"
+            assert ei.value.headers["Retry-After"] == "1"
+            t.join(timeout=30)
+            # shed observability: counter + gauges + queue-wait histo
+            text = Client("127.0.0.1",
+                          server.address[1]).metrics_text()
+            assert "query_shed_total 1" in text
+            assert "query_slots_in_use" in text
+            assert "query_slots_max 1" in text
+            assert "query_queue_wait_seconds_count" in text
+            status = api.status()
+            assert status["admission"]["shedTotal"] == 1
+            assert status["admission"]["maxConcurrent"] == 1
+            # the slot was not leaked by the shed: queries serve again
+            assert api.query("i", "Count(Row(f=1))")["results"] == [1]
+        finally:
+            server.close()
+            holder.close()

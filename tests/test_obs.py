@@ -1,7 +1,80 @@
-"""Observability tests: metrics registry + prometheus text, span tree +
-cross-node propagation (SURVEY.md §6)."""
+"""Observability tests: metrics registry + prometheus text, statsd
+emission, span tree + cross-node propagation (SURVEY.md §6)."""
 
-from pilosa_tpu.obs import Stats, Tracer
+from pilosa_tpu.obs import Stats, StatsdStats, Tracer
+
+
+class TestStatsd:
+    def _sink(self):
+        import socket
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.settimeout(5.0)
+        return s, s.getsockname()[1]
+
+    def _drain(self, sock, n):
+        pkts = []
+        for _ in range(n):
+            pkts.append(sock.recv(4096).decode())
+        return pkts
+
+    def test_udp_packets_with_tag_formatting(self):
+        sink, port = self._sink()
+        st = StatsdStats("127.0.0.1", port)
+        try:
+            st.count("reqs", 2, method="GET", status="200")
+            st.gauge("slots", 3)
+            st.timing("lat", 0.025, call="Count")
+            pkts = sorted(self._drain(sink, 3))
+            assert "pilosa.lat:25.0|ms|#call:Count" in pkts
+            assert "pilosa.reqs:2|c|#method:GET,status:200" in pkts
+            assert "pilosa.slots:3|g" in pkts
+        finally:
+            st.close()
+            sink.close()
+
+    def test_local_registry_stays_authoritative(self):
+        """Statsd is an ADDITIONAL sink: /metrics (prometheus text)
+        must keep working off the in-process registry."""
+        sink, port = self._sink()
+        st = StatsdStats("127.0.0.1", port)
+        try:
+            st.count("reqs", 1, method="GET")
+            st.observe("lat", 0.003)
+            text = st.prometheus_text()
+            assert 'reqs{method="GET"} 1' in text
+            assert "lat_count 1" in text
+        finally:
+            st.close()
+            sink.close()
+
+    def test_unreachable_collector_never_raises(self):
+        # fire-and-forget UDP: nothing listens on the port; the
+        # serving path must not care
+        st = StatsdStats("127.0.0.1", 1)
+        try:
+            for _ in range(10):
+                st.count("reqs", 1)
+        finally:
+            st.close()
+
+    def test_config_wires_statsd_backend(self, tmp_path):
+        from pilosa_tpu.cli.config import Config
+        from pilosa_tpu.server import PilosaTPUServer
+        sink, port = self._sink()
+        srv = PilosaTPUServer(Config(
+            data_dir=str(tmp_path), stats_backend="statsd",
+            statsd_address=f"127.0.0.1:{port}"))
+        try:
+            assert isinstance(srv.stats, StatsdStats)
+            srv.stats.count("boot", 1)
+            assert sink.recv(4096) == b"pilosa.boot:1|c"
+        finally:
+            sink.close()
+        import pytest
+        with pytest.raises(ValueError):
+            PilosaTPUServer(Config(data_dir=str(tmp_path),
+                                   stats_backend="graphite"))
 
 
 class TestStats:
