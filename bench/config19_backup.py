@@ -1,0 +1,163 @@
+"""Config #19: backup and restore throughput (MB/s) at the standard
+dataset sizes.
+
+The r8 backup subsystem (``pilosa_tpu/backup/``) claims production
+recovery: a consistent online backup pulled over HTTP with parallel
+workers, an incremental mode that re-transfers only changed fragments,
+and an elastic restore that re-routes by the target placement.  This
+config measures the two headline rates operators plan around —
+
+- **backup MB/s**: full archive of a freshly-built index (the standard
+  954-shard × 32-row plane unless overridden) through the streaming
+  fragment endpoints into a manifest directory;
+- **restore MB/s**: that archive pushed into a FRESH server through
+  the union-merge import path, digests verified first;
+
+plus the incremental property (one small mutation → the second run
+transfers only the touched fragments, asserted, not assumed) and an
+oracle check that the restored index answers the same counts.
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 4 rows on CPU —
+tier-1 runs it (tests/test_bench_smoke.py) so this bench can never
+bitrot.
+
+Prints ONE JSON line: backup MB/s, vs_baseline = restore MB/s; the
+figure lands in BENCH_r*.json rounds where bench.py's regression
+guard compares same-metric history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 4 if SMOKE else int(os.environ.get("PILOSA_BENCH_ROWS", "32"))
+WORKERS = 2 if SMOKE else 8
+WORDS = 32768  # words per shard (2^20 bits / 32)
+INDEX, FIELD = "i", "f"
+
+
+def write_index(plane: np.ndarray, data_dir: str) -> None:
+    """A REAL on-disk index from the packed plane (the config18
+    recipe): schema through the Holder, one roaring snapshot per
+    shard."""
+    from pilosa_tpu.store import Holder, roaring
+
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.api import API, Server
+    from pilosa_tpu.api.client import Client
+    from pilosa_tpu.backup import BackupDriver, RestoreDriver
+    from pilosa_tpu.store import Holder
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    oracle = (np.bitwise_count(plane).sum(dtype=np.int64)
+              if hasattr(np, "bitwise_count") else
+              int(np.unpackbits(plane.reshape(-1).view(np.uint8)).sum()))
+
+    base = tempfile.mkdtemp(prefix="pilosa_c19_")
+    try:
+        src_dir = os.path.join(base, "src")
+        write_index(plane, src_dir)
+        holder = Holder(src_dir).open()
+        api = API(holder)
+        srv = Server(api, "127.0.0.1", 0).start()
+        port = srv.address[1]
+        out = os.path.join(base, "arch")
+
+        # ------------------------------------------------------- backup
+        t0 = time.perf_counter()
+        res = BackupDriver("127.0.0.1", port, out,
+                           workers=WORKERS).run()
+        dt = time.perf_counter() - t0
+        backup_mbps = res["bytes"] / dt / 1e6
+        log(f"backup: {res['fragments']} fragments, "
+            f"{res['bytes'] / 1e6:.1f} MB in {dt:.2f}s "
+            f"= {backup_mbps:.1f} MB/s ({WORKERS} workers)")
+
+        # -------------------------------------------------- incremental
+        # a guaranteed-new bit (row N_ROWS is outside the random plane)
+        Client("127.0.0.1", port).query(
+            INDEX, f"Set(1, {FIELD}={N_ROWS})")
+        t0 = time.perf_counter()
+        inc = BackupDriver("127.0.0.1", port, out, workers=WORKERS,
+                           incremental=True).run()
+        inc_dt = time.perf_counter() - t0
+        assert len(inc["transferred"]) == 1, inc["transferred"]
+        assert len(inc["skipped"]) == res["fragments"] - 1
+        log(f"incremental after 1 Set: {len(inc['transferred'])} "
+            f"fragment re-transferred, {len(inc['skipped'])} skipped "
+            f"({inc['bytes'] / 1e6:.2f} MB in {inc_dt:.2f}s)")
+        srv.close()
+        holder.close()
+
+        # ------------------------------------------------------ restore
+        dst_dir = os.path.join(base, "dst")
+        h2 = Holder(dst_dir).open()
+        api2 = API(h2)
+        s2 = Server(api2, "127.0.0.1", 0).start()
+        t0 = time.perf_counter()
+        rres = RestoreDriver("127.0.0.1", s2.address[1], out,
+                             workers=WORKERS).run()
+        rdt = time.perf_counter() - t0
+        restore_mbps = rres["bytes"] / rdt / 1e6
+        log(f"restore: {rres['fragments']} fragments, "
+            f"{rres['bytes'] / 1e6:.1f} MB in {rdt:.2f}s "
+            f"= {restore_mbps:.1f} MB/s (incl. digest verify)")
+
+        # oracle: total bit count survives the round trip (+1 Set)
+        c2 = Client("127.0.0.1", s2.address[1])
+        pql = "".join(f"Count(Row({FIELD}={r}))"
+                      for r in range(N_ROWS + 1))
+        got = sum(c2.query(INDEX, pql))
+        want = int(oracle) + 1
+        assert got == want, f"restored count {got} != oracle {want}"
+        log(f"oracle: restored total count {got} matches source")
+        s2.close()
+        h2.close()
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": f"backup_mbps_{platform}",
+        "value": round(backup_mbps, 1), "unit": "MBps",
+        "vs_baseline": round(restore_mbps, 1),
+        "detail": {"restore_mbps": round(restore_mbps, 1),
+                   "bytes": res["bytes"],
+                   "fragments": res["fragments"],
+                   "workers": WORKERS,
+                   "incremental_transferred": len(inc["transferred"]),
+                   "incremental_skipped": len(inc["skipped"])}}))
+
+
+if __name__ == "__main__":
+    main()

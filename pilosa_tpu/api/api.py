@@ -457,6 +457,28 @@ class API:
 
     # -- introspection ------------------------------------------------------
 
+    def storage_stats(self) -> dict:
+        """Aggregate storage footprint: fragment count, op-log bytes
+        (un-compacted write-ahead growth) and snapshot bytes.  Cheap
+        (stat calls only); the ``/metrics`` gauges and the ``/status``
+        storage block both read this."""
+        frags = oplog = snap = 0
+        for idx in list(self.holder.indexes.values()):
+            for f in list(idx.fields.values()):
+                for v in list(f.views.values()):
+                    for frag in list(v.fragments.values()):
+                        frags += 1
+                        try:
+                            oplog += os.path.getsize(frag._oplog.path)
+                        except OSError:
+                            pass
+                        try:
+                            snap += os.path.getsize(frag.path)
+                        except OSError:
+                            pass
+        return {"fragmentCount": frags, "oplogBytes": oplog,
+                "snapshotBytes": snap}
+
     def status(self) -> dict:
         import jax
         devices = [{"id": d.id, "platform": d.platform, "kind": d.device_kind}
@@ -480,6 +502,10 @@ class API:
                     "shedTotal": int(sum(shed.values())),
                     "queueWait": ex.stats.histogram_summary(
                         "query_queue_wait_seconds")},
+                # on-disk footprint: what backup archives and the
+                # snapshot queue compacts (oplogBytes growth = log
+                # compaction falling behind)
+                "storage": self.storage_stats(),
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
                 "planeCache": self.executor.planes.stats(),

@@ -173,6 +173,8 @@ class Client:
             # always safe to retry once on a fresh connection
             conn.close()
             if not _retried:
+                if hasattr(body, "seek"):
+                    body.seek(0)  # streamed (file-object) bodies rewind
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",
@@ -189,6 +191,8 @@ class Client:
             idempotent = (method in self.IDEMPOTENT_METHODS
                           or self.idempotent_posts)
             if idempotent and not _retried:
+                if hasattr(body, "seek"):
+                    body.seek(0)  # streamed (file-object) bodies rewind
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",
@@ -225,6 +229,77 @@ class Client:
         if ctype.startswith("application/json"):
             return json.loads(data)
         return data
+
+    # streamed-download read size: bounds peak memory per transfer (a
+    # multi-GB fragment image never materializes as one bytes object)
+    DOWNLOAD_CHUNK = 1 << 20
+
+    def download(self, path: str, sink, chunk_size: int | None = None,
+                 timeout: float | None = None,
+                 _retried: bool = False) -> dict:
+        """Stream a GET response body into ``sink`` (anything with
+        ``write(bytes)``) in bounded chunks; returns the response
+        headers as a plain dict (``Content-Length``,
+        ``X-Content-SHA256``, …) so callers can verify digests they
+        computed while writing.
+
+        Retry contract: GET is idempotent, so a stale pooled socket
+        retries once — but only while ZERO body bytes have reached the
+        sink (a mid-body retry would duplicate the prefix; callers
+        that want mid-body recovery restart the whole transfer, e.g.
+        against another replica)."""
+        chunk_size = chunk_size or self.DOWNLOAD_CHUNK
+        t = self.timeout if timeout is None else timeout
+        conn = self._checkout(t, fresh=_retried)
+        wrote = 0
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                data = resp.read()
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._checkin(conn)
+                detail = data.decode(errors="replace")
+                try:
+                    detail = json.loads(detail).get("error", detail)
+                except json.JSONDecodeError:
+                    pass
+                raise ClientError(detail, resp.status)
+            while True:
+                chunk = resp.read(chunk_size)
+                if not chunk:
+                    break
+                sink.write(chunk)
+                wrote += len(chunk)
+        except (http.client.CannotSendRequest, http.client.BadStatusLine,
+                ConnectionResetError, BrokenPipeError) as e:
+            conn.close()
+            if not _retried and wrote == 0:
+                return self.download(path, sink, chunk_size,
+                                     timeout=timeout, _retried=True)
+            raise ClientError(f"connection reset by {self.base}",
+                              kind="unreachable") from e
+        except TimeoutError as e:
+            conn.close()
+            raise ClientError(f"request to {self.base} timed out",
+                              kind="timeout") from e
+        except OSError as e:
+            conn.close()
+            raise ClientError(f"cannot reach {self.base}: {e}",
+                              kind="unreachable") from e
+        headers = dict(resp.headers.items())
+        if resp.will_close:
+            conn.close()
+        else:
+            self._checkin(conn)
+        clen = headers.get("Content-Length")
+        if clen is not None and int(clen) != wrote:
+            raise ClientError(
+                f"short read from {self.base}{path}: got {wrote} of "
+                f"{clen} bytes", kind="transport")
+        return headers
 
     def _json(self, method: str, path: str, obj=None,
               headers: dict | None = None):

@@ -398,6 +398,14 @@ class Handler(BaseHTTPRequestHandler):
             # and queue-wait histogram fire() maintains
             stats.gauge("query_slots_in_use", ex.slots_in_use)
             stats.gauge("query_slots_max", ex.max_concurrent)
+            # storage growth visibility (r8): op-log bytes are what the
+            # snapshot queue + backup are supposed to bound — an
+            # operator watching oplog_bytes climb knows compaction has
+            # fallen behind before recovery time blows up
+            st = self.server.api.storage_stats()
+            stats.gauge("oplog_bytes", st["oplogBytes"])
+            stats.gauge("fragment_count", st["fragmentCount"])
+            stats.gauge("snapshot_bytes", st["snapshotBytes"])
         text = stats.prometheus_text() if stats is not None else ""
         self._reply(text.encode(),
                     content_type="text/plain; version=0.0.4")
@@ -521,6 +529,13 @@ def build_router() -> Router:
         pass
     else:
         register_internal_routes(r)
+    # backup/restore surface (same deferred-import contract)
+    try:
+        from pilosa_tpu.backup.endpoints import register_backup_routes
+    except ImportError:
+        pass
+    else:
+        register_backup_routes(r)
     return r
 
 

@@ -1,0 +1,22 @@
+"""Consistent online backup & elastic restore (reference:
+``ctl/backup.go`` / ``ctl/restore.go``, SURVEY.md §6).
+
+- :mod:`pilosa_tpu.backup.endpoints` — the ``/internal/backup/*`` HTTP
+  surface (generation-bracketed fragment images with digests);
+- :mod:`pilosa_tpu.backup.manifest` — the archive's ``manifest.json``
+  format, incremental diffing, digest verification;
+- :mod:`pilosa_tpu.backup.driver` — the client-side
+  :class:`BackupDriver` (parallel pull, replica fallback, incremental)
+  and :class:`RestoreDriver` (elastic re-routing by the target
+  placement, forced AAE convergence).
+
+CLI: ``python -m pilosa_tpu.cli backup --output DIR`` /
+``... restore DIR`` (see the README runbook).
+"""
+
+from pilosa_tpu.backup.driver import (BackupDriver, BackupError,
+                                      RestoreDriver)
+from pilosa_tpu.backup.manifest import DigestError, Manifest, ManifestError
+
+__all__ = ["BackupDriver", "RestoreDriver", "BackupError",
+           "Manifest", "ManifestError", "DigestError"]

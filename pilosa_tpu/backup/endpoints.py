@@ -1,0 +1,202 @@
+"""HTTP surface of the backup subsystem.
+
+Reference: the fragment-data export endpoints behind ``ctl/backup.go``
+(``/internal/fragment/data`` + translate/attr archives).  These routes
+serve CONSISTENT per-fragment images to the backup driver and accept
+the restore driver's translate pushes; unlike the ``/internal/*``
+cluster surface they work on a single un-clustered node too (a one-box
+deployment deserves backups).
+
+Consistency: a fragment image is **generation-bracketed** — read the
+generation, serialize, re-read; only equal brackets are served (the
+same validation trick the executor's plan cache uses).  A fragment
+under concurrent writes retries a bounded number of times, then takes
+the fragment lock for one guaranteed-consistent capture.  The served
+generation header is therefore always the generation OF the blob.
+
+Every payload carries ``Content-Length`` (``_reply`` always does),
+``X-Content-SHA256`` (end-to-end transfer integrity — the driver
+verifies while streaming to disk) and, for fragments,
+``X-Pilosa-Generation`` + ``X-Pilosa-Checksum`` (the restart-stable
+position checksum incremental mode diffs on).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import time
+import zlib
+
+from pilosa_tpu.api.api import ApiError
+from pilosa_tpu.api.server import Handler, Router
+from pilosa_tpu.store import roaring
+
+# bounded bracketing retries before falling back to a capture under the
+# fragment lock (writes in flight keep bumping the generation)
+BRACKET_RETRIES = 4
+
+
+def fragment_checksum(frag) -> str:
+    """Restart-stable content checksum: crc32 over the fragment's
+    sorted AAE block checksums.  ``Fragment.blocks()`` is generation-
+    cached, so an unchanged fragment answers from cache — the property
+    that makes incremental backup sweeps cheap."""
+    items = sorted(frag.blocks().items())
+    buf = b"".join(struct.pack("<qI", b, c & 0xFFFFFFFF)
+                   for b, c in items)
+    return format(zlib.crc32(buf), "08x")
+
+
+def capture_fragment(frag) -> tuple[bytes, int, str]:
+    """(roaring blob, generation, checksum) — generation-bracketed."""
+    for _ in range(BRACKET_RETRIES):
+        gen = frag.generation
+        blob = roaring.serialize(frag.positions())
+        checksum = fragment_checksum(frag)
+        if frag.generation == gen:
+            return blob, gen, checksum
+    # hot fragment: one guaranteed capture under its lock
+    with frag.lock:
+        gen = frag.generation
+        blob = roaring.serialize(frag.positions())
+        checksum = fragment_checksum(frag)
+    return blob, gen, checksum
+
+
+def _find_fragment(handler: Handler, index: str, field: str, view: str,
+                   shard: str):
+    api = handler.server.api
+    idx = api.holder.index(index)
+    if idx is None:
+        raise ApiError(f"index {index!r} not found", 404)
+    f = idx.field(field)
+    if f is None:
+        raise ApiError(f"field {field!r} not found", 404)
+    v = f.view(view)
+    if v is None:
+        raise ApiError(f"view {view!r} not found", 404)
+    frag = v.fragment(int(shard))
+    if frag is None:
+        raise ApiError(f"fragment {shard} not found", 404)
+    return frag
+
+
+# -- handlers ----------------------------------------------------------------
+
+
+def h_backup_inventory(self: Handler) -> None:
+    """Every local fragment holding data, with generation + checksum
+    when ``?checksums=1`` (incremental mode's skip detector).  Walks
+    the holder directly — works clustered or not."""
+    want_sums = "checksums" in self.query
+    out = []
+    holder = self.server.api.holder
+    for iname, idx in list(holder.indexes.items()):
+        for fname, f in list(idx.fields.items()):
+            for vname, v in list(f.views.items()):
+                for shard, frag in list(v.fragments.items()):
+                    if not frag.present:
+                        continue
+                    ent = {"index": iname, "field": fname,
+                           "view": vname, "shard": shard}
+                    if want_sums:
+                        ent["generation"] = frag.generation
+                        ent["checksum"] = fragment_checksum(frag)
+                    out.append(ent)
+    self._reply({"fragments": out})
+
+
+def h_backup_fragment(self: Handler, index: str, field: str, view: str,
+                      shard: str) -> None:
+    t0 = time.perf_counter()
+    frag = _find_fragment(self, index, field, view, shard)
+    blob, gen, checksum = capture_fragment(frag)
+    digest = hashlib.sha256(blob).hexdigest()
+    stats = getattr(self.server, "stats", None)
+    if stats is not None:
+        stats.count("backup_bytes_total", len(blob))
+        stats.observe("backup_fragment_seconds",
+                      time.perf_counter() - t0)
+    self._reply(blob, content_type="application/octet-stream",
+                headers={"X-Content-SHA256": digest,
+                         "X-Pilosa-Generation": str(gen),
+                         "X-Pilosa-Checksum": checksum})
+
+
+def h_backup_schema(self: Handler) -> None:
+    body = json.dumps({"schema": self.server.api.schema()}).encode()
+    self._reply(body, headers={
+        "X-Content-SHA256": hashlib.sha256(body).hexdigest()})
+
+
+def h_backup_attrs_list(self: Handler) -> None:
+    """Attribute stores present on disk: ``[{index, field|null}]``.
+    Existence is judged by the ``_attrs.db`` file so listing never
+    CREATES empty stores as a side effect."""
+    holder = self.server.api.holder
+    out = []
+    for iname, idx in list(holder.indexes.items()):
+        if os.path.exists(os.path.join(idx.path, "_attrs.db")):
+            out.append({"index": iname, "field": None})
+        for fname, f in list(idx.fields.items()):
+            if os.path.exists(os.path.join(f.path, "_attrs.db")):
+                out.append({"index": iname, "field": fname})
+    self._reply({"stores": out})
+
+
+def h_backup_attrs(self: Handler, index: str) -> None:
+    """Full item dump of one attribute store."""
+    holder = self.server.api.holder
+    idx = holder.index(index)
+    if idx is None:
+        raise ApiError(f"index {index!r} not found", 404)
+    field = self.query.get("field", [""])[0]
+    if field:
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field {field!r} not found", 404)
+        store = f.row_attrs
+    else:
+        store = idx.column_attrs
+    items: dict[str, dict] = {}
+    for block in sorted(store.blocks()):
+        items.update({str(k): v
+                      for k, v in store.block_items(block).items()})
+    body = json.dumps({"items": items}).encode()
+    self._reply(body, headers={
+        "X-Content-SHA256": hashlib.sha256(body).hexdigest()})
+
+
+def h_restore_translate(self: Handler, index: str) -> None:
+    """Restore-side translate append: same semantics as
+    ``/internal/translate/replicate`` (append-only, offset-deduped)
+    but serves un-clustered nodes too — restore of a keyed index must
+    not require a cluster."""
+    b = self._json_body()
+    api = self.server.api
+    log = (api.executor.translate.columns(index)
+           if not b.get("field")
+           else api.executor.translate.rows(index, b["field"]))
+    try:
+        log.append_replicated(int(b["start_id"]), b["keys"])
+    except KeyError as e:
+        raise ApiError(str(e), 409)
+    stats = getattr(self.server, "stats", None)
+    if stats is not None:
+        stats.count("restore_keys_total", len(b["keys"]))
+    self._reply({"len": len(log)})
+
+
+def register_backup_routes(router: Router) -> None:
+    router.add("GET", "/internal/backup/inventory", h_backup_inventory)
+    router.add("GET",
+               "/internal/backup/fragment/{index}/{field}/{view}/{shard}",
+               h_backup_fragment)
+    router.add("GET", "/internal/backup/schema", h_backup_schema)
+    router.add("GET", "/internal/backup/attrs", h_backup_attrs_list)
+    router.add("GET", "/internal/backup/attrs/{index}", h_backup_attrs)
+    router.add("POST", "/internal/backup/translate/{index}",
+               h_restore_translate)

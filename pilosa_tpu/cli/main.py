@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 
@@ -165,23 +166,70 @@ def cmd_export(args) -> int:
 
 
 def cmd_backup(args) -> int:
+    """Directory backup (manifest + per-fragment archives, incremental
+    capable, works against a live cluster) — or, when ``--output`` ends
+    in ``.tar``, the legacy single-node tar."""
     cfg = _load_cfg(args)
-    client = _client(cfg)
-    blob = client._do("GET", "/internal/backup")
-    with open(args.output, "wb") as f:
-        f.write(blob)
-    print(f"wrote {len(blob)} bytes to {args.output}", file=sys.stderr)
+    if args.output.endswith(".tar"):
+        client = _client(cfg)
+        blob = client._do("GET", "/internal/backup")
+        with open(args.output, "wb") as f:
+            f.write(blob)
+        print(f"wrote {len(blob)} bytes to {args.output}",
+              file=sys.stderr)
+        return 0
+    from pilosa_tpu.api.client import ClientError
+    from pilosa_tpu.backup import BackupDriver, BackupError, ManifestError
+    drv = BackupDriver(cfg.host, cfg.port, args.output,
+                       workers=args.workers,
+                       incremental=args.incremental,
+                       ssl_context=cfgmod.client_ssl_of(cfg))
+    try:
+        res = drv.run()
+    except (BackupError, ManifestError, ClientError, OSError) as e:
+        print(f"backup failed: {e}", file=sys.stderr)
+        return 1
+    print(f"backup: {res['fragments']} fragments "
+          f"({len(res['transferred'])} transferred, "
+          f"{len(res['skipped'])} skipped, "
+          f"{res['fallbacks']} replica fallbacks), "
+          f"{res['bytes']} bytes in {res['seconds']}s -> {args.output}",
+          file=sys.stderr)
     return 0
 
 
 def cmd_restore(args) -> int:
+    """Restore a directory archive into a FRESH (possibly different-
+    sized) cluster; a ``.tar``/file input takes the legacy tar path."""
     cfg = _load_cfg(args)
-    client = _client(cfg)
-    with open(args.input, "rb") as f:
-        blob = f.read()
-    client._do("POST", "/internal/restore", blob,
-               content_type="application/x-tar")
-    print("restored", file=sys.stderr)
+    if not os.path.exists(args.input):
+        print(f"restore failed: no archive at {args.input!r}",
+              file=sys.stderr)
+        return 1
+    if not os.path.isdir(args.input):
+        client = _client(cfg)
+        with open(args.input, "rb") as f:
+            blob = f.read()
+        client._do("POST", "/internal/restore", blob,
+                   content_type="application/x-tar")
+        print("restored", file=sys.stderr)
+        return 0
+    from pilosa_tpu.api.client import ClientError
+    from pilosa_tpu.backup import (BackupError, DigestError,
+                                   ManifestError, RestoreDriver)
+    drv = RestoreDriver(cfg.host, cfg.port, args.input,
+                        workers=args.workers,
+                        ssl_context=cfgmod.client_ssl_of(cfg))
+    try:
+        res = drv.run()
+    except (BackupError, DigestError, ManifestError, ClientError,
+            OSError) as e:
+        print(f"restore failed: {e}", file=sys.stderr)
+        return 1
+    print(f"restore: {res['fragments']} fragments "
+          f"({res['pushes']} pushes) onto {res['nodes']} node(s), "
+          f"{res['bytes']} bytes in {res['seconds']}s "
+          f"(aae repaired {res['aaeRepaired']})", file=sys.stderr)
     return 0
 
 
@@ -277,14 +325,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_export)
 
-    sp = sub.add_parser("backup", help="tar the server's data")
+    sp = sub.add_parser(
+        "backup", help="consistent online backup to a directory "
+        "(or legacy tar when -o ends in .tar)")
     _add_common(sp)
-    sp.add_argument("-o", "--output", required=True)
+    sp.add_argument("-o", "--output", required=True,
+                    help="archive directory (manifest.json + fragment "
+                         "files); a .tar path takes the legacy path")
+    sp.add_argument("--workers", type=int, default=4,
+                    help="parallel fragment transfers")
+    sp.add_argument("--incremental", action="store_true",
+                    help="diff against the output dir's prior manifest "
+                         "and transfer only changed fragments")
     sp.set_defaults(fn=cmd_backup)
 
-    sp = sub.add_parser("restore", help="restore a backup tar")
+    sp = sub.add_parser(
+        "restore", help="restore a backup directory into a fresh "
+        "cluster (elastic: node count may differ), or a legacy tar")
     _add_common(sp)
-    sp.add_argument("input")
+    sp.add_argument("input", help="archive directory or legacy .tar")
+    sp.add_argument("--workers", type=int, default=4,
+                    help="parallel fragment pushes")
     sp.set_defaults(fn=cmd_restore)
 
     sp = sub.add_parser("check", help="offline data-dir integrity check")

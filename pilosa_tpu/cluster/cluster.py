@@ -293,6 +293,10 @@ class Cluster:
                     "state": self.state,
                     "placement": list(self.placement_ids),
                     "placementVersion": self.placement_version,
+                    # replica factor rides along so external placement
+                    # walkers (the backup/restore drivers) can compute
+                    # shard_nodes without a config side channel
+                    "replicas": self.cfg.replicas,
                     "ts": time.time()}
 
     def _pull_cluster_state(self, node_id: str) -> None:

@@ -222,8 +222,20 @@ def h_fragment_data(self: Handler) -> None:
 
 def h_fragment_merge(self: Handler) -> None:
     frag = _fragment(self, create=True)
-    changed = frag.merge_positions(roaring.deserialize(self._body()))
+    body = self._body()
+    changed = frag.merge_positions(roaring.deserialize(body))
+    stats = getattr(self.server, "stats", None)
+    if stats is not None and self.headers.get("X-Pilosa-Restore") == "1":
+        # restore pushes ride this union-merge path; tag their volume
+        # separately from AAE repair traffic
+        stats.count("restore_bytes_total", len(body))
     self._reply({"changed": changed})
+
+
+def h_aae_run(self: Handler) -> None:
+    """Force one anti-entropy round NOW (restore's convergence step —
+    replicas a push missed must not wait out the periodic sweep)."""
+    self._reply({"repaired": _cluster(self).sync_once()})
 
 
 def _attr_store(self: Handler):
@@ -320,6 +332,7 @@ def register_internal_routes(router: Router) -> None:
     router.add("GET", "/internal/fragment/blocks", h_fragment_blocks)
     router.add("GET", "/internal/fragment/data", h_fragment_data)
     router.add("POST", "/internal/fragment/merge", h_fragment_merge)
+    router.add("POST", "/internal/aae/run", h_aae_run)
     router.add("POST", "/internal/resize/push", h_resize_push)
     router.add("POST", "/internal/resize/trigger", h_resize_trigger)
     router.add("POST", "/internal/resize/abort", h_resize_abort)

@@ -69,15 +69,29 @@ class SnapshotQueue:
                     "background snapshot failed for %s", frag.path)
 
     def close(self) -> None:
-        """Stop accepting work and drop the backlog — fragments compact
-        themselves on close anyway."""
+        """Stop accepting work and DRAIN the backlog (the worker loop
+        keeps popping after ``_stop`` until pending is empty).  A clean
+        shutdown therefore never leaves an over-threshold op-log tail
+        to replay on next open, and a backup taken right after close
+        sees compacted fragments.  Anything still queued after the
+        bounded join (no worker ever started, or it is wedged on one
+        huge compaction) compacts inline here — close is the last
+        chance."""
         with self._cv:
             self._stop = True
-            self._pending.clear()
-            self._inq.clear()
             self._cv.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=30)
+        with self._cv:
+            leftover, self._pending = self._pending, []
+            self._inq.clear()
+        for frag in leftover:
+            try:
+                frag.maybe_snapshot()
+            except Exception:  # noqa: BLE001 — same contract as _loop
+                import logging
+                logging.getLogger("pilosa_tpu.store").exception(
+                    "close-time snapshot failed for %s", frag.path)
 
 
 class Holder:

@@ -1,0 +1,520 @@
+"""Backup & restore subsystem (reference: ``ctl/backup.go`` /
+``ctl/restore.go``, SURVEY.md §6).
+
+The round-trip proof the r8 tentpole claims: a live 3-node cluster is
+backed up WHILE writes are in flight, the archive is restored into a
+smaller (2-node) fresh cluster, and every PQL shape (Count / Row /
+TopN / BSI range / Sum) answers oracle-exact on every target node.  A
+chaos variant kills a node mid-backup and the backup still completes
+from replicas.  Incremental mode provably transfers only changed
+fragments; a corrupted archive file is detected by digest before the
+target is touched.
+
+Also pinned here (satellites): ``fragment.import_roaring`` restore
+semantics (generation bump, plane-cache invalidation, idempotent
+re-push), the SnapshotQueue close-time drain, the client's bounded-
+memory streaming download, and the storage observability block.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API, Server
+from pilosa_tpu.api.client import Client
+from pilosa_tpu.backup import (BackupDriver, DigestError, Manifest,
+                               RestoreDriver)
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.store import Holder
+from pilosa_tpu.store.fragment import Fragment
+from pilosa_tpu.store.holder import SnapshotQueue
+from pilosa_tpu.store import roaring
+from pilosa_tpu.testing import run_cluster
+
+SW = SHARD_WIDTH
+
+
+@contextmanager
+def fresh_node(path: str):
+    """A single un-clustered server over its own holder (restore
+    targets, endpoint tests)."""
+    holder = Holder(path).open()
+    api = API(holder)
+    server = Server(api, "127.0.0.1", 0).start()
+    try:
+        yield SimpleNamespace(
+            holder=holder, api=api, server=server,
+            port=server.address[1],
+            client=Client("127.0.0.1", server.address[1]))
+    finally:
+        server.close()
+        holder.close()
+
+
+@pytest.fixture
+def node(tmp_path):
+    with fresh_node(str(tmp_path / "data")) as n:
+        yield n
+
+
+# ---------------------------------------------------------------------------
+# tentpole: online cluster backup -> elastic restore
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineClusterRoundTrip:
+    N_ROWS = 3
+
+    def _write(self, client, acked, row: int, col: int) -> None:
+        client.query("bk", f"Set({col}, f={row})")
+        acked.setdefault(row, set()).add(col)
+
+    def test_backup_during_writes_restores_into_smaller_cluster(
+            self, tmp_path):
+        """3 nodes (replicas=2) -> archive -> fresh 2-node cluster.
+        Full backup runs with a writer in flight; a quiesced
+        incremental pass then catches the tail (the operational
+        full+incremental recipe), so the restored answers must match
+        the acked-write oracle EXACTLY on every target node."""
+        out = str(tmp_path / "arch")
+        acked: dict[int, set[int]] = {}
+        with run_cluster(3, str(tmp_path / "src"), replicas=2) as src:
+            c = src.client(0)
+            c.create_index("bk")
+            c.create_field("bk", "f")
+            c.create_field("bk", "n",
+                           {"type": "int", "min": -100, "max": 100000})
+            # phase 1: even columns over 3 shards
+            for i in range(36):
+                self._write(c, acked, i % self.N_ROWS,
+                            (i * 74) % (3 * SW))
+            bsi_cols = [5, SW + 2, 2 * SW + 9, 40, SW + 77]
+            bsi_vals = [7, 60, 120, -3, 55]
+            c.import_values("bk", "n", columnIDs=bsi_cols,
+                            values=bsi_vals)
+
+            # phase 2: writer in flight (odd columns — never collides
+            # with phase 1) while the FULL backup runs
+            stop = threading.Event()
+            wrote = threading.Event()
+
+            def writer():
+                k = 0
+                while not stop.is_set():
+                    self._write(c, acked, k % self.N_ROWS,
+                                ((k * 74) + 1) % (3 * SW))
+                    k += 1
+                    if k >= 5:
+                        wrote.set()
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=writer)
+            t.start()
+            try:
+                assert wrote.wait(10), "writer never got going"
+                port = src.servers[0].http.address[1]
+                res1 = BackupDriver("127.0.0.1", port, out,
+                                    workers=3).run()
+            finally:
+                stop.set()
+                t.join(10)
+            assert res1["fragments"] == len(res1["transferred"])
+
+            # quiesced incremental pass: catches everything the writer
+            # landed after each fragment's capture
+            res2 = BackupDriver("127.0.0.1", port, out, workers=3,
+                                incremental=True).run()
+            assert res2["incremental"]
+            assert set(res2["transferred"]) | set(res2["skipped"]) \
+                == set(res1["transferred"])
+
+            # strict incremental granularity: ONE new bit on shard 0
+            # must re-transfer exactly the two shard-0 fragments it
+            # touches (field f + the _exists existence row)
+            used = set()
+            for cols in acked.values():
+                used |= {col for col in cols if col < SW}
+            used |= {col for col in bsi_cols if col < SW}
+            new_col = next(col for col in range(SW)
+                           if col not in used)
+            self._write(c, acked, 10, new_col)
+            res3 = BackupDriver("127.0.0.1", port, out, workers=3,
+                                incremental=True).run()
+            assert set(res3["transferred"]) == {
+                "bk/f/standard/0", "bk/_exists/standard/0"}
+            assert set(res3["skipped"]) == (
+                set(res1["transferred"]) - set(res3["transferred"]))
+
+            # the manifest itself records the diffable state
+            man = Manifest.load(out)
+            assert set(man.fragments) == set(res1["transferred"])
+            assert all(ent["sha256"] and ent["checksum"]
+                       for ent in man.fragments.values())
+
+            # source-side expected answers (already oracle-checked
+            # below via `acked`)
+            topn_src = c.query("bk", "TopN(f)")
+            range_src = c.query("bk", "Row(n > 50)")
+            sum_src = c.query("bk", "Sum(field=n)")
+            assert set(range_src[0]["columns"]) == {
+                col for col, v in zip(bsi_cols, bsi_vals) if v > 50}
+
+        # elastic restore: 2-node fresh cluster (different node count)
+        with run_cluster(2, str(tmp_path / "dst"), replicas=2) as dst:
+            rres = RestoreDriver(
+                "127.0.0.1", dst.servers[0].http.address[1], out,
+                workers=3).run()
+            assert rres["fragments"] == len(man.fragments)
+            assert rres["nodes"] == 2
+            for i in range(2):
+                c2 = dst.client(i)
+                for row, cols in sorted(acked.items()):
+                    got = c2.query(
+                        "bk", f"Row(f={row})Count(Row(f={row}))")
+                    assert set(got[0]["columns"]) == cols, \
+                        f"node {i} row {row} diverges"
+                    assert got[1] == len(cols)
+                assert c2.query("bk", "TopN(f)") == topn_src
+                assert c2.query("bk", "Row(n > 50)") == range_src
+                assert c2.query("bk", "Sum(field=n)") == sum_src
+
+            # restore refuses a non-fresh target (second run would
+            # collide with the indexes it just created)
+            from pilosa_tpu.backup import BackupError
+            with pytest.raises(BackupError, match="fresh"):
+                RestoreDriver("127.0.0.1",
+                              dst.servers[0].http.address[1],
+                              out).run()
+
+    def test_node_death_mid_backup_falls_back_to_replicas(
+            self, tmp_path):
+        """Chaos variant: a non-entry node's HTTP surface dies after
+        the first fragment transfer; with replicas=2 every fragment
+        has a surviving holder, so the backup must still complete and
+        restore to the exact acked oracle."""
+        out = str(tmp_path / "arch")
+        acked: dict[int, set[int]] = {}
+        with run_cluster(3, str(tmp_path / "src"), replicas=2) as src:
+            c = src.client(0)
+            c.create_index("bk")
+            c.create_field("bk", "f")
+            for i in range(30):
+                self._write(c, acked, i % self.N_ROWS,
+                            (i * 119) % (3 * SW))
+            victim = src.servers[1]
+            killed = threading.Event()
+
+            def on_fragment(key):
+                if not killed.is_set():
+                    killed.set()
+                    victim.http.close()  # node dies mid-backup
+
+            port = src.servers[0].http.address[1]
+            res = BackupDriver("127.0.0.1", port, out, workers=1,
+                               on_fragment=on_fragment).run()
+            assert killed.is_set()
+            # every fragment made it into the archive despite the death
+            man = Manifest.load(out)
+            assert len(man.fragments) == res["fragments"] > 0
+
+        with fresh_node(str(tmp_path / "dst")) as dst:
+            RestoreDriver("127.0.0.1", dst.port, out).run()
+            for row, cols in sorted(acked.items()):
+                got = dst.client.query(
+                    "bk", f"Row(f={row})Count(Row(f={row}))")
+                assert set(got[0]["columns"]) == cols
+                assert got[1] == len(cols)
+
+
+# ---------------------------------------------------------------------------
+# archive integrity
+# ---------------------------------------------------------------------------
+
+
+class TestArchiveIntegrity:
+    def test_corrupted_archive_file_fails_digest_verification(
+            self, node, tmp_path):
+        c = node.client
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(10, f=1)Set(2000, f=2)")
+        out = str(tmp_path / "arch")
+        BackupDriver("127.0.0.1", node.port, out).run()
+        # flip one byte of one fragment image
+        frag_file = os.path.join(out, "fragments", "i", "f",
+                                 "standard", "0")
+        blob = bytearray(open(frag_file, "rb").read())
+        blob[-1] ^= 0xFF
+        open(frag_file, "wb").write(bytes(blob))
+        with fresh_node(str(tmp_path / "dst")) as dst:
+            with pytest.raises(DigestError, match="sha256 mismatch"):
+                RestoreDriver("127.0.0.1", dst.port, out).run()
+            # fail-fast contract: the target was never touched
+            assert dst.client.schema() == []
+
+    def test_fragment_endpoint_serves_digest_and_generation(self, node):
+        c = node.client
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(10, f=1)")
+
+        class Sink:
+            def __init__(self):
+                self.chunks = []
+
+            def write(self, b):
+                self.chunks.append(bytes(b))
+                return len(b)
+
+        sink = Sink()
+        headers = c.download("/internal/backup/fragment/i/f/standard/0",
+                             sink)
+        body = b"".join(sink.chunks)
+        assert int(headers["Content-Length"]) == len(body)
+        assert headers["X-Content-SHA256"] \
+            == hashlib.sha256(body).hexdigest()
+        assert int(headers["X-Pilosa-Generation"]) >= 1
+        assert roaring.deserialize(body).tolist() \
+            == [1 * SW + 10]
+
+    def test_download_streams_in_bounded_chunks(self, node):
+        c = node.client
+        c.create_index("i")
+        c.create_field("i", "f")
+        cols = list(range(0, 50000, 7))  # a bitmap container: ~8 KB blob
+        c.import_bits("i", "f", rowIDs=[1] * len(cols),
+                      columnIDs=cols)
+
+        class Sink:
+            def __init__(self):
+                self.sizes = []
+                self.h = hashlib.sha256()
+
+            def write(self, b):
+                self.sizes.append(len(b))
+                self.h.update(b)
+                return len(b)
+
+        sink = Sink()
+        headers = c.download(
+            "/internal/backup/fragment/i/f/standard/0", sink,
+            chunk_size=64)
+        assert max(sink.sizes) <= 64          # bounded memory
+        assert len(sink.sizes) > 1            # genuinely chunked
+        assert sink.h.hexdigest() == headers["X-Content-SHA256"]
+
+    def test_download_http_error_raises_client_error(self, node):
+        from pilosa_tpu.api.client import ClientError
+
+        class Sink:
+            def write(self, b):
+                raise AssertionError("error bodies must not hit sinks")
+
+        with pytest.raises(ClientError):
+            node.client.download(
+                "/internal/backup/fragment/nope/f/standard/0", Sink())
+
+
+# ---------------------------------------------------------------------------
+# satellite: import_roaring restore semantics
+# ---------------------------------------------------------------------------
+
+
+class TestImportRoaringRestoreSemantics:
+    def test_generation_bump_idempotent_repush_and_clear(self, tmp_path):
+        frag = Fragment(str(tmp_path / "0"), 0).open()
+        positions = np.array([1 * SW + 10, 1 * SW + 11, 2 * SW + 7],
+                             np.uint64)
+        blob = roaring.serialize(positions)
+        assert frag.import_roaring(blob) == 3
+        g1 = frag.generation
+        assert g1 >= 1
+        # idempotent re-push (restore retry): no double count, no
+        # spurious invalidation
+        assert frag.import_roaring(blob) == 0
+        assert frag.generation == g1
+        # clear=True removes exactly those bits and bumps
+        assert frag.import_roaring(blob, clear=True) == 3
+        g2 = frag.generation
+        assert g2 > g1
+        assert frag.row_ids() == []
+        # idempotent re-clear
+        assert frag.import_roaring(blob, clear=True) == 0
+        assert frag.generation == g2
+        frag.close()
+
+    def test_restore_push_invalidates_cached_planes(self, tmp_path):
+        """A restore push lands through import_roaring; the generation
+        bump must flow through to query results (the device plane
+        cache keys on it) — a stale cached plane would silently answer
+        pre-restore counts."""
+        from pilosa_tpu.exec import Executor
+        holder = Holder(str(tmp_path / "d")).open()
+        idx = holder.create_index("i", track_existence=False)
+        idx.create_field("f")
+        idx.set_bit("f", 1, 10)
+        ex = Executor(holder)
+        assert ex.execute("i", "Count(Row(f=1))") == [1]  # warms cache
+        frag = idx.field("f").view("standard").fragment(0)
+        gen_before = frag.generation
+        more = roaring.serialize(
+            np.array([1 * SW + 20, 1 * SW + 21], np.uint64))
+        assert frag.import_roaring(more) == 2
+        assert frag.generation > gen_before
+        assert ex.execute("i", "Count(Row(f=1))") == [3]
+        # and the idempotent re-push changes neither state nor answers
+        assert frag.import_roaring(more) == 0
+        assert ex.execute("i", "Count(Row(f=1))") == [3]
+        holder.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: snapshot-queue drain on close
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotQueueDrain:
+    def test_close_drains_backlog_instead_of_dropping_it(self):
+        done = []
+        ready = threading.Event()
+
+        class FakeFrag:
+            path = "fake"
+
+            def __init__(self, i):
+                self.i = i
+
+            def maybe_snapshot(self):
+                ready.wait(5)      # hold the worker so a backlog forms
+                time.sleep(0.005)
+                done.append(self.i)
+
+        q = SnapshotQueue()
+        frags = [FakeFrag(i) for i in range(6)]
+        for f in frags:
+            q.submit(f)
+        ready.set()
+        q.close()
+        assert sorted(done) == list(range(6)), \
+            "close() dropped queued compactions"
+
+    def test_clean_shutdown_leaves_no_oplog_tail(self, tmp_path):
+        data = str(tmp_path / "d")
+        h = Holder(data).open()
+        idx = h.create_index("i", track_existence=False)
+        idx.create_field("f")
+        frag = idx.field("f").view("standard", create=True) \
+            .fragment(0, create=True)
+        frag.max_op_n = 1  # every write over-thresholds
+        for k in range(4):
+            idx.set_bit("f", 1, 10 + k)
+        h.close()
+        for oplog in glob.glob(f"{data}/**/*.oplog", recursive=True):
+            assert os.path.getsize(oplog) == 0, \
+                f"{oplog} left a tail to replay"
+        h2 = Holder(data).open()
+        frag2 = h2.index("i").field("f").view("standard").fragment(0)
+        assert frag2.op_n == 0
+        assert frag2.row(1).columns().tolist() == [10, 11, 12, 13]
+        h2.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: storage observability
+# ---------------------------------------------------------------------------
+
+
+class TestStorageObservability:
+    def test_status_storage_block_and_metrics_gauges(self, tmp_path):
+        from pilosa_tpu.obs import Stats
+        holder = Holder(str(tmp_path / "data")).open()
+        api = API(holder)
+        server = Server(api, "127.0.0.1", 0, stats=Stats()).start()
+        try:
+            c = Client("127.0.0.1", server.address[1])
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Set(10, f=1)Set(11, f=2)")
+            st = c.status()["storage"]
+            assert st["fragmentCount"] >= 2   # f + _exists
+            assert st["oplogBytes"] > 0       # un-compacted tail
+            text = c.metrics_text()
+            assert "\noplog_bytes " in text or \
+                text.startswith("oplog_bytes ")
+            assert "fragment_count" in text
+            assert "snapshot_bytes" in text
+        finally:
+            server.close()
+            holder.close()
+
+    def test_backup_restore_metrics_counters(self, node, tmp_path):
+        """backup_bytes_total counts served images; restore pushes
+        tagged X-Pilosa-Restore count restore_bytes_total."""
+        from pilosa_tpu.obs import Stats
+        c = node.client
+        c.create_index("i")
+        c.create_field("i", "f")
+        c.query("i", "Set(10, f=1)")
+        stats = Stats()
+        node.server.httpd.stats = stats
+        out = str(tmp_path / "arch")
+        BackupDriver("127.0.0.1", node.port, out).run()
+        counters = stats.snapshot()["counters"]
+        assert sum(counters["backup_bytes_total"].values()) > 0
+        with fresh_node(str(tmp_path / "dst")) as dst:
+            rstats = Stats()
+            dst.server.httpd.stats = rstats
+            RestoreDriver("127.0.0.1", dst.port, out).run()
+            rc = rstats.snapshot()["counters"]
+            assert sum(rc["restore_bytes_total"].values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# manifest unit coverage
+# ---------------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_diff_classifies_changed_unchanged_removed(self):
+        old = Manifest()
+        old.fragments = {
+            "i/f/standard/0": {"checksum": "aa", "file": "x"},
+            "i/f/standard/1": {"checksum": "bb", "file": "y"},
+            "i/f/standard/2": {"checksum": "cc", "file": "z"},
+        }
+        new = Manifest()
+        new.fragments = {
+            "i/f/standard/0": {"checksum": "aa", "file": "x"},   # same
+            "i/f/standard/1": {"checksum": "b2", "file": "y"},   # changed
+            "i/f/standard/3": {"checksum": "dd", "file": "w"},   # new
+        }
+        d = new.diff(old)
+        assert d["unchanged"] == ["i/f/standard/0"]
+        assert d["changed"] == ["i/f/standard/1", "i/f/standard/3"]
+        assert d["removed"] == ["i/f/standard/2"]
+        # no prior manifest: everything is a change
+        full = new.diff(None)
+        assert full["changed"] == sorted(new.fragments)
+
+    def test_version_gate_and_malformed_manifest(self, tmp_path):
+        from pilosa_tpu.backup import ManifestError
+        out = str(tmp_path)
+        with pytest.raises(ManifestError, match="no manifest"):
+            Manifest.load(out)
+        path = os.path.join(out, "manifest.json")
+        with open(path, "w") as f:
+            json.dump({"formatVersion": 99}, f)
+        with pytest.raises(ManifestError, match="format"):
+            Manifest.load(out)
+        with open(path, "w") as f:
+            f.write("not json")
+        with pytest.raises(ManifestError, match="malformed"):
+            Manifest.load(out)

@@ -98,3 +98,28 @@ def test_config18_concurrency_gap_smoke():
     stages = out["detail"]["stages"]
     assert set(stages) == {"1", "2", "4"}
     assert all("read" in s for s in stages.values())
+
+
+def test_config19_backup_smoke():
+    """bench/config19 (backup/restore MB/s) in --smoke mode: tiny
+    plane, CPU, full + incremental + restore with an oracle check —
+    runs under tier-1 so the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config19_backup.py"), "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("backup_mbps")
+    assert out["unit"] == "MBps" and out["value"] > 0
+    assert out["detail"]["restore_mbps"] > 0
+    # the incremental property is asserted inside the bench; its
+    # figures must surface in the artifact detail
+    assert out["detail"]["incremental_transferred"] == 1
+    assert out["detail"]["incremental_skipped"] == \
+        out["detail"]["fragments"] - 1

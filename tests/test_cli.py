@@ -107,6 +107,42 @@ class TestCommands:
         assert main(["check", "--data-dir", str(data2)]) == 0
         assert "all fragments ok" in capsys.readouterr().out
 
+    def test_backup_restore_directory_mode(self, running, tmp_path):
+        """The r8 manifest-directory surface: full, incremental (no-op
+        on an unchanged server), elastic restore into a fresh node."""
+        _, _, bind = running
+        csv = tmp_path / "in.csv"
+        csv.write_text("1,10\n2,2000000\n")
+        main(["import", "--bind", bind, "-i", "i", "-f", "f", "--create",
+              str(csv)])
+        arch = tmp_path / "arch"
+        assert main(["backup", "--bind", bind, "-o", str(arch)]) == 0
+        assert (arch / "manifest.json").exists()
+        import json as _json
+        man1 = _json.loads((arch / "manifest.json").read_text())
+        assert main(["backup", "--bind", bind, "-o", str(arch),
+                     "--incremental"]) == 0
+        man2 = _json.loads((arch / "manifest.json").read_text())
+        # unchanged server: same fragment files, marked incremental
+        assert man2["fragments"] == man1["fragments"]
+        assert man2["incrementalOf"] == man1["createdAt"]
+
+        data2 = tmp_path / "data2"
+        h2 = Holder(str(data2)).open()
+        s2 = Server(API(h2), "127.0.0.1", 0).start()
+        bind2 = f"127.0.0.1:{s2.address[1]}"
+        try:
+            assert main(["restore", "--bind", bind2, str(arch)]) == 0
+            from pilosa_tpu.api.client import Client
+            c2 = Client("127.0.0.1", s2.address[1])
+            (r,) = c2.query("i", "Row(f=1)")
+            assert r == {"columns": [10]}
+            (r,) = c2.query("i", "Row(f=2)")
+            assert r == {"columns": [2000000]}
+        finally:
+            s2.close()
+            h2.close()
+
 
 class TestCheckCorruption:
     def test_check_reports_torn_snapshot(self, tmp_path, capsys):
