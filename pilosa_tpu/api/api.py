@@ -49,11 +49,23 @@ def field_options_from_json(o: dict) -> FieldOptions:
 
 class API:
     def __init__(self, holder: Holder, executor: Executor | None = None,
-                 cluster=None, query_timeout: float = 0.0):
+                 cluster=None, query_timeout: float = 0.0,
+                 trace_sample_rate: float = 0.01,
+                 slow_query_threshold: float = 1.0):
+        from pilosa_tpu.obs import SlowQueryLog
         self.holder = holder
         self.executor = executor or Executor(holder)
         self.cluster = cluster  # set by the cluster layer when distributed
         self.query_timeout = query_timeout  # seconds; 0 = unlimited
+        # always-on sampled tracing: this fraction of ordinary queries
+        # is retained in the finished-trace ring without the caller
+        # asking (profile=true and slow queries always retain)
+        self.trace_sample_rate = min(max(float(trace_sample_rate), 0.0), 1.0)
+        # queries slower than this (seconds) are captured — PQL, index,
+        # shards, duration, full span tree — in the bounded ring behind
+        # GET /debug/slow; 0 disables
+        self.slow_query_threshold = float(slow_query_threshold)
+        self.slow_log = SlowQueryLog()
 
     # -- schema -------------------------------------------------------------
 
@@ -126,12 +138,23 @@ class API:
         HTTP 408.  The server's ``query_timeout`` config is a CAP, not
         just a default: per-request values clamp to it (otherwise any
         caller could disable the operator's protection with
-        ?timeout=0)."""
+        ?timeout=0).
+
+        Tracing is always on: every query runs under a per-request
+        tracer (concurrent queries' spans never interleave) with one
+        node-tagged ``query`` root span; the REST edge surfaces its id
+        as ``X-Pilosa-Trace-Id``.  The tree is RETAINED in the process
+        finished-ring (``/internal/traces?trace_id=``) when the caller
+        profiled, the sampler picked it (``trace_sample_rate``), or it
+        came in over ``slow_query_threshold`` — slow queries
+        additionally land in the ``/debug/slow`` ring with their PQL."""
+        import random
         import time as _time
 
         from pilosa_tpu.exec.executor import (ExecutionError,
                                               ExecutorSaturatedError,
                                               QueryTimeoutError)
+        from pilosa_tpu.obs import GLOBAL_TRACER, Tracer
         from pilosa_tpu.pql.parser import ParseError
         self._index(index)
         cap = self.query_timeout
@@ -140,30 +163,66 @@ class API:
         elif cap:
             timeout = min(timeout, cap)
         deadline = (_time.monotonic() + timeout) if timeout else None
-        tracer = None
+        sampled = (self.trace_sample_rate > 0
+                   and random.random() < self.trace_sample_rate)
+        tracer = Tracer()
+        # the fan-out propagates this as the traceparent flags segment:
+        # peers of an unsampled query still trace (a slow coordinator
+        # trace needs their subtrees) but don't churn their own rings
+        tracer.sampled = sampled or profile
+        node = (self.cluster.node_id if self.cluster is not None
+                else "local")
+        err: ApiError | None = None
+        out: dict = {}
+        t0 = _time.perf_counter()
+        with tracer.span("query", index=index, node=node) as root:
+            try:
+                if self.cluster is not None:
+                    out = {"results": self.cluster.dist.execute_json(
+                        index, pql, shards=shards, tracer=tracer,
+                        deadline=deadline)}
+                else:
+                    results = self.executor.execute(index, pql,
+                                                    shards=shards,
+                                                    tracer=tracer,
+                                                    deadline=deadline)
+                    out = {"results": [result_to_json(r)
+                                       for r in results]}
+            except QueryTimeoutError as e:
+                err = ApiError(str(e), 408)
+            except ExecutorSaturatedError as e:
+                # admission shedding (VERDICT advice #6): a saturated
+                # executor is overload, not a client mistake — 503 with
+                # a Retry-After hint, never a generic 500/400
+                err = ApiError(str(e), 503, retry_after=e.retry_after)
+            except (ParseError, ExecutionError) as e:
+                err = ApiError(str(e), 400)
+            if err is not None:
+                root.tags["error"] = str(err)
+        duration = _time.perf_counter() - t0
+        slow = (self.slow_query_threshold > 0
+                and duration >= self.slow_query_threshold)
+        stats = self.executor.stats
+        if sampled:
+            stats.count("trace_sampled_total", 1)
+        if slow:
+            stats.count("slow_query_total", 1)
+            self.slow_log.record({
+                "ts": _time.time(), "index": index,
+                "pql": pql if len(pql) <= 4096 else pql[:4096] + "…",
+                "shards": list(shards) if shards is not None else None,
+                "durationMs": round(duration * 1e3, 3),
+                "traceId": root.trace_id,
+                "error": str(err) if err is not None else None,
+                "profile": root.to_json()})
+        if sampled or slow or profile:
+            # publish into the process ring so the trace id resolves
+            # via GET /internal/traces?trace_id= after the request
+            GLOBAL_TRACER.record(root)
+        if err is not None:
+            raise err
+        out["traceId"] = root.trace_id
         if profile:
-            from pilosa_tpu.obs import Tracer
-            tracer = Tracer()
-        try:
-            if self.cluster is not None:
-                out = {"results": self.cluster.dist.execute_json(
-                    index, pql, shards=shards, tracer=tracer,
-                    deadline=deadline)}
-            else:
-                results = self.executor.execute(index, pql, shards=shards,
-                                                tracer=tracer,
-                                                deadline=deadline)
-                out = {"results": [result_to_json(r) for r in results]}
-        except QueryTimeoutError as e:
-            raise ApiError(str(e), 408)
-        except ExecutorSaturatedError as e:
-            # admission shedding (VERDICT advice #6): a saturated
-            # executor is overload, not a client mistake — 503 with a
-            # Retry-After hint, never a generic 500/400
-            raise ApiError(str(e), 503, retry_after=e.retry_after)
-        except (ParseError, ExecutionError) as e:
-            raise ApiError(str(e), 400)
-        if tracer is not None:
             out["profile"] = [s.to_json() for s in tracer.finished()]
         return out
 
@@ -506,6 +565,11 @@ class API:
                 # snapshot queue compacts (oplogBytes growth = log
                 # compaction falling behind)
                 "storage": self.storage_stats(),
+                # slow-query visibility: ring totals + the configured
+                # threshold (full records behind GET /debug/slow)
+                "slowQueries": {
+                    **self.slow_log.summary(),
+                    "thresholdSeconds": self.slow_query_threshold},
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
                 "planeCache": self.executor.planes.stats(),

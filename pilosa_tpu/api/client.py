@@ -79,6 +79,11 @@ class Client:
         self._ssl = ssl_context
         self._idle: list[http.client.HTTPConnection] = []
         self._plock = threading.Lock()
+        # per-thread flag: did the LAST completed request on this
+        # thread go through a retry?  Read by the cluster fan-out so a
+        # trace records that its remote leg was redelivered — traces
+        # must not lie under failure (chaos scenario)
+        self._tls = threading.local()
 
     # -- transport ----------------------------------------------------------
 
@@ -139,6 +144,8 @@ class Client:
         hdrs = dict(headers or {})
         if body:
             hdrs["Content-Type"] = content_type
+        if not _retried:
+            self._tls.retried = False
         if fault.ACTIVE:
             # failpoint BEFORE the socket: a partitioned peer is
             # indistinguishable from connection-refused (the request
@@ -175,6 +182,7 @@ class Client:
             if not _retried:
                 if hasattr(body, "seek"):
                     body.seek(0)  # streamed (file-object) bodies rewind
+                self._tls.retried = True
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",
@@ -193,6 +201,7 @@ class Client:
             if idempotent and not _retried:
                 if hasattr(body, "seek"):
                     body.seek(0)  # streamed (file-object) bodies rewind
+                self._tls.retried = True
                 return self._do(method, path, body, content_type, headers,
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",
@@ -229,6 +238,11 @@ class Client:
         if ctype.startswith("application/json"):
             return json.loads(data)
         return data
+
+    def last_retried(self) -> bool:
+        """Whether the most recent ``_do`` on THIS thread retried (lost
+        response redelivered / stale socket resent)."""
+        return getattr(self._tls, "retried", False)
 
     # streamed-download read size: bounds peak memory per transfer (a
     # multi-GB fragment image never materializes as one bytes object)

@@ -50,6 +50,19 @@ def parse_timeout_param(raw: str) -> float:
     return timeout
 
 
+# /debug/profile capture bounds: a capture shorter than the profiler's
+# startup cost is noise; one longer than a minute holds the device
+# profiler (and the handler thread) hostage
+PROFILE_SECONDS_MIN = 0.1
+PROFILE_SECONDS_MAX = 60.0
+
+
+def clamp_profile_seconds(seconds: float) -> float:
+    """Clamp a ``?seconds=`` jax-profiler capture window to
+    [PROFILE_SECONDS_MIN, PROFILE_SECONDS_MAX]."""
+    return min(max(seconds, PROFILE_SECONDS_MIN), PROFILE_SECONDS_MAX)
+
+
 class Router:
     def __init__(self):
         self.routes: list[tuple[str, re.Pattern, object]] = []
@@ -228,19 +241,27 @@ class Handler(BaseHTTPRequestHandler):
         if "timeout" in self.query:
             timeout = parse_timeout_param(self.query["timeout"][0])
         if not want_proto:
-            self._reply(self.server.api.query(index, pql, shards=shards,
-                                              profile=profile,
-                                              timeout=timeout))
+            out = self.server.api.query(index, pql, shards=shards,
+                                        profile=profile,
+                                        timeout=timeout)
+            # the per-request trace identity rides a header, not the
+            # body (resolvable via /internal/traces?trace_id=)
+            tid = out.pop("traceId", None)
+            self._reply(out, headers={"X-Pilosa-Trace-Id": tid}
+                        if tid else None)
             return
         if profile:
             # QueryResponse has no profile field; fail loudly rather
             # than silently dropping the span tree the caller asked for
+            # (pinned by tests/test_proto.py; documented in the README
+            # observability runbook — use the JSON surface to profile)
             raise ApiError("?profile is not supported with "
                            "application/x-protobuf responses")
         # errors keep the proto body (so the caller can decode them) but
         # carry the same HTTP status the JSON surface would — status-code
         # behavior must not diverge by content type
         status = 200
+        trace_id = None
         try:
             res = self.server.api.query(index, pql, shards=shards,
                                         timeout=timeout)
@@ -248,6 +269,7 @@ class Handler(BaseHTTPRequestHandler):
             raw = proto.encode_query_response(err=str(e))
             status = e.status
         else:
+            trace_id = res.pop("traceId", None)
             try:
                 raw = proto.encode_query_response(res["results"])
             except ValueError as e:  # result shape has no proto encoding
@@ -255,7 +277,9 @@ class Handler(BaseHTTPRequestHandler):
                 # answered IN proto so the caller can decode it
                 raw = proto.encode_query_response(err=str(e))
                 status = 400
-        self._reply(raw, status=status, content_type=proto.CONTENT_TYPE)
+        self._reply(raw, status=status, content_type=proto.CONTENT_TYPE,
+                    headers={"X-Pilosa-Trace-Id": trace_id}
+                    if trace_id else None)
 
     def h_create_index(self, index: str) -> None:
         body = self._json_body()
@@ -456,9 +480,23 @@ class Handler(BaseHTTPRequestHandler):
         self._reply({"success": True})
 
     def h_traces(self) -> None:
+        """Recent retained traces (sampled / slow / profiled queries,
+        plus this node's continuation spans of distributed queries);
+        ``?trace_id=`` narrows to one trace."""
         from pilosa_tpu.obs import GLOBAL_TRACER
-        self._reply({"traces": [s.to_json()
-                                for s in GLOBAL_TRACER.finished()]})
+        spans = GLOBAL_TRACER.finished()
+        want = self.query.get("trace_id", [None])[0]
+        if want:
+            spans = [s for s in spans if s.trace_id == want]
+        self._reply({"traces": [s.to_json() for s in spans]})
+
+    def h_debug_slow(self) -> None:
+        """The slow-query ring: queries over ``slow_query_threshold``
+        with PQL, shards, duration and the full span tree."""
+        api = self.server.api
+        self._reply({"thresholdSeconds": api.slow_query_threshold,
+                     **api.slow_log.summary(),
+                     "slow": api.slow_log.entries()})
 
     def h_debug_threads(self) -> None:
         """Python stack dump of every thread — the rebuild's
@@ -476,14 +514,20 @@ class Handler(BaseHTTPRequestHandler):
         self._reply("\n".join(out).encode(), content_type="text/plain")
 
     def h_debug_profile(self) -> None:
-        """Capture a jax device profile for ?seconds= (default 3) into
-        ?dir= (default under the data dir) — TensorBoard-readable
+        """Capture a jax device profile for ?seconds= (default 3,
+        clamped — see :func:`clamp_profile_seconds`) into ?dir=
+        (default under the data dir) — TensorBoard-readable
         (SURVEY.md §6: expose jax.profiler traces)."""
         import time as _time
 
         import jax
-        seconds = float(self.query.get("seconds", ["3"])[0])
-        seconds = min(max(seconds, 0.1), 60.0)
+        raw = self.query.get("seconds", ["3"])[0]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            # a garbage ?seconds= is a client mistake, not a 500
+            raise ApiError(f"bad seconds param {raw!r}")
+        seconds = clamp_profile_seconds(seconds)
         out_dir = self.query.get("dir", [None])[0] or \
             self.server.api.holder.path + "/_profiles"
         jax.profiler.start_trace(out_dir)
@@ -518,6 +562,7 @@ def build_router() -> Router:
     r.add("GET", "/internal/backup", Handler.h_backup)
     r.add("POST", "/internal/restore", Handler.h_restore)
     r.add("GET", "/internal/traces", Handler.h_traces)
+    r.add("GET", "/debug/slow", Handler.h_debug_slow)
     r.add("GET", "/debug/threads", Handler.h_debug_threads)
     r.add("POST", "/debug/profile", Handler.h_debug_profile)
     # node-to-node surface (deferred import: cluster depends on this

@@ -34,6 +34,14 @@ class Config:
     stats_backend: str = ""             # "" = in-process /metrics only;
                                         # "statsd" also emits UDP statsd
     statsd_address: str = "127.0.0.1:8125"
+    # always-on tracing: every query runs under a per-request span tree
+    # (X-Pilosa-Trace-Id on each response); this fraction of ordinary
+    # queries is RETAINED in the /internal/traces ring without the
+    # caller asking (profile=true and slow queries always retain)
+    trace_sample_rate: float = 0.01
+    # queries slower than this (seconds) are captured — PQL, shards,
+    # duration, full span tree — behind GET /debug/slow; 0 disables
+    slow_query_threshold: float = 1.0
     # fault injection (chaos testing): JSON list of failpoint specs,
     # armed at boot — see pilosa_tpu.fault.configure.  Usually set via
     # PILOSA_FAULTS; live arming uses POST /internal/fault instead.

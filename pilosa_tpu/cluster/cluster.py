@@ -567,8 +567,15 @@ class Cluster:
 
     def internal_query(self, node_id: str, index: str, pql: str,
                        shards, deadline: float | None = None,
-                       map_unreachable: bool = True) -> list:
+                       map_unreachable: bool = True,
+                       trace: dict | None = None) -> list:
         """Run ``pql`` on ``node_id`` via ``/internal/query``.
+
+        ``trace`` (cross-node span fan-in, r9): a mutable dict whose
+        ``headers`` carry the coordinator's ``Traceparent``; on return
+        it gains ``profile`` (the peer's finished span subtree, JSON)
+        and ``retried`` (the transport redelivered the request), which
+        the dist layer grafts into the coordinator's span tree.
 
         Error mapping (ADVICE r4): every failure leaves here as an
         executor exception the API layer answers with 4xx/408 — except
@@ -597,10 +604,16 @@ class Cluster:
                 raise QueryTimeoutError("query timeout exceeded")
             path += f"&timeout={remaining:.6f}"
             socket_timeout = remaining + 10.0
+        client = self._client(node_id)
         try:
-            return self._client(node_id)._do(
+            resp = client._do(
                 "POST", path, pql.encode(),
-                timeout=socket_timeout)["results"]
+                headers=(trace or {}).get("headers"),
+                timeout=socket_timeout)
+            if trace is not None:
+                trace["profile"] = resp.get("profile") or []
+                trace["retried"] = client.last_retried()
+            return resp["results"]
         except ClientError as e:
             if e.status == 408:
                 # peer's share of the budget expired

@@ -92,6 +92,11 @@ class DistributedExecutor:
 
     def __init__(self, cluster):
         self.cluster = cluster  # Cluster (membership + clients + api)
+        # the active request's tracer, visible to every nested _read /
+        # _fanout_partials on this thread (the public surface threads
+        # tracer only into execute_json)
+        import threading
+        self._tls = threading.local()
 
     # -- public -------------------------------------------------------------
 
@@ -111,50 +116,57 @@ class DistributedExecutor:
         query = parse_cached(pql)
         out = []
         calls = query.calls
-        i = 0
-        while i < len(calls):
-            if deadline is not None and _time.monotonic() > deadline:
-                raise QueryTimeoutError("query timeout exceeded")
-            call = calls[i]
-            name = _call_of(call).name
-            # consecutive plain reads fan out as ONE multi-call query
-            # per node — a 32-Count batch costs (nodes-1) RPCs, not
-            # 32*(nodes-1) (reference: executor.go runs the whole query
-            # per shard in one mapReduce; per-call fan-out was the r5
-            # config12 finding, +80 ms/request at 4 nodes)
-            if self._batchable(call):
-                j = i
-                while j < len(calls) and self._batchable(calls[j]):
-                    j += 1
-                batch = calls[i:j]
-                span = (nullcontext() if tracer is None
-                        else tracer.span(f"cluster.batch[{len(batch)}]",
-                                         index=index)
-                        if len(batch) > 1
-                        else tracer.span("cluster." + name, index=index))
+        self._tls.tracer = tracer
+        try:
+            i = 0
+            while i < len(calls):
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise QueryTimeoutError("query timeout exceeded")
+                call = calls[i]
+                name = _call_of(call).name
+                # consecutive plain reads fan out as ONE multi-call
+                # query per node — a 32-Count batch costs (nodes-1)
+                # RPCs, not 32*(nodes-1) (reference: executor.go runs
+                # the whole query per shard in one mapReduce; per-call
+                # fan-out was the r5 config12 finding, +80 ms/request
+                # at 4 nodes)
+                if self._batchable(call):
+                    j = i
+                    while j < len(calls) and self._batchable(calls[j]):
+                        j += 1
+                    batch = calls[i:j]
+                    span = (nullcontext() if tracer is None
+                            else tracer.span(
+                                f"cluster.batch[{len(batch)}]",
+                                index=index)
+                            if len(batch) > 1
+                            else tracer.span("cluster." + name,
+                                             index=index))
+                    with span:
+                        if len(batch) == 1:
+                            out.append(self._read(index, call, shards,
+                                                  deadline=deadline))
+                        else:
+                            out.extend(self._read_group(
+                                index, batch, shards, deadline=deadline))
+                    i = j
+                    continue
+                span = (tracer.span("cluster." + name, index=index)
+                        if tracer is not None else nullcontext())
                 with span:
-                    if len(batch) == 1:
+                    if name in ATTR_CALLS:
+                        out.append(self._attr_write(index, call))
+                    elif name in WRITE_CALLS:
+                        out.append(self._write(index, call))
+                    elif name == "Percentile":
+                        out.append(self._percentile(index, call, shards,
+                                                    deadline=deadline))
+                    else:
                         out.append(self._read(index, call, shards,
                                               deadline=deadline))
-                    else:
-                        out.extend(self._read_group(
-                            index, batch, shards, deadline=deadline))
-                i = j
-                continue
-            span = (tracer.span("cluster." + name, index=index)
-                    if tracer is not None else nullcontext())
-            with span:
-                if name in ATTR_CALLS:
-                    out.append(self._attr_write(index, call))
-                elif name in WRITE_CALLS:
-                    out.append(self._write(index, call))
-                elif name == "Percentile":
-                    out.append(self._percentile(index, call, shards,
-                                                deadline=deadline))
-                else:
-                    out.append(self._read(index, call, shards,
-                                          deadline=deadline))
-            i += 1
+                i += 1
+        finally:
+            self._tls.tracer = None
         return out
 
     @staticmethod
@@ -278,6 +290,18 @@ class DistributedExecutor:
             raise ExecutionError(str(e)) from e
         groups = self.cluster.group_shards_by_node(index, all_shards)
         pql = "\n".join(str(s) for s in subs)
+        # span fan-in: capture the dispatching thread's open cluster.*
+        # span HERE — remote legs run on pool threads where the
+        # tracer's thread-local stack is empty — inject it as the
+        # Traceparent every leg carries, and graft each peer's returned
+        # subtree under it (to_json renders dict children verbatim)
+        tracer = getattr(self._tls, "tracer", None)
+        parent = tracer.current_span() if tracer is not None else None
+        trace_headers = None
+        if parent is not None:
+            trace_headers = {}
+            tracer.inject(trace_headers, span=parent,
+                          sampled=getattr(tracer, "sampled", True))
 
         def remote(node_id, node_shards):
             if fault.ACTIVE:
@@ -285,9 +309,28 @@ class DistributedExecutor:
                 # the fan-out (a remote leg dying mid-query), `delay`
                 # models a straggler node without touching its process
                 fault.fire("dist.fanout", peer=node_id, index=index)
-            return self.cluster.internal_query(node_id, index, pql,
-                                               node_shards,
-                                               deadline=deadline)
+            tr = ({"headers": trace_headers}
+                  if trace_headers is not None else None)
+            results = self.cluster.internal_query(node_id, index, pql,
+                                                  node_shards,
+                                                  deadline=deadline,
+                                                  trace=tr)
+            return results, tr
+
+        def graft(tr) -> None:
+            # graft on the DISPATCHING thread only, from collected
+            # futures: a straggler leg abandoned by an earlier leg's
+            # raise must never mutate a span tree that may already be
+            # closed, retained, and served (its thread only ever
+            # touches its own `tr` dict)
+            if tr is None or parent is None:
+                return
+            for sub in tr.get("profile") or []:
+                if tr.get("retried"):
+                    # the leg was redelivered (lost response →
+                    # idempotent retry): the trace must say so
+                    sub.setdefault("tags", {})["retried"] = True
+                parent.children.append(sub)
 
         from concurrent.futures import ThreadPoolExecutor
         remote_items = [(n, s) for n, s in groups.items()
@@ -301,12 +344,18 @@ class DistributedExecutor:
                 futures = [pool.submit(remote, n, s)
                            for n, s in remote_items]
             if self.cluster.node_id in groups:
+                # the local group executes on THIS thread, inside the
+                # open cluster.* span — its executor spans nest there
                 rs = self.cluster.api.executor.execute(
                     index, Query(list(subs)),
                     shards=list(groups[self.cluster.node_id]),
-                    translate_output=False, deadline=deadline)
+                    translate_output=False, deadline=deadline,
+                    tracer=tracer)
                 per_node.append([result_to_json(r) for r in rs])
-            per_node.extend(f.result() for f in futures)
+            for f in futures:
+                results, tr = f.result()
+                graft(tr)
+                per_node.append(results)
         finally:
             if pool is not None:
                 pool.shutdown(wait=False)

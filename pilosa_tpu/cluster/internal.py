@@ -83,7 +83,13 @@ def h_cluster_status(self: Handler) -> None:
 
 def h_internal_query(self: Handler) -> None:
     """Execute locally only (no re-fan-out) with raw-ID results —
-    reference: ``/internal/query`` remote execution."""
+    reference: ``/internal/query`` remote execution.
+
+    Cross-node span fan-in (r9): a ``Traceparent`` header opens a
+    node-tagged continuation span around the local execution, and the
+    finished subtree rides back in the response as ``profile`` — the
+    coordinator grafts it under its ``cluster.*`` span, so one profile
+    tree covers every node.  Requests without the header pay nothing."""
     from pilosa_tpu.exec import result_to_json
     from pilosa_tpu.exec.executor import (ExecutionError,
                                           ExecutorSaturatedError,
@@ -106,10 +112,33 @@ def h_internal_query(self: Handler) -> None:
         deadline = time.monotonic() + parse_timeout_param(
             self.query["timeout"][0])
     pql = self._body().decode()
+    from contextlib import nullcontext
+
+    tracer = span = None
+    retain = False
+    parsed = None
+    tp = self.headers.get("Traceparent")
+    if tp:
+        from pilosa_tpu.obs import parse_traceparent
+        parsed = parse_traceparent(tp)
+    if parsed is not None:
+        from pilosa_tpu.obs import Tracer
+        tracer = Tracer()
+        # flags "01" = the coordinator will retain this trace
+        # (sampled/profiled): keep a copy in THIS node's ring too.
+        # "00" = trace and return the subtree (the coordinator may yet
+        # retain a SLOW trace) but don't churn the local ring for it.
+        retain = parsed[2] == "01"
+    node = (api.cluster.node_id if api.cluster is not None else "local")
+    ctx = (tracer.extract(self.headers, "internal.query",
+                          node=node, index=index)
+           if tracer is not None else nullcontext())
     try:
-        results = api.executor.execute(index, pql, shards=shards,
-                                       translate_output=False,
-                                       deadline=deadline)
+        with ctx as span:
+            results = api.executor.execute(index, pql, shards=shards,
+                                           translate_output=False,
+                                           deadline=deadline,
+                                           tracer=tracer)
     except QueryTimeoutError as e:
         raise ApiError(str(e), 408)
     except ExecutorSaturatedError as e:
@@ -119,7 +148,14 @@ def h_internal_query(self: Handler) -> None:
         raise ApiError(str(e), 503, retry_after=e.retry_after)
     except (ParseError, ExecutionError) as e:
         raise ApiError(str(e), 400)
-    self._reply({"results": [result_to_json(r) for r in results]})
+    out = {"results": [result_to_json(r) for r in results]}
+    if span is not None:
+        # ship the finished subtree back for coordinator-side grafting
+        out["profile"] = [span.to_json()]
+        if retain:
+            from pilosa_tpu.obs import GLOBAL_TRACER
+            GLOBAL_TRACER.record(span)
+    self._reply(out)
 
 
 def h_shards(self: Handler) -> None:

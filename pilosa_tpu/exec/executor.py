@@ -328,7 +328,9 @@ class Executor:
         timer = None
         if depth == 0:
             from pilosa_tpu.obs import StageTimer
-            timer = StageTimer(self.stats)
+            # stage marks double as `stage.*` child spans on the traced
+            # query (per-request tracer when given, else the shared one)
+            timer = StageTimer(self.stats, tracer=tracer or self.tracer)
             # bounded concurrency FIRST: each executing query holds
             # live device scratch (program temps, per-query outputs);
             # with residency near budget, unbounded client threads

@@ -22,6 +22,10 @@ duplicate_delivery                    dropped internal responses ⇒
 dropped_placement_broadcast           a dropped resize-completion
                                       broadcast still converges via
                                       the heartbeat placement version
+dropped_internal_response_trace       a redelivered fan-out leg is
+                                      visible in the profile tree
+                                      (``retried`` tag) — traces
+                                      never lie under failure
 ====================================  ==================================
 
 Oracle semantics are at-least-once honest: a write the harness saw FAIL
@@ -385,12 +389,83 @@ def scenario_dropped_placement_broadcast(cluster,
     return h
 
 
+def scenario_dropped_internal_response_trace(cluster,
+                                             seed: int) -> ChaosHarness:
+    """Traces must not lie under failure: a fan-out leg whose response
+    is dropped (``client.recv`` failpoint — the peer answered, the
+    coordinator never heard it) is transparently redelivered by the
+    idempotent internode retry, and the coordinator's profile tree must
+    SAY so — the grafted remote subtree carries a ``retried`` tag, the
+    answer stays oracle-exact."""
+    import json as _json
+
+    h = ChaosHarness(cluster, seed, index="chaos_trace")
+    h.setup()
+    # row 0 populated in every shard, so any shard-restricted Count
+    # has bits to count
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(10)
+    h.check_oracle()
+    # a remote leg must be GUARANTEED, not left to hash placement: pick
+    # an entry node missing some shard and restrict the query to it
+    # (with replicas < nodes such a pair always exists)
+    entry = shard = None
+    for i in range(h.n):
+        held = h.client(i)._json(
+            "GET", f"/internal/shards?index={h.index}")["shards"]
+        missing = [s for s in range(3) if s not in held]
+        if missing:
+            entry, shard = i, missing[0]
+            break
+    if entry is None:
+        raise h._fail("every node holds every shard; no remote leg")
+    h.set_fault(entry, "client.recv", "drop", nth=1,
+                match={"path": "/internal/query"})
+    try:
+        resp = h.client(entry)._do(
+            "POST",
+            f"/index/{h.index}/query?profile=true&shards={shard}",
+            f"Count(Row({h.field}=0))".encode())
+    finally:
+        h.clear_faults()
+    # the answer is still oracle-bounded (acked ⊆ observed ⊆ attempted,
+    # restricted to the queried shard)
+    count = resp["results"][0]
+    acked = {c for c in h.acked.get(0, ()) if c // SHARD_WIDTH == shard}
+    att = {c for c in h.attempted.get(0, ()) if c // SHARD_WIDTH == shard}
+    if not len(acked) <= count <= len(att):
+        raise h._fail(f"count {count} outside oracle "
+                      f"[{len(acked)}, {len(att)}] after retry")
+
+    def walk(span):
+        yield span
+        for child in span.get("children", []):
+            yield from walk(child)
+
+    spans = [s for root in resp["profile"] for s in walk(root)]
+    retried = [s for s in spans if s.get("tags", {}).get("retried")]
+    if not retried:
+        raise h._fail(
+            "trace hides the dropped-response redelivery: no span "
+            f"tagged retried in {_json.dumps(resp['profile'])[:800]}")
+    entry_id = f"127.0.0.1:{cluster.nodes[entry].port}"
+    if not all(s["tags"].get("node") not in (None, entry_id)
+               for s in retried):
+        raise h._fail("retried tag landed on a non-remote span")
+    h.check_oracle()
+    return h
+
+
 SCENARIOS = {
     "partition_during_resize": (scenario_partition_during_resize, 3),
     "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
     "duplicate_delivery": (scenario_duplicate_delivery, 2),
     "dropped_placement_broadcast": (scenario_dropped_placement_broadcast,
                                     2),
+    "dropped_internal_response_trace":
+        (scenario_dropped_internal_response_trace, 3),
 }
 
 

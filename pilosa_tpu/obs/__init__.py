@@ -4,7 +4,9 @@ logging."""
 from pilosa_tpu.obs.logging import get_logger
 from pilosa_tpu.obs.metrics import (NopStats, StageTimer, Stats,
                                     StatsdStats)
-from pilosa_tpu.obs.tracing import GLOBAL_TRACER, Tracer
+from pilosa_tpu.obs.tracing import (GLOBAL_TRACER, SlowQueryLog, Tracer,
+                                    parse_traceparent)
 
 __all__ = ["Stats", "NopStats", "StageTimer", "StatsdStats",
-           "get_logger", "Tracer", "GLOBAL_TRACER"]
+           "get_logger", "Tracer", "GLOBAL_TRACER", "SlowQueryLog",
+           "parse_traceparent"]

@@ -16,11 +16,13 @@ import time
 from pilosa_tpu import __version__
 
 
-def build_payload(holder, cluster=None, stats=None) -> dict:
+def build_payload(holder, cluster=None, stats=None, slow_log=None) -> dict:
     """Anonymized usage snapshot (counts only, no names/keys).  With
     ``stats``, includes the per-stage query-overhead summary
     (``query_stage_seconds``) so a payload doubles as the serving-path
-    attribution dump."""
+    attribution dump; with ``slow_log`` (a
+    :class:`pilosa_tpu.obs.SlowQueryLog`), the slow-query counters
+    (totals and slowest only — never PQL text, which may carry keys)."""
     n_fields = 0
     n_shards = 0
     field_types: dict[str, int] = {}
@@ -46,6 +48,11 @@ def build_payload(holder, cluster=None, stats=None) -> dict:
                 "query_stage_seconds")
         except Exception:  # noqa: BLE001
             pass
+    if slow_log is not None:
+        try:
+            payload["slowQueries"] = slow_log.summary()
+        except Exception:  # noqa: BLE001
+            pass
     try:
         import jax
         payload["deviceKind"] = jax.devices()[0].device_kind
@@ -60,10 +67,11 @@ class Diagnostics:
     (upstream default-on behavior deliberately inverted)."""
 
     def __init__(self, holder, cluster=None, interval: float = 0.0,
-                 send=None, logger=None, stats=None):
+                 send=None, logger=None, stats=None, slow_log=None):
         self.holder = holder
         self.cluster = cluster
         self.stats = stats
+        self.slow_log = slow_log
         self.interval = interval
         self.send = send or self._log_sink
         self.logger = logger
@@ -86,7 +94,8 @@ class Diagnostics:
         while not self._stop.wait(self.interval):
             try:
                 self.send(build_payload(self.holder, self.cluster,
-                                        stats=self.stats))
+                                        stats=self.stats,
+                                        slow_log=self.slow_log))
             except Exception:  # noqa: BLE001
                 pass
 

@@ -217,18 +217,27 @@ class StageTimer:
     charged here, there is no per-request dispatch to time), and
     ``assemble`` (host result construction).  The per-stage sums are
     the attribution bench/config18 prints — the residual product/raw
-    concurrency gap is measured per stage, not guessed."""
+    concurrency gap is measured per stage, not guessed.
 
-    __slots__ = ("_stats", "_metric", "_last")
+    With a ``tracer`` attached, every mark ALSO lands as a completed
+    ``stage.<name>`` child span under the innermost open span of the
+    traced query — the per-stage children a distributed profile tree
+    carries on every node (no-op outside any span)."""
 
-    def __init__(self, stats, metric: str = "query_stage_seconds"):
+    __slots__ = ("_stats", "_metric", "_last", "tracer")
+
+    def __init__(self, stats, metric: str = "query_stage_seconds",
+                 tracer=None):
         self._stats = stats
         self._metric = metric
+        self.tracer = tracer
         self._last = time.perf_counter()
 
     def mark(self, stage: str) -> None:
         now = time.perf_counter()
         self._stats.observe(self._metric, now - self._last, stage=stage)
+        if self.tracer is not None:
+            self.tracer.stage("stage." + stage, now - self._last)
         self._last = now
 
     def reset(self) -> None:

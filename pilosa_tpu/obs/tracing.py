@@ -4,8 +4,21 @@ Reference: ``tracing/`` wrapping opentracing — spans per executor call
 and per shard, HTTP header inject/extract for cross-node traces
 (SURVEY.md §3.3, §6).  The rebuild is self-contained (no opentracing in
 the image): explicit span tree, W3C-style ``traceparent`` header
-propagation, and an in-memory ring of finished traces exposed for
-``profile=true`` query responses and debugging.
+propagation, and an in-memory ring of finished traces exposed at
+``GET /internal/traces`` (``?trace_id=`` looks one trace up) and in
+``profile=true`` query responses.
+
+Cross-node fan-in (r9): the coordinator injects ``Traceparent`` on the
+internal fan-out POSTs; the peer's ``/internal/query`` handler extracts
+it, runs under a continuation span (node-tagged), and ships the
+finished subtree back IN the response — the coordinator grafts it under
+its ``cluster.*`` span, so one profile tree spans every node.  Grafted
+subtrees arrive as plain JSON dicts; :meth:`Span.to_json` passes them
+through, which is why ``Span.children`` may mix ``Span`` and ``dict``.
+
+Slow queries land in :class:`SlowQueryLog` — a bounded ring of
+(PQL, index, shards, duration, span tree) records behind
+``GET /debug/slow``.
 """
 
 from __future__ import annotations
@@ -20,6 +33,36 @@ from dataclasses import dataclass, field as dc_field
 TRACEPARENT = "Traceparent"  # traceparent: 00-<trace_id>-<span_id>-01
 
 
+_HEX = frozenset("0123456789abcdefABCDEF")
+
+
+def parse_traceparent(value: str | None) \
+        -> tuple[str, str, str] | None:
+    """Validated ``(trace_id, parent_span_id, flags)`` from a
+    traceparent header, or None for anything malformed: wrong segment
+    count, empty ids, non-hex ids.  The caller falls back to a fresh
+    root span — a garbage header from an arbitrary peer must never
+    raise, and must never be accepted as a trace identity (it would
+    alias unrelated traces in the finished ring).  Hex validation is
+    by character set, NOT ``int(x, 16)`` — the int parser's literal
+    quirks (underscores, signs, whitespace) are not hex ids.
+
+    ``flags`` carries the coordinator's retain decision ("01" =
+    sampled/profiled: the peer keeps its subtree in its own ring too;
+    anything else = trace but don't retain locally)."""
+    if not value:
+        return None
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, flags = parts
+    if not trace_id or not span_id:
+        return None
+    if not (set(trace_id) <= _HEX and set(span_id) <= _HEX):
+        return None
+    return trace_id, span_id, flags
+
+
 @dataclass
 class Span:
     name: str
@@ -29,13 +72,19 @@ class Span:
     start: float = 0.0
     duration: float = 0.0
     tags: dict = dc_field(default_factory=dict)
-    children: list["Span"] = dc_field(default_factory=list)
+    # Span children for spans opened on this node; grafted remote
+    # subtrees are appended as already-serialized dicts (to_json output
+    # of the peer's continuation span)
+    children: list = dc_field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
             "name": self.name, "durationUs": round(self.duration * 1e6),
+            "traceId": self.trace_id, "spanId": self.span_id,
+            "parentId": self.parent_id,
             "tags": self.tags,
-            "children": [c.to_json() for c in self.children],
+            "children": [c.to_json() if isinstance(c, Span) else c
+                         for c in self.children],
         }
 
 
@@ -52,6 +101,11 @@ class Tracer:
         if not hasattr(self._local, "stack"):
             self._local.stack = []
         return self._local.stack
+
+    def current_span(self) -> Span | None:
+        """The innermost open span on THIS thread (None outside any)."""
+        stack = self._stack()
+        return stack[-1] if stack else None
 
     @contextmanager
     def span(self, name: str, **tags):
@@ -77,43 +131,101 @@ class Tracer:
                 with self._lock:
                     self._finished.append(s)
 
+    def stage(self, name: str, duration: float, **tags) -> None:
+        """Attach an already-timed child span (a StageTimer mark) to the
+        innermost open span on this thread; no-op outside any span —
+        per-stage attribution only makes sense inside a traced query."""
+        parent = self.current_span()
+        if parent is None:
+            return
+        parent.children.append(Span(
+            name=name, trace_id=parent.trace_id,
+            span_id=secrets.token_hex(4), parent_id=parent.span_id,
+            duration=duration, tags=tags))
+
     # -- cross-node propagation (reference: handler extract / client inject)
 
-    def inject(self, headers: dict) -> None:
-        stack = self._stack()
-        if stack:
-            s = stack[-1]
-            headers[TRACEPARENT] = f"00-{s.trace_id}-{s.span_id}-01"
+    def inject(self, headers: dict, span: Span | None = None,
+               sampled: bool = True) -> None:
+        """Write the active trace identity into ``headers``.  ``span``
+        overrides the thread-local stack — fan-out legs run on pool
+        threads where the coordinator's stack is not visible, so the
+        dispatching thread captures its span first.  ``sampled=False``
+        sets the flags segment to "00": the peer still traces and
+        returns its subtree (the coordinator may yet retain a SLOW
+        trace), but must not churn its own finished ring for a trace
+        that is 99%-likely discarded."""
+        s = span if span is not None else self.current_span()
+        if s is not None:
+            flags = "01" if sampled else "00"
+            headers[TRACEPARENT] = f"00-{s.trace_id}-{s.span_id}-{flags}"
 
     @contextmanager
-    def extract(self, headers, name: str):
-        """Open a span continuing the trace in ``headers`` (if any)."""
+    def extract(self, headers, name: str, **tags):
+        """Open a span continuing the trace in ``headers`` (if any).
+        Malformed or garbage traceparent values (wrong segment count,
+        non-hex ids) fall back to a fresh root span — never an
+        exception, never a fabricated trace identity."""
         tp = headers.get(TRACEPARENT) or headers.get(TRACEPARENT.lower())
-        if tp:
+        parsed = parse_traceparent(tp)
+        if parsed is not None:
+            trace_id, parent_id, _ = parsed
+            remote = Span(name="remote-parent", trace_id=trace_id,
+                          span_id=parent_id, parent_id=None)
+            self._stack().append(remote)
             try:
-                _, trace_id, parent_id, _ = tp.split("-")
-            except ValueError:
-                trace_id = None
-            if trace_id is not None:
-                remote = Span(name="remote-parent", trace_id=trace_id,
-                              span_id=parent_id, parent_id=None)
-                self._stack().append(remote)
-                try:
-                    with self.span(name) as s:
-                        yield s
-                finally:
-                    self._stack().pop()
-                    # the synthetic parent is discarded; its real children
-                    # are this node's roots for the propagated trace
-                    with self._lock:
-                        self._finished.extend(remote.children)
-                return
-        with self.span(name) as s:
+                with self.span(name, **tags) as s:
+                    yield s
+            finally:
+                self._stack().pop()
+                # the synthetic parent is discarded; its real children
+                # are this node's roots for the propagated trace
+                with self._lock:
+                    self._finished.extend(remote.children)
+            return
+        with self.span(name, **tags) as s:
             yield s
+
+    def record(self, span: Span) -> None:
+        """Publish a finished root span into this tracer's ring —
+        per-request tracers hand their retained roots to the process
+        GLOBAL_TRACER here, so ``/internal/traces?trace_id=`` resolves
+        them after the request is gone."""
+        with self._lock:
+            self._finished.append(span)
 
     def finished(self) -> list[Span]:
         with self._lock:
             return list(self._finished)
+
+
+class SlowQueryLog:
+    """Bounded ring of slow-query records: PQL, index, shards,
+    duration, trace id and the full span tree.  ``total`` keeps
+    counting past the ring bound (the ring is a sample, the counter is
+    the truth — same split as prometheus counter vs. exemplars)."""
+
+    def __init__(self, keep: int = 64):
+        self._entries: deque[dict] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._total += 1
+            self._entries.append(entry)
+
+    def entries(self) -> list[dict]:
+        """Newest first (the one an operator is chasing is recent)."""
+        with self._lock:
+            return list(reversed(self._entries))
+
+    def summary(self) -> dict:
+        with self._lock:
+            slowest = max((e.get("durationMs", 0.0)
+                           for e in self._entries), default=0.0)
+            return {"total": self._total, "kept": len(self._entries),
+                    "slowestMs": slowest}
 
 
 GLOBAL_TRACER = Tracer()

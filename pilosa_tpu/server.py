@@ -79,7 +79,9 @@ class PilosaTPUServer:
             count_batch_window=self.cfg.count_batch_window,
             max_concurrent=self.cfg.max_concurrent_queries)
         self.api = API(self.holder, self.executor,
-                       query_timeout=self.cfg.query_timeout)
+                       query_timeout=self.cfg.query_timeout,
+                       trace_sample_rate=self.cfg.trace_sample_rate,
+                       slow_query_threshold=self.cfg.slow_query_threshold)
         from pilosa_tpu.api import tls as tlsmod
         from pilosa_tpu.cli.config import tls_of
         tls_cfg = tls_of(self.cfg)
@@ -116,7 +118,8 @@ class PilosaTPUServer:
         self.diagnostics = Diagnostics(
             self.holder, self.cluster,
             interval=self.cfg.diagnostics_interval,
-            logger=self.logger, stats=self.stats).start()
+            logger=self.logger, stats=self.stats,
+            slow_log=self.api.slow_log).start()
         return self
 
     def close(self) -> None:

@@ -191,12 +191,34 @@ class TestOps:
         assert e.value.status == 404
 
     def test_traces_endpoint(self, srv):
-        _, _, _, c = srv
+        """Sampled queries are retained as ONE tree per query (root
+        span "query" with the executor spans nested) and resolve by
+        trace id."""
+        _, api, server, c = srv
+        api.trace_sample_rate = 1.0  # every query retained in the ring
         c.create_index("i")
         c.create_field("i", "f")
-        c.query("i", "Count(Row(f=1))")
-        traces = c._json("GET", "/internal/traces")["traces"]
-        assert any(t["name"] == "executor.Count" for t in traces)
+        port = server.address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/i/query",
+            data=b"Count(Row(f=1))", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            trace_id = resp.headers["X-Pilosa-Trace-Id"]
+        assert trace_id
+
+        def walk(span):
+            yield span
+            for child in span["children"]:
+                yield from walk(child)
+
+        traces = c._json("GET",
+                         f"/internal/traces?trace_id={trace_id}")["traces"]
+        assert len(traces) == 1 and traces[0]["name"] == "query"
+        names = [s["name"] for s in walk(traces[0])]
+        assert "executor.Count" in names
+        # unknown ids filter to nothing (not a 500, not the full ring)
+        assert c._json("GET",
+                       "/internal/traces?trace_id=feedface")["traces"] == []
 
 
 class TestBackupRestore:
@@ -342,6 +364,9 @@ class TestInfoEndpoints:
         _, _, _, c = srv
         dump = c._do("GET", "/debug/threads").decode()
         assert "Thread" in dump or "Current thread" in dump
+        # the handler thread serving THIS request is in the dump —
+        # proof the dump walks every live thread, not just the caller's
+        assert "pilosa" in dump or "http" in dump
 
     def test_debug_profile(self, srv, tmp_path):
         _, _, _, c = srv
@@ -349,6 +374,31 @@ class TestInfoEndpoints:
         assert out["seconds"] == 0.2
         import os
         assert os.path.isdir(out["traceDir"])
+        # an explicit ?dir= is honored
+        d = str(tmp_path / "prof_out")
+        out = c._json("POST", f"/debug/profile?seconds=0.1&dir={d}")
+        assert out["traceDir"] == d and os.path.isdir(d)
+
+    def test_debug_profile_seconds_clamped(self, srv):
+        """The jax capture window clamps to [0.1, 60] — a sub-floor
+        request still captures (not zero), and the clamp bounds are
+        unit-pinned so an over-long request can never wedge the
+        profiler for minutes (exercised without sleeping 60s)."""
+        from pilosa_tpu.api.server import (PROFILE_SECONDS_MAX,
+                                           PROFILE_SECONDS_MIN,
+                                           clamp_profile_seconds)
+        _, _, _, c = srv
+        out = c._json("POST", "/debug/profile?seconds=0.001")
+        assert out["seconds"] == PROFILE_SECONDS_MIN == 0.1
+        assert clamp_profile_seconds(999.0) == PROFILE_SECONDS_MAX == 60.0
+        assert clamp_profile_seconds(-3.0) == PROFILE_SECONDS_MIN
+        assert clamp_profile_seconds(3.0) == 3.0
+
+    def test_debug_profile_bad_seconds_is_400(self, srv):
+        _, _, _, c = srv
+        with pytest.raises(ClientError) as e:
+            c._json("POST", "/debug/profile?seconds=nope")
+        assert e.value.status == 400
 
 
 class TestBackupRestoreKeyed:

@@ -100,6 +100,30 @@ def test_config18_concurrency_gap_smoke():
     assert all("read" in s for s in stages.values())
 
 
+def test_config20_tracing_smoke():
+    """bench/config20 (sampled-tracing overhead vs tracing-off on the
+    config18 concurrency workload) in --smoke mode: tiny plane, CPU,
+    sweep 1/2/4, trace-id + ring-residency asserted while measuring —
+    runs under tier-1 so the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config20_tracing.py"), "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("tracing_overhead_pct")
+    assert out["unit"] == "pct" and out["vs_baseline"] > 0
+    # both tiers measured at every swept level, every trace retained
+    assert set(out["detail"]["qps_off"]) == {"1", "2", "4"}
+    assert set(out["detail"]["qps_on"]) == {"1", "2", "4"}
+    assert out["detail"]["sampled_traces"] > 0
+
+
 def test_config19_backup_smoke():
     """bench/config19 (backup/restore MB/s) in --smoke mode: tiny
     plane, CPU, full + incremental + restore with an oracle check —

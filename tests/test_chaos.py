@@ -6,6 +6,8 @@ distributed invariant after faults clear:
 - crash mid-oplog-append       → replay recovers the clean prefix
 - duplicate delivery           → idempotent redelivery never corrupts
 - dropped placement broadcast  → heartbeat pull-on-mismatch converges
+- dropped internal response    → the redelivered fan-out leg surfaces
+                                 as a `retried` tag in the profile tree
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -40,6 +42,10 @@ def test_duplicate_delivery_on_internal_posts(trio):
 
 def test_dropped_placement_broadcast(trio):
     chaos.scenario_dropped_placement_broadcast(trio, SEED)
+
+
+def test_dropped_internal_response_trace(trio):
+    chaos.scenario_dropped_internal_response_trace(trio, SEED)
 
 
 def test_crash_mid_oplog_append(tmp_path):

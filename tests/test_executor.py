@@ -565,9 +565,17 @@ class TestParityBatch:
         api.query("i", "Set(1, f=1)")
         out = api.query("i", "Count(Row(f=1)) Row(f=1)", profile=True)
         assert out["results"][0] == 1
-        names = [s["name"] for s in out["profile"]]
+        # ONE tree per query (r9): a "query" root span wraps the
+        # executor call spans (+ stage.* attribution children)
+        (root,) = out["profile"]
+        assert root["name"] == "query" and root["tags"]["node"] == "local"
+        names = [c["name"] for c in root["children"]
+                 if c["name"].startswith("executor.")]
         assert names == ["executor.Count", "executor.Row"]
-        assert all(s["durationUs"] >= 0 for s in out["profile"])
+        assert any(c["name"].startswith("stage.")
+                   for c in root["children"])
+        assert root["durationUs"] >= 0
+        assert out["traceId"] == root["traceId"]
 
 
 class TestCountBatching:

@@ -1,0 +1,324 @@
+"""End-to-end distributed query tracing (r9 tentpole): traceparent
+validation, always-on sampled tracing with `X-Pilosa-Trace-Id` +
+`/internal/traces?trace_id=` lookup, slow-query capture behind
+`/debug/slow`, and the headline claim — a 3-node `profile=true` query
+returns ONE span tree containing node-tagged spans from every node,
+with per-stage children and intact parent linkage."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API, Client, Server
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.obs import Stats, Tracer, parse_traceparent
+from pilosa_tpu.store import Holder
+from pilosa_tpu.testing import run_cluster
+
+
+def walk(span: dict):
+    yield span
+    for child in span.get("children", []):
+        yield from walk(child)
+
+
+class TestTraceparentValidation:
+    """Satellite: Tracer.extract must treat any malformed traceparent
+    as absent — fresh root span, never an exception, never a fabricated
+    trace identity."""
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "00-aaaa-bbbb",            # too few segments
+        "00-aaaa-bbbb-01-ff",                # too many segments
+        "00--bbbb-01", "00-aaaa--01",        # empty ids
+        "00-zzzz-bbbb-01", "00-aaaa-qqqq-01",  # non-hex ids
+        # int(x, 16) literal quirks are NOT hex ids: underscores,
+        # signs, surrounding whitespace
+        "00-1_f-bbbb-01", "00-aaaa-+2a-01", "00- 2a -bbbb-01",
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_wellformed_accepted(self):
+        assert parse_traceparent("00-deadbeef-cafe-01") == \
+            ("deadbeef", "cafe", "01")
+        # flags ride through verbatim (the retain decision)
+        assert parse_traceparent("00-deadbeef-cafe-00")[2] == "00"
+
+    @pytest.mark.parametrize("header", [
+        "00-aaaa-bbbb-01-junk", "garbage", "00-xyzw-bbbb-01",
+    ])
+    def test_extract_falls_back_to_fresh_root(self, header):
+        t = Tracer()
+        with t.extract({"Traceparent": header}, "server-side") as s:
+            assert s.parent_id is None     # fresh root, not continuation
+            assert s.trace_id not in ("aaaa", "xyzw")
+        (root,) = t.finished()
+        assert root.name == "server-side"
+
+    def test_extract_garbage_never_raises_or_pollutes(self):
+        t = Tracer()
+        with t.extract({"Traceparent": "1-2"}, "a"):
+            pass
+        # the thread-local stack is balanced after a malformed header
+        # (a stale synthetic parent would corrupt every later trace)
+        with t.span("clean") as s:
+            assert s.parent_id is None
+
+
+@pytest.fixture
+def traced_srv(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder, trace_sample_rate=1.0, slow_query_threshold=0.0)
+    server = Server(api, "127.0.0.1", 0, stats=Stats()).start()
+    client = Client("127.0.0.1", server.address[1])
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(1, f=1)")
+    yield api, server, client
+    server.close()
+    holder.close()
+
+
+def _post_query(port, pql, qs=""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/index/i/query{qs}",
+        data=pql.encode(), method="POST")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+class TestSampledTracing:
+    def test_trace_id_header_on_every_response(self, traced_srv):
+        _, server, _ = traced_srv
+        body, headers = _post_query(server.address[1], "Count(Row(f=1))")
+        assert body == {"results": [1]}  # trace id rides a HEADER only
+        assert headers["X-Pilosa-Trace-Id"]
+
+    def test_sampled_trace_resolvable_by_id(self, traced_srv):
+        _, server, c = traced_srv
+        _, headers = _post_query(server.address[1], "Count(Row(f=1))")
+        tid = headers["X-Pilosa-Trace-Id"]
+        traces = c._json("GET",
+                         f"/internal/traces?trace_id={tid}")["traces"]
+        assert len(traces) == 1
+        spans = list(walk(traces[0]))
+        assert traces[0]["traceId"] == tid
+        assert any(s["name"] == "executor.Count" for s in spans)
+        assert any(s["name"].startswith("stage.") for s in spans)
+
+    def test_unsampled_not_retained(self, traced_srv):
+        api, server, c = traced_srv
+        api.trace_sample_rate = 0.0
+        _, headers = _post_query(server.address[1], "Count(Row(f=1))")
+        tid = headers["X-Pilosa-Trace-Id"]  # header still present
+        assert c._json("GET",
+                       f"/internal/traces?trace_id={tid}")["traces"] == []
+
+    def test_sampled_counter_on_metrics(self, tmp_path):
+        from pilosa_tpu.exec import Executor
+        holder = Holder(str(tmp_path)).open()
+        stats = Stats()
+        api = API(holder, Executor(holder, stats=stats),
+                  trace_sample_rate=1.0, slow_query_threshold=0.0)
+        server = Server(api, "127.0.0.1", 0, stats=stats).start()
+        c = Client("127.0.0.1", server.address[1])
+        try:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Count(Row(f=1))")
+            assert "trace_sampled_total 1" in c.metrics_text()
+        finally:
+            server.close()
+            holder.close()
+
+    def test_proto_response_carries_trace_header(self, traced_srv):
+        from pilosa_tpu.api import proto
+        _, server, _ = traced_srv
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.address[1]}/index/i/query",
+            data=b"Count(Row(f=1))", method="POST",
+            headers={"Accept": proto.CONTENT_TYPE})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["X-Pilosa-Trace-Id"]
+            assert proto.decode_query_response(resp.read())["results"] \
+                == [1]
+
+
+class TestSlowQueryCapture:
+    def test_slow_query_recorded_with_span_tree(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        stats = Stats()
+        from pilosa_tpu.exec import Executor
+        api = API(holder, Executor(holder, stats=stats),
+                  trace_sample_rate=0.0, slow_query_threshold=1e-9)
+        server = Server(api, "127.0.0.1", 0, stats=stats).start()
+        c = Client("127.0.0.1", server.address[1])
+        try:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Set(1, f=1)")
+            c.query("i", "Count(Row(f=1))", )
+            slow = c._json("GET", "/debug/slow")
+            assert slow["thresholdSeconds"] == 1e-9
+            assert slow["total"] >= 2 and slow["kept"] >= 2
+            entry = slow["slow"][0]  # newest first
+            assert entry["pql"] == "Count(Row(f=1))"
+            assert entry["index"] == "i" and entry["durationMs"] > 0
+            assert entry["traceId"]
+            spans = list(walk(entry["profile"]))
+            assert any(s["name"] == "executor.Count" for s in spans)
+            # slow traces are retained: the id resolves in the ring
+            got = c._json(
+                "GET",
+                f"/internal/traces?trace_id={entry['traceId']}")["traces"]
+            assert len(got) == 1
+            # counter + /status visibility
+            text = c.metrics_text()
+            assert "slow_query_total" in text
+            st = c.status()
+            assert st["slowQueries"]["total"] >= 2
+            assert st["slowQueries"]["slowestMs"] > 0
+        finally:
+            server.close()
+            holder.close()
+
+    def test_threshold_zero_disables(self, traced_srv):
+        api, server, c = traced_srv
+        assert api.slow_query_threshold == 0.0
+        _post_query(server.address[1], "Count(Row(f=1))")
+        assert c._json("GET", "/debug/slow")["total"] == 0
+
+    def test_slow_ring_is_bounded(self):
+        from pilosa_tpu.obs import SlowQueryLog
+        log = SlowQueryLog(keep=4)
+        for i in range(10):
+            log.record({"durationMs": float(i)})
+        s = log.summary()
+        assert s["total"] == 10 and s["kept"] == 4
+        assert [e["durationMs"] for e in log.entries()] == \
+            [9.0, 8.0, 7.0, 6.0]
+
+    def test_diagnostics_payload_carries_slow_summary(self, tmp_path):
+        from pilosa_tpu.obs import SlowQueryLog
+        from pilosa_tpu.obs.diagnostics import build_payload
+        h = Holder(str(tmp_path)).open()
+        log = SlowQueryLog()
+        log.record({"durationMs": 12.0})
+        p = build_payload(h, slow_log=log)
+        assert p["slowQueries"]["total"] == 1
+        h.close()
+
+
+class TestDistributedProfile:
+    """Acceptance: a 3-node profile=true query returns a SINGLE span
+    tree containing node-tagged spans from all 3 nodes, with per-stage
+    children, and remote spans parent-linked to the coordinator's
+    cluster.* span."""
+
+    @staticmethod
+    def _write_until_all_nodes_own(cl, c, want_nodes: int) -> int:
+        """Grow the shard set until every node owns at least one shard
+        (ownership is hash-placed over random test ports, so a fixed
+        shard count would flake); returns the shard count."""
+        n_shards = 0
+        while True:
+            n_shards += 8
+            assert n_shards <= 64, "placement never covered every node"
+            c.query("i", "".join(f"Set({s * SHARD_WIDTH + 1}, f=1)"
+                                 for s in range(n_shards)))
+            groups = cl.servers[0].cluster.group_shards_by_node(
+                "i", tuple(range(n_shards)))
+            if len(groups) == want_nodes:
+                return n_shards
+
+    def test_three_node_single_tree(self, tmp_path):
+        with run_cluster(3, str(tmp_path)) as cl:
+            c = cl.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            n_shards = self._write_until_all_nodes_own(cl, c, 3)
+            port = cl.servers[0].http.address[1]
+            body, headers = _post_query(port, "Count(Row(f=1))",
+                                        qs="?profile=true")
+            assert body["results"] == [n_shards]
+            (root,) = body["profile"]          # ONE tree
+            assert root["name"] == "query"
+            spans = list(walk(root))
+            by_id = {s["spanId"]: s for s in spans}
+            node_ids = set(cl.node_ids())
+            seen_nodes = {s["tags"].get("node") for s in spans
+                          if s["tags"].get("node")}
+            assert seen_nodes == node_ids, \
+                f"spans missing nodes: {node_ids - seen_nodes}"
+            # one trace id spans the whole tree, and it is the header's
+            assert {s["traceId"] for s in spans} == \
+                {headers["X-Pilosa-Trace-Id"]}
+            # remote continuation spans hang off the coordinator's
+            # cluster.* span: parent linkage intact across the wire
+            remotes = [s for s in spans if s["name"] == "internal.query"]
+            assert len(remotes) >= 2  # both peers contributed
+            for r in remotes:
+                parent = by_id.get(r["parentId"])
+                assert parent is not None and \
+                    parent["name"].startswith("cluster."), \
+                    f"remote span not grafted under cluster.*: {r}"
+                # per-stage children on the REMOTE side too
+                sub = list(walk(r))
+                assert any(s["name"].startswith("stage.") for s in sub)
+                assert any(s["name"].startswith("executor.")
+                           for s in sub)
+            # per-stage children on the coordinator side
+            assert any(s["name"].startswith("stage.") for s in spans)
+
+    def test_remote_node_ring_keeps_its_fragment(self, tmp_path):
+        """Every involved node can resolve the trace id for ITS spans
+        via /internal/traces?trace_id= (the runbook's per-node view)."""
+        with run_cluster(2, str(tmp_path)) as cl:
+            c = cl.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            self._write_until_all_nodes_own(cl, c, 2)
+            port = cl.servers[0].http.address[1]
+            body, headers = _post_query(port, "Count(Row(f=1))",
+                                        qs="?profile=true")
+            tid = headers["X-Pilosa-Trace-Id"]
+            spans = [s for root in body["profile"] for s in walk(root)]
+            peer_id = cl.servers[1].cluster.node_id
+            assert any(s["tags"].get("node") == peer_id for s in spans)
+            got = cl.client(1)._json(
+                "GET", f"/internal/traces?trace_id={tid}")["traces"]
+            assert got and all(t["traceId"] == tid for t in got)
+            assert any(s["name"].startswith("executor.")
+                       for t in got for s in walk(t))
+
+    def test_unsampled_legs_do_not_churn_peer_ring(self, tmp_path):
+        """An unretained query (rate=0, no profile) still traces its
+        remote legs — a slow coordinator trace needs their subtrees —
+        but the traceparent flags carry the retain decision, so peers
+        must NOT record it into their own 128-slot ring (at serving
+        rates that churn would evict every trace an operator is
+        actually chasing)."""
+        with run_cluster(2, str(tmp_path), trace_sample_rate=0.0,
+                         slow_query_threshold=0.0) as cl:
+            c = cl.client(0)
+            c.create_index("i")
+            c.create_field("i", "f")
+            self._write_until_all_nodes_own(cl, c, 2)
+            port = cl.servers[0].http.address[1]
+            _, headers = _post_query(port, "Count(Row(f=1))")
+            tid = headers["X-Pilosa-Trace-Id"]
+            for i in (0, 1):
+                assert cl.client(i)._json(
+                    "GET",
+                    f"/internal/traces?trace_id={tid}")["traces"] == []
+            # but a slow query DOES retain remote subtrees in its
+            # captured tree (the flags gate ring residency, not the
+            # subtree shipping)
+            cl.servers[0].api.slow_query_threshold = 1e-9
+            body, headers = _post_query(port, "Count(Row(f=1))")
+            slow = c._json("GET", "/debug/slow")["slow"][0]
+            peer_id = cl.servers[1].cluster.node_id
+            assert any(s["tags"].get("node") == peer_id
+                       for s in walk(slow["profile"]))
