@@ -1,0 +1,172 @@
+"""Config #21: cold vs warm dense plane build MB/s at the standard
+4 GB bench scale.
+
+BENCH_r05 put plane build (roaring→dense expand + device_put) at
+**364 s for the 4 GB plane** against a 2.9 s raw host→HBM copy — a
+~125× host-side overhead paid on every cold start, OOM-evict rebuild
+and elastic restore.  The r10 pipeline attacks all of it: parallel
+fragment expansion (native ``rc_expand_rows_into`` straight into the
+staging slab, GIL released), double-buffered H2D overlap, and the warm
+dense-sidecar cache (``<fragment>.dense`` images re-expanded through
+the all-bitmap memcpy fast path after a restart).
+
+Measures, on a freshly written on-disk index (the config19 recipe):
+
+- **cold MB/s**: first `_build_plane_chunked` — no sidecars on disk;
+- **warm MB/s**: a restarted Holder/Executor rebuilding the same
+  plane from the sidecars the cold build just wrote (asserted: every
+  fragment warm-hits);
+
+and proves both planes answer **oracle-exact** against numpy popcounts
+through real executor Count queries.
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 4 rows on CPU —
+tier-1 runs it (tests/test_bench_smoke.py) so this bench can never
+bitrot.
+
+Prints ONE JSON line: value = cold MB/s, vs_baseline = warm MB/s,
+plus the shared regression-guard verdict for this metric (bench.py
+compares same-metric BENCH_r*.json history).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 4 if SMOKE else int(os.environ.get("PILOSA_BENCH_ROWS", "32"))
+WORDS = 32768  # words per shard (2^20 bits / 32)
+INDEX, FIELD = "i", "f"
+
+
+def write_index(plane: np.ndarray, data_dir: str) -> None:
+    """A REAL on-disk index from the packed plane (the config19
+    recipe): schema through the Holder, one roaring snapshot per
+    shard — the same all-bitmap blobs bench.py's product index uses,
+    so cold numbers compare against the BENCH_r05 364 s figure."""
+    from pilosa_tpu.store import Holder, roaring
+
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+
+
+def regression_guard(metric: str, value: float) -> list:
+    """bench.py's same-metric history guard (the module file is
+    shadowed by this package on import; load it explicitly)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.regression_guard(metric, value)
+
+
+def build_and_verify(data_dir: str, row_counts: np.ndarray,
+                     label: str) -> tuple[float, dict]:
+    """Open the index fresh, time one chunked plane build, pin the
+    result into the cache, and verify Count answers per row against
+    the numpy oracle.  Returns (seconds, plane-cache stats)."""
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.store import Holder
+
+    holder = Holder(data_dir).open()
+    try:
+        ex = Executor(holder)
+        idx = holder.index(INDEX)
+        field = idx.field(FIELD)
+        shards = tuple(idx.available_shards())
+        t0 = time.perf_counter()
+        ps = ex.planes._build_plane_chunked(field, "standard", shards)
+        dt = time.perf_counter() - t0
+        key = ("plane", INDEX, FIELD, "standard", shards)
+        ex.planes._insert_entry(
+            key, ex.planes._gens(field, "standard", shards), ps,
+            ps.plane.size * 4)
+        pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+        got = ex.execute(INDEX, pql)
+        assert list(got) == [int(c) for c in row_counts], \
+            f"{label}: counts diverge from the numpy oracle"
+        log(f"{label}: Count answers oracle-exact over {N_ROWS} rows")
+        return dt, ex.planes.stats()
+    finally:
+        holder.close()
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    row_counts = np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+    plane_bytes = plane.nbytes
+    log(f"plane: {plane_bytes / 1e9:.2f} GB, "
+        f"{N_SHARDS} shards x {N_ROWS} rows")
+
+    base = tempfile.mkdtemp(prefix="pilosa_c21_")
+    try:
+        data_dir = os.path.join(base, "data")
+        t0 = time.perf_counter()
+        write_index(plane, data_dir)
+        log(f"index written in {time.perf_counter() - t0:.1f}s")
+        del plane
+
+        # ------------------------------------------------------- cold
+        cold_s, stats = build_and_verify(data_dir, row_counts, "cold")
+        cold_mbps = plane_bytes / cold_s / 1e6
+        log(f"cold build: {cold_s:.2f}s = {cold_mbps:.1f} MB/s "
+            f"(warm hits {stats['warmHits']}, sidecars written)")
+        assert stats["warmHits"] == 0
+
+        # ------------------------------------------------------- warm
+        # a fresh Holder/Executor = the restarted node; the sidecars
+        # the cold build wrote are the only carry-over
+        warm_s, stats = build_and_verify(data_dir, row_counts, "warm")
+        warm_mbps = plane_bytes / warm_s / 1e6
+        log(f"warm build: {warm_s:.2f}s = {warm_mbps:.1f} MB/s "
+            f"({stats['warmHits']} fragments from sidecars)")
+        assert stats["warmHits"] == N_SHARDS, \
+            f"expected {N_SHARDS} warm hits, got {stats['warmHits']}"
+        log(f"warm speedup over cold: {cold_s / warm_s:.2f}x")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    metric = f"plane_build_cold_mbps_{platform}"
+    print(json.dumps({
+        "metric": metric,
+        "value": round(cold_mbps, 1), "unit": "MBps",
+        "vs_baseline": round(warm_mbps, 1),
+        "regressions": regression_guard(metric, cold_mbps),
+        "detail": {"cold_seconds": round(cold_s, 2),
+                   "warm_seconds": round(warm_s, 2),
+                   "warm_mbps": round(warm_mbps, 1),
+                   "plane_bytes": plane_bytes,
+                   "shards": N_SHARDS, "rows": N_ROWS,
+                   "warm_hits": stats["warmHits"]}}))
+
+
+if __name__ == "__main__":
+    main()

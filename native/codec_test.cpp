@@ -19,6 +19,9 @@ int64_t rc_serialize(const uint64_t*, size_t, uint8_t*, size_t);
 int64_t rc_serialized_bound(const uint64_t*, size_t);
 int64_t rc_expand_plane(const uint8_t*, size_t, uint64_t, const uint64_t*,
                         size_t, uint32_t*, size_t);
+int64_t rc_expand_rows_into(const uint8_t*, size_t, uint64_t,
+                            const uint64_t*, const uint64_t*, size_t,
+                            uint32_t*, size_t, size_t);
 int64_t rc_pack_columns(const uint32_t*, size_t, uint32_t*, size_t);
 int64_t rc_popcount(const uint32_t*, size_t);
 }
@@ -62,6 +65,50 @@ int main() {
   assert(plane[1] == (1u << 1));  // bit 33 -> word 1 bit 1
   assert(plane[2] == 1u);
   assert(rc_popcount(plane, 4) == 3);
+
+  // expand_rows_into: same blob, rows 3 and 9 written to swapped,
+  // non-contiguous slots of a 4-row plane
+  {
+    uint64_t rows[2] = {3, 9};
+    uint64_t dslots[2] = {3, 0};  // row 3 -> slot 3, row 9 -> slot 0
+    uint32_t p2[4 * 2] = {0};
+    int64_t s2 = rc_expand_rows_into(blob.data(), len, 64, rows, dslots, 2,
+                                     p2, 2, 4);
+    assert(s2 == 3);
+    assert(p2[0] == 1u);              // row 9 at slot 0
+    assert(p2[3 * 2] == (1u << 1));   // row 3 at slot 3
+    assert(p2[3 * 2 + 1] == (1u << 1));
+    // a slot past the plane must error, never write out of bounds
+    uint64_t bad_slots[2] = {3, 4};
+    assert(rc_expand_rows_into(blob.data(), len, 64, rows, bad_slots, 2,
+                               p2, 2, 4) == -4);
+    // unmapped rows are skipped
+    uint64_t only9[1] = {9};
+    uint64_t at0[1] = {0};
+    uint32_t p3[2] = {0, 0};
+    assert(rc_expand_rows_into(blob.data(), len, 64, only9, at0, 1,
+                               p3, 2, 1) == 1);
+    assert(p3[0] == 1u && p3[1] == 0u);
+    // malformed run containers share the validated expansion path
+    uint32_t p4[2048] = {0};
+    std::vector<uint8_t> evil_blob;
+    {
+      std::vector<uint8_t> b(8 + 12 + 4 + 2 + 4, 0);
+      b[0] = 12348 & 0xFF; b[1] = 12348 >> 8;
+      b[4] = 1;
+      b[8 + 8] = 3;  // run
+      uint32_t off = 8 + 12 + 4;
+      std::memcpy(&b[8 + 12], &off, 4);
+      uint16_t nr = 1, st = 10, la = 3;  // descending run
+      std::memcpy(&b[off], &nr, 2);
+      std::memcpy(&b[off + 2], &st, 2);
+      std::memcpy(&b[off + 4], &la, 2);
+      evil_blob = b;
+    }
+    uint64_t r0[1] = {0}, s0[1] = {0};
+    assert(rc_expand_rows_into(evil_blob.data(), evil_blob.size(), 65536,
+                               r0, s0, 1, p4, 2048, 1) == -5);
+  }
 
   uint32_t words[4] = {0, 0, 0, 0};
   uint32_t cols[3] = {0, 33, 127};

@@ -234,6 +234,82 @@ int64_t rc_expand_plane(const uint8_t* buf, size_t len, uint64_t row_width,
   return set;
 }
 
+// Expand a blob's rows straight into caller-chosen plane slots:
+//   rows[i] (sorted ascending) maps to plane row slots[i] — slots need
+//   NOT be contiguous or ordered, so callers write fragment rows
+//   directly into their final position of a shared chunk buffer (no
+//   tmp slab + reorder copy, the pre-r10 plane_rows overhead).  plane
+//   holds plane_rows * words_per_row uint32 words; rows absent from
+//   rows[] are skipped.  The bulk entry point behind
+//   store/native.expand_rows_into (parallel plane build: ctypes
+//   releases the GIL for the whole call).  Returns bits set.
+int64_t rc_expand_rows_into(const uint8_t* buf, size_t len,
+                            uint64_t row_width, const uint64_t* rows,
+                            const uint64_t* slots, size_t n_rows,
+                            uint32_t* plane, size_t words_per_row,
+                            size_t plane_rows) {
+  std::vector<ContainerRef> refs;
+  int64_t n = parse_headers(buf, len, refs);
+  if (n < 0) return n;
+  for (size_t i = 0; i < n_rows; i++)
+    if (slots[i] >= plane_rows) return ERR_CAP;
+  uint16_t lows[65536];
+  int64_t set = 0;
+  size_t slot = 0;
+  bool slot_ok = false;
+  uint64_t slot_row = ~0ull;
+  auto lookup = [&](uint64_t row) {
+    if (row == slot_row) return;
+    slot_row = row;
+    slot_ok = false;
+    size_t lo = 0, hi = n_rows;
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (rows[mid] < row)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    if (lo < n_rows && rows[lo] == row) {
+      slot = (size_t)slots[lo];
+      slot_ok = true;
+    }
+  };
+  for (auto& c : refs) {
+    // same word-aligned OR-copy fast path as rc_expand_plane: a warm
+    // dense sidecar (serialize_dense image) is ALL bitmap containers,
+    // so its expansion is a straight memcpy-speed pass
+    if (c.type == kTypeBitmap && row_width % 65536 == 0) {
+      if (c.data_len < 8192) return ERR_SHORT;
+      uint64_t base = c.key << 16;
+      lookup(base / row_width);
+      if (!slot_ok) continue;
+      size_t word0 = (size_t)((base % row_width) / 32);
+      if (word0 + 2048 > words_per_row) return ERR_CAP;
+      uint32_t* dst = plane + slot * words_per_row + word0;
+      for (size_t w = 0; w < 2048; w++) {
+        uint32_t v = rd32(c.data + 4 * w);
+        dst[w] |= v;
+        set += __builtin_popcount(v);
+      }
+      continue;
+    }
+    int64_t m = expand_container(c, lows);
+    if (m < 0) return m;
+    uint64_t base = c.key << 16;
+    for (int64_t i = 0; i < m; i++) {
+      uint64_t p = base | lows[i];
+      uint64_t bit = p % row_width;
+      lookup(p / row_width);
+      if (!slot_ok) continue;
+      if (bit / 32 >= words_per_row) return ERR_CAP;
+      plane[slot * words_per_row + bit / 32] |= 1u << (bit % 32);
+      set++;
+    }
+  }
+  return set;
+}
+
 // Serialized size upper bound for n positions (exact header + worst-case
 // container payloads).
 int64_t rc_serialized_bound(const uint64_t* positions, size_t n) {

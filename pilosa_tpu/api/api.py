@@ -549,6 +549,7 @@ class API:
             state = self.cluster.state
         ex = self.executor
         shed = ex.stats.snapshot()["counters"].get("query_shed_total", {})
+        pc = ex.planes.stats()
         return {"state": state, "nodes": nodes,
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
@@ -563,8 +564,17 @@ class API:
                         "query_queue_wait_seconds")},
                 # on-disk footprint: what backup archives and the
                 # snapshot queue compacts (oplogBytes growth = log
-                # compaction falling behind)
-                "storage": self.storage_stats(),
+                # compaction falling behind), plus the plane-build
+                # pipeline's health (r10): cold-build volume, failures
+                # (a wedged background build is otherwise invisible),
+                # and the dense-sidecar warm cache's hit ratio
+                "storage": {
+                    **self.storage_stats(),
+                    "planeBuild": {
+                        k: pc[k]
+                        for k in ("builds", "buildSeconds", "buildBytes",
+                                  "buildFailures", "warmHits",
+                                  "warmMisses")}},
                 # slow-query visibility: ring totals + the configured
                 # threshold (full records behind GET /debug/slow)
                 "slowQueries": {
@@ -572,7 +582,7 @@ class API:
                     "thresholdSeconds": self.slow_query_threshold},
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
-                "planeCache": self.executor.planes.stats(),
+                "planeCache": pc,
                 # per-stage overhead attribution (parse/plan/admit/
                 # dispatch/read/assemble) — the diagnostics dump behind
                 # bench/config18's concurrency-gap breakdown

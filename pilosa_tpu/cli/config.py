@@ -56,6 +56,15 @@ class Config:
     query_timeout: float = 0.0         # seconds per query; 0 = unlimited
                                        # (?timeout= overrides per request)
     plane_budget_bytes: int = 4 << 30
+    # Warm dense-plane cache: cold plane builds persist generation-
+    # keyed dense sidecar images (<fragment>.dense) so a restarted
+    # node re-expands at near raw-copy speed instead of re-decoding
+    # roaring containers; any write/compaction/restore invalidates.
+    plane_sidecars: bool = True
+    # JAX persistent compilation cache directory ("" = off): warm
+    # restarts skip the ~1 s first-query XLA compile by reloading
+    # compiled programs from disk (jax_compilation_cache_dir).
+    compilation_cache_dir: str = ""
     # Queries EXECUTING at once; extras queue at the executor (bounds
     # concurrent device scratch; 0 = off).  Size against HBM headroom:
     # resident planes (plane_budget_bytes) + slots × ~0.5 GB scratch

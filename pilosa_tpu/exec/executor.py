@@ -200,7 +200,7 @@ class Executor:
                  place=None, plane_budget: int | None = None, placement=None,
                  stats=None, tracer=None,
                  count_batch_window: float | str = "adaptive",
-                 max_concurrent: int = 8):
+                 max_concurrent: int = 8, plane_sidecars: bool = True):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -217,9 +217,11 @@ class Executor:
         if placement is not None and place is None:
             place = placement.place
         kw = {"budget_bytes": plane_budget} if plane_budget else {}
-        self.planes = PlaneCache(place, placement=placement, **kw)
         from pilosa_tpu.obs import GLOBAL_TRACER, NopStats
         self.stats = stats or NopStats()
+        self.planes = PlaneCache(place, placement=placement,
+                                 stats=self.stats,
+                                 sidecars=plane_sidecars, **kw)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache()

@@ -132,16 +132,32 @@ class SparseSet:
 
 class PlaneCache:
     def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET,
-                 placement=None):
+                 placement=None, stats=None, sidecars: bool = True):
         """``place(np_array) -> jax.Array`` controls device placement /
         mesh sharding; default is plain ``jax.device_put``.
         ``placement`` (the MeshPlacement the executor runs under, if
-        any) additionally drives the sparse build's device blocking."""
+        any) additionally drives the sparse build's device blocking.
+        ``stats`` (an obs registry) receives the plane-build metrics;
+        ``sidecars`` toggles the warm dense-plane cache (``<fragment>
+        .dense`` images written on cold builds, loaded at near
+        raw-copy speed after a restart)."""
         from pilosa_tpu.exec._lru import Stamps
+        from pilosa_tpu.obs import NopStats
         self.place = place or (placement.place if placement is not None
                                else jax.device_put)
         self.placement = placement
         self.budget = budget_bytes
+        self._stats = stats or NopStats()
+        self.sidecars = sidecars
+        # plane-build accounting (also on /status via stats()):
+        # warm = fragment expansions served from a dense sidecar
+        self.warm_hits = 0
+        self.warm_misses = 0
+        self.builds = 0
+        self.build_failures = 0
+        self.build_seconds_total = 0.0
+        self.build_bytes_total = 0
+        self._failed_logged: set = set()
         # plain dict (NOT OrderedDict): the serving hot path revalidates
         # entries lock-free (GIL-atomic dict reads + a recency-stamp
         # write), so the one cache RLock stops serializing every
@@ -217,9 +233,10 @@ class PlaneCache:
     # it, field_plane_nowait hands the build to a background thread.
     SYNC_BUILD_MAX = 256 << 20
 
-    # Rows per background-build transfer chunk: bounds host staging
-    # memory AND splits the multi-GB single device_put (the r3/r4
-    # tunnel-wedge exposure) into restartable pieces.
+    # Bytes per background-build transfer chunk: bounds host staging
+    # memory (2× with the r10 double buffer) AND splits the multi-GB
+    # single device_put (the r3/r4 tunnel-wedge exposure) into
+    # restartable pieces.
     BUILD_CHUNK_BYTES = 256 << 20
 
     def field_plane_nowait(self, index: str, field: Field, view_name: str,
@@ -297,23 +314,207 @@ class PlaneCache:
             # entry stale and the next query refreshes incrementally.
             self._insert_entry(key, gens, ps, ps.plane.size * 4)
         except Exception:  # noqa: BLE001 — build failure ≠ serving failure
-            pass           # queries keep streaming; next request retries
+            # queries keep streaming and the next request retries, but
+            # a wedged build must be observable: count every failure
+            # and log the traceback once per key (not once per retry)
+            with self._lock:
+                self.build_failures += 1
+                first_for_key = key not in self._failed_logged
+                if first_for_key:
+                    if len(self._failed_logged) > 64:
+                        self._failed_logged.clear()
+                    self._failed_logged.add(key)
+            self._stats.count("plane_build_failures_total", 1)
+            if first_for_key:
+                import logging
+                logging.getLogger("pilosa_tpu.exec").exception(
+                    "background plane build failed for %s "
+                    "(queries keep streaming; next request retries)", key)
         finally:
             with self._lock:
                 self._building.pop(key, None)
 
+    # Builder threads for parallel fragment expansion: each expansion
+    # is one native rc_expand_rows_into call that releases the GIL, so
+    # the roaring→dense decode of a whole chunk runs at N-core speed
+    # instead of one fragment at a time (BENCH_r05: 364 s of host-side
+    # expansion in front of a 2.9 s raw copy).
+    BUILD_WORKERS = 8
+
     def _build_plane_chunked(self, field: Field, view_name: str,
                              shards: tuple[int, ...]) -> PlaneSet:
-        """Assemble a dense plane on device from fixed-size row blocks:
-        one donated dynamic-update program per chunk, so device memory
-        stays 1× the plane (+1 chunk) and no single transfer exceeds
-        BUILD_CHUNK_BYTES."""
-        import jax.numpy as jnp
-        from functools import partial
+        """Assemble a dense plane on device as a PIPELINE (r10):
+        fragments of a chunk expand concurrently on a thread pool
+        (bulk ``Fragment.expand_rows_into`` — native decode straight
+        into the staging slab, dense sidecars served at memcpy speed),
+        and chunks double-buffer so chunk N's host expansion overlaps
+        chunk N−1's ``device_put`` + donated ``dynamic_update_slice``.
+        Device memory stays 1× the plane (+1 chunk) and no single
+        transfer exceeds BUILD_CHUNK_BYTES.
 
+        Chunk axis: whole shards when a shard's slab fits a chunk (the
+        common many-shards case — lets each fragment expand ONCE and
+        write/read its dense sidecar), else row blocks across all
+        shards (few huge shards)."""
+        import time as _time
+        t0 = _time.perf_counter()
         row_ids = self._union_row_ids(field, view_name, shards)
         r_pad = _pow2(max(1, len(row_ids)))
         slot_of = {int(r): i for i, r in enumerate(row_ids)}
+        slab = r_pad * WORDS_PER_SHARD * 4
+        if slab <= self.BUILD_CHUNK_BYTES:
+            ps = self._build_shard_chunks(field, view_name, shards,
+                                          row_ids, r_pad, slot_of)
+        else:
+            ps = self._build_row_chunks(field, view_name, shards,
+                                        row_ids, r_pad, slot_of)
+        dt = _time.perf_counter() - t0
+        nbytes = ps.plane.size * 4
+        with self._lock:  # concurrent background builds both tally
+            self.builds += 1
+            self.build_seconds_total += dt
+            self.build_bytes_total += nbytes
+        self._stats.observe("plane_build_seconds", dt)
+        self._stats.count("plane_build_bytes_total", nbytes)
+        return ps
+
+    def _expand_tasks(self, pool, tasks, tally: bool = True) -> None:
+        """Run fragment-expansion closures on the builder pool and
+        tally sidecar warm/cold accounting (one count per FRAGMENT —
+        callers whose chunks revisit fragments pass tally=False);
+        re-raises the first failure (a build must never silently ship
+        a half-expanded chunk)."""
+        from concurrent.futures import wait
+        futs = [pool.submit(t) for t in tasks]
+        wait(futs)
+        hits = misses = 0
+        for f in futs:
+            mode = f.result()
+            if not tally:
+                continue
+            if mode == "warm":
+                hits += 1
+            elif self.sidecars:  # a miss only exists with the cache on
+                misses += 1
+        if hits or misses:
+            # counters shared with concurrent builds + stats() readers
+            with self._lock:
+                self.warm_hits += hits
+                self.warm_misses += misses
+            if hits:
+                self._stats.count("plane_cache_warm_hits_total", hits)
+            if misses:
+                self._stats.count("plane_cache_warm_misses_total", misses)
+
+    def _build_shard_chunks(self, field: Field, view_name: str,
+                            shards: tuple[int, ...], row_ids: np.ndarray,
+                            r_pad: int, slot_of: dict) -> PlaneSet:
+        """Shard-major pipeline: each chunk is a group of whole shards,
+        so every fragment expands exactly once (all rows, one native
+        call) and its dense sidecar is written/read in one piece."""
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+        from functools import partial
+
+        slab = r_pad * WORDS_PER_SHARD * 4
+        spc = max(1, min(len(shards), self.BUILD_CHUNK_BYTES // slab))
+        full = jnp.zeros((len(shards), r_pad, WORDS_PER_SHARD),
+                         dtype=jnp.uint32)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(full, chunk, start):
+            return jax.lax.dynamic_update_slice(
+                full, chunk, (start, 0, 0))
+
+        view = field.view(view_name)
+        slots = np.arange(len(row_ids), dtype=np.uint64)
+        # sidecar disk writes overlap the build on one writer thread
+        # (bounded queue: a slow disk backpressures the expansion pool
+        # instead of buffering unbounded blob bytes).  Safe deferred:
+        # each item is immutable bytes stamped under the fragment lock.
+        import queue as _queue
+        from pilosa_tpu.store.fragment import Fragment
+        wq: _queue.Queue | None = None
+        wt = None
+        submit = None
+        if self.sidecars:
+            wq = _queue.Queue(maxsize=8)
+
+            def submit(path, hdr, blob):  # noqa: E306 — writer feed
+                wq.put((path, hdr, blob))
+
+            def _writer():
+                while True:
+                    item = wq.get()
+                    if item is None:
+                        return
+                    Fragment.write_sidecar_file(*item)
+
+            wt = threading.Thread(target=_writer, name="plane-sidecar",
+                                  daemon=True)
+            wt.start()
+        # double buffers keyed (parity, group length): the tail group
+        # may be narrower — its own buffer, its own compiled shape
+        bufs: dict[tuple, np.ndarray] = {}
+        inflight: dict[int, object] = {}
+        try:
+            with ThreadPoolExecutor(max_workers=self.BUILD_WORKERS) as pool:
+                for gi, s0 in enumerate(range(0, len(shards), spc)):
+                    glen = min(spc, len(shards) - s0)
+                    par = gi % 2
+                    buf = bufs.get((par, glen))
+                    if buf is None:
+                        buf = bufs[(par, glen)] = np.zeros(
+                            (glen, r_pad, WORDS_PER_SHARD), np.uint32)
+                    else:
+                        # reusing a staging buffer: its previous H2D
+                        # copy must have completed — the placed chunk
+                        # that consumed it being ready guarantees that
+                        if inflight.get(par) is not None:
+                            inflight[par].block_until_ready()
+                        buf[:] = 0
+                    tasks = []
+                    if view is not None and len(row_ids):
+                        for li in range(glen):
+                            s = shards[s0 + li]
+                            if s == PAD_SHARD:
+                                continue
+                            frag = view.fragment(s)
+                            if frag is None:
+                                continue
+                            tasks.append(partial(
+                                frag.expand_rows_into, row_ids, buf[li],
+                                slots, sidecar=self.sidecars,
+                                sidecar_submit=submit))
+                    self._expand_tasks(pool, tasks)
+                    placed = self.place(buf)
+                    full = update(full, placed, np.int32(s0))
+                    # track the NON-donated placed chunk: it being
+                    # ready proves the H2D copy out of buf completed
+                    # (full itself is donated into the next update and
+                    # can't be polled)
+                    inflight[par] = placed
+        finally:
+            if wq is not None:
+                wq.put(None)
+                wt.join()
+        full.block_until_ready()
+        return PlaneSet(full, shards, row_ids, slot_of)
+
+    def _build_row_chunks(self, field: Field, view_name: str,
+                          shards: tuple[int, ...], row_ids: np.ndarray,
+                          r_pad: int, slot_of: dict) -> PlaneSet:
+        """Row-block pipeline for planes whose per-shard slab exceeds
+        BUILD_CHUNK_BYTES: chunks span all shards × a row block (the
+        pre-r10 tiling, now with parallel expansion + overlapped H2D).
+        Sidecars are OFF here: a row block never covers a fragment's
+        full row set (so images could never be written), and warm
+        reads would re-open + re-crc the entire multi-hundred-MB image
+        once per chunk — O(chunks × image bytes) of redundant work."""
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+        from functools import partial
+
         block = max(1, self.BUILD_CHUNK_BYTES
                     // (len(shards) * WORDS_PER_SHARD * 4))
         # pow2 ≤ r_pad so chunks tile evenly — dynamic_update_slice
@@ -328,23 +529,38 @@ class PlaneCache:
                 full, chunk, (0, start, 0))
 
         view = field.view(view_name)
-        for start in range(0, r_pad, block):
-            chunk_rows = row_ids[start:start + block]
-            if not len(chunk_rows):
-                break  # the pow2 tail is already zeros
-            host = np.zeros((len(shards), block, WORDS_PER_SHARD),
-                            dtype=np.uint32)
-            if view is not None:
-                chunk_slots = {int(r): i for i, r in enumerate(chunk_rows)}
-                for si, s in enumerate(shards):
-                    if s == PAD_SHARD:
-                        continue
-                    frag = view.fragment(s)
-                    if frag is None:
-                        continue
-                    frag.plane_rows(list(chunk_slots.keys()), host[si],
-                                    slots=list(chunk_slots.values()))
-            full = update(full, self.place(host), np.int32(start))
+        bufs: list = [None, None]
+        inflight: list = [None, None]
+        with ThreadPoolExecutor(max_workers=self.BUILD_WORKERS) as pool:
+            for ci, start in enumerate(range(0, r_pad, block)):
+                chunk_rows = row_ids[start:start + block]
+                if not len(chunk_rows):
+                    break  # the pow2 tail is already zeros
+                par = ci % 2
+                buf = bufs[par]
+                if buf is None:
+                    buf = bufs[par] = np.zeros(
+                        (len(shards), block, WORDS_PER_SHARD), np.uint32)
+                else:
+                    if inflight[par] is not None:
+                        inflight[par].block_until_ready()
+                    buf[:] = 0
+                slots = np.arange(len(chunk_rows), dtype=np.uint64)
+                tasks = []
+                if view is not None:
+                    for si, s in enumerate(shards):
+                        if s == PAD_SHARD:
+                            continue
+                        frag = view.fragment(s)
+                        if frag is None:
+                            continue
+                        tasks.append(partial(
+                            frag.expand_rows_into, chunk_rows, buf[si],
+                            slots))
+                self._expand_tasks(pool, tasks, tally=False)
+                placed = self.place(buf)
+                full = update(full, placed, np.int32(start))
+                inflight[par] = placed  # non-donated: pollable copy fence
         full.block_until_ready()
         return PlaneSet(full, shards, row_ids, slot_of)
 
@@ -616,7 +832,15 @@ class PlaneCache:
             return {"bytes": self._bytes, "budgetBytes": self.budget,
                     "entries": len(self._entries),
                     "pinnedEntries": len(self._pinned()),
-                    "incrementalRefreshes": self.incremental_applied}
+                    "incrementalRefreshes": self.incremental_applied,
+                    # plane-build pipeline (r10): cold-build volume and
+                    # the dense-sidecar warm cache's hit ratio
+                    "builds": self.builds,
+                    "buildSeconds": round(self.build_seconds_total, 3),
+                    "buildBytes": self.build_bytes_total,
+                    "buildFailures": self.build_failures,
+                    "warmHits": self.warm_hits,
+                    "warmMisses": self.warm_misses}
 
     def invalidate(self, index: str | None = None) -> None:
         with self._lock:
@@ -844,6 +1068,11 @@ class PlaneCache:
 
     def _build_plane(self, field: Field, view_name: str,
                      shards: tuple[int, ...]) -> PlaneSet:
+        """Monolithic single-transfer build — the pure-Python
+        ``plane_rows`` path, kept untouched as the ORACLE the pipelined
+        chunked build is tested bit-exact against."""
+        import time as _time
+        t0 = _time.perf_counter()
         view = field.view(view_name)
         row_ids = self._union_row_ids(field, view_name, shards)
         r_pad = _pow2(max(1, len(row_ids)))
@@ -859,7 +1088,15 @@ class PlaneCache:
                 rows_here = frag.row_ids()
                 frag.plane_rows(rows_here, host[si],
                                 slots=[slot_of[r] for r in rows_here])
-        return PlaneSet(self.place(host), shards, row_ids, slot_of)
+        ps = PlaneSet(self.place(host), shards, row_ids, slot_of)
+        dt = _time.perf_counter() - t0
+        with self._lock:
+            self.builds += 1
+            self.build_seconds_total += dt
+            self.build_bytes_total += host.nbytes
+        self._stats.observe("plane_build_seconds", dt)
+        self._stats.count("plane_build_bytes_total", host.nbytes)
+        return ps
 
     def _build_bsi(self, field: Field, view_name: str,
                    shards: tuple[int, ...]) -> PlaneSet:

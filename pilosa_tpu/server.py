@@ -63,6 +63,31 @@ class PilosaTPUServer:
                             if self.cfg.jax_process_id >= 0 else None))
             self.logger.info("jax.distributed: process %d of %d",
                              jax.process_index(), jax.process_count())
+        if self.cfg.compilation_cache_dir:
+            # persistent XLA compilation cache: a warm restart reloads
+            # compiled programs from disk instead of paying the ~1 s
+            # first-query compile (BENCH_r05).  Thresholds drop to
+            # zero so the handful of serving programs always persist.
+            import os as _os
+
+            import jax
+            cache_dir = _os.path.expanduser(self.cfg.compilation_cache_dir)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            # the cache singleton latches its directory on first use:
+            # drop any instance initialized before this config landed
+            # (library embedders may have compiled already).  Private
+            # API — a jax that moved it degrades to a cold compile,
+            # never a failed boot.
+            try:
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except (ImportError, AttributeError):
+                pass
+            self.logger.info("compilation cache: %s", cache_dir)
         from pilosa_tpu.store import syswrap
         syswrap.GLOBAL.set_max(self.cfg.max_map_count)
         self.holder.open()
@@ -77,7 +102,8 @@ class PilosaTPUServer:
             self.holder, placement=placement, stats=self.stats,
             plane_budget=self.cfg.plane_budget_bytes,
             count_batch_window=self.cfg.count_batch_window,
-            max_concurrent=self.cfg.max_concurrent_queries)
+            max_concurrent=self.cfg.max_concurrent_queries,
+            plane_sidecars=self.cfg.plane_sidecars)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

@@ -422,6 +422,183 @@ class Fragment:
                 for r, s in zip(pend, pend_slots):
                     self._snap_dir.row_words(r, out[s])
 
+    # -- bulk expansion + dense sidecar (r10 plane pipeline) ----------------
+
+    # <snapshot>.dense sidecar: header + a serialize_dense roaring
+    # image of the fragment's full dense rows.  The header stamps the
+    # on-disk state the image captured plus a crc32 of the image; any
+    # write grows the op-log and any compaction replaces the snapshot,
+    # so a stamp mismatch is the (restart-stable) invalidation, and
+    # the crc catches byte corruption that would otherwise still parse
+    # (a flipped container key silently misroutes bits).
+    DENSE_MAGIC = b"PDN1"
+    DENSE_VERSION = 1
+    _DENSE_HDR = struct.Struct("<4sHHQQQQI")
+
+    @property
+    def dense_path(self) -> str:
+        return self.path + ".dense"
+
+    def _dense_stamp(self) -> tuple[int, int, int]:
+        """Restart-stable identity of this fragment's on-disk state:
+        (snapshot size, snapshot mtime_ns, op-log size).  The op-log is
+        flushed per append, so the size moves with every mutation."""
+        try:
+            st = os.stat(self.path)
+            snap = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            snap = (0, 0)
+        return (snap[0], snap[1], self._oplog.size())
+
+    def expand_rows_into(self, row_ids, out: np.ndarray, slots=None, *,
+                         sidecar: bool = False,
+                         sidecar_submit=None) -> str:
+        """Bulk-direct :meth:`plane_rows`: OR ``row_ids[i]``'s packed
+        words into ``out[slots[i]]`` (caller passes zeroed slabs),
+        writing straight into the destination via the native codec —
+        no tmp slab + reorder copy, and the C call releases the GIL so
+        builder threads genuinely overlap.  ``plane_rows`` remains the
+        pure-Python fallback and oracle.
+
+        With ``sidecar=True``: a fresh ``<path>.dense`` image
+        short-cuts the whole expansion (all-bitmap containers — the
+        word-aligned memcpy fast path), and a cold expansion covering
+        the fragment's full row set writes one for the next restart.
+        ``sidecar_submit`` (a ``(path, header, blob)`` callable) defers
+        the disk write off the expansion critical path — safe because
+        content and stamp are captured together under the fragment
+        lock; a mutation racing the deferred write only stale-stamps
+        the file, which the next reader rejects.
+        Returns ``"warm"`` or ``"cold"`` for cache accounting."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        if slots is None:
+            slots = np.arange(len(row_ids), dtype=np.uint64)
+        else:
+            slots = np.asarray(slots, dtype=np.uint64)
+        if len(row_ids) > 1 and not (row_ids[1:] >= row_ids[:-1]).all():
+            # the native lookup binary-searches row_ids: unsorted input
+            # would silently MISS rows, not error
+            order = np.argsort(row_ids, kind="stable")
+            row_ids, slots = row_ids[order], slots[order]
+        with self.lock:
+            self._touch_map()
+            if sidecar and self._expand_sidecar(row_ids, slots, out):
+                return "warm"
+            self._flush_pending()
+            pend, pend_slots = [], []
+            for r, s in zip(row_ids, slots):
+                r = int(r)
+                if r in self._snap_pending:
+                    pend.append(r)
+                    pend_slots.append(int(s))
+                else:
+                    b = self.rows.get(r)
+                    if b is not None and b.any():
+                        out[int(s)] |= b.words()
+            if pend:
+                from pilosa_tpu.store import native
+                if native.available():
+                    order = np.argsort(pend)
+                    native.expand_rows_into(
+                        self._snap_dir.buf, SHARD_WIDTH,
+                        np.array(pend, np.uint64)[order],
+                        np.array(pend_slots, np.uint64)[order], out)
+                else:
+                    for r, s in zip(pend, pend_slots):
+                        self._snap_dir.row_words(r, out[s])
+            if sidecar:
+                self._write_sidecar(row_ids, slots, out, sidecar_submit)
+            return "cold"
+
+    def _expand_sidecar(self, row_ids: np.ndarray, slots: np.ndarray,
+                        out: np.ndarray) -> bool:
+        """OR a valid sidecar image into ``out``; False when absent,
+        stale (stamp mismatch) or corrupt (caller cold-builds and
+        rewrites).  Caller holds the fragment lock."""
+        import mmap as _mmaplib
+        try:
+            with open(self.dense_path, "rb") as f:
+                hdr = f.read(self._DENSE_HDR.size)
+                if len(hdr) != self._DENSE_HDR.size:
+                    return False
+                magic, ver, _, s0, s1, s2, blen, crc = \
+                    self._DENSE_HDR.unpack(hdr)
+                if (magic != self.DENSE_MAGIC or ver != self.DENSE_VERSION
+                        or (s0, s1, s2) != self._dense_stamp()):
+                    return False
+                if os.fstat(f.fileno()).st_size \
+                        != self._DENSE_HDR.size + blen:
+                    return False
+                mm = _mmaplib.mmap(f.fileno(), 0,
+                                   access=_mmaplib.ACCESS_READ)
+        except (OSError, ValueError):
+            return False
+        try:
+            blob = memoryview(mm)[self._DENSE_HDR.size:]
+            # integrity before use: corruption inside the image can
+            # still PARSE (silently wrong bits).  zlib releases the
+            # GIL, so the pass overlaps across builder threads.
+            if zlib.crc32(blob) != crc:
+                return False
+            from pilosa_tpu.store import native
+            if native.available():
+                native.expand_rows_into(blob, SHARD_WIDTH, row_ids,
+                                        slots, out)
+            else:
+                d = roaring.Directory(blob)
+                for r, s in zip(row_ids, slots):
+                    d.row_words(int(r), out[int(s)])
+                del d
+            return True
+        except ValueError:
+            return False  # corrupt image: cold build overwrites it
+        finally:
+            del blob
+            try:
+                mm.close()
+            except BufferError:  # a stray view: freed on GC instead
+                pass
+
+    def _write_sidecar(self, row_ids: np.ndarray, slots: np.ndarray,
+                       out: np.ndarray, submit=None) -> None:
+        """Persist the just-expanded dense image (best-effort: sidecar
+        failure must never fail a plane build).  Only written when the
+        expansion covered the fragment's FULL row set — a partial image
+        would serve missing rows as absent on the next warm load."""
+        live = np.asarray(self.row_ids(), np.uint64)
+        if not len(live) or not np.isin(live, row_ids).all():
+            return
+        stamp = self._dense_stamp()
+        try:
+            img = out[slots.astype(np.intp)]
+            blob = roaring.serialize_dense(img, row_ids)
+        except ValueError:
+            return  # image exceeds the format limit: stay cold
+        hdr = self._DENSE_HDR.pack(
+            self.DENSE_MAGIC, self.DENSE_VERSION, 0, *stamp,
+            len(blob), zlib.crc32(blob))
+        if submit is not None:
+            submit(self.dense_path, hdr, blob)
+        else:
+            self.write_sidecar_file(self.dense_path, hdr, blob)
+
+    @staticmethod
+    def write_sidecar_file(path: str, hdr: bytes, blob: bytes) -> None:
+        """Atomic best-effort sidecar write (also the deferred-writer
+        entry point — the blob is immutable bytes, so writing after
+        the build moved on is safe)."""
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(hdr)
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
     # Cap on the generation-cached inverted index (sparse bits copied
     # into one flat array): 64M bits = 256MB.  Beyond it a second flat
     # copy of a huge field is not held.
@@ -629,6 +806,7 @@ class Fragment:
         (positions() composes from the old blob + overlay without
         materializing, so rows must not be left half-resident)."""
         with self.lock:
+            pre_stamp = self._dense_stamp()  # state the sidecar may match
             blob = roaring.serialize(self.positions())  # includes pending
             self._pend_pos = np.empty(0, np.uint64)
             self._probe_cache = None
@@ -652,6 +830,40 @@ class Fragment:
                 self._load_positions(roaring.deserialize(blob))
             self._oplog.truncate()
             self.op_n = 0
+            # compaction preserves CONTENT, so a sidecar that matched
+            # the pre-compaction state stays byte-valid: re-stamp it
+            # against the new snapshot+empty-oplog identity instead of
+            # discarding it (a clean shutdown compacts every dirty
+            # fragment — deleting here would strand every restart cold)
+            self._restamp_sidecar(pre_stamp)
+
+    def _restamp_sidecar(self, pre_stamp: tuple[int, int, int]) -> None:
+        """After compaction: carry a still-valid sidecar forward to the
+        new on-disk identity, drop a stale one.  Caller holds the lock.
+        A crash mid-rewrite only tears the header — the stamp then
+        mismatches and the next build goes cold (never wrong)."""
+        hdr_s = self._DENSE_HDR
+        try:
+            with open(self.dense_path, "r+b") as f:
+                hdr = f.read(hdr_s.size)
+                valid = False
+                if len(hdr) == hdr_s.size:
+                    magic, ver, _, s0, s1, s2, blen, crc = \
+                        hdr_s.unpack(hdr)
+                    valid = (magic == self.DENSE_MAGIC
+                             and ver == self.DENSE_VERSION
+                             and (s0, s1, s2) == pre_stamp)
+                if valid:
+                    f.seek(0)
+                    f.write(hdr_s.pack(magic, ver, 0,
+                                       *self._dense_stamp(), blen, crc))
+                    return
+        except OSError:
+            return  # no sidecar (or unreadable): nothing to do
+        try:
+            os.unlink(self.dense_path)
+        except OSError:
+            pass
 
     # -- anti-entropy -------------------------------------------------------
 

@@ -52,6 +52,11 @@ def _load():
     lib.rc_expand_plane.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64,
                                     u64p, ctypes.c_size_t, u32p,
                                     ctypes.c_size_t]
+    lib.rc_expand_rows_into.restype = ctypes.c_int64
+    lib.rc_expand_rows_into.argtypes = [u8p, ctypes.c_size_t,
+                                        ctypes.c_uint64, u64p, u64p,
+                                        ctypes.c_size_t, u32p,
+                                        ctypes.c_size_t, ctypes.c_size_t]
     # void* so callers can pass bare addresses (see _u32p)
     lib.rc_union_u32.restype = ctypes.c_int64
     lib.rc_union_u32.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
@@ -123,6 +128,34 @@ def expand_plane(buf: bytes, row_width: int, row_slots: np.ndarray,
         len(row_slots),
         plane.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         plane.shape[-1]), "expand_plane")
+
+
+def expand_rows_into(buf, row_width: int, row_ids: np.ndarray,
+                     slots: np.ndarray, plane: np.ndarray) -> int:
+    """Expand a fragment blob's rows straight into caller-chosen slots
+    of ``plane`` (uint32[n_rows, words_per_row]): row ``row_ids[i]``
+    (sorted ascending) ORs into ``plane[slots[i]]``; rows absent from
+    ``row_ids`` are skipped.  Unlike :func:`expand_plane` the slots are
+    arbitrary, so the parallel plane build writes each fragment's rows
+    directly into their final chunk position — no tmp slab + reorder
+    copy.  The C call releases the GIL, so per-fragment expansions
+    genuinely overlap across builder threads.  Returns bits set."""
+    ptr, keep = _u8(buf)
+    row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
+    slots = np.ascontiguousarray(slots, dtype=np.uint64)
+    if len(row_ids) != len(slots):
+        raise ValueError("expand_rows_into: row_ids/slots length mismatch")
+    if plane.dtype != np.uint32 or not plane.flags.c_contiguous:
+        raise ValueError("plane must be C-contiguous uint32")
+    if plane.ndim != 2:
+        raise ValueError("plane must be 2-D [n_rows, words_per_row]")
+    return _check(_lib.rc_expand_rows_into(
+        ptr, len(buf), row_width,
+        row_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(row_ids),
+        plane.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        plane.shape[-1], plane.shape[0]), "expand_rows_into")
 
 
 def _u32p(arr):

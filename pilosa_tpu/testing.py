@@ -179,6 +179,10 @@ class ProcessNode:
             PILOSA_HEARTBEAT_INTERVAL=str(self.heartbeat),
             PILOSA_ANTI_ENTROPY_INTERVAL=str(self.anti_entropy),
             PILOSA_MESH="0",
+            # persistent XLA compilation cache: restarted nodes (the
+            # kill -9 chaos/backup scenarios re-boot the same data dir)
+            # skip the first-query compile instead of re-paying it
+            PILOSA_COMPILATION_CACHE_DIR=self.data_dir + "_jaxcache",
         )
         if self.seed_port is not None:
             env["PILOSA_SEEDS"] = f"127.0.0.1:{self.seed_port}"

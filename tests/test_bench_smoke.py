@@ -124,6 +124,32 @@ def test_config20_tracing_smoke():
     assert out["detail"]["sampled_traces"] > 0
 
 
+def test_config21_plane_build_smoke():
+    """bench/config21 (cold vs warm plane build MB/s) in --smoke mode:
+    tiny plane, CPU, cold build + sidecar-warm rebuild, Count answers
+    oracle-exact on both paths, regression-guard verdict attached —
+    runs under tier-1 so the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config21_plane_build.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("plane_build_cold_mbps")
+    assert out["unit"] == "MBps" and out["value"] > 0
+    assert out["vs_baseline"] > 0  # warm MB/s
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)
+    # the warm path must have come from sidecars, not a re-expansion
+    assert out["detail"]["warm_hits"] == out["detail"]["shards"]
+
+
 def test_config19_backup_smoke():
     """bench/config19 (backup/restore MB/s) in --smoke mode: tiny
     plane, CPU, full + incremental + restore with an oracle check —

@@ -112,3 +112,57 @@ class TestExpandPlane:
                                   np.array([1], np.uint64), plane)
         assert got == 1
         assert plane[0, 0] == 1 << 5
+
+
+class TestExpandRowsInto:
+    """The r10 bulk entry point: expansion straight into arbitrary
+    destination slots (the parallel plane build's direct-write path)."""
+
+    def test_arbitrary_slots_match_expand_plane(self, rng):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        rows = np.array([2, 7, 40], np.uint64)
+        positions = np.concatenate([
+            r * np.uint64(SHARD_WIDTH)
+            + np.sort(rng.choice(SHARD_WIDTH, 300, replace=False))
+            .astype(np.uint64) for r in rows])
+        blob = roaring.serialize(positions)
+        # oracle: slot i = row i (expand_plane's implicit mapping)
+        oracle = np.zeros((3, WORDS_PER_SHARD), np.uint32)
+        native.expand_plane(blob, SHARD_WIDTH, rows, oracle)
+        # scattered, non-contiguous slots in a larger plane
+        out = np.zeros((7, WORDS_PER_SHARD), np.uint32)
+        slots = np.array([6, 0, 3], np.uint64)
+        got = native.expand_rows_into(blob, SHARD_WIDTH, rows, slots, out)
+        assert got == 900
+        np.testing.assert_array_equal(out[6], oracle[0])
+        np.testing.assert_array_equal(out[0], oracle[1])
+        np.testing.assert_array_equal(out[3], oracle[2])
+        assert not out[[1, 2, 4, 5]].any()
+
+    def test_unmapped_rows_skipped_and_slot_bounds(self):
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        positions = np.array([5, SHARD_WIDTH + 5], np.uint64)
+        blob = roaring.serialize(positions)
+        out = np.zeros((1, WORDS_PER_SHARD), np.uint32)
+        got = native.expand_rows_into(blob, SHARD_WIDTH,
+                                      np.array([1], np.uint64),
+                                      np.array([0], np.uint64), out)
+        assert got == 1 and out[0, 0] == 1 << 5
+        with pytest.raises(ValueError):  # slot past the plane: error,
+            native.expand_rows_into(     # never an out-of-bounds write
+                blob, SHARD_WIDTH, np.array([1], np.uint64),
+                np.array([1], np.uint64), out)
+
+    def test_dense_sidecar_image_round_trip(self, rng):
+        """serialize_dense image (all-bitmap containers — the warm
+        sidecar format) expands through the word-aligned fast path
+        bit-exact with the original plane."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        words = rng.integers(0, 1 << 32, size=(3, WORDS_PER_SHARD),
+                             dtype=np.uint32)
+        row_ids = np.array([1, 8, 200], np.uint64)
+        blob = roaring.serialize_dense(words, row_ids)
+        out = np.zeros((3, WORDS_PER_SHARD), np.uint32)
+        native.expand_rows_into(blob, SHARD_WIDTH, row_ids,
+                                np.arange(3, dtype=np.uint64), out)
+        np.testing.assert_array_equal(out, words)
