@@ -25,12 +25,30 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 
 class ApiError(Exception):
     def __init__(self, msg: str, status: int = 400,
-                 retry_after: float | None = None):
+                 retry_after: float | None = None,
+                 extra: dict | None = None):
         super().__init__(msg)
         self.status = status
         # seconds for a Retry-After response header (load shedding:
         # a 503 should tell the client when to come back)
         self.retry_after = retry_after
+        # structured fields merged into the JSON error body next to
+        # "error" (e.g. the 504 timeout block: elapsed, deadline,
+        # shards outstanding)
+        self.extra = extra
+
+    @classmethod
+    def timeout(cls, exc, elapsed: float,
+                deadline: float | None) -> "ApiError":
+        """The deadline-exceeded contract, shared by the public and
+        ``/internal/query`` edges: HTTP 504 with a structured body —
+        how long the query ran, what the budget was, how many shards
+        never answered."""
+        return cls(str(exc), 504, extra={"timeout": {
+            "elapsedSeconds": round(elapsed, 6),
+            "deadlineSeconds": deadline or None,
+            "shardsOutstanding": getattr(exc, "shards_outstanding",
+                                         None)}})
 
 
 def field_options_from_json(o: dict) -> FieldOptions:
@@ -135,7 +153,8 @@ class API:
         response (reference: query ``profile`` option, SURVEY.md §6).
         ``timeout`` (seconds) bounds execution — the deadline analogue
         of upstream's request-context cancellation; expiry answers
-        HTTP 408.  The server's ``query_timeout`` config is a CAP, not
+        HTTP 504 with a structured ``timeout`` body (elapsed, deadline,
+        shards outstanding).  The server's ``query_timeout`` config is a CAP, not
         just a default: per-request values clamp to it (otherwise any
         caller could disable the operator's protection with
         ?timeout=0).
@@ -189,7 +208,10 @@ class API:
                     out = {"results": [result_to_json(r)
                                        for r in results]}
             except QueryTimeoutError as e:
-                err = ApiError(str(e), 408)
+                # a deadline-exceeded query is its own failure class —
+                # never a generic 500, and distinct from client errors
+                err = ApiError.timeout(e, _time.perf_counter() - t0,
+                                       timeout)
             except ExecutorSaturatedError as e:
                 # admission shedding (VERDICT advice #6): a saturated
                 # executor is overload, not a client mistake — 503 with
@@ -544,13 +566,19 @@ class API:
                    for d in jax.devices()]
         state = "NORMAL"
         nodes = [{"id": "local", "uri": "", "state": state, "isPrimary": True}]
+        cluster_health = None
         if self.cluster is not None:
             nodes = self.cluster.nodes_status()
             state = self.cluster.state
+            # serving-through-failure visibility: per-peer last-seen
+            # age, suspect verdict, breaker state
+            cluster_health = self.cluster.health_payload()
         ex = self.executor
         shed = ex.stats.snapshot()["counters"].get("query_shed_total", {})
         pc = ex.planes.stats()
         return {"state": state, "nodes": nodes,
+                **({"clusterHealth": cluster_health}
+                   if cluster_health is not None else {}),
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
                 "devices": devices,

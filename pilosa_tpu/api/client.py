@@ -110,8 +110,13 @@ class Client:
         try:
             conn.connect()
         except TimeoutError as e:
-            raise ClientError(f"cannot reach {self.base}: {e}",
-                              kind="timeout") from e
+            # CONNECT timeout: not one byte of the request was sent, so
+            # this is "unreachable" (a write definitely did not apply),
+            # NOT the state-unknown "timeout" class — that kind is
+            # reserved for sockets that time out AFTER the request left
+            # (the peer may still be processing it)
+            raise ClientError(f"cannot reach {self.base}: connect timed "
+                              f"out: {e}", kind="unreachable") from e
         except OSError as e:
             # refused / DNS / TLS-handshake rejection: the request was
             # never delivered — a write definitely did not apply

@@ -185,7 +185,10 @@ class Handler(BaseHTTPRequestHandler):
                 # 503 shedding: tell well-behaved clients when to come
                 # back instead of letting them hammer the queue
                 hdrs = {"Retry-After": str(max(1, int(e.retry_after)))}
-            self._reply({"error": str(e)}, e.status, headers=hdrs)
+            # structured error fields (e.g. the 504 timeout block) ride
+            # the body next to "error"
+            self._reply({"error": str(e), **(e.extra or {})}, e.status,
+                        headers=hdrs)
         except BrokenPipeError:
             code = 499
         except Exception as e:  # noqa: BLE001 — server must not die

@@ -29,6 +29,20 @@ class Config:
                                         # (single seed node of a new cluster)
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
     heartbeat_interval: float = 2.0
+    # read availability (serving through failure):
+    # replica-failover hops a fan-out read leg may take after a
+    # transport-class failure before the query fails (reads are
+    # idempotent by the internode contract; writes never fail over)
+    failover_max_depth: int = 2
+    # hedge a straggling fan-out leg onto a live replica after this
+    # many seconds — first answer wins, the loser is abandoned.
+    # 0 disables (default); 0.15 is the documented starting point for
+    # sub-second read SLOs (≈ a few p99s of a healthy internode leg)
+    hedge_after: float = 0.0
+    # consecutive transport failures that OPEN a peer's circuit
+    # breaker (open peers are skipped at read-routing time; half-open
+    # probes ride the heartbeat loop)
+    breaker_threshold: int = 3
     diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
     # observability backends
     stats_backend: str = ""             # "" = in-process /metrics only;

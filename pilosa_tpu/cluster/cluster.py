@@ -29,6 +29,7 @@ import threading
 import time
 
 from pilosa_tpu import fault
+from pilosa_tpu.cluster.breaker import BreakerBoard
 from pilosa_tpu.cluster.dist import DistributedExecutor
 from pilosa_tpu.obs import NopStats, get_logger
 from pilosa_tpu.parallel.placement import shard_nodes
@@ -80,6 +81,12 @@ class Cluster:
         self.placement_version: float = 0.0
         self._load_placement()
         self._placement_pull = threading.Lock()  # one pull at a time
+        # per-peer circuit breakers: consecutive transport failures
+        # open a peer (reads route straight to replicas); half-open
+        # probes ride the heartbeat loop
+        self.breakers = BreakerBoard(
+            threshold=getattr(cfg, "breaker_threshold", 3),
+            stats=self.stats, logger=self.logger)
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
         # index -> (fetched_at, shards, incomplete): `incomplete` rides
@@ -245,6 +252,10 @@ class Cluster:
             is_new = node["id"] not in self.nodes
             self.nodes[node["id"]] = {**node, "state": STATE_NORMAL}
             self._last_seen[node["id"]] = time.monotonic()
+        # a node that rejoined through the membership path is routable
+        # again NOW — stale breaker history must not make its shards
+        # pay failover detours until a probe happens by
+        self.breakers.reset(node["id"])
         if is_new:
             # propagate the tombstone clear: every peer must re-admit the
             # rejoining node or its heartbeats keep getting bounced
@@ -272,6 +283,14 @@ class Cluster:
                 # node knows us but we lost it (e.g. restarted): re-add
                 self.nodes[node_id] = {"id": node_id, "uri": node_id,
                                        "state": state}
+            else:
+                # keep the sender's state FRESH: a restarted seed node
+                # re-learns its peers from their heartbeats, and the
+                # first one may arrive while the sender is still
+                # DEGRADED from the outage — pinning that snapshot
+                # forever left the rejoined cluster reporting DEGRADED
+                # members after everyone had recovered (r11)
+                self.nodes[node_id]["state"] = state
             ours = self.placement_version
         if placement_version > ours:
             # the SENDER has a newer activated placement than us: pull
@@ -331,6 +350,13 @@ class Cluster:
             for n in payload["nodes"]:
                 if n["id"] in self._removed:
                     continue
+                if n["id"] == self.node_id:
+                    # our OWN state is authoritative: a peer's snapshot
+                    # may predate our recovery, and (now that heartbeat
+                    # states stay fresh, r11) a DEGRADED-era echo would
+                    # latch in our self entry forever — nothing else
+                    # ever rewrites it
+                    continue
                 self.nodes[n["id"]] = n
                 self._last_seen.setdefault(n["id"], now)
             self.state = payload["state"]
@@ -379,11 +405,16 @@ class Cluster:
         for nid in self.member_ids():
             if nid == self.node_id:
                 continue
+            # this round's heartbeat doubles as the breaker's half-open
+            # probe: an OPEN peer steps to HALF_OPEN, then the result
+            # below either closes it or re-opens it
+            self.breakers.begin_probe(nid)
             try:
                 resp = self._client(nid)._json(
                     "POST", "/internal/heartbeat",
                     {"id": self.node_id, "state": self.state,
                      "placementVersion": self.placement_version})
+                self.breakers.record_success(nid)
                 if resp.get("removed"):
                     # we were explicitly removed: drop to single-node
                     # membership (an operator rejoin brings us back)
@@ -405,8 +436,17 @@ class Cluster:
                     # broadcast is best-effort): pull it now — inline,
                     # this loop is already a background thread
                     self._pull_cluster_state(nid)
-            except Exception:  # noqa: BLE001 — peer down
-                pass
+            except Exception as e:  # noqa: BLE001 — peer down
+                from pilosa_tpu.api.client import ClientError
+                if isinstance(e, ClientError) and e.status != 0:
+                    # the peer ANSWERED (an HTTP error): alive for
+                    # breaker purposes — only never-answered requests
+                    # count toward opening, same rule as internal_query
+                    # (an erroring-but-alive peer must not have its
+                    # strict writes refused via _write_reachable)
+                    self.breakers.record_success(nid)
+                else:
+                    self.breakers.record_failure(nid)
         alive = set(self.alive_ids())
         with self._lock:
             dead = set(self.nodes) - alive
@@ -493,13 +533,23 @@ class Cluster:
             plist = list(self.placement_ids)
         return shard_nodes(index, shard, plist, self.cfg.replicas)
 
-    def group_shards_by_node(self, index: str,
-                             shards: tuple[int, ...]) -> dict[str, tuple]:
-        alive = set(self.alive_ids())
+    def group_shards_by_node(self, index: str, shards: tuple[int, ...],
+                             exclude=frozenset()) -> dict[str, tuple]:
+        """Route each shard to one alive owner, replicas in placement
+        order.  Peers with a non-closed breaker are SKIPPED while a
+        healthy replica exists (straight to the replica — no per-query
+        connect-timeout tax on a sick peer), but remain a last resort:
+        the breaker is an optimization, never a correctness gate.
+        ``exclude`` drops nodes entirely — read failover passes the
+        nodes that already failed the leg."""
+        alive = set(self.alive_ids()) - set(exclude)
+        healthy = alive - self.breakers.unhealthy_peers()
         groups: dict[str, list[int]] = {}
         for s in shards:
             owners = self.shard_owners(index, s)
-            target = next((o for o in owners if o in alive), None)
+            target = next((o for o in owners if o in healthy), None)
+            if target is None:
+                target = next((o for o in owners if o in alive), None)
             if target is None:
                 raise RuntimeError(
                     f"no alive replica for shard {s} of {index!r} "
@@ -518,7 +568,23 @@ class Cluster:
         resurrected cluster-wide by union-merge AAE (r5 review).
         Non-strict callers (AAE sweeps, resize planning) get the
         degraded view, cached only for ``_SHARD_NEG_TTL`` so recovery
-        is quick but a sick peer isn't hammered per query."""
+        is quick but a sick peer isn't hammered per query.
+
+        Replica bound (r11): with ``replicas`` copies, every shard has
+        ``replicas`` holders — as long as the unheard nodes (fetch
+        failures plus suspect members, which are never polled) number
+        fewer than the replica factor, at least one holder of every
+        shard was polled, so the union is still the complete universe
+        and reads keep serving through a dead node instead of 500ing
+        until the suspect horizon drops it.  With zero fetch failures
+        the suspect count alone never marks incompleteness (baseline
+        semantics: a dead node's exclusive shards are unreachable
+        whether their ids are known or not — refusing every strict
+        read on a degraded replicas=1 cluster would brick it).
+        (Caveat: an orphan fragment held only by the sick peer
+        mid-resize can hide; AAE's handoff window is the same exposure
+        the pre-r11 code had.)  Peers with an OPEN breaker are counted
+        as failed without paying the connect attempts."""
         def raise_incomplete():
             raise RuntimeError(
                 f"shard universe for {index!r} is incomplete (an alive "
@@ -532,14 +598,13 @@ class Cluster:
                 if hit[2] and strict:
                     raise_incomplete()
                 return hit[1]
-        incomplete = False
+        failed = 0
         shards: set[int] = set()
         idx = self.api.holder.index(index)
         if idx is not None:
             shards.update(idx.available_shards())
-        for nid in self.alive_ids():
-            if nid == self.node_id:
-                continue
+
+        def fetch(nid) -> bool:
             try:
                 try:
                     resp = self._client(nid)._json(
@@ -548,10 +613,46 @@ class Cluster:
                     resp = self._client(nid)._json(
                         "GET", f"/internal/shards?index={index}")
                 shards.update(resp["shards"])
+                return True
             except Exception as e:  # noqa: BLE001
                 self.logger.warning(
                     "shard list from %s failed: %r", nid, e)
-                incomplete = True
+                return False
+
+        bound = max(1, int(self.cfg.replicas))
+        alive = set(self.alive_ids())
+        with self._lock:
+            members = set(self.placement_ids) | set(self.nodes)
+        # SUSPECT members are never polled; they count toward the
+        # bound when PAIRED with a fetch failure — a dead owner plus a
+        # transient failure on its co-replica can cover all holders of
+        # a shard, and declaring that complete silently undercounts.
+        # With no fetch failures the universe keeps baseline semantics:
+        # a suspect node's exclusive shards are unreachable whether we
+        # know their ids or not, and refusing every strict read on a
+        # degraded replicas=1 cluster would brick it for no gain.
+        suspect = len(members - alive - {self.node_id})
+        deferred = []  # open-breaker peers: skip the connect tax...
+        for nid in sorted(alive):
+            if nid == self.node_id:
+                continue
+            if self.breakers.state(nid) == "open":
+                deferred.append(nid)
+                continue
+            if not fetch(nid):
+                failed += 1
+
+        def at_risk(n_failed: int) -> bool:
+            return n_failed >= 1 and n_failed + suspect >= bound
+
+        if at_risk(failed + len(deferred)):
+            # ... unless skipping them would make the universe
+            # incomplete — the breaker is never a correctness gate, so
+            # give the open peers their chance to answer
+            failed += sum(not fetch(nid) for nid in deferred)
+        else:
+            failed += len(deferred)
+        incomplete = at_risk(failed)
         out = tuple(sorted(shards)) if shards else (0,)
         with self._lock:
             if incomplete:
@@ -578,7 +679,7 @@ class Cluster:
         the dist layer grafts into the coordinator's span tree.
 
         Error mapping (ADVICE r4): every failure leaves here as an
-        executor exception the API layer answers with 4xx/408 — except
+        executor exception the API layer answers with 4xx/504 — except
         kind=="unreachable" when ``map_unreachable=False``, which write
         replication (`dist._run_on`) needs verbatim to distinguish
         "peer never saw the write" (safe to skip best-effort) from
@@ -596,7 +697,7 @@ class Cluster:
             # own monotonic clock (wall clocks may disagree; budgets
             # don't).  An already-expired budget fails here.  The
             # socket timeout follows the budget (+slack for transfer
-            # and the peer's own 408 answer) — the Client default would
+            # and the peer's own 504 answer) — the Client default would
             # otherwise cap every remote leg at 60 s regardless of the
             # query's deadline.
             remaining = deadline - time.monotonic()
@@ -610,13 +711,22 @@ class Cluster:
                 "POST", path, pql.encode(),
                 headers=(trace or {}).get("headers"),
                 timeout=socket_timeout)
+            self.breakers.record_success(node_id)
             if trace is not None:
                 trace["profile"] = resp.get("profile") or []
                 trace["retried"] = client.last_retried()
             return resp["results"]
         except ClientError as e:
-            if e.status == 408:
-                # peer's share of the budget expired
+            # breaker accounting: only never-answered transport faults
+            # count toward opening (an HTTP error means the peer is
+            # alive; a post-send timeout may be the query's fault)
+            if e.status == 0 and e.kind in ("unreachable", "transport"):
+                self.breakers.record_failure(node_id)
+            elif e.status != 0:
+                self.breakers.record_success(node_id)
+            if e.status in (408, 504):
+                # peer's share of the budget expired (504 since r11;
+                # 408 kept for mixed-version peers mid-upgrade)
                 raise QueryTimeoutError(str(e)) from e
             if e.status == 400:
                 # peer rejected the query itself: surface as a query
@@ -1115,6 +1225,29 @@ class Cluster:
             content_type="application/octet-stream")
 
     # -- introspection -------------------------------------------------------
+
+    def health_payload(self) -> dict:
+        """The ``clusterHealth`` block on ``/status``: per-peer
+        last-seen age, suspect verdict, and breaker state — what an
+        operator needs to see why reads are (or are not) detouring."""
+        alive = set(self.alive_ids())
+        now = time.monotonic()
+        horizon = SUSPECT_AFTER * self.cfg.heartbeat_interval
+        with self._lock:
+            members = sorted(self.nodes)
+            seen = dict(self._last_seen)
+        peers = []
+        for nid in members:
+            if nid == self.node_id:
+                continue
+            age = (now - seen[nid]) if nid in seen else None
+            peers.append({
+                "id": nid,
+                "lastSeenAgeSeconds": (round(age, 3)
+                                       if age is not None else None),
+                "suspect": nid not in alive,
+                "breaker": self.breakers.state(nid)})
+        return {"suspectAfterSeconds": horizon, "peers": peers}
 
     def nodes_status(self) -> list[dict]:
         alive = set(self.alive_ids())

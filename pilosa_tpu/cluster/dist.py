@@ -36,6 +36,40 @@ def _call_of(call: Call) -> Call:
     return call.children[0] if call.name == "Options" and call.children else call
 
 
+def _transport_class(e: BaseException):
+    """The transport-class failure behind a failed READ leg — the class
+    that is safe and useful to retry on a replica — or None when the
+    failure is fatal no matter which node answers: query errors (400),
+    deadline expiry (``QueryTimeoutError`` — the budget is gone on
+    every replica), and other HTTP statuses.  Counted as transport:
+
+    - ``ClientError`` kinds ``unreachable``/``transport``/``timeout``
+      (dead peer, connect refused/reset/timed out, TLS alert; a
+      post-send timeout is retryable for READS because the internode
+      query surface is idempotent by contract) — raw, or as the
+      ``__cause__`` of ``internal_query``'s ``ExecutionError`` mapping;
+    - a peer 503 (saturated or not-yet-clustered: route around it, as
+      ``h_internal_query``'s shedding contract intends);
+    - ``fault.FaultError`` (the ``dist.fanout`` ``error`` action — it
+      stands in for a leg dying mid-flight).
+    """
+    from pilosa_tpu.api.client import ClientError
+    from pilosa_tpu.exec.executor import QueryTimeoutError
+    if isinstance(e, QueryTimeoutError):
+        return None
+    if isinstance(e, fault.FaultError):
+        return e
+    c = e if isinstance(e, ClientError) else None
+    if c is None and isinstance(e, ExecutionError) \
+            and isinstance(e.__cause__, ClientError):
+        c = e.__cause__
+    if c is None:
+        return None
+    if c.status == 503 or c.kind != "http":
+        return c
+    return None
+
+
 def _nested_limit(call: Call, top: bool = True) -> bool:
     eff = _call_of(call) if top else call
     if eff.name == "Limit" and not top:
@@ -106,7 +140,7 @@ class DistributedExecutor:
         """``deadline`` is checked between top-level calls, honored by
         the local partial execution inside each fan-out, and shipped to
         remote nodes as their remaining budget (re-anchored on the
-        peer's monotonic clock; a peer's expiry comes back as 408 and
+        peer's monotonic clock; a peer's expiry comes back as 504 and
         re-raises as QueryTimeoutError here)."""
         import time as _time
 
@@ -279,8 +313,36 @@ class DistributedExecutor:
         """The one per-node fan-out: run ``subs`` locally over this
         node's shard group while peers execute the same multi-call
         query concurrently.  Returns one ``[per-call JSON partial]``
-        list per participating node.  The pool is torn down on EVERY
-        exit path (a local raise must not strand worker threads)."""
+        list per participating node (the caller's merges are
+        associative over disjoint shard sets, so a failed-over or
+        hedged leg may legally come back as several entries).
+
+        Availability (r11) — reads are idempotent by the internode
+        contract, so a leg is never a single point of failure:
+
+        - **replica failover**: a leg that dies with a transport-class
+          error (:func:`_transport_class`) re-groups its shards by
+          their next live replica — per shard, since replicas differ
+          across partitions — and retries there, bounded by
+          ``failover_max_depth`` hops and the query deadline.  Writes
+          never take this path (``_write``/``_run_on`` keep their
+          strict semantics).
+        - **hedged requests**: when ``hedge_after`` > 0, a leg that
+          exceeds it gets a duplicate issued to live replicas; the
+          first complete answer wins and the loser is abandoned.  The
+          winning subtree is grafted with a ``hedged`` trace tag.
+
+        The pool is torn down on EVERY exit path with
+        ``cancel_futures=True`` — failover and hedging multiply
+        in-flight legs, and none may outlive the dispatch (queued legs
+        are dropped; already-running stragglers finish into ignored
+        futures and release their threads)."""
+        import time as _time
+        from concurrent.futures import (FIRST_COMPLETED,
+                                        ThreadPoolExecutor, wait)
+
+        from pilosa_tpu.exec.executor import QueryTimeoutError
+
         try:
             all_shards = (tuple(shards) if shards is not None
                           else self.cluster.index_shards(index,
@@ -303,62 +365,217 @@ class DistributedExecutor:
             tracer.inject(trace_headers, span=parent,
                           sampled=getattr(tracer, "sampled", True))
 
-        def remote(node_id, node_shards):
+        def remote(node_id, node_shards, tags=None):
             if fault.ACTIVE:
                 # per-leg failpoint: `error` fails ONE node's share of
                 # the fan-out (a remote leg dying mid-query), `delay`
                 # models a straggler node without touching its process
                 fault.fire("dist.fanout", peer=node_id, index=index)
-            tr = ({"headers": trace_headers}
+            tr = ({"headers": trace_headers, **(tags or {})}
                   if trace_headers is not None else None)
-            results = self.cluster.internal_query(node_id, index, pql,
-                                                  node_shards,
-                                                  deadline=deadline,
-                                                  trace=tr)
+            results = self.cluster.internal_query(
+                node_id, index, pql, node_shards, deadline=deadline,
+                trace=tr, map_unreachable=False)
             return results, tr
+
+        def run_local(node_shards):
+            # the local group executes on the DISPATCHING thread,
+            # inside the open cluster.* span — its executor spans nest
+            # there (also the failover target when a dead peer's shards
+            # re-group onto this node)
+            rs = self.cluster.api.executor.execute(
+                index, Query(list(subs)), shards=list(node_shards),
+                translate_output=False, deadline=deadline,
+                tracer=tracer)
+            return [result_to_json(r) for r in rs]
 
         def graft(tr) -> None:
             # graft on the DISPATCHING thread only, from collected
             # futures: a straggler leg abandoned by an earlier leg's
-            # raise must never mutate a span tree that may already be
-            # closed, retained, and served (its thread only ever
-            # touches its own `tr` dict)
+            # raise (or by losing its hedge race) must never mutate a
+            # span tree that may already be closed, retained, and
+            # served (its thread only ever touches its own `tr` dict)
             if tr is None or parent is None:
                 return
             for sub in tr.get("profile") or []:
-                if tr.get("retried"):
-                    # the leg was redelivered (lost response →
-                    # idempotent retry): the trace must say so
-                    sub.setdefault("tags", {})["retried"] = True
+                tags = sub.setdefault("tags", {})
+                for flag in ("retried", "hedged", "failover"):
+                    # redelivered / hedge-winner / failed-over legs are
+                    # visible in the profile: traces never lie under
+                    # failure
+                    if tr.get(flag):
+                        tags[flag] = True
                 parent.children.append(sub)
 
-        from concurrent.futures import ThreadPoolExecutor
+        cfg = self.cluster.cfg
+        hedge_after = float(getattr(cfg, "hedge_after", 0.0) or 0.0)
+        max_depth = int(getattr(cfg, "failover_max_depth", 2))
+        stats = self.cluster.stats
         remote_items = [(n, s) for n, s in groups.items()
                         if n != self.cluster.node_id]
-        per_node = []
+        per_node: list[list] = []
         pool = None
+
+        def new_slot(node_id, node_shards, tried, depth, tags=None):
+            return {"node": node_id, "shards": tuple(node_shards),
+                    "primary": pool.submit(remote, node_id,
+                                           tuple(node_shards), tags),
+                    "tried": set(tried) | {node_id},
+                    "depth": depth, "start": _time.monotonic(),
+                    "hedge": None, "hedge_ok": [], "hedge_dead": False,
+                    "settled": False}
+
+        def settle(slot):
+            slot["settled"] = True
+            slots.remove(slot)
+
+        def failover(slot, failed_node, err):
+            """Re-group a transport-failed leg's shards onto their next
+            live replicas (which may include THIS node) and retry."""
+            stats.count("read_failover_total", 1, peer=failed_node)
+            if deadline is not None and _time.monotonic() > deadline:
+                raise QueryTimeoutError(
+                    "query timeout exceeded during read failover") \
+                    from err
+            if slot["depth"] + 1 > max_depth:
+                raise ExecutionError(
+                    f"node {failed_node} unreachable and read failover "
+                    f"exhausted after {max_depth} hops: {err}") from err
+            try:
+                regroups = self.cluster.group_shards_by_node(
+                    index, slot["shards"], exclude=slot["tried"])
+            except RuntimeError as e2:
+                raise ExecutionError(
+                    f"node {failed_node} unreachable: {err} (and no "
+                    f"live replica remains: {e2})") from err
+            for n2, s2 in regroups.items():
+                if n2 == self.cluster.node_id:
+                    per_node.append(run_local(s2))
+                else:
+                    slots.append(new_slot(n2, s2, slot["tried"],
+                                          slot["depth"] + 1,
+                                          tags={"failover": True}))
+
+        def fire_hedges(now):
+            for slot in slots:
+                if (slot["hedge"] is not None or slot["primary"] is None
+                        or now - slot["start"] < hedge_after):
+                    continue
+                slot["hedge"] = {}  # marks "hedge attempted" even if 0
+                try:
+                    # exclude every node that already failed this leg
+                    # (tried includes the straggler): a failover leg
+                    # must not hedge back onto the node that just died
+                    regroups = self.cluster.group_shards_by_node(
+                        index, slot["shards"], exclude=slot["tried"])
+                except RuntimeError:
+                    continue  # no live replica to hedge to
+                if self.cluster.node_id in regroups:
+                    # a self-targeted part would run synchronously on
+                    # the dispatch thread and block the loop — let the
+                    # straggler stand (failover still covers death)
+                    continue
+                stats.count("read_hedged_total", 1, peer=slot["node"])
+                slot["hedge"] = {
+                    pool.submit(remote, n2, s2, {"hedged": True}): n2
+                    for n2, s2 in regroups.items()}
+
+        slots: list[dict] = []
         try:
-            futures = []
             if remote_items:
-                pool = ThreadPoolExecutor(max_workers=len(remote_items))
-                futures = [pool.submit(remote, n, s)
-                           for n, s in remote_items]
+                # headroom beyond the original legs: failover and hedge
+                # legs must not deadlock behind abandoned stragglers
+                pool = ThreadPoolExecutor(
+                    max_workers=2 * len(remote_items) + 2)
+                for n, s in remote_items:
+                    slots.append(new_slot(n, s, set(), 0))
             if self.cluster.node_id in groups:
-                # the local group executes on THIS thread, inside the
-                # open cluster.* span — its executor spans nest there
-                rs = self.cluster.api.executor.execute(
-                    index, Query(list(subs)),
-                    shards=list(groups[self.cluster.node_id]),
-                    translate_output=False, deadline=deadline,
-                    tracer=tracer)
-                per_node.append([result_to_json(r) for r in rs])
-            for f in futures:
-                results, tr = f.result()
-                graft(tr)
-                per_node.append(results)
+                per_node.append(run_local(groups[self.cluster.node_id]))
+            while slots:
+                now = _time.monotonic()
+                if hedge_after > 0:
+                    fire_hedges(now)
+                timeout = None
+                if hedge_after > 0:
+                    unhedged = [s["start"] + hedge_after for s in slots
+                                if s["hedge"] is None
+                                and s["primary"] is not None]
+                    if unhedged:
+                        timeout = max(0.0, min(unhedged) - now)
+                futs = {}
+                for slot in slots:
+                    if slot["primary"] is not None:
+                        futs[slot["primary"]] = slot
+                    for hf in (slot["hedge"] or {}):
+                        futs[hf] = slot
+                done, _ = wait(list(futs), timeout=timeout,
+                               return_when=FIRST_COMPLETED)
+                for f in done:
+                    slot = futs[f]
+                    if slot["settled"]:
+                        continue  # twin answered earlier this pass
+                    is_hedge = bool(slot["hedge"]) and f in slot["hedge"]
+                    try:
+                        results, tr = f.result()
+                    except Exception as e:  # noqa: BLE001 — classified
+                        te = _transport_class(e)
+                        if te is None:
+                            if isinstance(e, QueryTimeoutError):
+                                e.shards_outstanding = sum(
+                                    len(s["shards"]) for s in slots
+                                    if not s["settled"])
+                            raise
+                        if is_hedge:
+                            failed = slot["hedge"].pop(f)
+                            slot["tried"].add(failed)
+                            if slot["primary"] is None:
+                                # the primary already died; the hedge
+                                # was the leg — fail over for real
+                                settle(slot)
+                                failover(slot, failed, te)
+                            else:
+                                # primary still in flight; the hedge
+                                # set can no longer complete
+                                slot["hedge_dead"] = True
+                            continue
+                        if slot["hedge"] and not slot["hedge_dead"]:
+                            # primary died but a live hedge set covers
+                            # the shards — let it race on
+                            slot["primary"] = None
+                            continue
+                        settle(slot)
+                        failover(slot, slot["node"], te)
+                        continue
+                    if is_hedge:
+                        # pop FIRST: a completed future left in the
+                        # hedge map would re-trigger wait() instantly
+                        # and busy-spin the loop until the primary lands
+                        node2 = slot["hedge"].pop(f)
+                        if slot["hedge_dead"]:
+                            continue  # abandoned set; primary decides
+                        slot["hedge_ok"].append((results, tr, node2))
+                        if slot["hedge"]:
+                            continue  # parts still outstanding
+                        # the full hedge set answered first: it wins;
+                        # the primary straggler is abandoned (its
+                        # result is never read or grafted)
+                        settle(slot)
+                        if slot["primary"] is not None:
+                            slot["primary"].cancel()
+                        for r2, t2, _n2 in slot["hedge_ok"]:
+                            graft(t2)
+                            per_node.append(r2)
+                        continue
+                    # primary answered: it wins; queued hedge parts are
+                    # cancelled, running ones abandoned
+                    settle(slot)
+                    for hf in (slot["hedge"] or {}):
+                        hf.cancel()
+                    graft(tr)
+                    per_node.append(results)
         finally:
             if pool is not None:
-                pool.shutdown(wait=False)
+                pool.shutdown(wait=False, cancel_futures=True)
         return per_node
 
     def _read_many(self, index: str, calls: list[Call], shards,
@@ -458,6 +675,16 @@ class DistributedExecutor:
             # stays strict — a clear missed by a dead replica would be
             # RESURRECTED by union-merge AAE (no deletion tombstones on
             # bit data), so failing loudly is the only sound behavior.
+            if eff.name == "Clear":
+                # pre-mutation fail-fast, same rationale as ClearRow
+                # below: refuse BEFORE any replica applies
+                dead = sorted(set(owners) - self._write_reachable())
+                if dead:
+                    raise ExecutionError(
+                        f"replica {dead[0]} unreachable for Clear: this "
+                        "op requires every replica (a copy missed by a "
+                        "down node would be resurrected by anti-entropy "
+                        "union merge)")
             results = self._run_on(index, call, owners, shards=None,
                                    best_effort=eff.name == "Set")
             return bool(results[0])
@@ -479,8 +706,7 @@ class DistributedExecutor:
         # fail fast BEFORE mutating anything: discovering a dead owner
         # mid-loop would leave the clear half-applied (and the halves
         # on dead-owner shards later resurrected by AAE)
-        alive = set(self.cluster.alive_ids())
-        dead = sorted(set(groups) - alive)
+        dead = sorted(set(groups) - self._write_reachable())
         if dead:
             raise ExecutionError(
                 f"replica {dead[0]} unreachable for {eff.name}: this op "
@@ -493,6 +719,17 @@ class DistributedExecutor:
                                         shards=tuple(kv[1]))[0],
                 groups.items()))
         return any(bool(r) for r in results)
+
+    def _write_reachable(self) -> set[str]:
+        """The node set a STRICT write's pre-mutation fail-fast trusts:
+        alive AND breaker-closed.  The breaker sees a dead peer within
+        a few transport failures — seconds before the suspect horizon —
+        and a Clear/ClearRow/Store that proceeded in that window would
+        half-apply on the live replicas before raising, leaving bits
+        for AAE to resurrect on rejoin.  Strictness is unchanged: this
+        only refuses EARLIER (before mutating), never skips a replica."""
+        return (set(self.cluster.alive_ids())
+                - self.cluster.breakers.unhealthy_peers())
 
     def _attr_write(self, index: str, call: Call):
         """SetRowAttrs/SetColumnAttrs apply on every alive node — attr

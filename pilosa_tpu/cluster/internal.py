@@ -103,14 +103,16 @@ def h_internal_query(self: Handler) -> None:
     if "shards" in self.query:
         shards = [int(s) for s in self.query["shards"][0].split(",") if s]
     deadline = None
+    budget = None
     if "timeout" in self.query:
         # remaining budget shipped by the coordinator, re-anchored on
         # THIS node's monotonic clock.  Validated exactly like the
         # public ?timeout= (ADVICE r4) — this endpoint is reachable by
         # any peer.
         from pilosa_tpu.api.server import parse_timeout_param
-        deadline = time.monotonic() + parse_timeout_param(
-            self.query["timeout"][0])
+        budget = parse_timeout_param(self.query["timeout"][0])
+        deadline = time.monotonic() + budget
+    t0 = time.monotonic()
     pql = self._body().decode()
     from contextlib import nullcontext
 
@@ -140,7 +142,10 @@ def h_internal_query(self: Handler) -> None:
                                            deadline=deadline,
                                            tracer=tracer)
     except QueryTimeoutError as e:
-        raise ApiError(str(e), 408)
+        # same structured 504 as the public edge: the coordinator maps
+        # it back to QueryTimeoutError, and an operator curling a node
+        # directly sees elapsed-vs-budget
+        raise ApiError.timeout(e, time.monotonic() - t0, budget)
     except ExecutorSaturatedError as e:
         # a saturated PEER is overload, not a bad query: 503 so the
         # coordinator's fan-out classifies it like a busy node (and a

@@ -26,6 +26,17 @@ dropped_internal_response_trace       a redelivered fan-out leg is
                                       visible in the profile tree
                                       (``retried`` tag) — traces
                                       never lie under failure
+node_kill_failover                    kill -9 mid-serve (replicas=2):
+                                      zero read failures via replica
+                                      failover, breaker opens, strict
+                                      writes still refuse, rejoin
+                                      closes the breaker
+straggler_hedged_read                 a delayed leg is hedged to a
+                                      replica: bounded latency, exact
+                                      answer, ``hedged`` trace tag
+breaker_lifecycle                     open → half_open → closed pinned
+                                      through partition + heal; open
+                                      routing pays no failover tax
 ====================================  ==================================
 
 Oracle semantics are at-least-once honest: a write the harness saw FAIL
@@ -53,6 +64,16 @@ from pilosa_tpu.engine.words import SHARD_WIDTH
 
 class InvariantViolation(AssertionError):
     """A chaos invariant failed; the message carries the seed."""
+
+
+def prom_counter_total(text: str, name: str) -> float:
+    """Sum one counter family across its labels from Prometheus
+    exposition text (shared by the harness and bench/config22)."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and line[len(name)] in "{ ":
+            total += float(line.rsplit(" ", 1)[1])
+    return total
 
 
 class ChaosHarness:
@@ -107,6 +128,22 @@ class ChaosHarness:
                        match={"peer": peer_i})
 
     # -- cluster introspection ----------------------------------------------
+
+    def node_id(self, i: int) -> str:
+        return f"127.0.0.1:{self.cluster.nodes[i].port}"
+
+    def breaker_state(self, via: int, peer_id: str) -> str | None:
+        """Peer breaker state as node ``via`` reports it on the
+        ``/status`` clusterHealth block."""
+        st = self.client(via)._json("GET", "/status")
+        for p in st.get("clusterHealth", {}).get("peers", []):
+            if p["id"] == peer_id:
+                return p["breaker"]
+        return None
+
+    def counter_total(self, via: int, name: str) -> float:
+        """Sum a counter family across labels from ``/metrics``."""
+        return prom_counter_total(self.client(via).metrics_text(), name)
 
     def coordinator_index(self) -> int:
         status = self.client(0)._json("GET", "/status")
@@ -458,6 +495,193 @@ def scenario_dropped_internal_response_trace(cluster,
     return h
 
 
+def scenario_node_kill_failover(cluster, seed: int) -> ChaosHarness:
+    """kill -9 a replica-holding node MID-SERVE (replicas=2): every
+    read keeps answering oracle-exact through replica failover — zero
+    query failures from the kill onward — the entry node's breaker for
+    the dead peer opens (routing then skips it entirely), strict
+    writes still refuse as today, and after a restart the breaker
+    closes via heartbeat probes and every node serves again."""
+    h = ChaosHarness(cluster, seed, index="chaos_kill")
+    h.setup()
+    # bits in every shard so every node's shard group is exercised
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(30)
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = next(i for i in range(h.n) if i != victim)
+    victim_id = h.node_id(victim)
+    cluster.nodes[victim].kill9()
+    # serve THROUGH the failure: every read from the kill to past
+    # breaker-open must answer, oracle-exact — zero failures allowed
+    # (pre-horizon legs to the corpse fail over; post-open routing
+    # skips it outright)
+    deadline = time.monotonic() + 30
+    reads = 0
+    opened = False
+    while time.monotonic() < deadline:
+        try:
+            h.check_oracle(via=entry)
+        except InvariantViolation:
+            raise
+        except (ClientError, OSError) as e:
+            raise h._fail(f"read failed after kill -9: {e!r}")
+        reads += 1
+        if h.breaker_state(entry, victim_id) == "open":
+            opened = True
+            break
+    if not opened:
+        raise h._fail(f"breaker never opened for the dead peer "
+                      f"({reads} reads served)")
+    if h.counter_total(entry, "read_failover_total") < 1:
+        raise h._fail("no read ever failed over to a replica")
+    for _ in range(5):  # breaker open: reads keep serving
+        h.check_oracle(via=entry)
+    # write-path strictness unchanged: ClearRow touches every replica
+    # including the dead one and must refuse loudly, not half-apply
+    try:
+        h.client(entry).query(h.index, f"ClearRow({h.field}=0)")
+    except (ClientError, OSError) as e:
+        if getattr(e, "status", 0) != 400:
+            raise h._fail(f"strict write failed oddly: {e!r}")
+    else:
+        raise h._fail("ClearRow succeeded with a replica dead")
+    h.check_oracle(via=entry)  # the refused clear mutated nothing
+    # restart: the breaker must close via the heartbeat probe and the
+    # node must serve its shards again
+    node = cluster.nodes[victim]
+    node.stop()  # reap the corpse + release the log handle
+    node.start()
+    node.await_up()
+    cluster.await_membership(3)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if h.breaker_state(entry, victim_id) == "closed":
+            break
+        time.sleep(0.3)
+    else:
+        raise h._fail("breaker never closed after the node returned")
+    h.await_oracle()  # every node (the restarted one included) exact
+    return h
+
+
+def scenario_straggler_hedged_read(cluster, seed: int) -> ChaosHarness:
+    """A straggler leg (``dist.fanout`` delay failpoint) with hedging
+    on: the entry node duplicates the leg to a live replica after
+    ``hedge_after``, the first answer wins — latency stays bounded by
+    the hedge, the result stays oracle-exact, and the winning subtree
+    carries the ``hedged`` trace tag.  Requires a cluster booted with
+    ``PILOSA_HEDGE_AFTER`` (see SCENARIOS)."""
+    h = ChaosHarness(cluster, seed, index="chaos_hedge")
+    h.setup()
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(10)
+    h.check_oracle()
+    # guarantee a remote leg AND a remote hedge target: pick an entry
+    # node holding none of some shard — with replicas=2 its two owners
+    # are both other nodes (the dropped-response trace scenario's
+    # discovery)
+    entry = shard = None
+    for i in range(h.n):
+        held = h.client(i)._json(
+            "GET", f"/internal/shards?index={h.index}")["shards"]
+        missing = [s for s in range(3) if s not in held]
+        if missing:
+            entry, shard = i, missing[0]
+            break
+    if entry is None:
+        raise h._fail("every node holds every shard; no remote leg")
+    h.set_fault(entry, "dist.fanout", "delay", nth=1,
+                match={"index": h.index}, args={"seconds": 1.5})
+    t0 = time.monotonic()
+    try:
+        resp = h.client(entry)._do(
+            "POST",
+            f"/index/{h.index}/query?profile=true&shards={shard}",
+            f"Count(Row({h.field}=0))".encode())
+    finally:
+        h.clear_faults()
+    elapsed = time.monotonic() - t0
+    count = resp["results"][0]
+    acked = {c for c in h.acked.get(0, ()) if c // SHARD_WIDTH == shard}
+    att = {c for c in h.attempted.get(0, ())
+           if c // SHARD_WIDTH == shard}
+    if not len(acked) <= count <= len(att):
+        raise h._fail(f"hedged count {count} outside oracle "
+                      f"[{len(acked)}, {len(att)}]")
+    if elapsed >= 1.2:
+        raise h._fail(f"hedge did not bound the straggler: the query "
+                      f"took {elapsed:.2f}s against a 1.5s delay")
+
+    def walk(span):
+        yield span
+        for child in span.get("children", []):
+            yield from walk(child)
+
+    spans = [s for root in resp["profile"] for s in walk(root)]
+    if not any(s.get("tags", {}).get("hedged") for s in spans):
+        raise h._fail("winning subtree lost its hedged trace tag")
+    if h.counter_total(entry, "read_hedged_total") < 1:
+        raise h._fail("read_hedged_total never incremented")
+    h.check_oracle()
+    return h
+
+
+def scenario_breaker_lifecycle(cluster, seed: int) -> ChaosHarness:
+    """Breaker lifecycle pinned end-to-end: an asymmetric partition
+    (entry cannot reach the victim; the victim's inbound heartbeats
+    keep it 'alive') accumulates transport failures until the breaker
+    OPENS — reads stay exact throughout via failover, then stop
+    detouring (routing skips the open peer: the failover counter goes
+    quiet).  Healing the partition lets the heartbeat probe walk
+    open → half_open → closed, visible in breaker_transitions_total."""
+    h = ChaosHarness(cluster, seed, index="chaos_breaker")
+    h.setup()
+    h.random_writes(20)
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = next(i for i in range(h.n) if i != victim)
+    victim_id = h.node_id(victim)
+    h.set_fault(entry, "client.send", "partition",
+                match={"peer": victim_id})
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        h.check_oracle(via=entry)  # must never fail while opening
+        if h.breaker_state(entry, victim_id) == "open":
+            break
+    else:
+        raise h._fail("breaker never opened under the partition")
+    # open: routing skips the peer — no more failover churn
+    base = h.counter_total(entry, "read_failover_total")
+    for _ in range(5):
+        h.check_oracle(via=entry)
+    if h.breaker_state(entry, victim_id) in ("open", "half_open") \
+            and h.counter_total(entry, "read_failover_total") != base:
+        raise h._fail("open breaker still paid per-query failovers")
+    h.clear_faults()
+    # heal: the heartbeat probe closes it
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if h.breaker_state(entry, victim_id) == "closed":
+            break
+        time.sleep(0.2)
+    else:
+        raise h._fail("breaker never closed after the partition healed")
+    text = h.client(entry).metrics_text()
+    for leg in ('to="open"', 'to="half_open"', 'to="closed"'):
+        if ("breaker_transitions_total{" not in text
+                or leg not in text):
+            raise h._fail(f"breaker transition {leg} not exported")
+    h.check_oracle()
+    return h
+
+
 SCENARIOS = {
     "partition_during_resize": (scenario_partition_during_resize, 3),
     "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
@@ -466,6 +690,12 @@ SCENARIOS = {
                                     2),
     "dropped_internal_response_trace":
         (scenario_dropped_internal_response_trace, 3),
+    # r11 — serving through failure (the third element, when present,
+    # is extra env the scenario's cluster must boot with)
+    "node_kill_failover": (scenario_node_kill_failover, 3),
+    "straggler_hedged_read": (scenario_straggler_hedged_read, 3,
+                              {"PILOSA_HEDGE_AFTER": "0.15"}),
+    "breaker_lifecycle": (scenario_breaker_lifecycle, 3),
 }
 
 
@@ -484,11 +714,13 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
     for name in names:
-        fn, n_nodes = SCENARIOS[name]
+        fn, n_nodes, *rest = SCENARIOS[name]
+        extra_env = rest[0] if rest else None
         replicas = 2 if n_nodes > 1 else 1
         with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
             with run_process_cluster(n_nodes, tmp, replicas=replicas,
-                                     anti_entropy=1.0) as cluster:
+                                     anti_entropy=1.0,
+                                     extra_env=extra_env) as cluster:
                 fn(cluster, args.seed)
         print(f"[chaos] {name}: OK (seed={args.seed})", flush=True)
     return 0

@@ -272,7 +272,8 @@ class TestRawHttp:
 class TestQueryTimeout:
     """Query deadlines (reference: upstream threads request-context
     cancellation through the executor; here a monotonic deadline is
-    checked at call/block boundaries, HTTP 408 on expiry)."""
+    checked at call/block boundaries, HTTP 504 + a structured
+    ``timeout`` body on expiry)."""
 
     def test_expired_deadline_aborts(self, srv):
         import time
@@ -290,9 +291,12 @@ class TestQueryTimeout:
         assert api.query("i", "Count(Row(f=1))",
                          timeout=60)["results"] == [1]
 
-    def test_rest_timeout_param_returns_408(self, srv):
+    def test_rest_timeout_param_returns_504(self, srv):
         # a 1 us budget expires during parse/dispatch setup, so the
-        # first boundary check fires deterministically
+        # first boundary check fires deterministically.  504, not 408
+        # (the server ran out of time, the client did nothing wrong)
+        # and not a generic 500 — with the structured body: elapsed,
+        # the effective deadline, shards outstanding.
         _, api, server, c = srv
         c.create_index("i")
         c.create_field("i", "f")
@@ -303,8 +307,13 @@ class TestQueryTimeout:
             data=b"Count(Row(f=1))", method="POST")
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req)
-        assert ei.value.code == 408
-        assert "timeout" in json.loads(ei.value.read())["error"]
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert "timeout" in body["error"]
+        tinfo = body["timeout"]
+        assert tinfo["deadlineSeconds"] == pytest.approx(1e-6)
+        assert tinfo["elapsedSeconds"] >= 0
+        assert "shardsOutstanding" in tinfo
 
     def test_bad_timeout_param(self, srv):
         _, _, server, _ = srv
@@ -325,14 +334,14 @@ class TestQueryTimeout:
         api.create_field("i", "f")
         with pytest.raises(ApiError) as ei:
             api.query("i", "Count(Row(f=1))")
-        assert ei.value.status == 408
+        assert ei.value.status == 504
         # per-request values CLAMP to the server cap (otherwise any
         # caller could disable the operator's protection): a generous
         # timeout and an explicit 0 both stay bounded by the config
         for t in (60, 0):
             with pytest.raises(ApiError) as ei:
                 api.query("i", "Count(Row(f=1))", timeout=t)
-            assert ei.value.status == 408
+            assert ei.value.status == 504
         holder.close()
         # with no cap configured, per-request values apply as-is
         holder2 = Holder(str(tmp_path / "e")).open()

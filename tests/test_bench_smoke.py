@@ -173,3 +173,36 @@ def test_config19_backup_smoke():
     assert out["detail"]["incremental_transferred"] == 1
     assert out["detail"]["incremental_skipped"] == \
         out["detail"]["fragments"] - 1
+
+
+def test_config22_availability_smoke():
+    """bench/config22 (read availability through a kill -9 + rejoin) in
+    --smoke mode: 3-process cluster, replicas=2, a replica-holding node
+    killed MID-SERVE — the headline acceptance bar is pinned here:
+    availability 1.0, i.e. ZERO failed or wrong reads through the
+    failure window (replica failover + breakers), and the rejoin window
+    serves clean too — runs under tier-1 so the bench can never
+    bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config22_availability.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("read_availability_node_kill")
+    assert out["unit"] == "ratio"
+    # the acceptance criterion: zero query failures through the kill
+    assert out["value"] == 1.0, out["detail"]["failure"]
+    assert out["detail"]["failure"]["failed"] == 0
+    assert out["detail"]["rejoin"]["failed"] == 0
+    # the failure window actually exercised the failover machinery
+    assert out["detail"]["failover_total"] >= 1
+    assert out["detail"]["breaker_transitions_total"] >= 1
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -8,6 +8,13 @@ distributed invariant after faults clear:
 - dropped placement broadcast  → heartbeat pull-on-mismatch converges
 - dropped internal response    → the redelivered fan-out leg surfaces
                                  as a `retried` tag in the profile tree
+- node kill failover           → kill -9 mid-serve: zero read failures
+                                 (replica failover), breaker opens,
+                                 strict writes refuse, rejoin closes it
+- straggler hedged read        → hedging bounds a delayed leg; the
+                                 winner carries the `hedged` trace tag
+- breaker lifecycle            → open→half_open→closed pinned through
+                                 partition and heal
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -48,6 +55,26 @@ def test_dropped_internal_response_trace(trio):
     chaos.scenario_dropped_internal_response_trace(trio, SEED)
 
 
+def test_breaker_lifecycle(trio):
+    chaos.scenario_breaker_lifecycle(trio, SEED)
+
+
 def test_crash_mid_oplog_append(tmp_path):
     with run_process_cluster(1, str(tmp_path)) as cluster:
         chaos.scenario_crash_mid_oplog_append(cluster, SEED)
+
+
+def test_node_kill_failover(tmp_path):
+    # own cluster: the scenario kill -9s and restarts a member — the
+    # shared trio must stay pristine for its other scenarios
+    with run_process_cluster(3, str(tmp_path), replicas=2,
+                             anti_entropy=1.0) as cluster:
+        chaos.scenario_node_kill_failover(cluster, SEED)
+
+
+def test_straggler_hedged_read(tmp_path):
+    # own cluster: hedging is a boot-time knob (off by default)
+    env = dict(chaos.SCENARIOS["straggler_hedged_read"][2])
+    with run_process_cluster(3, str(tmp_path), replicas=2,
+                             extra_env=env) as cluster:
+        chaos.scenario_straggler_hedged_read(cluster, SEED)

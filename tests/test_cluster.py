@@ -363,7 +363,7 @@ class TestClusterQueryTimeout:
                 c.client(0)._do(
                     "POST", "/index/i/query?timeout=0.000001",
                     b"Count(Row(f=1))")
-            assert ei.value.status == 408
+            assert ei.value.status == 504
             assert c.client(0)._do(
                 "POST", "/index/i/query?timeout=30",
                 b"Count(Row(f=1))")["results"] == [1]
@@ -405,7 +405,7 @@ class TestClusterQueryTimeout:
                     cl._do("POST",
                            "/index/i/query?timeout=0.2",
                            f"Count(Row(f=1))".encode())
-                assert ei.value.status == 408
+                assert ei.value.status == 504
                 assert slept, "query never reached the peer"
             finally:
                 peer.executor.execute = real
@@ -1599,3 +1599,544 @@ class TestOrphanHandoff:
             assert fld.view("standard").fragment(shard) is None, \
                 "empty orphan must be dropped, not re-scanned forever"
             assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# serving through failure (r11): replica-failover reads, hedged fan-out,
+# per-peer circuit breakers
+# ---------------------------------------------------------------------------
+
+
+class TestReadFailover:
+    """A fan-out read leg that dies with a transport-class error must
+    re-group its shards onto the next live replicas and still answer
+    exactly — one dead or slow node must not fail every query that
+    touches its shards."""
+
+    def test_failed_leg_retries_on_replica(self, tmp_path):
+        from pilosa_tpu import fault
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=0.2) as c:
+            oracle = spread_bits(c.client(0))
+            entry = c.servers[0]
+            # a peer the entry node actually routes legs to (placement
+            # is hash-driven; a fixed pick could own no queried shard)
+            groups = entry.cluster.group_shards_by_node(
+                "i", tuple(range(6)))
+            victim_id = next(n for n in groups
+                             if n != entry.cluster.node_id)
+            try:
+                # every leg the entry node sends to the victim dies
+                # (the dist.fanout failpoint models a leg lost
+                # mid-flight); the victim process itself stays healthy
+                fault.set_fault("dist.fanout", "error",
+                                match={"peer": victim_id})
+                for row, cols in oracle.items():
+                    (got,) = c.client(0).query("i", f"Row(f={row})")
+                    assert set(got["columns"]) == cols
+                snap = entry.stats.snapshot()["counters"]
+                total = sum(snap.get("read_failover_total", {}).values())
+                assert total >= 1, "no leg ever failed over"
+            finally:
+                fault.clear()
+
+    def test_failover_exhaustion_fails_loudly(self, tmp_path):
+        """replicas=1: a dead leg has nowhere to go — the query fails
+        with the unreachable error, never a silent partial answer."""
+        from pilosa_tpu import fault
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=1) as c:
+            spread_bits(c.client(0))
+            peer = c.servers[1].cluster.node_id
+            try:
+                fault.set_fault("dist.fanout", "error",
+                                match={"peer": peer})
+                with pytest.raises(ClientError) as ei:
+                    c.client(0).query("i", "Count(Row(f=1))")
+                assert "unreachable" in str(ei.value)
+            finally:
+                fault.clear()
+
+    def test_failover_lands_on_local_replica(self, tmp_path):
+        """The next live replica may be the DISPATCHING node itself:
+        the re-grouped shards execute locally, not through a loopback
+        RPC."""
+        from pilosa_tpu import fault
+
+        with run_cluster(2, str(tmp_path), replicas=2) as c:
+            oracle = spread_bits(c.client(0))
+            # with replicas == nodes, every shard lives on both nodes:
+            # the only failover target for a dead peer leg is local
+            peer = c.servers[1].cluster.node_id
+            try:
+                fault.set_fault("dist.fanout", "error",
+                                match={"peer": peer})
+                for row, cols in oracle.items():
+                    (got,) = c.client(0).query("i", f"Row(f={row})")
+                    assert set(got["columns"]) == cols
+            finally:
+                fault.clear()
+
+    def test_write_strictness_untouched(self, tmp_path):
+        """Reads fail over; writes keep today's semantics: with a
+        replica unreachable, Clear-family ops refuse loudly (a clear
+        missed by a down replica would be resurrected by AAE)."""
+        from pilosa_tpu import fault
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=0.2) as c:
+            oracle = spread_bits(c.client(0))
+            entry = c.servers[0]
+            victim = next(s for s in c.servers
+                          if s.cluster.node_id != entry.cluster.node_id)
+            vid = victim.cluster.node_id
+            try:
+                # sever entry -> victim at the transport (both the
+                # read legs and the write replication see it)
+                fault.set_fault("client.send", "partition",
+                                match={"peer": vid})
+                # reads: exact through failover
+                for row, cols in oracle.items():
+                    (got,) = c.client(0).query("i", f"Row(f={row})")
+                    assert set(got["columns"]) == cols
+                # strict write: refused while a replica is unreachable
+                with pytest.raises(ClientError) as ei:
+                    c.client(0).query("i", "ClearRow(f=1)")
+                assert ei.value.status == 400
+                assert "unreachable" in str(ei.value)
+            finally:
+                fault.clear()
+
+
+class TestHedgedReads:
+    def test_straggler_leg_hedges_to_replica(self, tmp_path):
+        """A leg past hedge_after gets a duplicate on a live replica;
+        the first answer wins, latency stays bounded by the hedge (not
+        the straggler), and the winning subtree carries the hedged
+        trace tag."""
+        import time
+
+        from pilosa_tpu import fault
+
+        with run_cluster(3, str(tmp_path), replicas=2, heartbeat=0.2,
+                         hedge_after=0.1) as c:
+            oracle = spread_bits(c.client(0))
+            entry = c.servers[0]
+            # a shard NEITHER of whose owners is the entry node: the
+            # primary leg is remote AND the hedge target is remote (a
+            # self-targeted hedge is skipped by design)
+            peer_shard = next(
+                s for s in range(64)
+                if entry.cluster.node_id
+                not in entry.cluster.shard_owners("i", s))
+            row = 1
+            want = sum(1 for cc in oracle.get(row, ())
+                       if cc // SHARD_WIDTH == peer_shard)
+            try:
+                # first leg for this index stalls 1.5 s (nth=1 fires
+                # exactly once: the hedge leg sails through)
+                fault.set_fault("dist.fanout", "delay", nth=1,
+                                match={"index": "i"},
+                                args={"seconds": 1.5})
+                t0 = time.monotonic()
+                resp = c.client(0)._do(
+                    "POST",
+                    f"/index/i/query?profile=true&shards={peer_shard}",
+                    f"Count(Row(f={row}))".encode())
+                elapsed = time.monotonic() - t0
+            finally:
+                fault.clear()
+            assert resp["results"] == [want]
+            assert elapsed < 1.2, \
+                f"hedge did not bound the straggler: {elapsed:.2f}s"
+
+            def walk(span):
+                yield span
+                for ch in span.get("children", []):
+                    yield from walk(ch)
+
+            spans = [s for root in resp["profile"] for s in walk(root)]
+            assert any(s.get("tags", {}).get("hedged") for s in spans), \
+                "winning subtree lost its hedged tag"
+            snap = entry.stats.snapshot()["counters"]
+            assert sum(snap.get("read_hedged_total", {}).values()) >= 1
+
+    def test_hedging_off_by_default(self, tmp_path):
+        """hedge_after=0 (the default): a slow leg is simply awaited —
+        no duplicate legs, no hedge counter."""
+        from pilosa_tpu import fault
+
+        with run_cluster(2, str(tmp_path), replicas=2) as c:
+            spread_bits(c.client(0))
+            try:
+                fault.set_fault("dist.fanout", "delay", nth=1,
+                                match={"index": "i"},
+                                args={"seconds": 0.3})
+                assert c.client(0).query(
+                    "i", "Count(Row(f=1))")  # exact, just slower
+            finally:
+                fault.clear()
+            snap = c.servers[0].stats.snapshot()["counters"]
+            assert not snap.get("read_hedged_total")
+
+
+class TestPeerBreakers:
+    def test_lifecycle_deterministic(self):
+        """closed -(N consecutive transport failures)-> open
+        -(heartbeat probe)-> half_open -> closed on success / straight
+        back to open on failure; any answered request resets the
+        streak."""
+        from pilosa_tpu.cluster.breaker import BreakerBoard
+        from pilosa_tpu.obs import Stats
+
+        stats = Stats()
+        b = BreakerBoard(threshold=3, stats=stats)
+        p = "127.0.0.1:1"
+        assert b.state(p) == "closed"
+        b.record_failure(p)
+        b.record_failure(p)
+        # an answered request resets the consecutive count
+        b.record_success(p)
+        b.record_failure(p)
+        b.record_failure(p)
+        assert b.state(p) == "closed"
+        b.record_failure(p)
+        assert b.state(p) == "open"
+        assert b.unhealthy_peers() == {p}
+        # probe: half-open, then a failure re-opens immediately
+        assert b.begin_probe(p) is True
+        assert b.state(p) == "half_open"
+        assert b.unhealthy_peers() == {p}  # still skipped for routing
+        b.record_failure(p)
+        assert b.state(p) == "open"
+        # probe again, success closes
+        assert b.begin_probe(p) is True
+        b.record_success(p)
+        assert b.state(p) == "closed"
+        assert b.unhealthy_peers() == set()
+        # exported: gauge tracks the state, transitions counted
+        snap = stats.snapshot()
+        assert snap["gauges"]["peer_breaker_state"][(("peer", p),)] == 0
+        trans = snap["counters"]["breaker_transitions_total"]
+        labels = {(dict(k)["from"], dict(k)["to"]): v
+                  for k, v in trans.items()}
+        assert labels[("closed", "open")] == 1
+        assert labels[("open", "half_open")] == 2
+        assert labels[("half_open", "open")] == 1
+        assert labels[("half_open", "closed")] == 1
+
+    def test_open_peer_skipped_at_routing(self, tmp_path):
+        # heartbeat=5.0: the background probe must not close the
+        # manually-opened breaker mid-assertion
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            spread_bits(c.client(0))
+            entry = c.servers[0]
+            victim = c.servers[1].cluster.node_id
+            for _ in range(entry.cluster.breakers.threshold):
+                entry.cluster.breakers.record_failure(victim)
+            assert entry.cluster.breakers.state(victim) == "open"
+            groups = entry.cluster.group_shards_by_node(
+                "i", tuple(range(6)))
+            assert victim not in groups, \
+                "open-breaker peer must be skipped while replicas exist"
+            # and queries stay exact through the detour
+            (n,) = c.client(0).query("i", "Count(Row(f=1))")
+            assert n > 0
+
+    def test_open_breaker_is_not_a_correctness_gate(self, tmp_path):
+        """With no healthy replica left, the router falls back to the
+        open peer rather than failing the query."""
+        with run_cluster(2, str(tmp_path), replicas=1,
+                         heartbeat=5.0) as c:
+            spread_bits(c.client(0))
+            entry = c.servers[0]
+            peer = c.servers[1].cluster.node_id
+            for _ in range(entry.cluster.breakers.threshold):
+                entry.cluster.breakers.record_failure(peer)
+            assert entry.cluster.breakers.state(peer) == "open"
+            groups = entry.cluster.group_shards_by_node(
+                "i", tuple(range(6)))
+            assert peer in groups  # last resort: still routed
+            (n,) = c.client(0).query("i", "Count(Row(f=1))")
+            assert n > 0
+
+    def test_heartbeat_probe_closes_breaker(self, tmp_path):
+        """The half-open probe rides the heartbeat loop: one round
+        against a healthy peer closes an open breaker."""
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            entry = c.servers[0]
+            peer = c.servers[1].cluster.node_id
+            for _ in range(entry.cluster.breakers.threshold):
+                entry.cluster.breakers.record_failure(peer)
+            assert entry.cluster.breakers.state(peer) == "open"
+            entry.cluster._heartbeat_once()
+            assert entry.cluster.breakers.state(peer) == "closed"
+
+    def test_answered_http_errors_never_open_the_breaker(self, tmp_path):
+        """Only never-answered transport faults count toward opening —
+        a peer whose heartbeat handler 500s is ALIVE (its query path
+        may serve fine), and opening its breaker would wrongly refuse
+        strict writes via _write_reachable."""
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            entry = c.servers[0]
+            peer = c.servers[1].cluster.node_id
+            client = entry.cluster._client(peer)
+            real = client._json
+
+            def http_500(method, path, obj=None, **kw):
+                if path == "/internal/heartbeat":
+                    raise ClientError("internal error", 500)
+                return real(method, path, obj, **kw)
+
+            client._json = http_500
+            try:
+                for _ in range(5):
+                    entry.cluster._heartbeat_once()
+            finally:
+                client._json = real
+            assert entry.cluster.breakers.state(peer) == "closed"
+
+    def test_status_cluster_health_block(self, tmp_path):
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            st = c.client(0).status()
+            health = st["clusterHealth"]
+            assert health["suspectAfterSeconds"] == pytest.approx(15.0)
+            (peer,) = health["peers"]
+            assert peer["id"] == c.servers[1].cluster.node_id
+            assert peer["suspect"] is False
+            assert peer["breaker"] == "closed"
+            assert peer["lastSeenAgeSeconds"] is not None
+            # open the breaker; the block must say so
+            c.servers[0].cluster.breakers.record_failure(peer["id"])
+            for _ in range(3):
+                c.servers[0].cluster.breakers.record_failure(peer["id"])
+            (peer,) = c.client(0).status()["clusterHealth"]["peers"]
+            assert peer["breaker"] == "open"
+
+
+class TestSuspectHorizonBoundary:
+    """The failover layer depends on alive_ids being EXACT at the
+    suspect horizon (SUSPECT_AFTER x heartbeat_interval): at the
+    boundary a peer is suspect; any younger last-seen is alive."""
+
+    def test_boundary_exact(self, tmp_path):
+        import time
+
+        from pilosa_tpu.cluster.cluster import SUSPECT_AFTER
+
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            cl = c.servers[0].cluster
+            peer = c.servers[1].cluster.node_id
+            horizon = SUSPECT_AFTER * cl.cfg.heartbeat_interval
+            assert horizon == pytest.approx(15.0)
+            now = time.monotonic()
+            # exactly AT the horizon: suspect (strict <)
+            with cl._lock:
+                cl._last_seen[peer] = now - horizon
+            assert peer not in cl.alive_ids()
+            # comfortably inside: alive (5 s of slack >> test runtime)
+            with cl._lock:
+                cl._last_seen[peer] = time.monotonic() - horizon + 5.0
+            assert peer in cl.alive_ids()
+            # self is always alive regardless of bookkeeping
+            assert cl.node_id in cl.alive_ids()
+
+    def test_suspect_peer_not_routed(self, tmp_path):
+        import time
+
+        from pilosa_tpu.cluster.cluster import SUSPECT_AFTER
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            spread_bits(c.client(0))
+            cl = c.servers[0].cluster
+            victim = c.servers[1].cluster.node_id
+            horizon = SUSPECT_AFTER * cl.cfg.heartbeat_interval
+            with cl._lock:
+                cl._last_seen[victim] = time.monotonic() - horizon
+            groups = cl.group_shards_by_node("i", tuple(range(6)))
+            assert victim not in groups
+
+
+class TestRejoinBecomesRoutable:
+    def test_tombstone_cleared_rejoin_routes_again(self, tmp_path):
+        """A tombstoned node whose id explicitly rejoins (the restart
+        path: same id, same port) must become routable again — the
+        tombstone clears, stale breaker history resets, and the shard
+        router includes it.  The failover layer depends on all three:
+        a rejoined replica that stays 'open' would silently halve the
+        failover options forever."""
+        import time
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=0.2) as c:
+            spread_bits(c.client(0))
+            coord = next(s for s in c.servers
+                         if s.cluster.is_coordinator())
+            victim = next(s for s in c.servers if s is not coord)
+            vid = victim.cluster.node_id
+            entry = next(s for s in c.servers
+                         if s is not victim)
+            # worst-case stale state on a surviving peer: the node is
+            # tombstoned AND its breaker is open
+            with entry.cluster._lock:
+                entry.cluster._removed[vid] = time.time()
+            for _ in range(4):
+                entry.cluster.breakers.record_failure(vid)
+            assert entry.cluster.breakers.state(vid) == "open"
+            # tombstoned: heartbeats bounce, the node is unroutable
+            resp = entry.cluster.handle_heartbeat(vid, "NORMAL")
+            assert resp.get("removed")
+            # ... until the explicit rejoin lands on this peer
+            entry.cluster.handle_join({"id": vid, "uri": vid})
+            assert vid not in entry.cluster._removed
+            assert entry.cluster.breakers.state(vid) == "closed", \
+                "rejoin must reset stale breaker history"
+            assert vid in entry.cluster.alive_ids()
+            # routable: for a shard the rejoined node owns, it is the
+            # router's pick once its co-owners are excluded (whether it
+            # is any shard's FIRST choice is placement luck — exclusion
+            # pins the property deterministically)
+            shard = next(s for s in range(64)
+                         if vid in entry.cluster.shard_owners("i", s))
+            others = {s.cluster.node_id for s in c.servers} - {vid}
+            groups = entry.cluster.group_shards_by_node(
+                "i", (shard,), exclude=others)
+            assert groups == {vid: (shard,)}, \
+                "rejoined node must be routable"
+
+
+class TestFanoutTeardown:
+    def test_no_thread_leak_with_abandoned_legs(self, tmp_path):
+        """After a leg raises (and with hedging multiplying in-flight
+        legs), the fan-out pool must cancel queued futures and release
+        every worker — repeated queries must not accumulate threads."""
+        import threading
+        import time
+
+        from pilosa_tpu import fault
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(3, str(tmp_path), replicas=1,
+                         hedge_after=0.05) as c:
+            spread_bits(c.client(0))
+            entry = c.servers[0]
+            peers = [s.cluster.node_id for s in c.servers[1:]]
+            # one shard per node so BOTH peers are guaranteed a leg
+            # (placement is hash-driven over random ports)
+            shard_of = {}
+            for s in range(64):
+                ((n, _),) = entry.cluster.group_shards_by_node(
+                    "i", (s,)).items()
+                shard_of.setdefault(n, s)
+                if len(shard_of) == 3:
+                    break
+            assert set(peers) <= set(shard_of), "a peer owns nothing"
+            qs = ",".join(str(s) for s in sorted(shard_of.values()))
+            try:
+                # one leg always dies (no replica: the query fails),
+                # the other straggles — its abandoned future must not
+                # pin a thread beyond its sleep
+                fault.set_fault("dist.fanout", "error",
+                                match={"peer": peers[0]})
+                fault.set_fault("dist.fanout", "delay",
+                                match={"peer": peers[1]},
+                                args={"seconds": 0.1})
+                for _ in range(3):  # warmup (lazy pools, keepalives)
+                    with pytest.raises(ClientError):
+                        c.client(0)._do(
+                            "POST", f"/index/i/query?shards={qs}",
+                            b"Count(Row(f=1))")
+                time.sleep(0.5)
+                baseline = threading.active_count()
+                for _ in range(12):
+                    with pytest.raises(ClientError):
+                        c.client(0)._do(
+                            "POST", f"/index/i/query?shards={qs}",
+                            b"Count(Row(f=1))")
+            finally:
+                fault.clear()
+            time.sleep(1.0)  # stragglers drain, pool threads exit
+            leaked = threading.active_count() - baseline
+            assert leaked <= 2, \
+                f"{leaked} threads leaked across 12 failed fan-outs"
+
+
+class TestShardUniverseReplicaBound:
+    def test_one_dead_peer_with_replicas_stays_complete(self, tmp_path):
+        """replicas=2: one unreachable peer cannot hide shards (every
+        shard has another holder that was polled), so strict reads keep
+        serving instead of refusing until the suspect horizon."""
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=2.0) as c:
+            spread_bits(c.client(0))
+            survivor = c.servers[0]
+            victim = c.servers[1]
+            want = survivor.cluster.index_shards("i", strict=True)
+            victim.close()
+            # pre-horizon: the victim is still in alive_ids, its shard
+            # list unreadable — the union over the other replica is
+            # still the full universe
+            assert victim.cluster.node_id in survivor.cluster.alive_ids()
+            survivor.cluster._shard_cache.clear()
+            got = survivor.cluster.index_shards("i", strict=True)
+            assert got == want
+
+    def test_suspect_member_counts_toward_the_bound(self, tmp_path):
+        """A dead owner PAST the suspect horizon is never polled — it
+        must still count as failed, or one transient fetch failure on
+        its co-replica would declare the universe complete while both
+        holders of a shard went unheard (review r11)."""
+        import time
+
+        from pilosa_tpu import fault
+        from pilosa_tpu.cluster.cluster import SUSPECT_AFTER
+
+        with run_cluster(3, str(tmp_path), replicas=2,
+                         heartbeat=5.0) as c:
+            spread_bits(c.client(0))
+            survivor, victim, other = c.servers
+            cl = survivor.cluster
+            victim.close()
+            horizon = SUSPECT_AFTER * cl.cfg.heartbeat_interval
+            with cl._lock:
+                cl._last_seen[victim.cluster.node_id] = \
+                    time.monotonic() - horizon
+            assert victim.cluster.node_id not in cl.alive_ids()
+            try:
+                fault.set_fault(
+                    "client.send", "partition",
+                    match={"peer": other.cluster.node_id,
+                           "path": "/internal/shards"})
+                cl._shard_cache.clear()
+                with pytest.raises(RuntimeError, match="incomplete"):
+                    cl.index_shards("i", strict=True)
+            finally:
+                fault.clear()
+            # with the co-replica reachable again the universe is
+            # complete (one dead peer < replicas)
+            cl._shard_cache.clear()
+            assert cl.index_shards("i", strict=True)
+
+    def test_replicas1_still_strict(self, tmp_path):
+        """replicas=1: an unreadable peer CAN hold exclusive shards —
+        the strict universe must refuse exactly as before."""
+        with run_cluster(2, str(tmp_path), replicas=1,
+                         heartbeat=2.0) as c:
+            spread_bits(c.client(0))
+            survivor, victim = c.servers
+            victim.close()
+            assert victim.cluster.node_id in survivor.cluster.alive_ids()
+            survivor.cluster._shard_cache.clear()
+            with pytest.raises(RuntimeError, match="incomplete"):
+                survivor.cluster.index_shards("i", strict=True)
