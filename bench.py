@@ -74,6 +74,14 @@ FIELD = "f"
 # the 2.4x-class slides that motivated the guard)
 REGRESSION_RATIO = 0.8
 
+# the product path must serve at the raw-kernel ceiling: a full-scale
+# round whose product/raw ratio falls under this lands in the
+# `regressions` list (the r05 slide was 0.41 and went unremarked for a
+# round — never again).  Toy-scale smoke runs skip the check: per-query
+# fixed host costs dominate there and the ratio measures nothing.
+PRODUCT_RAW_RATIO_FLOOR = 0.95
+FULL_SCALE_SHARDS = 64  # below this the run is a smoke/toy override
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -120,6 +128,31 @@ def regression_guard(metric: str, value: float) -> list[dict]:
         return []
     log(f"regression guard: no prior round carries {metric!r}; skipped")
     return []
+
+
+def ratio_guard(prod_qps: float | None, raw_qps: float | None,
+                n_shards: int | None = None) -> list[dict]:
+    """Product/raw ratio regression entry (empty list when healthy).
+
+    Flags any FULL-SCALE round serving under ``PRODUCT_RAW_RATIO_FLOOR``
+    of the raw-kernel ceiling at the same concurrency; toy-scale smoke
+    rounds (shards < FULL_SCALE_SHARDS) and rounds missing either tier
+    return clean — absence of a measurement is reported elsewhere, not
+    as a ratio regression."""
+    n_shards = N_SHARDS if n_shards is None else n_shards
+    if (prod_qps is None or not raw_qps
+            or n_shards < FULL_SCALE_SHARDS):
+        return []
+    ratio = prod_qps / raw_qps
+    if ratio >= PRODUCT_RAW_RATIO_FLOOR:
+        return []
+    log(f"REGRESSION: product/raw ratio {ratio:.2f} is under the "
+        f"{PRODUCT_RAW_RATIO_FLOOR} floor (product {prod_qps:,.1f} qps "
+        f"vs raw {raw_qps:,.1f} qps)")
+    return [{"metric": "product_raw_ratio", "value": round(ratio, 3),
+             "floor": PRODUCT_RAW_RATIO_FLOOR,
+             "product_qps": round(prod_qps, 2),
+             "raw_qps": round(raw_qps, 2)}]
 
 
 def cpu_counts(plane: np.ndarray) -> np.ndarray:
@@ -527,7 +560,10 @@ def _measure() -> None:
         "value": round(headline, 2),
         "unit": "qps",
         "vs_baseline": round(headline / cpu_qps, 3),
-        "regressions": regression_guard(full_metric, headline),
+        # two independent guards: headline vs the newest same-metric
+        # round, and the product/raw ratio vs its floor (full scale)
+        "regressions": (regression_guard(full_metric, headline)
+                        + ratio_guard(prod_qps, raw_qps)),
     }))
 
 

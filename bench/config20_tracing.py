@@ -178,6 +178,39 @@ def main() -> None:
         qps_on = measure(api_on, want, "on", check_trace=True)
 
         top = SWEEP[-1]
+        # the r05 pin (ISSUE 7): the SERVING DEFAULT — tracing
+        # infrastructure on, sample rate 0.01, production slow
+        # threshold — must hold >=0.95x of tracing-off.  r05 fell to
+        # 0.41 exactly here: the default config materialized a span
+        # tree per query regardless of the retention decision.
+        # Interleaved best-of-5 bursts at the widest level filter
+        # scheduler noise; the smoke bar is noise-adjusted (toy-scale
+        # CPU bursts wander ±5%, and the r05 class measures ~0.5 at
+        # toy scale — 0.85 still catches it decisively) while full
+        # runs hold the 0.95 acceptance bar.
+        default_bar = 0.85 if SMOKE else 0.95
+        api_default = API(holder, executor, trace_sample_rate=0.01,
+                          slow_query_threshold=1.0)
+
+        def one(api_):
+            def call():
+                if api_.query(INDEX, pql)["results"] != want:
+                    raise AssertionError("default-tier count mismatch")
+            return burst(call, top, ITERS * 3, N_ROWS)
+
+        runs_off, runs_def = [], []
+        for _ in range(5):
+            runs_off.append(one(api_off))
+            runs_def.append(one(api_default))
+        best_off = max(runs_off)
+        best_def = max(runs_def)
+        default_ratio = best_def / best_off
+        log(f"default-config tracing ratio at {top} clients: "
+            f"{default_ratio:.3f} (default {best_def:,.1f} qps / off "
+            f"{best_off:,.1f} qps; bar {default_bar})")
+        assert default_ratio >= default_bar, \
+            (f"default tracing config serves {default_ratio:.2f}x of "
+             f"tracing-off; the r05-regression pin is {default_bar}x")
         overhead = 1.0 - qps_on[top] / qps_off[top]
         sampled = sum(stats.snapshot()["counters"]
                       .get("trace_sampled_total", {}).values())
@@ -188,8 +221,12 @@ def main() -> None:
             f"{sampled} traces retained)")
         if SMOKE:
             # toy scale: per-query fixed costs dominate and run-to-run
-            # noise exceeds the 3% bar — bound catastrophe only
-            assert overhead < 0.5, \
+            # noise exceeds the 3% bar — bound catastrophe only.  The
+            # r12 lite path widened the honest gap here (the off tier
+            # no longer builds trees at all while rate=1.0 builds one
+            # per query), so the catastrophe bound is 0.7; the real
+            # r05-class pin is default_ratio below
+            assert overhead < 0.7, \
                 f"smoke tracing overhead {overhead:.2%} is pathological"
         else:
             assert overhead < MAX_OVERHEAD, \
@@ -207,6 +244,8 @@ def main() -> None:
                                for k, v in qps_off.items()},
                    "qps_on": {str(k): round(v, 1)
                               for k, v in qps_on.items()},
+                   "default_ratio": round(default_ratio, 3),
+                   "default_ratio_bar": default_bar,
                    "sampled_traces": sampled}}))
 
 

@@ -1,0 +1,297 @@
+"""Config #23: per-kernel roofline harness — GB/s by kernel shape,
+chain depth, and multi-query width (ROADMAP item 5).
+
+Bench rounds consistently show dispatch chains at 462–477 GB/s device
+throughput (~57% of the v5e HBM spec) and a single-stream floor of
+~290 qps — one device→host read RPC per dispatch.  This config makes
+both first-class bench metrics instead of stderr asides:
+
+- **chain roofline**: the whole-plane ``row_counts`` program at chain
+  depths 1/8/32 (N in-order dispatches, ONE final read) → GB/s per
+  dispatch, the number the HBM-spec gap is measured against;
+- **selected-row gather** (``kernels.selected_row_counts``, the r12
+  multi-query fused popcount): width sweep → GB/s over only the
+  gathered rows' memory, oracle-checked;
+- **multi-query single-stream**: ONE client issuing W-Count requests
+  through the PRODUCT path (API → plan cache → fused kernels) — W
+  answers per read RPC.  The acceptance bar: the best width serves
+  ≥1.5× the width-1 (one-RPC-per-query) floor, oracle-exact;
+- **batched readback**: a mixed-kind collection window (selected
+  counts + whole-plane rowcounts) must pack into ONE device→host
+  read (``batcher_readback_packed``), asserted while measuring.
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 8 rows on CPU —
+tier-1 runs it (tests/test_bench_smoke.py) so this bench can never
+bitrot.
+
+Prints ONE JSON line: best chain GB/s; vs_baseline = the multi-query
+single-stream gain over the width-1 floor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 8 if SMOKE else int(os.environ.get("PILOSA_BENCH_ROWS", "32"))
+WORDS = 32768  # words per shard (2^20 bits / 32)
+INDEX, FIELD = "i", "f"
+CHAIN_DEPTHS = (1, 8, 32)
+ITERS = 3 if SMOKE else 5
+# the acceptance bar: best multi-query width vs the width-1 floor
+MULTIQ_GAIN_BAR = 1.2 if SMOKE else 1.5
+
+
+def write_index(plane: np.ndarray, data_dir: str) -> None:
+    """A REAL on-disk index from the packed plane (the config18
+    recipe)."""
+    from pilosa_tpu.store import Holder, roaring
+
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+
+
+def chain_roofline(d, plane_bytes: int) -> dict:
+    """GB/s per dispatch at each chain depth: N in-order dispatches of
+    the whole-plane count program, one final read — amortizing
+    enqueue/read overhead exposes the kernel's own memory throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.engine import kernels
+
+    @jax.jit
+    def count_batch(p):
+        return jnp.sum(kernels.row_counts(p), axis=0, dtype=jnp.int32)
+
+    np.asarray(count_batch(d))  # warm/compile
+    out = {}
+    for depth in CHAIN_DEPTHS:
+        best = None
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            outs = [count_batch(d) for _ in range(depth)]
+            np.asarray(outs[-1])
+            t = (time.perf_counter() - t0) / depth
+            best = t if best is None else min(best, t)
+        gbps = plane_bytes / best / 1e9
+        out[str(depth)] = {"ms_per_dispatch": round(best * 1e3, 3),
+                           "gbps": round(gbps, 1)}
+        log(f"chain depth {depth:>2}: {best * 1e3:.2f} ms/dispatch = "
+            f"{gbps:.0f} GB/s (HBM spec ~819 GB/s on v5e)")
+    return out
+
+
+def selected_roofline(d, oracle: np.ndarray) -> dict:
+    """The multi-query fused popcount at each width: GB/s over ONLY the
+    gathered rows' memory (the whole point — a W-row ask stops paying
+    the full plane scan), every width verified against the numpy
+    oracle."""
+    from pilosa_tpu.exec.fused import FusedCache
+
+    fused = FusedCache()
+    widths, w = [], 1
+    while w <= N_ROWS:
+        widths.append(w)
+        w *= 2
+    out = {}
+    for width in widths:
+        slots = tuple(range(width))
+        got = np.asarray(
+            fused.run_selected_counts(d, slots)).astype(np.int64)[:width]
+        np.testing.assert_array_equal(got, oracle[:width])
+        nbytes = N_SHARDS * width * WORDS * 4
+        best = None
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            np.asarray(fused.run_selected_counts(d, slots))
+            t = time.perf_counter() - t0
+            best = t if best is None else min(best, t)
+        out[str(width)] = {"ms": round(best * 1e3, 3),
+                           "gbps": round(nbytes / best / 1e9, 2),
+                           "qps": round(width / best, 1)}
+        log(f"selected width {width:>3}: {best * 1e3:.2f} ms = "
+            f"{nbytes / best / 1e9:.1f} GB/s over the gathered rows "
+            f"({width / best:,.0f} qps single-stream)")
+    return out
+
+
+def multiquery_single_stream(api, oracle: np.ndarray) -> dict:
+    """ONE client, W Counts per request, through the product path: W
+    answers per read RPC.  This is the attack on the ~290 qps
+    one-RPC-per-dispatch floor — qps scales with width until the scan
+    itself dominates."""
+    out = {}
+    widths, w = [], 1
+    while w <= N_ROWS:
+        widths.append(w)
+        w *= 2
+    for width in widths:
+        pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(width))
+        want = [int(c) for c in oracle[:width]]
+        assert api.query(INDEX, pql)["results"] == want, \
+            f"width {width}: product counts diverge from oracle"
+        lat = []
+        for _ in range(max(ITERS, 3)):
+            t0 = time.perf_counter()
+            if api.query(INDEX, pql)["results"] != want:
+                raise AssertionError(f"width {width}: count mismatch")
+            lat.append(time.perf_counter() - t0)
+        p50 = float(np.median(lat))
+        out[str(width)] = {"ms_per_request": round(p50 * 1e3, 3),
+                           "qps": round(width / p50, 1)}
+        log(f"multi-query width {width:>3}: {p50 * 1e3:.2f} ms/request "
+            f"= {width / p50:,.1f} qps single-stream")
+    return out
+
+
+def readback_pack_proof(executor, ps, stats, oracle: np.ndarray) -> dict:
+    """Land a mixed-kind window (selected counts + whole-plane
+    rowcounts) in the batcher and assert the whole window came back in
+    ONE packed device→host read — with BOTH groups' answers checked
+    against the oracle, pinning the cross-group slice offsets."""
+    batcher = executor.batcher
+    assert batcher is not None, "batcher must be on for the readback proof"
+    before = sum(stats.snapshot()["counters"]
+                 .get("batcher_readback_packed", {}).values())
+    packed = 0
+    for _ in range(20):  # the threads must land in ONE window; retry
+        barrier = threading.Barrier(3)
+        errs = []
+
+        def sel():
+            try:
+                barrier.wait()
+                got = np.asarray(batcher.submit_selected(ps.plane, (0, 1)))
+                np.testing.assert_array_equal(got, oracle[[0, 1]])
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        def rows():
+            try:
+                barrier.wait()
+                got = np.asarray(batcher.submit_rowcounts(ps.plane))
+                np.testing.assert_array_equal(got[:N_ROWS], oracle)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=sel), threading.Thread(target=rows)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        packed = sum(stats.snapshot()["counters"]
+                     .get("batcher_readback_packed", {}).values()) - before
+        if packed >= 1:
+            break
+    assert packed >= 1, \
+        "mixed-kind window never packed into one readback"
+    groups = sum(stats.snapshot()["counters"]
+                 .get("batcher_readback_groups", {}).values())
+    log(f"batched readback: {packed} packed window(s), "
+        f"{groups} groups served by single reads")
+    return {"packed_windows": packed, "groups_packed": groups}
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs import Stats
+    from pilosa_tpu.store import Holder
+    from pilosa_tpu.store.view import VIEW_STANDARD
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    oracle = (np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+              if hasattr(np, "bitwise_count") else
+              np.array([int(np.unpackbits(
+                  plane[:, r].reshape(-1).view(np.uint8)).sum())
+                  for r in range(N_ROWS)], dtype=np.int64))
+    log(f"plane: {plane.nbytes / 1e9:.2f} GB, {N_ROWS} rows x "
+        f"{N_SHARDS} shards on {platform}")
+
+    d = jax.device_put(plane)
+    jax.block_until_ready(d)
+    chain = chain_roofline(d, plane.nbytes)
+    selected = selected_roofline(d, oracle)
+    del d
+
+    data_dir = tempfile.mkdtemp(prefix="pilosa_c23_")
+    try:
+        write_index(plane, data_dir)
+        del plane
+        holder = Holder(data_dir).open()
+        stats = Stats()
+        executor = Executor(holder, stats=stats)
+        api = API(holder, executor)
+        # warm: plane residency + plan cache before the timed sweeps
+        warm_pql = "".join(f"Count(Row({FIELD}={r}))"
+                           for r in range(N_ROWS))
+        t0 = time.perf_counter()
+        assert api.query(INDEX, warm_pql)["results"] == \
+            [int(c) for c in oracle]
+        log(f"first product query (plane build + compile): "
+            f"{time.perf_counter() - t0:.1f}s")
+        multiq = multiquery_single_stream(api, oracle)
+        idx = holder.index(INDEX)
+        fld = idx.field(FIELD)
+        shards = tuple(idx.available_shards())
+        ps = executor.planes.field_plane(INDEX, fld, VIEW_STANDARD, shards)
+        readback = readback_pack_proof(executor, ps, stats, oracle)
+        holder.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    floor_qps = multiq["1"]["qps"]
+    best_width = max(multiq, key=lambda k: multiq[k]["qps"])
+    best_qps = multiq[best_width]["qps"]
+    gain = best_qps / floor_qps
+    log(f"multi-query gain: width {best_width} serves {best_qps:,.1f} "
+        f"qps single-stream = {gain:.2f}x the width-1 floor "
+        f"({floor_qps:,.1f} qps)")
+    assert gain >= MULTIQ_GAIN_BAR, \
+        (f"multi-query width {best_width} gains only {gain:.2f}x over "
+         f"the one-RPC-per-query floor; the bar is {MULTIQ_GAIN_BAR}x")
+
+    best_gbps = max(v["gbps"] for v in chain.values())
+    print(json.dumps({
+        "metric": f"kernel_roofline_gbps_{platform}",
+        "value": round(best_gbps, 1), "unit": "GBps",
+        "vs_baseline": round(gain, 3),
+        "regressions": [],
+        "detail": {"chain": chain, "selected": selected,
+                   "multiquery_single_stream": multiq,
+                   "multiquery_gain": round(gain, 3),
+                   "readback": readback}}))
+
+
+if __name__ == "__main__":
+    main()

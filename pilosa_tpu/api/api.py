@@ -12,12 +12,18 @@ from __future__ import annotations
 
 import io
 import os
+import random
+import time as _time
 from datetime import datetime
 
 import numpy as np
 
 from pilosa_tpu.engine.words import SHARD_WIDTH
 from pilosa_tpu.exec import Executor, result_to_json
+from pilosa_tpu.exec.executor import (ExecutionError,
+                                      ExecutorSaturatedError,
+                                      QueryTimeoutError)
+from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.store import FieldOptions, Holder
 from pilosa_tpu.store.field import BSI_TYPES
 from pilosa_tpu.store.view import VIEW_STANDARD
@@ -66,6 +72,15 @@ def field_options_from_json(o: dict) -> FieldOptions:
 
 
 class API:
+    # span trees are materialized only for queries that can be
+    # retained: sampled, profiled, or slow-HUNTED — an operator who
+    # sets slow_query_threshold at/under this floor is asking for full
+    # trees on (nearly) every query and gets them; above it, slow
+    # captures carry the root + per-stage breakdown instead (the lite
+    # path never builds the tree, which is what restored the r05
+    # product/raw ratio)
+    SLOW_TRACE_FLOOR = 0.05
+
     def __init__(self, holder: Holder, executor: Executor | None = None,
                  cluster=None, query_timeout: float = 0.0,
                  trace_sample_rate: float = 0.01,
@@ -159,22 +174,23 @@ class API:
         caller could disable the operator's protection with
         ?timeout=0).
 
-        Tracing is always on: every query runs under a per-request
-        tracer (concurrent queries' spans never interleave) with one
-        node-tagged ``query`` root span; the REST edge surfaces its id
-        as ``X-Pilosa-Trace-Id``.  The tree is RETAINED in the process
-        finished-ring (``/internal/traces?trace_id=``) when the caller
-        profiled, the sampler picked it (``trace_sample_rate``), or it
-        came in over ``slow_query_threshold`` — slow queries
-        additionally land in the ``/debug/slow`` ring with their PQL."""
-        import random
-        import time as _time
+        Tracing identity is always on — every REST response carries
+        ``X-Pilosa-Trace-Id`` — but the retention decision is made
+        BEFORE any span materializes (r12 hot-path fix; this ordering
+        is what keeps the product path at the raw-kernel ceiling):
 
-        from pilosa_tpu.exec.executor import (ExecutionError,
-                                              ExecutorSaturatedError,
-                                              QueryTimeoutError)
-        from pilosa_tpu.obs import GLOBAL_TRACER, Tracer
-        from pilosa_tpu.pql.parser import ParseError
+        - sampled (``trace_sample_rate``), profiled, or slow-HUNTED
+          (``slow_query_threshold`` at/under :data:`SLOW_TRACE_FLOOR`)
+          queries run under a per-request tracer with a node-tagged
+          ``query`` root and the full span tree, RETAINED in the
+          process ring (``/internal/traces?trace_id=``);
+        - every other query runs under a :class:`LiteTracer`: a trace
+          id and per-stage marks, zero span objects — if such a query
+          still comes in over ``slow_query_threshold`` it lands in
+          ``/debug/slow`` with a root + ``stage.*`` breakdown (its
+          PQL, shards and duration intact; full executor trees need
+          sampling/profile/floor)."""
+        from pilosa_tpu.obs import GLOBAL_TRACER, LiteTracer, Tracer
         self._index(index)
         cap = self.query_timeout
         if timeout is None or timeout == 0:
@@ -184,59 +200,60 @@ class API:
         deadline = (_time.monotonic() + timeout) if timeout else None
         sampled = (self.trace_sample_rate > 0
                    and random.random() < self.trace_sample_rate)
+        # the materialization decision, ahead of ANY span allocation
+        trace = (profile or sampled
+                 or 0 < self.slow_query_threshold <= self.SLOW_TRACE_FLOOR)
+        stats = self.executor.stats
+        if not trace:
+            tracer = LiteTracer()
+            t0 = _time.perf_counter()
+            out, err = self._run_query(index, pql, shards, tracer,
+                                       deadline, timeout, t0)
+            duration = _time.perf_counter() - t0
+            if (self.slow_query_threshold > 0
+                    and duration >= self.slow_query_threshold):
+                # slow capture on the lite path: root + stage.*
+                # children reconstructed from the timer marks (rare by
+                # construction — the threshold is above the floor)
+                node = (self.cluster.node_id if self.cluster is not None
+                        else "local")
+                root = tracer.slow_root("query", duration, index=index,
+                                        node=node, liteTrace=True)
+                if err is not None:
+                    root.tags["error"] = str(err)
+                stats.count("slow_query_total", 1)
+                self.slow_log.record(self._slow_entry(
+                    index, pql, shards, duration, root, err))
+                GLOBAL_TRACER.record(root)
+            if err is not None:
+                raise err
+            out["traceId"] = tracer.trace_id
+            return out
         tracer = Tracer()
-        # the fan-out propagates this as the traceparent flags segment:
-        # peers of an unsampled query still trace (a slow coordinator
-        # trace needs their subtrees) but don't churn their own rings
+        # the fan-out propagates this as the traceparent flags
+        # segment: sampled/profiled queries send "01" (peers build +
+        # ship their subtree AND keep a ring copy); slow-hunted
+        # queries send "02" (build + ship — a slow capture needs the
+        # subtrees — but do NOT churn peer rings at serving rate);
+        # lite-path queries send "00" and peers skip trees entirely
         tracer.sampled = sampled or profile
         node = (self.cluster.node_id if self.cluster is not None
                 else "local")
-        err: ApiError | None = None
-        out: dict = {}
         t0 = _time.perf_counter()
         with tracer.span("query", index=index, node=node) as root:
-            try:
-                if self.cluster is not None:
-                    out = {"results": self.cluster.dist.execute_json(
-                        index, pql, shards=shards, tracer=tracer,
-                        deadline=deadline)}
-                else:
-                    results = self.executor.execute(index, pql,
-                                                    shards=shards,
-                                                    tracer=tracer,
-                                                    deadline=deadline)
-                    out = {"results": [result_to_json(r)
-                                       for r in results]}
-            except QueryTimeoutError as e:
-                # a deadline-exceeded query is its own failure class —
-                # never a generic 500, and distinct from client errors
-                err = ApiError.timeout(e, _time.perf_counter() - t0,
-                                       timeout)
-            except ExecutorSaturatedError as e:
-                # admission shedding (VERDICT advice #6): a saturated
-                # executor is overload, not a client mistake — 503 with
-                # a Retry-After hint, never a generic 500/400
-                err = ApiError(str(e), 503, retry_after=e.retry_after)
-            except (ParseError, ExecutionError) as e:
-                err = ApiError(str(e), 400)
+            out, err = self._run_query(index, pql, shards, tracer,
+                                       deadline, timeout, t0)
             if err is not None:
                 root.tags["error"] = str(err)
         duration = _time.perf_counter() - t0
         slow = (self.slow_query_threshold > 0
                 and duration >= self.slow_query_threshold)
-        stats = self.executor.stats
         if sampled:
             stats.count("trace_sampled_total", 1)
         if slow:
             stats.count("slow_query_total", 1)
-            self.slow_log.record({
-                "ts": _time.time(), "index": index,
-                "pql": pql if len(pql) <= 4096 else pql[:4096] + "…",
-                "shards": list(shards) if shards is not None else None,
-                "durationMs": round(duration * 1e3, 3),
-                "traceId": root.trace_id,
-                "error": str(err) if err is not None else None,
-                "profile": root.to_json()})
+            self.slow_log.record(self._slow_entry(
+                index, pql, shards, duration, root, err))
         if sampled or slow or profile:
             # publish into the process ring so the trace id resolves
             # via GET /internal/traces?trace_id= after the request
@@ -247,6 +264,44 @@ class API:
         if profile:
             out["profile"] = [s.to_json() for s in tracer.finished()]
         return out
+
+    def _run_query(self, index: str, pql: str, shards, tracer,
+                   deadline, timeout, t0) -> tuple[dict, ApiError | None]:
+        """Execute + error-classify (shared by the lite and traced
+        paths): returns (response dict, ApiError-or-None) — the caller
+        owns raise/capture ordering."""
+        try:
+            if self.cluster is not None:
+                return {"results": self.cluster.dist.execute_json(
+                    index, pql, shards=shards, tracer=tracer,
+                    deadline=deadline)}, None
+            results = self.executor.execute(index, pql, shards=shards,
+                                            tracer=tracer,
+                                            deadline=deadline)
+            return {"results": [result_to_json(r) for r in results]}, None
+        except QueryTimeoutError as e:
+            # a deadline-exceeded query is its own failure class —
+            # never a generic 500, and distinct from client errors
+            return {}, ApiError.timeout(e, _time.perf_counter() - t0,
+                                        timeout)
+        except ExecutorSaturatedError as e:
+            # admission shedding (VERDICT advice #6): a saturated
+            # executor is overload, not a client mistake — 503 with a
+            # Retry-After hint, never a generic 500/400
+            return {}, ApiError(str(e), 503, retry_after=e.retry_after)
+        except (ParseError, ExecutionError) as e:
+            return {}, ApiError(str(e), 400)
+
+    def _slow_entry(self, index: str, pql: str, shards, duration: float,
+                    root, err) -> dict:
+        return {
+            "ts": _time.time(), "index": index,
+            "pql": pql if len(pql) <= 4096 else pql[:4096] + "…",
+            "shards": list(shards) if shards is not None else None,
+            "durationMs": round(duration * 1e3, 3),
+            "traceId": root.trace_id,
+            "error": str(err) if err is not None else None,
+            "profile": root.to_json()}
 
     # -- imports ------------------------------------------------------------
 

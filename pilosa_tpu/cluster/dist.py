@@ -147,15 +147,22 @@ class DistributedExecutor:
         from contextlib import nullcontext
 
         from pilosa_tpu.exec.executor import QueryTimeoutError
+        from pilosa_tpu.obs import LiteTracer
         query = parse_cached(pql)
         out = []
         calls = query.calls
         self._tls.tracer = tracer
+        # lite-path queries build no spans, but a slow capture still
+        # needs per-call attribution: record plain (name, seconds)
+        # marks on the LiteTracer — the traced path gets the same data
+        # from its cluster.* spans, so marking there would double it
+        lite = isinstance(tracer, LiteTracer)
         try:
             i = 0
             while i < len(calls):
                 if deadline is not None and _time.monotonic() > deadline:
                     raise QueryTimeoutError("query timeout exceeded")
+                t_call = _time.perf_counter() if lite else 0.0
                 call = calls[i]
                 name = _call_of(call).name
                 # consecutive plain reads fan out as ONE multi-call
@@ -183,6 +190,13 @@ class DistributedExecutor:
                         else:
                             out.extend(self._read_group(
                                 index, batch, shards, deadline=deadline))
+                    if lite:
+                        # mirror the traced path's span naming: a
+                        # single-call batch is "cluster.<name>"
+                        tracer.stage(
+                            f"cluster.batch[{len(batch)}]"
+                            if len(batch) > 1 else "cluster." + name,
+                            _time.perf_counter() - t_call)
                     i = j
                     continue
                 span = (tracer.span("cluster." + name, index=index)
@@ -198,6 +212,9 @@ class DistributedExecutor:
                     else:
                         out.append(self._read(index, call, shards,
                                               deadline=deadline))
+                if lite:
+                    tracer.stage("cluster." + name,
+                                 _time.perf_counter() - t_call)
                 i += 1
         finally:
             self._tls.tracer = None
@@ -360,10 +377,16 @@ class DistributedExecutor:
         tracer = getattr(self._tls, "tracer", None)
         parent = tracer.current_span() if tracer is not None else None
         trace_headers = None
-        if parent is not None:
+        if tracer is not None:
+            # a LiteTracer has no open span but still injects its
+            # trace IDENTITY (flags "00"): peers neither invent fresh
+            # root spans nor churn their rings for a tree the
+            # coordinator will never materialize
             trace_headers = {}
             tracer.inject(trace_headers, span=parent,
                           sampled=getattr(tracer, "sampled", True))
+            if not trace_headers:
+                trace_headers = None
 
         def remote(node_id, node_shards, tags=None):
             if fault.ACTIVE:

@@ -123,14 +123,24 @@ def h_internal_query(self: Handler) -> None:
     if tp:
         from pilosa_tpu.obs import parse_traceparent
         parsed = parse_traceparent(tp)
-    if parsed is not None:
+    if parsed is not None and parsed[2] in ("01", "02"):
         from pilosa_tpu.obs import Tracer
+        # the coordinator materializes this trace: build the
+        # node-tagged subtree, ship it back for grafting.  "01"
+        # (sampled/profiled) also keeps a copy in THIS node's ring;
+        # "02" (slow-hunt) ships the subtree — a slow capture needs
+        # it — WITHOUT churning the local 128-slot ring for every
+        # query at serving rate
         tracer = Tracer()
-        # flags "01" = the coordinator will retain this trace
-        # (sampled/profiled): keep a copy in THIS node's ring too.
-        # "00" = trace and return the subtree (the coordinator may yet
-        # retain a SLOW trace) but don't churn the local ring for it.
         retain = parsed[2] == "01"
+    exec_tracer = tracer
+    if tracer is None and parsed is not None:
+        # flags "00" = the coordinator runs the LITE path and will
+        # never materialize a tree — building one here would be pure
+        # per-request waste on every fan-out leg (the r05 class of
+        # hot-path cost); serve under the allocation-free tracer
+        from pilosa_tpu.obs import NULL_TRACER
+        exec_tracer = NULL_TRACER
     node = (api.cluster.node_id if api.cluster is not None else "local")
     ctx = (tracer.extract(self.headers, "internal.query",
                           node=node, index=index)
@@ -140,7 +150,7 @@ def h_internal_query(self: Handler) -> None:
             results = api.executor.execute(index, pql, shards=shards,
                                            translate_output=False,
                                            deadline=deadline,
-                                           tracer=tracer)
+                                           tracer=exec_tracer)
     except QueryTimeoutError as e:
         # same structured 504 as the public edge: the coordinator maps
         # it back to QueryTimeoutError, and an operator curling a node

@@ -113,6 +113,26 @@ def row_counts(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.A
     return count(plane)
 
 
+def selected_row_counts(plane: jax.Array,
+                        row_idx: jax.Array) -> jax.Array:
+    """Popcounts of N SELECTED rows in one pass over only their memory.
+
+    plane: uint32[..., R, W]; row_idx: int32[N] -> int32[..., N].
+
+    The multi-query fused popcount (ROADMAP item 5): where
+    :func:`row_counts` scans every row of the plane to answer any
+    subset, this gathers exactly the requested rows — one memory pass
+    over ``N/R`` of the plane, N accumulators — so a batch of Counts
+    touching a small fraction of a wide plane stops paying the whole
+    plane's bandwidth.  ``row_idx`` is a traced operand: one compiled
+    program serves any row selection of the same width.  Duplicate
+    indices are fine (each answers independently); indices must be in
+    range (callers resolve through the plane's slot map first).
+    """
+    sel = jnp.take(plane, row_idx, axis=-2)
+    return count(sel)
+
+
 def top_n(counts: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
     """(values, row_ids) of the n largest counts (reference: two-phase
     ``executeTopN`` merge, SURVEY.md §4.3 — exact by construction here).

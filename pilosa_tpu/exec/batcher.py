@@ -25,6 +25,16 @@ r6 changes (the concurrency-gap work, ISSUE 1):
   N concurrent requests over the SAME resident plane share one
   program and one read instead of stacking N copies of a multi-GB
   popcount), and Distinct presence scans (deduplicated likewise).
+
+r12 changes (the roofline work, ISSUE 7):
+
+- **selected-row counts** (``submit_selected``): the multi-query fused
+  popcount — concurrent requests' row slots union into ONE gather +
+  popcount pass over just those rows' memory;
+- **batched readback**: every one-program kind dispatches async and
+  the window's outputs pack into ONE device array read with ONE
+  device->host transfer — the window pays the per-read RPC floor once
+  total, not once per kind/shape group.
 """
 
 from __future__ import annotations
@@ -42,8 +52,10 @@ class _Pending:
 
     def __init__(self, kind, nodes, leaves):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
-        #                       | "distinct"
-        self.nodes = nodes    # count: tuple of plan trees; others: None
+        #                       | "selcounts" | "distinct"
+        self.nodes = nodes    # count: tuple of plan trees;
+        #                       selcounts: tuple of plane row slots;
+        #                       others: None
         self.leaves = leaves  # count: plan leaves; others: plane[, filter]
         self.event = threading.Event()
         self.result = None
@@ -154,6 +166,15 @@ class CountBatcher:
         leaves = (plane,) if filter_words is None else (plane, filter_words)
         return self._enqueue(_Pending("rowcounts", None, leaves))
 
+    def submit_selected(self, plane, slots: tuple) -> np.ndarray:
+        """Selected-row Counts (the multi-query fused popcount): the
+        window's items over the SAME resident plane merge into one
+        row-gather + popcount program — one pass over the UNION of
+        requested rows, N accumulators — and the per-item answers come
+        back int64[len(slots)] in the caller's slot order.  Duplicate
+        slots across concurrent requests are computed once."""
+        return self._submit(_Pending("selcounts", tuple(slots), (plane,)))
+
     def submit_distinct(self, plane, filter_words):
         """BSI Distinct presence: host (pos bool[2^d], neg bool[2^d]).
         Coalescing here is DEDUPLICATION only — the presence scan is a
@@ -192,72 +213,191 @@ class CountBatcher:
             # stacked outputs need uniform shapes: group by kind + the
             # output-shaping leaf dimension (counts: n_shards — mixed
             # row/plane leaf ranks fuse fine, only the int32[S] outputs
-            # must stack; aggregates/rowcounts: the full plane shape)
+            # must stack; aggregates/rowcounts: the full plane shape;
+            # selcounts: the plane IDENTITY — one gather per plane)
             groups: dict[tuple, list[_Pending]] = {}
             for p in batch:
                 if p.kind == "count":
                     key = ("count", p.leaves[0].shape[0])
+                elif p.kind == "selcounts":
+                    key = ("selcounts", id(p.leaves[0]))
                 else:
                     key = (p.kind, p.leaves[0].shape)
                 groups.setdefault(key, []).append(p)
-            # one program per group, but dispatch groups CONCURRENTLY:
-            # transports that overlap reads across threads (the axon
-            # tunnel does) pay one read floor for the window, not one
-            # per kind
-            items = list(groups.items())
-            if len(items) == 1:
-                self._run_one(*items[0])
-            else:
-                list(self._group_pool().map(
-                    lambda kv: self._run_one(*kv), items))
+            # BATCHED READBACK (r12): every one-program kind dispatches
+            # asynchronously, then the whole window's outputs are
+            # packed into ONE device array and read with ONE
+            # device->host transfer — on transports with a fixed
+            # per-read RPC floor, the window now pays that floor once
+            # total, not once per kind/shape group.  Distinct stays on
+            # the pool: its presence scan is a multi-dispatch host
+            # loop that cannot join a single readback.
+            pending = []
+            distinct_futs = []
+            program_groups = []
+            for key, group in groups.items():
+                if key[0] == "distinct":
+                    distinct_futs.append(self._group_pool().submit(
+                        self._run_distinct, group))
+                else:
+                    program_groups.append((key, group))
+            if len(program_groups) == 1:
+                # the common (and solo-path) case skips the pool
+                # round-trip: one group, dispatch inline
+                key, group = program_groups[0]
+                try:
+                    pending.append((key, group)
+                                   + self._dispatch_one(key, group))
+                except Exception:  # noqa: BLE001 — per-item fallback
+                    self._run_fallback(key, group)
+            elif program_groups:
+                # dispatch groups CONCURRENTLY (a first-time compile
+                # in one group must not stall the others' warm
+                # dispatches), then join for the window's single
+                # packed readback
+                futs = [(key, group, self._group_pool().submit(
+                    self._dispatch_one, key, group))
+                    for key, group in program_groups]
+                for key, group, fut in futs:
+                    try:
+                        pending.append((key, group) + fut.result())
+                    except Exception:  # noqa: BLE001 — per-item fallback
+                        self._run_fallback(key, group)
+            self._readback(pending)
+            for f in distinct_futs:
+                f.result()
 
-    def _run_one(self, key, group):
+    def _dispatch_one(self, key, group):
+        """Build + enqueue one group's fused program; returns
+        ``(device_out, finish)`` with the device->host read deferred to
+        the window's single packed readback.  Raises on dispatch
+        failure (the caller falls back per item)."""
         if key[0] == "count":
-            self._run_counts(group)
-        elif key[0] == "rowcounts":
-            self._run_rowcounts(group)
-        elif key[0] == "distinct":
-            self._run_distinct(group)
-        else:
-            self._run_aggs(key[0], group)
+            return self._dispatch_counts(group)
+        if key[0] == "rowcounts":
+            return self._dispatch_rowcounts(group)
+        if key[0] == "selcounts":
+            return self._dispatch_selcounts(group)
+        return self._dispatch_aggs(key[0], group)
 
-    def _run_counts(self, group: list[_Pending]) -> None:
-        from pilosa_tpu.exec.fused import shift_leaves
+    def _run_fallback(self, key, group):
+        if key[0] == "count":
+            self._fallback_counts(group)
+        elif key[0] == "rowcounts":
+            self._fallback_rowcounts(group)
+        elif key[0] == "selcounts":
+            self._fallback_selcounts(group)
+        else:
+            self._fallback_aggs(key[0], group)
+
+    def _readback(self, pending: list) -> None:
+        """One device->host transfer for the whole collection window:
+        pack every group's int32 output into a single flat array, read
+        it once, slice per group.  A single-group window reads its
+        output directly (the pack would only add a dispatch); any pack
+        or finish failure degrades to per-group reads, then to the
+        per-item fallbacks."""
+        if not pending:
+            return
+        if len(pending) == 1:
+            key, group, out, finish = pending[0]
+            try:
+                finish(np.asarray(out))
+            except Exception:  # noqa: BLE001 — per-item fallback
+                self._run_fallback(key, group)
+            return
+        # canonical pack order: groups arrive in batch order, so the
+        # same kinds in a different order would otherwise compile a
+        # fresh concatenate program per PERMUTATION of shapes —
+        # churning the shared program LRU for zero benefit
+        pending.sort(key=lambda item: (item[0][0], str(item[2].shape)))
         try:
-            all_nodes, all_leaves, spans = [], [], []
-            for p in group:
-                start = len(all_nodes)
-                for node in p.nodes:
-                    all_nodes.append(shift_leaves(node, len(all_leaves)))
-                all_leaves.extend(p.leaves)
-                spans.append((start, len(all_nodes)))
-            # pad the NODE count to a pow2 bucket by repeating node 0
-            # (already leaf-shifted) — without it, every distinct batch
-            # size compiles a fresh program and the compiles land on
-            # serving latency (measured: 32 concurrent HTTP clients
-            # collapsed to ~23 qps from the recompile storm)
-            n = len(all_nodes)
-            bucket = 1
-            while bucket < n:
-                bucket *= 2
-            all_nodes.extend([all_nodes[0]] * (bucket - n))
-            per_shard = self.fused.run_count_batch(
-                tuple(all_nodes), tuple(all_leaves))
-            host = np.asarray(per_shard).astype(np.int64)
+            packed = np.asarray(self.fused.run_readback_pack(
+                tuple(out for _, _, out, _ in pending)))
+            self.stats.count("batcher_readback_packed", 1)
+            self.stats.count("batcher_readback_groups", len(pending))
+        except Exception:  # noqa: BLE001 — per-group reads
+            packed = None
+        off = 0
+        for key, group, out, finish in pending:
+            try:
+                if packed is None:
+                    host = np.asarray(out)
+                else:
+                    size = int(np.prod(out.shape, dtype=np.int64))
+                    host = packed[off:off + size].reshape(out.shape)
+                    off += size
+                finish(host)
+            except Exception:  # noqa: BLE001 — per-item fallback
+                self._run_fallback(key, group)
+
+    def _dispatch_counts(self, group: list[_Pending]):
+        from pilosa_tpu.exec.fused import pow2_bucket, shift_leaves
+        all_nodes, all_leaves, spans = [], [], []
+        for p in group:
+            start = len(all_nodes)
+            for node in p.nodes:
+                all_nodes.append(shift_leaves(node, len(all_leaves)))
+            all_leaves.extend(p.leaves)
+            spans.append((start, len(all_nodes)))
+        # pad the NODE count to a pow2 bucket by repeating node 0
+        # (already leaf-shifted; see fused.pow2_bucket)
+        n = len(all_nodes)
+        all_nodes.extend([all_nodes[0]] * (pow2_bucket(n) - n))
+        per_shard = self.fused.run_count_batch(
+            tuple(all_nodes), tuple(all_leaves))
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
             for p, (a, b) in zip(group, spans):
                 p.result = [int(row.sum()) for row in host[a:b]]
                 p.event.set()
-        except Exception:  # noqa: BLE001 — per-item fallback
+        return per_shard, finish
+
+    def _fallback_counts(self, group: list[_Pending]) -> None:
+        for p in group:
+            try:
+                p.result = [
+                    int(kernels.shard_totals(
+                        self.fused.run(node, p.leaves, "count")))
+                    for node in p.nodes]
+            except Exception as e2:  # noqa: BLE001
+                p.error = e2
+            finally:
+                p.event.set()
+
+    def _dispatch_selcounts(self, group: list[_Pending]):
+        """The window's selected-row Counts over one plane: gather the
+        UNION of every item's requested slots once (N concurrent
+        requests over overlapping rows pay one pass over the union,
+        the multi-query analogue of the rowcounts dedup), popcount,
+        reduce shards on device."""
+        plane = group[0].leaves[0]
+        pos: dict[int, int] = {}
+        for p in group:
+            for s in p.nodes:
+                if s not in pos:
+                    pos[s] = len(pos)
+        out = self.fused.run_selected_counts(plane, tuple(pos))
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
             for p in group:
-                try:
-                    p.result = [
-                        int(kernels.shard_totals(
-                            self.fused.run(node, p.leaves, "count")))
-                        for node in p.nodes]
-                except Exception as e2:  # noqa: BLE001
-                    p.error = e2
-                finally:
-                    p.event.set()
+                p.result = host[[pos[s] for s in p.nodes]]
+                p.event.set()
+        return out, finish
+
+    def _fallback_selcounts(self, group: list[_Pending]) -> None:
+        import jax.numpy as jnp
+        for p in group:
+            try:
+                idx = jnp.asarray(p.nodes, dtype=jnp.int32)
+                p.result = kernels.shard_totals(
+                    kernels.selected_row_counts(p.leaves[0], idx))
+            except Exception as e2:  # noqa: BLE001
+                p.error = e2
+            finally:
+                p.event.set()
 
     @staticmethod
     def _dedupe(group: list[_Pending]):
@@ -276,7 +416,8 @@ class CountBatcher:
             assign.append(slot)
         return items, assign
 
-    def _run_rowcounts(self, group: list[_Pending]) -> None:
+    def _dispatch_rowcounts(self, group: list[_Pending]):
+        from pilosa_tpu.exec.fused import pow2_bucket
         items, assign = self._dedupe(group)
         # canonical flag order + pow2 pad (repeating item 0): bounded
         # program set per plane shape, like the aggregate batches
@@ -284,30 +425,29 @@ class CountBatcher:
         items = [items[i] for i in order]
         back = {old: new for new, old in enumerate(order)}
         assign = [back[a] for a in assign]
-        n = len(items)
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
-        padded = items + [items[0]] * (bucket - n)
+        padded = items + [items[0]] * (pow2_bucket(len(items))
+                                       - len(items))
         flags = tuple(len(p.leaves) == 2 for p in padded)
         leaves = tuple(a for p in padded for a in p.leaves)
-        try:
-            out = np.asarray(
-                self.fused.run_rowcounts_batch(flags, leaves)
-            ).astype(np.int64)
+        out = self.fused.run_rowcounts_batch(flags, leaves)
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
             for p, slot in zip(group, assign):
-                p.result = out[slot]
+                p.result = host[slot]
                 p.event.set()
-        except Exception:  # noqa: BLE001 — per-item fallback
-            for p in group:
-                try:
-                    flt = p.leaves[1] if len(p.leaves) == 2 else None
-                    p.result = kernels.shard_totals(
-                        kernels.row_counts(p.leaves[0], flt))
-                except Exception as e2:  # noqa: BLE001
-                    p.error = e2
-                finally:
-                    p.event.set()
+        return out, finish
+
+    def _fallback_rowcounts(self, group: list[_Pending]) -> None:
+        for p in group:
+            try:
+                flt = p.leaves[1] if len(p.leaves) == 2 else None
+                p.result = kernels.shard_totals(
+                    kernels.row_counts(p.leaves[0], flt))
+            except Exception as e2:  # noqa: BLE001
+                p.error = e2
+            finally:
+                p.event.set()
 
     def _run_distinct(self, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik
@@ -348,42 +488,40 @@ class CountBatcher:
                 p.result = results[slot]
             p.event.set()
 
-    def _run_aggs(self, kind: str, group: list[_Pending]) -> None:
+    def _dispatch_aggs(self, kind: str, group: list[_Pending]):
         from pilosa_tpu.engine import bsi as bsik
-        # pad the batch to a pow2 bucket (repeating item 0) so the
-        # program set stays bounded per (kind, shape): otherwise every
-        # distinct batch SIZE would compile a fresh program, and the
-        # compiles land on serving latency
+        from pilosa_tpu.exec.fused import pow2_bucket
+        # pad the batch to a pow2 bucket (repeating item 0; see
+        # fused.pow2_bucket) so the program set stays bounded per
+        # (kind, shape)
         group.sort(key=lambda p: len(p.leaves))  # canonical flag order:
         # program variants per bucket stay O(bucket), not O(2^bucket)
-        n = len(group)
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
-        pad = [group[0]] * (bucket - n)
+        pad = [group[0]] * (pow2_bucket(len(group)) - len(group))
         flags = tuple(len(p.leaves) == 2 for p in group + pad)
         all_leaves = tuple(a for p in group + pad for a in p.leaves)
-        try:
-            if kind == "sum":
-                out = np.asarray(self.fused.run_sum_batch(flags, all_leaves))
-                for k, p in enumerate(group):
-                    p.result = bsik.decode_sum_packed(out[k])
-                    p.event.set()
-            else:
-                out = np.asarray(
-                    self.fused.run_minmax_batch(flags, all_leaves))
-                for k, p in enumerate(group):
-                    p.result = bsik.decode_minmax_packed(out[k])
-                    p.event.set()
-        except Exception:  # noqa: BLE001 — per-item fallback
-            for p in group:
-                try:
-                    flt = p.leaves[1] if len(p.leaves) == 2 else None
-                    if kind == "sum":
-                        p.result = bsik.sum_count(p.leaves[0], flt)
-                    else:
-                        p.result = bsik.min_max(p.leaves[0], flt)
-                except Exception as e2:  # noqa: BLE001
-                    p.error = e2
-                finally:
-                    p.event.set()
+        if kind == "sum":
+            out = self.fused.run_sum_batch(flags, all_leaves)
+            decode = bsik.decode_sum_packed
+        else:
+            out = self.fused.run_minmax_batch(flags, all_leaves)
+            decode = bsik.decode_minmax_packed
+
+        def finish(host: np.ndarray) -> None:
+            for k, p in enumerate(group):
+                p.result = decode(host[k])
+                p.event.set()
+        return out, finish
+
+    def _fallback_aggs(self, kind: str, group: list[_Pending]) -> None:
+        from pilosa_tpu.engine import bsi as bsik
+        for p in group:
+            try:
+                flt = p.leaves[1] if len(p.leaves) == 2 else None
+                if kind == "sum":
+                    p.result = bsik.sum_count(p.leaves[0], flt)
+                else:
+                    p.result = bsik.min_max(p.leaves[0], flt)
+            except Exception as e2:  # noqa: BLE001
+                p.error = e2
+            finally:
+                p.event.set()

@@ -565,16 +565,62 @@ class Executor:
                                             VIEW_STANDARD, ctx.shards)
         if ps is None:
             return None
-        totals = self._plane_totals(
-            ps, getattr(self._tls, "stage_timer", None))
-        out = []
-        for rid in row_ids:
-            slot = (ps.slot_of.get(int(rid)) if rid is not None else None)
-            out.append(int(totals[slot]) if slot is not None else 0)
-        return out
+        return self._plane_count_rows(
+            ps, row_ids, getattr(self._tls, "stage_timer", None))
 
     # int32 cross-shard reduce stays exact while n_shards·2^20 < 2^31
     _REDUCE_SHARD_MAX = (1 << 31) // SHARD_WIDTH - 1
+
+    # selected-row gather beats the whole-plane scan when the request
+    # touches at most this fraction of the (padded) row axis: the
+    # gather's memory traffic is n_sel/R_pad of the plane, but it
+    # cannot dedupe as aggressively as identical whole-plane items
+    # (which collapse to ONE scan per window), so the cutover is
+    # conservative
+    _SELECTED_ROWS_FRACTION = 4  # use gather when n_sel * 4 <= R_pad
+
+    def _plane_count_rows(self, ps, row_ids, timer=None) -> list[int]:
+        """Per-call totals for resolved ``row_ids`` (None = absent row
+        -> 0) over a resident plane, choosing between the two
+        multi-query fused kernels:
+
+        - **selected-row gather** (r12): when the request touches a
+          small fraction of a wide plane, one pass over just those
+          rows' memory — N answers per gather, coalesced across
+          concurrent requests by slot-union in the batcher;
+        - **whole-plane row_counts**: otherwise — identical concurrent
+          requests dedupe to ONE scan per window, the headline serving
+          spine."""
+        slots = [ps.slot_of.get(int(r)) if r is not None else None
+                 for r in row_ids]
+        live = list(dict.fromkeys(s for s in slots if s is not None))
+        r_pad = ps.plane.shape[-2]
+        if (live and len(ps.shards) <= self._REDUCE_SHARD_MAX
+                and len(live) * self._SELECTED_ROWS_FRACTION <= r_pad):
+            by_slot = self._plane_selected_totals(ps, tuple(live), timer)
+            return [int(by_slot[s]) if s is not None else 0
+                    for s in slots]
+        totals = self._plane_totals(ps, timer)
+        return [int(totals[s]) if s is not None else 0 for s in slots]
+
+    def _plane_selected_totals(self, ps, slots: tuple,
+                               timer=None) -> dict:
+        """slot -> int64 total for the selected plane rows: one
+        row-gather + popcount program, shard axis reduced on device
+        (callers gate on ``_REDUCE_SHARD_MAX``), coalesced across
+        concurrent requests via the batcher."""
+        if self.batcher is not None:
+            vals = self.batcher.submit_selected(ps.plane, slots)
+            if timer is not None:
+                timer.mark("read")  # coalesced wait: window+dispatch+read
+        else:
+            out = self.fused.run_selected_counts(ps.plane, slots)
+            if timer is not None:
+                timer.mark("dispatch")
+            vals = np.asarray(out).astype(np.int64)[:len(slots)]
+            if timer is not None:
+                timer.mark("read")
+        return dict(zip(slots, (int(v) for v in vals)))
 
     def _plane_totals(self, ps, timer=None) -> np.ndarray:
         """Whole-plane per-row totals int64[R_pad]: one program + one
@@ -963,11 +1009,7 @@ class Executor:
                 return None
             if timer is not None:
                 timer.mark("plan")
-            totals = self._plane_totals(ps, timer)
-            out = []
-            for rid in entry.row_ids:
-                slot = (ps.slot_of.get(rid) if rid is not None else None)
-                out.append(int(totals[slot]) if slot is not None else 0)
+            out = self._plane_count_rows(ps, entry.row_ids, timer)
             if timer is not None:
                 timer.mark("assemble")
             return out

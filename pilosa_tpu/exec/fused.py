@@ -37,6 +37,19 @@ class Unfusable(Exception):
     """Raised by planners for shapes the fused path doesn't cover."""
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= max(n, 1).  Batch widths pad to pow2
+    buckets (repeating element 0) so the compiled-program set stays
+    bounded per shape — without it every distinct batch size compiles
+    a fresh program and the compiles land on serving latency
+    (measured: a recompile storm collapsed 32 concurrent HTTP clients
+    to ~23 qps)."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
 def _build(node, leaves):
     kind = node[0]
     if kind == "leaf":
@@ -214,6 +227,44 @@ class FusedCache:
             return program
         return self._cached(
             (flags, leaves[0].shape, "rowcounts-batch"), build)(*leaves)
+
+    def run_selected_counts(self, plane, slots) -> jax.Array:
+        """N selected-row Counts over one resident plane in ONE
+        program: gather the requested rows, popcount, reduce the shard
+        axis on device -> int32[N] (callers gate on the int32-exact
+        shard bound, like :meth:`run_rowcounts_batch`).  ``slots`` are
+        plane row indices (already slot-resolved); the width pads to a
+        pow2 bucket by repeating slot 0 so the program set stays
+        bounded per (plane shape, width bucket) — the slot VALUES are
+        a traced int32 operand, so any row selection of the same width
+        bucket reuses one executable.  Returns the device array
+        un-read: the batcher packs it into the window's single
+        readback."""
+        bucket = pow2_bucket(len(slots))
+        padded = tuple(slots) + (slots[0],) * (bucket - len(slots))
+        idx = jnp.asarray(padded, dtype=jnp.int32)
+
+        def build():
+            def program(p, ix):
+                return jnp.sum(kernels.selected_row_counts(p, ix),
+                               axis=0, dtype=jnp.int32)
+            return program
+        key = (("selcounts", plane.shape, bucket), "count")
+        return self._cached(key, build)(plane, idx)
+
+    def run_readback_pack(self, arrays: tuple) -> jax.Array:
+        """Concatenate the flattened int32 outputs of a collection
+        window's programs into ONE device array — the whole window
+        then costs a single device->host read instead of one per
+        kind/shape group (on transports with a fixed per-read RPC
+        floor, the read count IS the serving floor; BASELINE.md)."""
+        shapes = tuple(a.shape for a in arrays)
+
+        def build():
+            def program(*xs):
+                return jnp.concatenate([x.reshape(-1) for x in xs])
+            return program
+        return self._cached((shapes, "readback-pack"), build)(*arrays)
 
     def run_sum_batch(self, flags: tuple, leaves):
         """K BSI Sum items (same bit depth) in ONE program.  ``flags[k]``

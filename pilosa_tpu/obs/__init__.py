@@ -4,9 +4,12 @@ logging."""
 from pilosa_tpu.obs.logging import get_logger
 from pilosa_tpu.obs.metrics import (NopStats, StageTimer, Stats,
                                     StatsdStats)
-from pilosa_tpu.obs.tracing import (GLOBAL_TRACER, SlowQueryLog, Tracer,
+from pilosa_tpu.obs.tracing import (GLOBAL_TRACER, NULL_TRACER,
+                                    LiteTracer, NullTracer, SlowQueryLog,
+                                    Tracer, fast_span_id, fast_trace_id,
                                     parse_traceparent)
 
 __all__ = ["Stats", "NopStats", "StageTimer", "StatsdStats",
            "get_logger", "Tracer", "GLOBAL_TRACER", "SlowQueryLog",
-           "parse_traceparent"]
+           "LiteTracer", "NullTracer", "NULL_TRACER",
+           "fast_trace_id", "fast_span_id", "parse_traceparent"]

@@ -23,6 +23,7 @@ Slow queries land in :class:`SlowQueryLog` — a bounded ring of
 
 from __future__ import annotations
 
+import random
 import secrets
 import threading
 import time
@@ -31,6 +32,29 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field as dc_field
 
 TRACEPARENT = "Traceparent"  # traceparent: 00-<trace_id>-<span_id>-01
+
+
+# span/trace ids need uniqueness, not cryptographic strength — the
+# original secrets.token_hex path cost one urandom syscall per id
+# (~7 ids/query measured ~126us/query, the single largest slice of the
+# r05 product-path regression).  Per-thread PRNGs seeded from urandom
+# once keep ids unique across threads without sharing generator state.
+_id_tls = threading.local()
+
+
+def _id_rng() -> random.Random:
+    r = getattr(_id_tls, "rng", None)
+    if r is None:
+        r = _id_tls.rng = random.Random(secrets.token_bytes(16))
+    return r
+
+
+def fast_trace_id() -> str:
+    return f"{_id_rng().getrandbits(64):016x}"
+
+
+def fast_span_id() -> str:
+    return f"{_id_rng().getrandbits(32):08x}"
 
 
 _HEX = frozenset("0123456789abcdefABCDEF")
@@ -47,9 +71,13 @@ def parse_traceparent(value: str | None) \
     by character set, NOT ``int(x, 16)`` — the int parser's literal
     quirks (underscores, signs, whitespace) are not hex ids.
 
-    ``flags`` carries the coordinator's retain decision ("01" =
-    sampled/profiled: the peer keeps its subtree in its own ring too;
-    anything else = trace but don't retain locally)."""
+    ``flags`` carries the coordinator's materialize + retain
+    decisions: "01" = sampled/profiled — build the subtree, ship it
+    back, AND keep a copy in the local ring; "02" = slow-hunt — build
+    and ship the subtree but do NOT churn the local ring; "00" (or
+    anything else) = the coordinator runs the lite path and will never
+    materialize a tree — serve under NULL_TRACER, build nothing, ship
+    nothing."""
     if not value:
         return None
     parts = value.split("-")
@@ -63,7 +91,7 @@ def parse_traceparent(value: str | None) \
     return trace_id, span_id, flags
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
     name: str
     trace_id: str
@@ -113,8 +141,8 @@ class Tracer:
         parent = stack[-1] if stack else None
         s = Span(
             name=name,
-            trace_id=parent.trace_id if parent else secrets.token_hex(8),
-            span_id=secrets.token_hex(4),
+            trace_id=parent.trace_id if parent else fast_trace_id(),
+            span_id=fast_span_id(),
             parent_id=parent.span_id if parent else None,
             start=time.perf_counter(),
             tags=tags,
@@ -140,7 +168,7 @@ class Tracer:
             return
         parent.children.append(Span(
             name=name, trace_id=parent.trace_id,
-            span_id=secrets.token_hex(4), parent_id=parent.span_id,
+            span_id=fast_span_id(), parent_id=parent.span_id,
             duration=duration, tags=tags))
 
     # -- cross-node propagation (reference: handler extract / client inject)
@@ -150,14 +178,20 @@ class Tracer:
         """Write the active trace identity into ``headers``.  ``span``
         overrides the thread-local stack — fan-out legs run on pool
         threads where the coordinator's stack is not visible, so the
-        dispatching thread captures its span first.  ``sampled=False``
-        sets the flags segment to "00": the peer still traces and
-        returns its subtree (the coordinator may yet retain a SLOW
-        trace), but must not churn its own finished ring for a trace
-        that is 99%-likely discarded."""
+        dispatching thread captures its span first.
+
+        The flags segment carries TWO decisions to the peer:
+        ``sampled=True`` -> "01" (materialize the subtree, ship it
+        back, AND keep a copy in your own finished ring);
+        ``sampled=False`` -> "02" (materialize and ship — the
+        coordinator may yet capture a SLOW trace that needs your
+        subtree — but do NOT churn your ring for a trace that is
+        99%-likely discarded).  Lite-path queries never reach this
+        method; :class:`LiteTracer` injects flags "00" (build
+        nothing)."""
         s = span if span is not None else self.current_span()
         if s is not None:
-            flags = "01" if sampled else "00"
+            flags = "01" if sampled else "02"
             headers[TRACEPARENT] = f"00-{s.trace_id}-{s.span_id}-{flags}"
 
     @contextmanager
@@ -197,6 +231,99 @@ class Tracer:
     def finished(self) -> list[Span]:
         with self._lock:
             return list(self._finished)
+
+
+class _NullSpanCtx:
+    """Reusable no-op context manager: the span surface of the lite
+    path.  ``__enter__`` yields None — every ``with tracer.span(...)``
+    on the serving path uses the span positionally (no ``as``) or
+    tolerates None."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullSpanCtx()
+
+
+class NullTracer:
+    """Tracer surface with zero per-call allocation — what a peer runs
+    under when the coordinator's traceparent flags say the trace will
+    never be materialized (``00``).  No spans, no ids, no ring."""
+
+    __slots__ = ()
+    sampled = False
+
+    def span(self, name, **tags):
+        return _NULL_CTX
+
+    def stage(self, name, duration, **tags):
+        pass
+
+    def current_span(self):
+        return None
+
+    def inject(self, headers, span=None, sampled=False):
+        pass
+
+    def finished(self):
+        return []
+
+    def record(self, span):
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+class LiteTracer(NullTracer):
+    """Trace IDENTITY without a span tree (r12 hot-path fix).
+
+    The retention decision (sampling / profile / slow-hunt threshold)
+    is made BEFORE any span materializes; queries that lose it run
+    under this: a per-request trace id for the ``X-Pilosa-Trace-Id``
+    header and cross-node propagation (flags ``00`` — peers run under
+    :data:`NULL_TRACER`), plus a plain ``marks`` list the StageTimer
+    appends (name, seconds) tuples into, so a query that turns out SLOW
+    can still be captured with its per-stage breakdown.  Everything
+    else — span objects, id generation per span, ring churn — is
+    skipped entirely."""
+
+    __slots__ = ("trace_id", "marks")
+
+    def __init__(self, trace_id: str | None = None):
+        self.trace_id = trace_id or fast_trace_id()
+        self.marks: list[tuple[str, float]] = []
+
+    def stage(self, name, duration, **tags):
+        self.marks.append((name, duration))
+
+    def inject(self, headers, span=None, sampled=False):
+        # propagate identity so peers neither invent a fresh root nor
+        # churn their rings; flags "00" = the tree is never built
+        headers[TRACEPARENT] = f"00-{self.trace_id}-00000000-00"
+
+    def slow_root(self, name: str, duration: float, **tags) -> Span:
+        """Materialize a minimal root for slow-query capture AFTER the
+        fact: the request's stage marks become ``stage.*`` children.
+        This is the degraded (but still attributable) tree an
+        unsampled slow query gets; full executor-span trees need the
+        query to be sampled, profiled, or the slow threshold set at or
+        under the API's ``SLOW_TRACE_FLOOR``."""
+        root = Span(name=name, trace_id=self.trace_id,
+                    span_id=fast_span_id(), parent_id=None,
+                    duration=duration, tags=tags)
+        for mark, dur in self.marks:
+            root.children.append(Span(
+                name=mark, trace_id=self.trace_id,
+                span_id=fast_span_id(), parent_id=root.span_id,
+                duration=dur))
+        return root
 
 
 class SlowQueryLog:

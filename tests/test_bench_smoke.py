@@ -76,6 +76,66 @@ def test_regression_guard_flags_and_clears(tmp_path, monkeypatch):
                                   1.0) == []
 
 
+def test_product_raw_ratio_guard():
+    """ISSUE 7 satellite: any full-scale round serving under 0.95x of
+    the raw-kernel ceiling lands in the `regressions` list; toy-scale
+    smoke rounds and rounds missing a tier stay clean."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # the r05 shape: product 2263 vs raw 5472 at full scale -> flagged
+    flagged = bench.ratio_guard(2263.0, 5472.0, n_shards=954)
+    assert len(flagged) == 1
+    assert flagged[0]["metric"] == "product_raw_ratio"
+    assert flagged[0]["value"] == 0.414
+    assert flagged[0]["floor"] == bench.PRODUCT_RAW_RATIO_FLOOR == 0.95
+    # healthy full-scale round: clean
+    assert bench.ratio_guard(5460.0, 5472.0, n_shards=954) == []
+    # boundary: exactly at the floor is clean
+    assert bench.ratio_guard(950.0, 1000.0, n_shards=954) == []
+    # toy-scale smoke (env-overridden shards): never judged
+    assert bench.ratio_guard(1.0, 1000.0, n_shards=2) == []
+    # a missing tier is reported elsewhere, not as a ratio regression
+    assert bench.ratio_guard(None, 5472.0, n_shards=954) == []
+    assert bench.ratio_guard(100.0, None, n_shards=954) == []
+
+
+def test_config23_roofline_smoke():
+    """bench/config23 (per-kernel roofline: chain GB/s, selected-row
+    gather widths, multi-query single-stream sweep, batched-readback
+    proof) in --smoke mode: tiny plane, CPU — runs under tier-1 so the
+    bench can never bitrot.  The multi-query gain bar and the
+    one-packed-read property are asserted INSIDE the bench while
+    measuring."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config23_roofline.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("kernel_roofline_gbps")
+    assert out["unit"] == "GBps" and out["value"] > 0
+    detail = out["detail"]
+    # GB/s per kernel shape is a first-class metric now
+    assert set(detail["chain"]) == {"1", "8", "32"}
+    assert all(v["gbps"] > 0 for v in detail["chain"].values())
+    assert all(v["gbps"] > 0 for v in detail["selected"].values())
+    # the multi-query width sweep demonstrates the single-stream gain
+    assert detail["multiquery_gain"] >= 1.2
+    assert out["vs_baseline"] == detail["multiquery_gain"]
+    # the whole mixed-kind window came back in one packed read
+    assert detail["readback"]["packed_windows"] >= 1
+    assert detail["readback"]["groups_packed"] >= 2
+
+
 def test_config18_concurrency_gap_smoke():
     """bench/config18 (the product/raw concurrency-gap attribution
     bench) in --smoke mode: tiny plane, CPU, sweep 1/2/4 — runs under
@@ -122,6 +182,13 @@ def test_config20_tracing_smoke():
     assert set(out["detail"]["qps_off"]) == {"1", "2", "4"}
     assert set(out["detail"]["qps_on"]) == {"1", "2", "4"}
     assert out["detail"]["sampled_traces"] > 0
+    # the r05 pin, asserted inside the bench while measuring: the
+    # serving DEFAULT (tracing infrastructure on, rate 0.01) holds
+    # >=0.95x of tracing-off at full scale (smoke bar noise-adjusted
+    # to 0.85; the r05 class measures ~0.5 at toy scale, so it still
+    # cannot silently return)
+    assert out["detail"]["default_ratio"] >= \
+        out["detail"]["default_ratio_bar"] == 0.85
 
 
 def test_config21_plane_build_smoke():

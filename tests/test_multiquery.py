@@ -1,0 +1,262 @@
+"""Multi-query fused popcount + batched readback (ISSUE 7 tentpole b).
+
+The selected-row gather kernel answers N row-Counts in one pass over
+only the requested rows' memory; the batcher unions slots across
+concurrent requests and packs a whole collection window's outputs into
+ONE device→host read.  Everything here is pinned oracle-exact against
+numpy — at mixed widths, under 32-way concurrency, and with the
+batcher window forced to 0 (the solo path must be unchanged)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine import kernels
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.store import Holder
+
+WORDS = SHARD_WIDTH // 32
+
+
+def _np_row_counts(plane: np.ndarray) -> np.ndarray:
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+    return np.array([int(np.unpackbits(
+        plane[:, r].reshape(-1).view(np.uint8)).sum())
+        for r in range(plane.shape[1])], dtype=np.int64)
+
+
+class TestSelectedRowCountsKernel:
+    """kernels.selected_row_counts vs numpy at mixed widths."""
+
+    @pytest.mark.parametrize("width", [1, 2, 3, 5, 8])
+    def test_oracle_exact_mixed_widths(self, width):
+        rng = np.random.default_rng(7 + width)
+        plane = rng.integers(0, 1 << 32, size=(3, 8, 64),
+                             dtype=np.uint32)
+        oracle = (np.bitwise_count(plane).astype(np.int64)
+                  .sum(axis=2)) if hasattr(np, "bitwise_count") else None
+        rows = rng.integers(0, 8, size=width)
+        got = np.asarray(kernels.selected_row_counts(
+            jnp.asarray(plane), jnp.asarray(rows, dtype=jnp.int32)))
+        assert got.shape == (3, width)
+        for k, r in enumerate(rows):
+            want = (oracle[:, r] if oracle is not None else np.array(
+                [int(np.unpackbits(plane[s, r].view(np.uint8)).sum())
+                 for s in range(3)], dtype=np.int64))
+            np.testing.assert_array_equal(got[:, k].astype(np.int64),
+                                          want)
+
+    def test_duplicate_rows_answer_independently(self):
+        rng = np.random.default_rng(11)
+        plane = rng.integers(0, 1 << 32, size=(2, 4, 16),
+                             dtype=np.uint32)
+        got = np.asarray(kernels.selected_row_counts(
+            jnp.asarray(plane), jnp.asarray([2, 2, 0], dtype=jnp.int32)))
+        np.testing.assert_array_equal(got[:, 0], got[:, 1])
+
+    def test_fused_program_pads_and_slices(self):
+        """run_selected_counts pads the width to a pow2 bucket; the
+        leading len(slots) entries are the answers, shard-reduced."""
+        from pilosa_tpu.exec.fused import FusedCache
+        rng = np.random.default_rng(13)
+        plane = rng.integers(0, 1 << 32, size=(2, 8, 16),
+                             dtype=np.uint32)
+        want = (np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+                if hasattr(np, "bitwise_count") else
+                np.array([int(np.unpackbits(
+                    plane[:, r].reshape(-1).view(np.uint8)).sum())
+                    for r in range(8)], dtype=np.int64))
+        fused = FusedCache()
+        d = jnp.asarray(plane)
+        for slots in [(0,), (3, 1, 6), (7, 7, 0, 2, 5)]:
+            out = np.asarray(fused.run_selected_counts(d, slots))
+            assert len(out) >= len(slots)  # pow2-padded
+            np.testing.assert_array_equal(
+                out[:len(slots)].astype(np.int64),
+                np.array([want[s] for s in slots]))
+
+
+@pytest.fixture
+def wide_index(tmp_path):
+    """A 2-shard, 16-row field served through a real Holder — wide
+    enough that small asks take the selected-row gather (n*4 <= R_pad)
+    while full-width asks keep the whole-plane scan."""
+    from pilosa_tpu.store import roaring
+    import os
+
+    n_shards, n_rows = 2, 16
+    rng = np.random.default_rng(23)
+    plane = rng.integers(0, 1 << 32, size=(n_shards, n_rows, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("f")
+    h.close()
+    frag_dir = os.path.join(str(tmp_path), "i", "f", "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(n_shards):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+    holder = Holder(str(tmp_path)).open()
+    yield holder, _np_row_counts(plane), n_rows
+    holder.close()
+
+
+def _pql(rows) -> str:
+    return "".join(f"Count(Row(f={r}))" for r in rows)
+
+
+class TestExecutorSelectedPath:
+    def test_mixed_widths_oracle_exact(self, wide_index):
+        holder, oracle, n_rows = wide_index
+        ex = Executor(holder, stats=Stats())
+        for rows in ([3], [0, 5], [2, 9, 11], [7, 7, 1],
+                     list(range(n_rows))):
+            got = ex.execute("i", _pql(rows))
+            assert got == [int(oracle[r]) for r in rows], rows
+
+    def test_window_zero_solo_path_unchanged(self, wide_index):
+        """count_batch_window=0 disables the batcher entirely; the
+        selected path must serve directly (one program, no worker
+        thread) and stay oracle-exact."""
+        holder, oracle, n_rows = wide_index
+        ex = Executor(holder, stats=Stats(), count_batch_window=0)
+        assert ex.batcher is None
+        for rows in ([4], [1, 13], list(range(n_rows))):
+            got = ex.execute("i", _pql(rows))
+            assert got == [int(oracle[r]) for r in rows], rows
+
+    def test_missing_row_answers_zero(self, wide_index):
+        holder, oracle, _ = wide_index
+        ex = Executor(holder, stats=Stats())
+        got = ex.execute("i", "Count(Row(f=3))Count(Row(f=999))")
+        assert got == [int(oracle[3]), 0]
+
+    def test_32_way_concurrent_mixed_widths(self, wide_index):
+        """32 concurrent clients, each a different row subset (mixed
+        widths → selected AND whole-plane kernels coalescing in the
+        same windows), every answer oracle-exact."""
+        holder, oracle, n_rows = wide_index
+        ex = Executor(holder, stats=Stats(), max_concurrent=32)
+        rng = np.random.default_rng(31)
+        asks = []
+        for i in range(32):
+            width = int(rng.integers(1, n_rows + 1))
+            asks.append([int(r) for r in
+                         rng.integers(0, n_rows, size=width)])
+        ex.execute("i", _pql(asks[0]))  # warm the plane
+        errors: list = []
+        barrier = threading.Barrier(32)
+
+        def worker(rows):
+            try:
+                barrier.wait()
+                for _ in range(3):
+                    got = ex.execute("i", _pql(rows))
+                    want = [int(oracle[r]) for r in rows]
+                    if got != want:
+                        raise AssertionError(f"{rows}: {got} != {want}")
+            except Exception as e:  # noqa: BLE001 — surface after join
+                errors.append(repr(e))
+
+        ts = [threading.Thread(target=worker, args=(a,)) for a in asks]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors[:3]
+
+
+class TestBatchedReadback:
+    def test_mixed_kind_window_packs_to_one_read(self, wide_index):
+        """A collection window holding selected counts AND whole-plane
+        rowcounts must come back through ONE packed device→host read,
+        with every item's answer unchanged."""
+        from pilosa_tpu.store.view import VIEW_STANDARD
+
+        holder, oracle, n_rows = wide_index
+        stats = Stats()
+        # fixed wide window so the threads reliably land together
+        ex = Executor(holder, stats=stats, count_batch_window=0.05)
+        idx = holder.index("i")
+        fld = idx.field("f")
+        shards = tuple(idx.available_shards())
+        ps = ex.planes.field_plane("i", fld, VIEW_STANDARD, shards)
+        results: dict = {}
+        errors: list = []
+        barrier = threading.Barrier(2)
+
+        def sel():
+            try:
+                barrier.wait()
+                results["sel"] = ex.batcher.submit_selected(
+                    ps.plane, (2, 5))
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def rows():
+            try:
+                barrier.wait()
+                results["rows"] = ex.batcher.submit_rowcounts(ps.plane)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        packed = 0
+        for _ in range(20):  # both must land in ONE window; retry
+            before = sum(stats.snapshot()["counters"]
+                         .get("batcher_readback_packed", {}).values())
+            ts = [threading.Thread(target=sel),
+                  threading.Thread(target=rows)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            packed = sum(stats.snapshot()["counters"]
+                         .get("batcher_readback_packed", {}).values()) \
+                - before
+            if packed:
+                break
+        assert packed >= 1, "mixed-kind window never packed"
+        np.testing.assert_array_equal(
+            np.asarray(results["sel"]),
+            np.array([oracle[2], oracle[5]]))
+        np.testing.assert_array_equal(
+            np.asarray(results["rows"])[:n_rows], oracle)
+
+    def test_selected_slot_union_dedupes(self, wide_index):
+        """Concurrent selected items over overlapping rows of the same
+        plane share one gather: both answers exact, one program run."""
+        from pilosa_tpu.store.view import VIEW_STANDARD
+
+        holder, oracle, _ = wide_index
+        stats = Stats()
+        ex = Executor(holder, stats=stats, count_batch_window=0.05)
+        idx = holder.index("i")
+        fld = idx.field("f")
+        ps = ex.planes.field_plane("i", fld, VIEW_STANDARD,
+                                   tuple(idx.available_shards()))
+        out: dict = {}
+        barrier = threading.Barrier(2)
+
+        def ask(name, slots):
+            barrier.wait()
+            out[name] = ex.batcher.submit_selected(ps.plane, slots)
+
+        ts = [threading.Thread(target=ask, args=("a", (1, 4, 6))),
+              threading.Thread(target=ask, args=("b", (6, 4, 9)))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        np.testing.assert_array_equal(
+            np.asarray(out["a"]), oracle[[1, 4, 6]])
+        np.testing.assert_array_equal(
+            np.asarray(out["b"]), oracle[[6, 4, 9]])

@@ -211,6 +211,97 @@ class TestSlowQueryCapture:
         h.close()
 
 
+class TestLiteTracePath:
+    """ISSUE 7 satellite: the retention decision (sampling / profile /
+    slow-hunt floor) is made BEFORE any span materializes — an
+    unsampled, unprofiled query must never build a span tree, while
+    keeping its X-Pilosa-Trace-Id and slow-query capture."""
+
+    @pytest.fixture
+    def api_holder(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        api = API(holder, trace_sample_rate=0.0,
+                  slow_query_threshold=0.0)
+        yield api, holder
+        holder.close()
+
+    def _seed(self, api):
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Set(1, f=1)")
+
+    def test_unsampled_query_builds_no_spans(self, api_holder,
+                                             monkeypatch):
+        """Pin the structural fix: Tracer.span (the tree builder) is
+        never entered for an unsampled, unprofiled query — but the
+        response still carries a trace id."""
+        import pilosa_tpu.obs.tracing as tr
+        api, _ = api_holder
+        self._seed(api)
+        calls = []
+        orig = tr.Tracer.span
+
+        def counting(self, name, **tags):
+            calls.append(name)
+            return orig(self, name, **tags)
+
+        monkeypatch.setattr(tr.Tracer, "span", counting)
+        out = api.query("i", "Count(Row(f=1))")
+        assert out["results"] == [1] and out["traceId"]
+        assert calls == [], f"unsampled query materialized: {calls}"
+        # the SAME query profiled builds the full tree
+        out = api.query("i", "Count(Row(f=1))", profile=True)
+        assert any(n == "query" for n in calls)
+        assert any(n.startswith("executor.") for n in calls)
+        spans = list(walk(out["profile"][0]))
+        assert any(s["name"].startswith("stage.") for s in spans)
+
+    def test_slow_hunt_threshold_materializes_full_trees(self,
+                                                         api_holder):
+        """slow_query_threshold at/under SLOW_TRACE_FLOOR = the
+        operator is slow-hunting: full executor trees on capture (the
+        pre-r12 slow-capture contract, unchanged)."""
+        api, _ = api_holder
+        self._seed(api)
+        api.slow_query_threshold = 1e-9
+        assert api.slow_query_threshold <= api.SLOW_TRACE_FLOOR
+        api.query("i", "Count(Row(f=1))")
+        entry = api.slow_log.entries()[0]
+        spans = list(walk(entry["profile"]))
+        assert any(s["name"].startswith("executor.") for s in spans)
+
+    def test_lite_slow_capture_has_stage_breakdown(self, api_holder):
+        """A slow query on the LITE path (threshold above the floor)
+        is still captured — PQL, duration, trace id, and a root with
+        the per-stage breakdown — and its id resolves in the ring;
+        only the per-call executor spans are absent (they were never
+        built)."""
+        from pilosa_tpu.obs import GLOBAL_TRACER
+        api, _ = api_holder
+        self._seed(api)
+        api.slow_query_threshold = 1e-9
+        api.SLOW_TRACE_FLOOR = 0.0  # instance override: stay lite
+        out = api.query("i", "Count(Row(f=1))")
+        entry = api.slow_log.entries()[0]
+        assert entry["pql"] == "Count(Row(f=1))"
+        assert entry["durationMs"] > 0
+        assert entry["traceId"] == out["traceId"]
+        root = entry["profile"]
+        assert root["tags"].get("liteTrace") is True
+        names = {s["name"] for s in walk(root)}
+        assert any(n.startswith("stage.") for n in names)
+        assert not any(n.startswith("executor.") for n in names)
+        assert any(s.trace_id == out["traceId"]
+                   for s in GLOBAL_TRACER.finished())
+
+    def test_lite_trace_id_unique_per_request(self, api_holder):
+        api, _ = api_holder
+        self._seed(api)
+        ids = {api.query("i", "Count(Row(f=1))")["traceId"]
+               for _ in range(16)}
+        assert len(ids) == 16
+
+
 class TestDistributedProfile:
     """Acceptance: a 3-node profile=true query returns a SINGLE span
     tree containing node-tagged spans from all 3 nodes, with per-stage
@@ -294,12 +385,14 @@ class TestDistributedProfile:
                        for t in got for s in walk(t))
 
     def test_unsampled_legs_do_not_churn_peer_ring(self, tmp_path):
-        """An unretained query (rate=0, no profile) still traces its
-        remote legs — a slow coordinator trace needs their subtrees —
-        but the traceparent flags carry the retain decision, so peers
-        must NOT record it into their own 128-slot ring (at serving
-        rates that churn would evict every trace an operator is
-        actually chasing)."""
+        """A lite-path query (rate=0, no profile, no slow-hunt
+        threshold) propagates its trace IDENTITY with flags "00":
+        peers build NO subtree and must NOT record anything into
+        their own 128-slot ring (at serving rates that churn would
+        evict every trace an operator is actually chasing).  Full
+        remote subtrees require the materialize decision — sampling,
+        profile, or a slow-hunt threshold at/under SLOW_TRACE_FLOOR,
+        which flips the flags to "01"."""
         with run_cluster(2, str(tmp_path), trace_sample_rate=0.0,
                          slow_query_threshold=0.0) as cl:
             c = cl.client(0)
@@ -313,12 +406,32 @@ class TestDistributedProfile:
                 assert cl.client(i)._json(
                     "GET",
                     f"/internal/traces?trace_id={tid}")["traces"] == []
-            # but a slow query DOES retain remote subtrees in its
-            # captured tree (the flags gate ring residency, not the
-            # subtree shipping)
+            # a slow-HUNT threshold (<= SLOW_TRACE_FLOOR) promotes
+            # queries to the materializing path with flags "02": slow
+            # captures carry the peers' remote subtrees, but peers
+            # STILL don't churn their rings — at serving rates that
+            # churn would evict the very traces being chased
             cl.servers[0].api.slow_query_threshold = 1e-9
             body, headers = _post_query(port, "Count(Row(f=1))")
             slow = c._json("GET", "/debug/slow")["slow"][0]
             peer_id = cl.servers[1].cluster.node_id
             assert any(s["tags"].get("node") == peer_id
                        for s in walk(slow["profile"]))
+            # the coordinator's slow retention legitimately records
+            # the "query" root (nodes share one in-process ring here);
+            # what must NOT appear is a peer-side "internal.query"
+            # continuation root — that's what flags "01" would have
+            # ring-retained and "02" must not
+            got = cl.client(1)._json(
+                "GET",
+                f"/internal/traces?trace_id={slow['traceId']}")["traces"]
+            assert not any(t["name"] == "internal.query" for t in got)
+            # lite-path queries on a CLUSTER still accumulate per-call
+            # marks (dist records them on the LiteTracer), so a lite
+            # slow capture has a breakdown even when the coordinator
+            # owns no shards
+            from pilosa_tpu.obs import LiteTracer
+            lt = LiteTracer()
+            cl.servers[0].cluster.dist.execute_json(
+                "i", "Count(Row(f=1))", tracer=lt)
+            assert any(n.startswith("cluster.") for n, _ in lt.marks)
