@@ -22,7 +22,8 @@ from pilosa_tpu.engine.words import SHARD_WIDTH
 from pilosa_tpu.exec import Executor, result_to_json
 from pilosa_tpu.exec.executor import (ExecutionError,
                                       ExecutorSaturatedError,
-                                      QueryTimeoutError)
+                                      QueryTimeoutError,
+                                      WriteUnavailableError)
 from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.store import FieldOptions, Holder
 from pilosa_tpu.store.field import BSI_TYPES
@@ -55,6 +56,22 @@ class ApiError(Exception):
             "deadlineSeconds": deadline or None,
             "shardsOutstanding": getattr(exc, "shards_outstanding",
                                          None)}})
+
+    @classmethod
+    def write_unavailable(cls, exc) -> "ApiError":
+        """The write-unavailability contract (r13), shared by the
+        public and ``/internal/query`` edges: HTTP 503 + Retry-After
+        with a structured body naming the op, the down replica, and
+        why hinted handoff could not cover it (``replica_down`` —
+        handoff disabled, ``hint_overflow`` — backlog older than
+        hint_max_age, ``no_live_replica``, ``replica_busy`` — an
+        alive replica shed the op).  Mirrors the 504 timeout
+        block: unavailability is never a generic 400/500."""
+        return cls(str(exc), 503,
+                   retry_after=getattr(exc, "retry_after", 1.0),
+                   extra={"writeUnavailable": {
+                       "op": exc.op, "replica": exc.replica,
+                       "reason": exc.reason}})
 
 
 def field_options_from_json(o: dict) -> FieldOptions:
@@ -289,6 +306,12 @@ class API:
             # executor is overload, not a client mistake — 503 with a
             # Retry-After hint, never a generic 500/400
             return {}, ApiError(str(e), 503, retry_after=e.retry_after)
+        except WriteUnavailableError as e:
+            # a replica-down write refusal (handoff disabled/overflow/
+            # no live replica) is unavailability, not a client error:
+            # 503 + Retry-After with the structured writeUnavailable
+            # body naming the down replica (r13)
+            return {}, ApiError.write_unavailable(e)
         except (ParseError, ExecutionError) as e:
             return {}, ApiError(str(e), 400)
 
@@ -622,18 +645,24 @@ class API:
         state = "NORMAL"
         nodes = [{"id": "local", "uri": "", "state": state, "isPrimary": True}]
         cluster_health = None
+        write_health = None
         if self.cluster is not None:
             nodes = self.cluster.nodes_status()
             state = self.cluster.state
             # serving-through-failure visibility: per-peer last-seen
             # age, suspect verdict, breaker state
             cluster_health = self.cluster.health_payload()
+            # writes-through-failure visibility (r13): hint backlog,
+            # oldest age vs the hint_max_age bound, per-peer drains
+            write_health = self.cluster.write_health_payload()
         ex = self.executor
         shed = ex.stats.snapshot()["counters"].get("query_shed_total", {})
         pc = ex.planes.stats()
         return {"state": state, "nodes": nodes,
                 **({"clusterHealth": cluster_health}
                    if cluster_health is not None else {}),
+                **({"writeHealth": write_health}
+                   if write_health is not None else {}),
                 "localShardCount": sum(len(i.available_shards())
                                        for i in self.holder.indexes.values()),
                 "devices": devices,

@@ -498,6 +498,12 @@ class Client:
     def status(self) -> dict:
         return self._json("GET", "/status")
 
+    def write_health(self) -> dict:
+        """The ``writeHealth`` block of ``/status`` (hinted-handoff
+        backlog/age/per-peer drains) — what an operator or harness
+        polls to watch a rejoined node's hint drain complete."""
+        return self._json("GET", "/status").get("writeHealth", {})
+
     def info(self) -> dict:
         return self._json("GET", "/info")
 

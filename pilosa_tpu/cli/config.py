@@ -43,6 +43,17 @@ class Config:
     # breaker (open peers are skipped at read-routing time; half-open
     # probes ride the heartbeat loop)
     breaker_threshold: int = 3
+    # write availability (durable hinted handoff): a write that finds
+    # a replica down is applied on the live replicas and durably
+    # hinted for the dead one, then replayed in order on rejoin.
+    # hint_max_age bounds the handoff window (seconds): once a peer's
+    # oldest pending hint outlives it, strict writes (Clear/ClearRow/
+    # Store) flip back to loud 503 refusal and Set falls back to
+    # AAE-only repair — the hint log cannot grow without bound.
+    # <= 0 disables handoff entirely (the pre-r13 fail-fast contract).
+    hint_max_age: float = 300.0
+    # ops per replay POST when draining a peer's hint log
+    hint_replay_batch: int = 256
     diagnostics_interval: float = 0.0   # opt-in usage snapshot; 0 = off
     # observability backends
     stats_backend: str = ""             # "" = in-process /metrics only;

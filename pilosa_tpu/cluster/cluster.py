@@ -25,6 +25,7 @@ Queries fan out via :class:`pilosa_tpu.cluster.dist.DistributedExecutor`.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -87,6 +88,31 @@ class Cluster:
         self.breakers = BreakerBoard(
             threshold=getattr(cfg, "breaker_threshold", 3),
             stats=self.stats, logger=self.logger)
+        # durable hinted handoff (r13): per-peer crash-safe hint logs —
+        # writes keep serving through a dead replica, the missed copy
+        # replays in order on rejoin.  hint_max_age <= 0 disables
+        # (the pre-r13 strict fail-fast contract).
+        self.hints = None
+        if float(getattr(cfg, "hint_max_age", 0.0) or 0.0) > 0:
+            from pilosa_tpu.cluster.hints import HintBoard
+            self.hints = HintBoard(
+                os.path.join(api.holder.path, "_hints"),
+                max_age=cfg.hint_max_age, fsync=cfg.fsync,
+                stats=self.stats, logger=self.logger)
+        # receiver-side durable dedup window for /internal/hints/replay
+        # — always on (cheap), so this node dedups a peer's replays
+        # even when its own handoff is disabled
+        from pilosa_tpu.store.oplog import IdWindow
+        self.applied_ops = IdWindow(
+            os.path.join(api.holder.path, "_hints_applied.log"))
+        # peers with pending INBOUND hints anywhere in the cluster:
+        # holder id -> (hinted peer set, monotonic update ts).  Learned
+        # from every heartbeat (both directions carry ``hintsFor``) and
+        # seeded by the join response, so a rejoined stale peer and
+        # every up-to-date replica both know to defer AAE union-merge
+        # with each other BEFORE the first anti-entropy tick can run —
+        # the ordering rule that makes a replayed Clear irresurrectable.
+        self._hints_inbound: dict[str, tuple[set, float]] = {}
         self.dist = DistributedExecutor(self)
         self._clients: dict[str, object] = {}
         # index -> (fetched_at, shards, incomplete): `incomplete` rides
@@ -101,6 +127,12 @@ class Cluster:
         self._schema_tombstones: dict[tuple, float] = {}
         self._resize_lock = threading.Lock()
         self._resize_abort = threading.Event()
+        # set once open()'s join (and its schema pull) has completed:
+        # until then a missing index cannot be judged "deleted" — the
+        # HTTP server answers /internal/hints/replay before open()
+        # finishes, so a drain kicked by our own join request can race
+        # the join response's apply_schema
+        self._schema_ready = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -165,6 +197,13 @@ class Cluster:
                 for t in resp.get("schemaTombstones", []):
                     self.record_schema_tombstone(t["index"], t.get("field"),
                                                  t.get("ts", 0.0))
+                # seed the inbound-hints view from the join response:
+                # a REJOINING node may itself be the hinted peer, and
+                # it must defer its own AAE participation before its
+                # first anti-entropy tick (heartbeats refresh the view
+                # within one interval; this closes the boot window)
+                self._note_hints_inbound(
+                    "<join>", set(resp.get("hintedPeers", [])))
                 self.api.apply_schema(
                     self.filter_schema(resp.get("schema", [])))
                 self._pull_translate_tails(seed)
@@ -194,6 +233,7 @@ class Cluster:
             self.nodes[self.node_id]["state"] = STATE_NORMAL
             if self.state == STATE_STARTING:
                 self.state = STATE_NORMAL
+        self._schema_ready.set()
         self._spawn(self._heartbeat_loop, "heartbeat")
         if self.cfg.anti_entropy_interval > 0:
             self._spawn(self._aae_loop, "anti-entropy")
@@ -203,6 +243,9 @@ class Cluster:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        if self.hints is not None:
+            self.hints.close()
+        self.applied_ops.close()
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=f"pilosa-{name}", daemon=True)
@@ -256,6 +299,10 @@ class Cluster:
         # again NOW — stale breaker history must not make its shards
         # pay failover detours until a probe happens by
         self.breakers.reset(node["id"])
+        # rejoin hook: start draining any hints queued for it while it
+        # was down (writes keep hinting until the drain empties — the
+        # per-peer stream stays ordered)
+        self._drain_hints_async(node["id"])
         if is_new:
             # propagate the tombstone clear: every peer must re-admit the
             # rejoining node or its heartbeats keep getting bounced
@@ -268,10 +315,13 @@ class Cluster:
         return {"nodes": list(self.nodes.values()), "state": self.state,
                 "placement": list(self.placement_ids),
                 "placementVersion": self.placement_version,
-                "schema": self.api.schema(), "schemaTombstones": tombs}
+                "schema": self.api.schema(), "schemaTombstones": tombs,
+                "hintedPeers": sorted(self.hinted_peers())}
 
     def handle_heartbeat(self, node_id: str, state: str,
-                         placement_version: float = 0.0) -> dict:
+                         placement_version: float = 0.0,
+                         hints_for: list[str] | None = None) -> dict:
+        self._note_hints_inbound(node_id, set(hints_for or ()))
         with self._lock:
             if node_id in self._removed:
                 # tombstoned: tell the sender it was removed; it must
@@ -279,7 +329,8 @@ class Cluster:
                 return {"id": self.node_id, "state": self.state,
                         "removed": True}
             self._last_seen[node_id] = time.monotonic()
-            if node_id not in self.nodes:
+            unknown = node_id not in self.nodes
+            if unknown:
                 # node knows us but we lost it (e.g. restarted): re-add
                 self.nodes[node_id] = {"id": node_id, "uri": node_id,
                                        "state": state}
@@ -292,16 +343,27 @@ class Cluster:
                 # members after everyone had recovered (r11)
                 self.nodes[node_id]["state"] = state
             ours = self.placement_version
-        if placement_version > ours:
-            # the SENDER has a newer activated placement than us: pull
-            # it off-thread (this runs in an HTTP handler; the pull is
-            # its own round trip)
+        if unknown or placement_version > ours:
+            # pull the sender's full cluster state off-thread (this
+            # runs in an HTTP handler; the pull is its own round trip).
+            # Newer placementVersion: the sender activated a topology
+            # we missed.  UNKNOWN sender: our membership view is stale
+            # (we restarted and lost it) — the version check alone
+            # cannot heal that, because placement_version is persisted
+            # across restarts while membership is not: two nodes that
+            # both cold-restarted (e.g. the seed and a peer killed
+            # together) each re-learn only nodes that heartbeat THEM
+            # and never each other, wedging membership in an
+            # asymmetric split (surfaced by chaos
+            # coordinator_crash_hint_log, r13)
             threading.Thread(target=self._pull_cluster_state,
                              args=(node_id,),
                              name="pilosa-placement-pull",
                              daemon=True).start()
         return {"id": self.node_id, "state": self.state,
-                "placementVersion": ours}
+                "placementVersion": ours,
+                "hintsFor": (sorted(self.hints.pending_peers())
+                             if self.hints is not None else [])}
 
     def status_payload(self) -> dict:
         """The full cluster-state snapshot served at
@@ -413,7 +475,12 @@ class Cluster:
                 resp = self._client(nid)._json(
                     "POST", "/internal/heartbeat",
                     {"id": self.node_id, "state": self.state,
-                     "placementVersion": self.placement_version})
+                     "placementVersion": self.placement_version,
+                     # pending-hint advertising rides every heartbeat
+                     # both ways: the whole cluster learns which peers
+                     # must not be AAE-synced within one interval
+                     "hintsFor": (sorted(self.hints.pending_peers())
+                                  if self.hints is not None else [])})
                 self.breakers.record_success(nid)
                 if resp.get("removed"):
                     # we were explicitly removed: drop to single-node
@@ -430,6 +497,13 @@ class Cluster:
                     break
                 with self._lock:
                     self._last_seen[nid] = time.monotonic()
+                self._note_hints_inbound(nid,
+                                         set(resp.get("hintsFor", ())))
+                if (self.hints is not None
+                        and self.hints.has_pending(nid)):
+                    # the peer answered: it is reachable again — drain
+                    # its hint backlog off-thread (single-flight)
+                    self._drain_hints_async(nid)
                 if (resp.get("placementVersion", 0.0)
                         > self.placement_version):
                     # the PEER activated a placement we missed (its
@@ -457,6 +531,109 @@ class Cluster:
                 self.state = new_state
             if not dead and self.state == STATE_DEGRADED:
                 self.state = STATE_NORMAL
+
+    # -- hinted handoff (r13) ------------------------------------------------
+
+    def _note_hints_inbound(self, holder: str, peers: set) -> None:
+        """Record one holder's advertised pending-hint peer set (an
+        empty set clears its entry — the holder's drain finished)."""
+        with self._lock:
+            if peers:
+                self._hints_inbound[holder] = (set(peers),
+                                               time.monotonic())
+            else:
+                self._hints_inbound.pop(holder, None)
+
+    def hinted_peers(self) -> set[str]:
+        """Every peer with pending hinted writes anywhere in the
+        cluster — this node's own board plus what peers advertised on
+        their heartbeats.  AAE defers all union-merge with these peers
+        (and a node finding ITSELF here defers its own participation):
+        its copies are stale until the replay lands, and a sync now
+        could resurrect a cleared bit.
+
+        Advertised entries expire after the suspect horizon: a holder
+        that stopped refreshing is down, and gating forever on its
+        word would leave the hinted peer unrepairable.  (Caveat: if a
+        hint HOLDER stays dead past the horizon while its hinted peer
+        rejoins, AAE may converge the stale copy before the holder
+        returns to drain — a double failure the ``hint_max_age`` bound
+        keeps narrow; see the README runbook.)"""
+        horizon = SUSPECT_AFTER * self.cfg.heartbeat_interval
+        now = time.monotonic()
+        out: set[str] = set()
+        with self._lock:
+            stale = [h for h, (_, ts) in self._hints_inbound.items()
+                     if now - ts > horizon]
+            for h in stale:
+                del self._hints_inbound[h]
+            for peers, _ts in self._hints_inbound.values():
+                out |= peers
+        if self.hints is not None:
+            out |= self.hints.pending_peers()
+        return out
+
+    def _drain_hints_async(self, peer: str) -> None:
+        """Kick a background replay of ``peer``'s hint backlog (no-op
+        when empty or already draining)."""
+        if self.hints is None or not self.hints.has_pending(peer) \
+                or peer == self.node_id:
+            return
+        threading.Thread(target=self._drain_hints, args=(peer,),
+                         name="pilosa-hint-drain", daemon=True).start()
+
+    def _drain_hints(self, peer: str) -> None:
+        """Replay ``peer``'s hint log in append order via the
+        idempotent ``/internal/hints/replay`` endpoint, acking (and
+        compacting) batch by batch.  Single-flight per peer; a failed
+        batch aborts and the next heartbeat retries.  Writes landing
+        mid-drain keep appending behind the cursor — the loop runs
+        until the log is empty, and the write path only resumes direct
+        sends once ``pending_peers`` no longer lists the peer."""
+        hints = self.hints
+        if hints is None:
+            return
+        lock = hints.drain_lock(peer)
+        if not lock.acquire(blocking=False):
+            return
+        try:
+            batch_n = max(1, int(getattr(self.cfg, "hint_replay_batch",
+                                         256)))
+            total = 0
+            while True:
+                batch = hints.peek(peer, batch_n)
+                if not batch:
+                    break
+                resp = self._client(peer)._json(
+                    "POST", "/internal/hints/replay",
+                    {"ops": [rec for _seq, rec in batch]})
+                hints.ack(peer, batch[-1][0])
+                total += len(batch)
+                self.stats.count("hint_replay_total", len(batch),
+                                 peer=peer)
+                if resp.get("dropped"):
+                    self.stats.count("hint_replay_dropped_total",
+                                     resp["dropped"], peer=peer)
+            if total:
+                self.logger.info(
+                    "hints: drained %d op(s) to %s; direct writes "
+                    "resume", total, peer)
+        except Exception as e:  # noqa: BLE001 — retried next heartbeat
+            self.logger.warning("hint replay to %s failed: %s", peer, e)
+        finally:
+            lock.release()
+
+    def write_health_payload(self) -> dict:
+        """The ``writeHealth`` block on ``/status``: hint backlog and
+        age (total + per peer), the configured bound, and the
+        cluster-wide hinted-peer view AAE gating acts on."""
+        out: dict = {"hintedHandoff": self.hints is not None}
+        if self.hints is None:
+            return out
+        out["hintMaxAgeSeconds"] = float(self.cfg.hint_max_age)
+        out.update(self.hints.summary())
+        out["hintedPeers"] = sorted(self.hinted_peers())
+        return out
 
     # -- schema broadcast ---------------------------------------------------
 
@@ -501,6 +678,21 @@ class Cluster:
         with self._lock:
             cur = self._schema_tombstones.get((index, field), 0.0)
             self._schema_tombstones[(index, field)] = max(cur, ts)
+
+    def schema_settled(self, index: str, field: str | None) -> bool:
+        """True when a LOCALLY-missing index/field can be judged
+        deleted (hint-replay receiver drops the op) rather than
+        not-yet-learned (receiver answers 503 so the sender's drain
+        retries): boot-time join with its schema pull has completed,
+        or a tombstone explicitly records the deletion.  Without this
+        a drain racing a rejoiner's schema pull would permanently drop
+        an acked write for an index created while the node was down."""
+        if self._schema_ready.is_set():
+            return True
+        with self._lock:
+            return ((index, None) in self._schema_tombstones
+                    or (field is not None
+                        and (index, field) in self._schema_tombstones))
 
     def filter_schema(self, schema: list[dict]) -> list[dict]:
         """Drop schema entries deleted AFTER their creation: an entry
@@ -880,8 +1072,22 @@ class Cluster:
     def sync_once(self) -> int:
         """One AAE round: for every local fragment replicated elsewhere,
         diff block checksums with each replica and union-merge
-        differences both ways.  Returns blocks repaired."""
+        differences both ways.  Returns blocks repaired.
+
+        Hinted-handoff ordering rule (r13): any sync with a peer that
+        has pending hinted writes anywhere in the cluster is DEFERRED
+        — its copies are stale until the ordered replay lands, and a
+        union-merge now could resurrect a Clear the replay is about to
+        deliver.  A node finding ITSELF hinted sits the round out for
+        the same reason."""
         repaired = 0
+        deferred = 0
+        hinted = self.hinted_peers()
+        if self.node_id in hinted:
+            self.logger.info("anti-entropy deferred: hinted writes "
+                             "pending for this node (replay first)")
+            self.stats.count("aae_hint_deferred_total", 1)
+            return 0
         holder = self.api.holder
         for iname, idx in list(holder.indexes.items()):
             for fname, f in list(idx.fields.items()):
@@ -895,6 +1101,9 @@ class Cluster:
                             # the placement flipped — r5 review).  Hand
                             # the bits to every alive owner, then drop
                             # our copy so the handoff is one-time.
+                            if hinted & set(owners):
+                                deferred += 1
+                                continue
                             repaired += self._handoff_orphan(
                                 iname, fname, vname, shard, frag, v,
                                 owners)
@@ -902,9 +1111,14 @@ class Cluster:
                         for peer in owners:
                             if peer == self.node_id:
                                 continue
+                            if peer in hinted:
+                                deferred += 1
+                                continue
                             repaired += self._sync_fragment(
                                 peer, iname, fname, vname, shard, frag)
-        repaired += self._sync_attrs()
+        repaired += self._sync_attrs(exclude=hinted)
+        if deferred:
+            self.stats.count("aae_hint_deferred_total", deferred)
         if repaired:
             self.logger.info("anti-entropy repaired %d blocks", repaired)
             self.stats.count("aae_blocks_repaired", repaired)
@@ -979,11 +1193,12 @@ class Cluster:
             # snapshot we shipped — push again before deleting
         return 0  # kept hot by writers; next AAE round retries
 
-    def _sync_attrs(self) -> int:
+    def _sync_attrs(self, exclude: set | frozenset = frozenset()) -> int:
         """AAE for attribute stores (reference: AttrStore block sync,
         SURVEY.md §4.6).  Attr stores are fully replicated: diff with
-        every alive peer, merge differing blocks both ways."""
-        import os
+        every alive peer, merge differing blocks both ways.
+        ``exclude``: hinted peers — their attr state is stale until
+        the ordered replay lands (same deferral rule as fragments)."""
         repaired = 0
         holder = self.api.holder
         targets: list[tuple[str, str]] = []  # (index, field-or-"")
@@ -999,7 +1214,7 @@ class Cluster:
                      else idx.column_attrs)
             qs = f"index={iname}&field={fname}"
             for peer in self.alive_ids():
-                if peer == self.node_id:
+                if peer == self.node_id or peer in exclude:
                     continue
                 try:
                     theirs = self._client(peer)._json(
@@ -1063,6 +1278,14 @@ class Cluster:
                     "POST", f"/internal/fragment/merge?{qs}", mine,
                     content_type="application/octet-stream")
                 repaired += 1
+            except ClientError as e:
+                if e.status == 409:
+                    # hint-gated on the receiver (pending hinted
+                    # writes cover the fragment): quietly defer the
+                    # whole fragment to the post-drain round
+                    return repaired
+                self.logger.warning("aae %s/%s/%s/%d block %d: %s",
+                                    index, field, view, shard, block, e)
             except Exception as e:  # noqa: BLE001
                 self.logger.warning("aae %s/%s/%s/%d block %d: %s",
                                     index, field, view, shard, block, e)

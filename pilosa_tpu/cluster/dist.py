@@ -16,11 +16,13 @@ with ``translate_output=False``.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from pilosa_tpu import fault
 from pilosa_tpu.exec import result_to_json
-from pilosa_tpu.exec.executor import ExecutionError
+from pilosa_tpu.exec.executor import ExecutionError, WriteUnavailableError
 from pilosa_tpu.pql import parse_cached
 from pilosa_tpu.pql.ast import Call, Condition, Query
 
@@ -675,6 +677,27 @@ class DistributedExecutor:
     # -- writes -------------------------------------------------------------
 
     def _write(self, index: str, call: Call):
+        """Replicated write with durable hinted handoff (r13).
+
+        Every write — strict (Clear/ClearRow/Store) or best-effort
+        (Set) — keeps serving through a dead replica: the op applies
+        on the write-reachable owners and is durably HINTED for the
+        unreachable ones (appended to the crash-safe per-peer hint
+        log; replayed in order on rejoin).  Owners known dead UP FRONT
+        hint BEFORE the live applies run: a coordinator crash in
+        between re-delivers (idempotently) rather than loses, and a
+        torn hint append fails the op before anything mutated.  An
+        owner that dies MID-APPLY necessarily hints after the
+        surviving legs applied — a crash in that narrower window
+        leaves an un-acked op partially applied with no hint, which
+        AAE converges exactly like a pre-r13 best-effort miss (the
+        at-least-once contract: un-acked ops may partially apply).
+
+        Refusal (``WriteUnavailableError`` → 503 + Retry-After) is the
+        bounded fallback, not the default: handoff disabled
+        (``hint_max_age <= 0`` — the pre-r13 contract), a hinted
+        peer's backlog past ``hint_max_age``, or no live replica left
+        to apply the op right now."""
         from pilosa_tpu.engine.words import SHARD_WIDTH
         if (_call_of(call).name in ("Clear", "ClearRow", "Store")
                 and self.cluster.state == "RESIZING"):
@@ -690,34 +713,50 @@ class DistributedExecutor:
         create = _call_of(call).name in ("Set", "Store")
         call = self._translate_input(index, call, create=create)
         eff = _call_of(call)
+        hints = self.cluster.hints
         if eff.name in ("Set", "Clear"):
-            col = int(eff.args["_col"])
-            owners = self.cluster.shard_owners(index, col // SHARD_WIDTH)
-            # Set is best-effort over reachable owners: a down replica
-            # is repaired by AAE's union-merge when it rejoins.  Clear
-            # stays strict — a clear missed by a dead replica would be
-            # RESURRECTED by union-merge AAE (no deletion tombstones on
-            # bit data), so failing loudly is the only sound behavior.
-            if eff.name == "Clear":
-                # pre-mutation fail-fast, same rationale as ClearRow
-                # below: refuse BEFORE any replica applies
-                dead = sorted(set(owners) - self._write_reachable())
-                if dead:
-                    raise ExecutionError(
-                        f"replica {dead[0]} unreachable for Clear: this "
-                        "op requires every replica (a copy missed by a "
-                        "down node would be resurrected by anti-entropy "
-                        "union merge)")
-            results = self._run_on(index, call, owners, shards=None,
-                                   best_effort=eff.name == "Set")
+            shard = int(eff.args["_col"]) // SHARD_WIDTH
+            owners = self.cluster.shard_owners(index, shard)
+            if hints is None:
+                # handoff disabled: the legacy contract — Set is
+                # best-effort over reachable owners (AAE repairs a
+                # dead replica on rejoin), Clear fail-fasts BEFORE any
+                # replica applies (a copy missed by a down node would
+                # be resurrected by union-merge AAE)
+                if eff.name == "Clear":
+                    dead = sorted(set(owners) - self._write_reachable())
+                    if dead:
+                        raise self._unavailable(eff.name, dead[0],
+                                                "replica_down")
+                results = self._run_on(index, call, owners, shards=None,
+                                       best_effort=eff.name == "Set")
+                return bool(results[0])
+            targets, handed = self._split_write_targets(eff.name, owners)
+            hinter = self._hinter(index, call, (shard,))
+            for peer in handed:
+                # hint FIRST (durable intent), then apply on the live
+                # owners: a crash in between re-delivers — never loses
+                hinter(peer)
+            results = self._run_on(index, call, targets, shards=None,
+                                   best_effort=eff.name == "Set",
+                                   handoff=hinter)
+            if not results:
+                # every live target died mid-apply (each was hinted):
+                # nothing applied NOW, the same state the up-front
+                # split refuses as no_live_replica — acking would
+                # claim otherwise.  The hints stay queued: the
+                # un-acked op may still replay (at-least-once).
+                raise self._unavailable(eff.name, targets[0],
+                                        "no_live_replica")
             return bool(results[0])
         # ClearRow / Store touch every shard, and every REPLICA of each
-        # shard must apply them: both clear bits, and a replica that
-        # missed a clear would diverge — then union-merge AAE would
-        # resurrect the cleared bits cluster-wide.  (Strict: any owner
-        # down fails the op, same rationale as Clear above — and the
-        # shard UNIVERSE itself must be complete, or shards only the
-        # unreadable peer knows about would miss the clear.)
+        # shard must eventually apply them (a replica that missed a
+        # clear would diverge and union-merge AAE would resurrect the
+        # cleared bits cluster-wide).  The shard UNIVERSE itself must
+        # be complete, or shards only the unreadable peer knows about
+        # would miss the clear.  Down owners get the op hinted with
+        # exactly their shard group; AAE defers those fragments until
+        # the hints drain, so the ordering rule holds per shard.
         try:
             all_shards = self.cluster.index_shards(index, strict=True)
         except RuntimeError as e:
@@ -726,44 +765,208 @@ class DistributedExecutor:
         for s in all_shards:
             for o in self.cluster.shard_owners(index, s):
                 groups.setdefault(o, []).append(s)
-        # fail fast BEFORE mutating anything: discovering a dead owner
-        # mid-loop would leave the clear half-applied (and the halves
-        # on dead-owner shards later resurrected by AAE)
-        dead = sorted(set(groups) - self._write_reachable())
+        reachable = self._write_reachable()
+        dead = sorted(set(groups) - reachable)
+        if dead and hints is None:
+            # legacy fail-fast BEFORE mutating anything: discovering a
+            # dead owner mid-loop would leave the clear half-applied
+            raise self._unavailable(eff.name, dead[0], "replica_down")
         if dead:
-            raise ExecutionError(
-                f"replica {dead[0]} unreachable for {eff.name}: this op "
-                "requires every replica (a copy missed by a down node "
-                "would be resurrected by anti-entropy union merge)")
+            # at least one REACHABLE owner per shard must apply the op
+            # now — with every owner of a shard down there is no live
+            # copy to serve reads from either, so refuse loudly
+            for s in all_shards:
+                owners_s = self.cluster.shard_owners(index, s)
+                if not any(o in reachable for o in owners_s):
+                    raise self._unavailable(eff.name, owners_s[0],
+                                            "no_live_replica")
+            for o in dead:
+                if hints.overflowed(o):
+                    raise self._unavailable(eff.name, o, "hint_overflow")
+            for o in dead:
+                self._hinter(index, call, groups[o])(o)
+        live = {o: s for o, s in groups.items() if o not in dead}
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
-            results = list(pool.map(
-                lambda kv: self._run_on(index, call, [kv[0]],
-                                        shards=tuple(kv[1]))[0],
-                groups.items()))
-        return any(bool(r) for r in results)
+
+        def leg(kv):
+            o, shards_o = kv
+            handoff = (self._hinter(index, call, shards_o)
+                       if hints is not None else None)
+            rs = self._run_on(index, call, [o], shards=tuple(shards_o),
+                              handoff=handoff)
+            # an answered leg may legitimately return a falsy result
+            # (no bits changed), so "applied" is rs non-empty, not
+            # rs[0] truthiness
+            return o, (rs[0] if rs else False), bool(rs)
+
+        with ThreadPoolExecutor(max_workers=len(live)) as pool:
+            legs = list(pool.map(leg, live.items()))
+        if hints is not None:
+            # the up-front rule re-checked against what actually
+            # happened: every shard needs at least one LIVE apply —
+            # an owner that died mid-apply was hinted, and if it was
+            # a shard's only reachable owner the op applied nowhere
+            # live for that shard (ack would claim otherwise)
+            applied_on = {o for o, _r, ok in legs if ok}
+            for s in all_shards:
+                owners_s = self.cluster.shard_owners(index, s)
+                if not any(o in applied_on for o in owners_s):
+                    raise self._unavailable(eff.name, owners_s[0],
+                                            "no_live_replica")
+        return any(bool(r) for _o, r, _ok in legs)
 
     def _write_reachable(self) -> set[str]:
-        """The node set a STRICT write's pre-mutation fail-fast trusts:
-        alive AND breaker-closed.  The breaker sees a dead peer within
-        a few transport failures — seconds before the suspect horizon —
-        and a Clear/ClearRow/Store that proceeded in that window would
-        half-apply on the live replicas before raising, leaving bits
-        for AAE to resurrect on rejoin.  Strictness is unchanged: this
-        only refuses EARLIER (before mutating), never skips a replica."""
-        return (set(self.cluster.alive_ids())
-                - self.cluster.breakers.unhealthy_peers())
+        """The node set a write may target DIRECTLY: alive, breaker-
+        closed, and — with handoff enabled — holding no pending hints.
+        The breaker sees a dead peer within a few transport failures,
+        seconds before the suspect horizon.  A peer with pending hints
+        is not write-reachable even once alive again: new writes to it
+        must append BEHIND the older hints (one ordered stream per
+        peer) until the drain empties the log, or a replayed Clear
+        could land after a newer direct Set and destroy it."""
+        out = (set(self.cluster.alive_ids())
+               - self.cluster.breakers.unhealthy_peers())
+        hints = self.cluster.hints
+        if hints is not None:
+            out -= hints.pending_peers()
+        return out
+
+    def _split_write_targets(self, op: str,
+                             owners) -> tuple[list[str], list[str]]:
+        """(apply-now targets, hand-off peers) for one shard's owner
+        set, refusing when the split cannot serve: no live replica at
+        all, or a hand-off peer whose backlog overflowed
+        ``hint_max_age`` (Set falls back to the legacy best-effort
+        miss there instead — AAE union-merge repairs additive
+        divergence, so boundedness never costs Set availability)."""
+        hints = self.cluster.hints
+        reachable = self._write_reachable()
+        targets = [o for o in owners if o in reachable]
+        dead = [o for o in owners if o not in reachable]
+        if not targets:
+            raise self._unavailable(op, dead[0] if dead else None,
+                                    "no_live_replica")
+        handed = []
+        for o in dead:
+            if hints.overflowed(o):
+                if op == "Set":
+                    self.cluster.stats.count("write_replicas_missed", 1)
+                    self.cluster.logger.warning(
+                        "Set not hinted for %s (backlog older than "
+                        "hint_max_age=%gs); AAE repairs on rejoin",
+                        o, hints.max_age)
+                    continue
+                raise self._unavailable(op, o, "hint_overflow")
+            handed.append(o)
+        return targets, handed
+
+    def _unavailable(self, op: str, replica: str | None,
+                     reason: str) -> WriteUnavailableError:
+        """The structured refusal every write-unavailability path
+        shares: the API edges map it to 503 + Retry-After with a body
+        naming the down replica (mirrors the 504 timeout block)."""
+        hints = self.cluster.hints
+        if reason == "replica_down":
+            msg = (f"replica {replica} unreachable for {op}: this op "
+                   "requires every replica (a copy missed by a down "
+                   "node would be resurrected by anti-entropy union "
+                   "merge, and hinted handoff is disabled)")
+        elif reason == "hint_overflow":
+            msg = (f"replica {replica} unreachable for {op} and its "
+                   f"hint backlog is older than hint_max_age="
+                   f"{hints.max_age:g}s; refusing to diverge further "
+                   "(drain or remove the node)")
+        elif reason == "replica_busy":
+            msg = (f"replica {replica} shed {op} (executor saturated): "
+                   "the peer is alive and still serving reads, so "
+                   "hinting would let this strict op ack while that "
+                   "replica contradicts it — retry shortly")
+        else:
+            msg = (f"no live replica reachable for {op}"
+                   + (f" (first unreachable: {replica})" if replica
+                      else ""))
+        retry = max(1.0, float(getattr(self.cluster.cfg,
+                                       "heartbeat_interval", 1.0)))
+        return WriteUnavailableError(msg, op=op, replica=replica,
+                                     reason=reason, retry_after=retry)
+
+    def _hint_record(self, index: str, call: Call, shards) -> dict:
+        """One replayable hint: the already-translated PQL plus the
+        routing facts (index/field/shards) AAE gating keys on, and a
+        unique 128-bit op id the receiver dedups by."""
+        eff = _call_of(call)
+        return {"id": os.urandom(16).hex(), "index": index,
+                "pql": str(call), "op": eff.name,
+                "field": self._write_field(eff),
+                "shards": (sorted(int(s) for s in shards)
+                           if shards is not None else None)}
+
+    def _hinter(self, index: str, call: Call, shards):
+        """A hand-off callable for one op: durably hints ``call`` for
+        a peer (used both pre-apply for known-dead owners and from
+        ``_run_on`` when a target dies mid-apply)."""
+        hints = self.cluster.hints
+
+        def hand_off(node_id: str, err=None) -> None:
+            hints.add(node_id, self._hint_record(index, call, shards))
+            self.cluster.stats.count("hint_handoff_total", 1,
+                                     peer=node_id)
+            self.cluster.logger.info(
+                "%s hinted for %s (replica down%s)",
+                _call_of(call).name, node_id,
+                f": {err}" if err is not None else "")
+
+        return hand_off
+
+    @staticmethod
+    def _write_field(eff: Call) -> str | None:
+        """The field a write call targets (the single non-reserved
+        field arg — the same rule the translate walk uses), or None
+        when indeterminable (gating then treats the hint as covering
+        every field of the index: conservative, never unsound)."""
+        from pilosa_tpu.exec.executor import reserved_for
+        rk = reserved_for(eff.name)
+        for k, v in eff.args.items():
+            if (k in rk or k.startswith("_")
+                    or isinstance(v, (Condition, Call))):
+                continue
+            return str(k)
+        f = eff.args.get("_field")
+        return str(f) if f is not None else None
 
     def _attr_write(self, index: str, call: Call):
-        """SetRowAttrs/SetColumnAttrs apply on every alive node — attr
-        stores are fully replicated, AAE repairs missed nodes."""
+        """SetRowAttrs/SetColumnAttrs apply on every member — attr
+        stores are fully replicated.  Routed through the breaker-aware
+        write-reachable set (r13 fix: this fanned out over
+        ``alive_ids()`` ignoring breaker state, so a sick-but-not-yet-
+        suspect peer ate a connect timeout on every attrs write);
+        unreachable members are durably hinted when handoff is
+        enabled, else left to attr AAE as before."""
         call = self._translate_input(index, call, create=True)
-        self._run_on(index, call, self.cluster.alive_ids(), shards=None,
-                     best_effort=True)
+        hints = self.cluster.hints
+        reachable = self._write_reachable()
+        members = self.cluster.member_ids()
+        targets = [n for n in members if n in reachable]
+        rest = [n for n in members if n not in reachable]
+        handoff = None
+        if hints is not None:
+            hinter = self._hinter(index, call, None)
+            handoff = hinter
+            for peer in rest:
+                if not hints.overflowed(peer):
+                    hinter(peer)
+        elif rest:
+            self.cluster.stats.count("write_replicas_missed", len(rest))
+            self.cluster.logger.warning(
+                "%s skipped %d unreachable member(s) %s (attr AAE "
+                "repairs on rejoin)", _call_of(call).name, len(rest),
+                rest)
+        self._run_on(index, call, targets, shards=None, best_effort=True,
+                     handoff=handoff)
         return None
 
     def _run_on(self, index: str, call: Call, node_ids, shards,
-                best_effort: bool = False):
+                best_effort: bool = False, handoff=None):
         """Execute one call on each named node (replica-synchronous for
         writes, replicas in parallel); returns the successful results,
         primary's first.
@@ -775,7 +978,15 @@ class DistributedExecutor:
         "unreachable": the peer saw the request and may still apply
         the write after we give up, so it propagates as a hard
         failure ("state unknown") on every path — skipping it would
-        undercount a write that likely applied (ADVICE r4)."""
+        undercount a write that likely applied (ADVICE r4): a hinted
+        replay of a maybe-applied op could land AFTER a newer direct
+        write and reorder it, so only never-delivered failures hand
+        off.
+
+        ``handoff`` (r13): a callable ``(node_id, err)`` that durably
+        hints the op for a target that died mid-apply (the "down"
+        class only) — the failure is then handled, not raised, and the
+        op keeps serving on the surviving results."""
         from pilosa_tpu.api.client import ClientError
 
         pql = str(call)
@@ -798,14 +1009,21 @@ class DistributedExecutor:
             try:
                 return ("ok", one(node_id))
             except ClientError as e:
-                # only never-delivered failures mean "node down":
+                # only never-delivered failures mean "node DOWN":
                 # connection refused/reset, TLS handshake alerts
                 # ("transport" — the handshake precedes any request
-                # processing), or an explicit 503.  A 5xx from an
-                # alive peer is a real failed write and must
-                # propagate, not be waved off as AAE-repairable
-                if e.status == 503 or (e.status == 0
-                                       and e.kind != "timeout"):
+                # processing).  An answered 503 is an ALIVE peer that
+                # shed the request pre-execution ("busy"): it keeps
+                # serving reads, so hinting it would ack a strict
+                # Clear that a read on that replica then contradicts —
+                # busy legs keep the pre-r13 semantics (best-effort
+                # miss / strict refusal) and never hand off.  Any
+                # other 5xx from an alive peer is a real failed write
+                # and must propagate, not be waved off as
+                # AAE-repairable
+                if e.status == 503:
+                    return ("busy", (node_id, e))
+                if e.status == 0 and e.kind != "timeout":
                     return ("down", (node_id, e))
                 raise
 
@@ -818,6 +1036,21 @@ class DistributedExecutor:
                 outs = list(pool.map(guarded, node_ids))
         oks = [r for tag, r in outs if tag == "ok"]
         downs = [r for tag, r in outs if tag == "down"]
+        busys = [r for tag, r in outs if tag == "busy"]
+        if downs and handoff is not None:
+            # durable hinted handoff: targets that died mid-apply get
+            # the op appended to their hint log (ordered replay on
+            # rejoin) instead of failing or silently diverging
+            for nid, err in downs:
+                handoff(nid, err)
+            downs = []
+        if busys and not best_effort:
+            # a saturated replica shed the op pre-execution: transient
+            # unavailability, retryable — structured 503, never hinted
+            nid, err = busys[0]
+            raise self._unavailable(_call_of(call).name, nid,
+                                    "replica_busy")
+        downs += busys
         if downs and (not best_effort or not oks):
             nid, err = downs[0]
             raise ExecutionError(

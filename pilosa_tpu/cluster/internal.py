@@ -13,7 +13,9 @@ have processed it (stale keep-alive socket, connection reset) —
 at-least-once delivery.  The current endpoints all qualify: fragment
 ``merge`` is a union (∪ is idempotent), translate ``replicate`` dedupes
 by log offset, ``heartbeat``/``status``/``schema`` apply last-writer
-state merges, ``resize/push`` re-streams a union-merge.  A future
+state merges, ``resize/push`` re-streams a union-merge, and
+``hints/replay`` dedupes by unique op id against a durable window
+(the r13 request-ID pattern this docstring used to promise).  A future
 non-idempotent endpoint must NOT ride this client — give it a dedicated
 ``Client()`` (default: no retry after a possibly-delivered request) or
 add request IDs."""
@@ -67,7 +69,8 @@ def h_heartbeat(self: Handler) -> None:
     b = self._json_body()
     self._reply(_cluster(self).handle_heartbeat(
         b["id"], b.get("state", "NORMAL"),
-        float(b.get("placementVersion", 0.0))))
+        float(b.get("placementVersion", 0.0)),
+        hints_for=b.get("hintsFor")))
 
 
 def h_cluster_state(self: Handler) -> None:
@@ -93,7 +96,8 @@ def h_internal_query(self: Handler) -> None:
     from pilosa_tpu.exec import result_to_json
     from pilosa_tpu.exec.executor import (ExecutionError,
                                           ExecutorSaturatedError,
-                                          QueryTimeoutError)
+                                          QueryTimeoutError,
+                                          WriteUnavailableError)
     from pilosa_tpu.pql.parser import ParseError
     import time
 
@@ -161,6 +165,11 @@ def h_internal_query(self: Handler) -> None:
         # coordinator's fan-out classifies it like a busy node (and a
         # best-effort write may route around it), never 400
         raise ApiError(str(e), 503, retry_after=e.retry_after)
+    except WriteUnavailableError as e:
+        # same structured 503 as the public edge (r13): a replica-down
+        # refusal names the down replica and why handoff could not
+        # cover it — unavailability, never a generic 400
+        raise ApiError.write_unavailable(e)
     except (ParseError, ExecutionError) as e:
         raise ApiError(str(e), 400)
     out = {"results": [result_to_json(r) for r in results]}
@@ -256,7 +265,79 @@ def h_translate_logs(self: Handler) -> None:
     self._reply({"logs": [{"index": i, "field": f} for i, f in stores]})
 
 
+def h_hints_replay(self: Handler) -> None:
+    """Drain-side receive path for durable hinted handoff (r13): apply
+    a batch of hinted ops IN ORDER, deduping by unique op id against
+    the node's durable :class:`~pilosa_tpu.store.oplog.IdWindow` —
+    re-delivered batches (lost response, sender crash mid-ack) are
+    no-ops, so the at-least-once internode retry is safe here.
+
+    A hint that can no longer apply (index/field deleted since it was
+    queued) is DROPPED with a warning rather than wedging the sender's
+    drain forever — but only once this node's boot-time schema pull
+    has settled (``Cluster.schema_settled``): a drain kicked by our
+    own join request can arrive BEFORE the join response's
+    ``apply_schema`` lands, and judging "deleted" then would
+    permanently lose an acked write for an index created while this
+    node was down.  A not-yet-settled miss (and a saturated executor)
+    answers 503 so the sender retries the whole batch later (the
+    applied prefix dedups)."""
+    from pilosa_tpu.exec.executor import (ExecutionError,
+                                          ExecutorSaturatedError)
+    from pilosa_tpu.pql.parser import ParseError
+
+    cluster = _cluster(self)
+    api = self.server.api
+    applied = deduped = dropped = 0
+    for op in self._json_body().get("ops", []):
+        op_id = str(op.get("id", ""))
+        if not op_id:
+            raise ApiError("hint op missing id")
+        if op_id in cluster.applied_ops:
+            deduped += 1
+            continue
+        fld = op.get("field")
+        fld = str(fld) if fld is not None else None
+        idx_obj = api.holder.index(op["index"])
+        if ((idx_obj is None
+             or (fld is not None and idx_obj.field(fld) is None))
+                and not cluster.schema_settled(op["index"], fld)):
+            raise ApiError(
+                f"hint replay deferred: index {op['index']!r}"
+                + (f" field {fld!r}" if fld is not None else "")
+                + " not known here yet (schema pull pending)",
+                503, retry_after=1.0)
+        shards = op.get("shards")
+        try:
+            api.executor.execute(
+                op["index"], op["pql"],
+                shards=([int(s) for s in shards] if shards else None),
+                translate_output=False)
+        except ExecutorSaturatedError as e:
+            raise ApiError(str(e), 503, retry_after=e.retry_after)
+        except (ParseError, ExecutionError) as e:
+            cluster.logger.warning(
+                "hint replay dropped %s on %s: %s",
+                op.get("op", "?"), op.get("index", "?"), e)
+            dropped += 1
+            cluster.stats.count("hint_replay_dropped_total", 1)
+            cluster.applied_ops.add(op_id)
+            continue
+        cluster.applied_ops.add(op_id)
+        applied += 1
+    self._reply({"applied": applied, "deduped": deduped,
+                 "dropped": dropped})
+
+
 def h_fragment_blocks(self: Handler) -> None:
+    cluster = self.server.api.cluster
+    if cluster is not None and cluster.node_id in cluster.hinted_peers():
+        # this node has hinted writes pending somewhere: its copies
+        # are stale until the replay lands — a peer diffing against
+        # them now could union a cleared bit back in.  409 defers the
+        # sync (the peer retries after the drain).
+        raise ApiError("fragment blocks deferred: hinted writes "
+                       "pending for this node (replay first)", 409)
     frag = _fragment(self)
     self._reply({"blocks": {str(k): v for k, v in frag.blocks().items()}})
 
@@ -272,6 +353,16 @@ def h_fragment_data(self: Handler) -> None:
 
 
 def h_fragment_merge(self: Handler) -> None:
+    cluster = self.server.api.cluster
+    if (cluster is not None and cluster.hints is not None
+            and cluster.hints.gated_fragment(
+                _qs(self, "index"), _qs(self, "field"),
+                int(_qs(self, "shard")))):
+        # this node coordinated writes still hinted for a down peer
+        # covering this fragment: a union-merge in could resurrect a
+        # Clear the replay is about to deliver — defer until drained
+        raise ApiError("fragment merge deferred: pending hinted "
+                       "writes cover it (retry after drain)", 409)
     frag = _fragment(self, create=True)
     body = self._body()
     changed = frag.merge_positions(roaring.deserialize(body))
@@ -383,6 +474,7 @@ def register_internal_routes(router: Router) -> None:
     router.add("GET", "/internal/fragment/blocks", h_fragment_blocks)
     router.add("GET", "/internal/fragment/data", h_fragment_data)
     router.add("POST", "/internal/fragment/merge", h_fragment_merge)
+    router.add("POST", "/internal/hints/replay", h_hints_replay)
     router.add("POST", "/internal/aae/run", h_aae_run)
     router.add("POST", "/internal/resize/push", h_resize_push)
     router.add("POST", "/internal/resize/trigger", h_resize_trigger)

@@ -129,6 +129,31 @@ class ExecutorSaturatedError(ExecutionError):
         self.retry_after = retry_after
 
 
+class WriteUnavailableError(ExecutionError):
+    """A write cannot serve right now: a replica is down and durable
+    hinted handoff cannot cover it — handoff disabled
+    (``hint_max_age <= 0``), the peer's hint backlog overflowed past
+    ``hint_max_age``, or no live replica remains to apply the op at
+    all.  The API edge maps this to HTTP 503 + ``Retry-After`` with a
+    structured ``writeUnavailable`` body naming the down replica
+    (r13; mirrors the 504 timeout treatment) — unavailability is not a
+    client error and must not surface as a generic 400/500.
+
+    ``reason`` is one of ``"replica_down"`` (handoff disabled — the
+    pre-r13 strict contract), ``"hint_overflow"`` (the boundedness
+    rule fired), ``"no_live_replica"`` (every owner of some shard is
+    unreachable), or ``"replica_busy"`` (an alive replica shed the op
+    pre-execution — saturation is transient, so it is never hinted)."""
+
+    def __init__(self, msg: str, op: str, replica: str | None,
+                 reason: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.op = op
+        self.replica = replica
+        self.reason = reason
+        self.retry_after = retry_after
+
+
 # negative plan-cache entry: this query shape is structurally outside
 # the plan cache (not all-Count, time ranges, …) — skip re-walking it
 _UNPLANNABLE = object()

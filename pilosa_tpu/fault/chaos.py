@@ -26,17 +26,29 @@ dropped_internal_response_trace       a redelivered fan-out leg is
                                       visible in the profile tree
                                       (``retried`` tag) — traces
                                       never lie under failure
-node_kill_failover                    kill -9 mid-serve (replicas=2):
-                                      zero read failures via replica
-                                      failover, breaker opens, strict
-                                      writes still refuse, rejoin
-                                      closes the breaker
+node_kill_failover                    kill -9 mid-serve (replicas=2,
+                                      handoff disabled): zero read
+                                      failures via replica failover,
+                                      breaker opens, strict writes
+                                      refuse 503, rejoin closes the
+                                      breaker
 straggler_hedged_read                 a delayed leg is hedged to a
                                       replica: bounded latency, exact
                                       answer, ``hedged`` trace tag
 breaker_lifecycle                     open → half_open → closed pinned
                                       through partition + heal; open
                                       routing pays no failover tax
+clear_during_kill_handoff             kill -9 mid-serve (replicas=2,
+                                      handoff ON): Set/Clear/ClearRow
+                                      all keep serving, rejoin drains
+                                      the hint log, every node ends
+                                      oracle-exact and a forced AAE
+                                      round resurrects nothing
+coordinator_crash_hint_log            kill -9 the write coordinator
+                                      mid-hint-append (torn record):
+                                      recovery truncates the torn op
+                                      (it never applies anywhere) and
+                                      replays the clean prefix
 ====================================  ==================================
 
 Oracle semantics are at-least-once honest: a write the harness saw FAIL
@@ -44,6 +56,9 @@ may still have applied on some replica (lost response, torn tail after
 the memory mutation).  The standing bar — "no lost acknowledged
 writes" — is therefore checked as ``acked ⊆ observed ⊆ attempted``;
 observed bits outside ``attempted`` are corruption and fail loudly.
+Clears sharpen it (r13): ``observed ∩ cleared = ∅`` — an acked Clear
+not re-attempted since must stay absent on every node, forever; a bit
+resurrected by anti-entropy is the loudest possible failure.
 
 Every schedule is reproducible: all randomness (write placement, fault
 parameters, drop probabilities) flows from one printed seed.
@@ -90,6 +105,10 @@ class ChaosHarness:
         self.index, self.field = index, field
         self.acked: dict[int, set[int]] = {}
         self.attempted: dict[int, set[int]] = {}
+        # bits whose Clear was ACKED and not re-attempted since: they
+        # must be absent on every node once hints drain — the
+        # resurrection oracle for the r13 handoff scenarios
+        self.cleared: dict[int, set[int]] = {}
         print(f"[chaos] scenario index={index!r} seed={seed}", flush=True)
 
     def _fail(self, msg: str) -> "InvariantViolation":
@@ -145,6 +164,20 @@ class ChaosHarness:
         """Sum a counter family across labels from ``/metrics``."""
         return prom_counter_total(self.client(via).metrics_text(), name)
 
+    def await_hints_drained(self, via: int, timeout: float = 40.0) -> None:
+        """Poll ``writeHealth`` on node ``via`` until its hint backlog
+        is empty (the rejoined peer has replayed every queued op)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if not self.client(via).write_health().get(
+                        "hintBacklogOps"):
+                    return
+            except (ClientError, OSError):
+                pass
+            time.sleep(0.3)
+        raise self._fail("hint backlog never drained")
+
     def coordinator_index(self) -> int:
         status = self.client(0)._json("GET", "/status")
         primary = next(nd["id"] for nd in status["nodes"]
@@ -198,14 +231,46 @@ class ChaosHarness:
         """One ``Set``; records the attempt, and the ack only when the
         cluster answered 200.  A failed write may still have applied on
         some replica (at-least-once) — that is what ``attempted``
-        captures."""
+        captures.  The ATTEMPT also lifts the bit's cleared-ness: a
+        Set racing an earlier acked Clear may legitimately re-appear."""
         self.attempted.setdefault(row, set()).add(col)
+        self.cleared.setdefault(row, set()).discard(col)
         try:
             self.client(via).query(self.index,
                                    f"Set({col}, {self.field}={row})")
         except (ClientError, OSError):
             return False
         self.acked.setdefault(row, set()).add(col)
+        return True
+
+    def clear(self, row: int, col: int, via: int = 0) -> bool:
+        """One ``Clear``.  The ATTEMPT removes the bit from ``acked``
+        (a failed clear may still have applied — state unknown); an
+        acked clear moves it to ``cleared``: the bit must be absent on
+        every node once hints drain, and must NEVER be resurrected by
+        anti-entropy."""
+        self.acked.setdefault(row, set()).discard(col)
+        try:
+            self.client(via).query(self.index,
+                                   f"Clear({col}, {self.field}={row})")
+        except (ClientError, OSError):
+            return False
+        self.attempted.setdefault(row, set()).discard(col)
+        self.cleared.setdefault(row, set()).add(col)
+        return True
+
+    def clear_row(self, row: int, via: int = 0) -> bool:
+        """One ``ClearRow``; on ack, every bit the row might hold
+        becomes cleared-and-must-stay-absent (until re-set)."""
+        self.acked[row] = set()
+        try:
+            self.client(via).query(self.index,
+                                   f"ClearRow({self.field}={row})")
+        except (ClientError, OSError):
+            return False
+        self.cleared.setdefault(row, set()).update(
+            self.attempted.get(row, set()))
+        self.attempted[row] = set()
         return True
 
     def random_writes(self, count: int, via: int = 0) -> int:
@@ -220,9 +285,10 @@ class ChaosHarness:
 
     def check_oracle(self, via: int | None = None) -> None:
         """Every node's answer for every row satisfies
-        ``acked ⊆ observed ⊆ attempted`` (and Count agrees with Row) —
-        acked writes are never lost, and nothing appears that was never
-        written (corruption / replayed half-records)."""
+        ``acked ⊆ observed ⊆ attempted`` and ``observed ∩ cleared = ∅``
+        (and Count agrees with Row) — acked writes are never lost,
+        nothing appears that was never written (corruption / replayed
+        half-records), and an acked clear is never resurrected."""
         nodes = [via] if via is not None else range(self.n)
         for i in nodes:
             c = self.client(i)
@@ -235,10 +301,15 @@ class ChaosHarness:
                 count = res[1]
                 acked = self.acked.get(row, set())
                 attempted = self.attempted.get(row, set())
+                cleared = self.cleared.get(row, set())
                 if not acked <= got:
                     raise self._fail(
                         f"node {i} row {row}: LOST acked writes "
                         f"{sorted(acked - got)[:10]}")
+                if got & cleared:
+                    raise self._fail(
+                        f"node {i} row {row}: RESURRECTED cleared bits "
+                        f"{sorted(got & cleared)[:10]}")
                 if not got <= attempted:
                     raise self._fail(
                         f"node {i} row {row}: phantom bits "
@@ -496,12 +567,16 @@ def scenario_dropped_internal_response_trace(cluster,
 
 
 def scenario_node_kill_failover(cluster, seed: int) -> ChaosHarness:
-    """kill -9 a replica-holding node MID-SERVE (replicas=2): every
-    read keeps answering oracle-exact through replica failover — zero
-    query failures from the kill onward — the entry node's breaker for
-    the dead peer opens (routing then skips it entirely), strict
-    writes still refuse as today, and after a restart the breaker
-    closes via heartbeat probes and every node serves again."""
+    """kill -9 a replica-holding node MID-SERVE (replicas=2, hinted
+    handoff DISABLED — the legacy strict-write pin): every read keeps
+    answering oracle-exact through replica failover — zero query
+    failures from the kill onward — the entry node's breaker for the
+    dead peer opens (routing then skips it entirely), strict writes
+    refuse loudly with the structured 503, and after a restart the
+    breaker closes via heartbeat probes and every node serves again.
+    Requires a cluster booted with ``PILOSA_HINT_MAX_AGE=0`` (see
+    SCENARIOS) — the handoff-enabled write path has its own scenario,
+    ``clear_during_kill_handoff``."""
     h = ChaosHarness(cluster, seed, index="chaos_kill")
     h.setup()
     # bits in every shard so every node's shard group is exercised
@@ -540,15 +615,17 @@ def scenario_node_kill_failover(cluster, seed: int) -> ChaosHarness:
         raise h._fail("no read ever failed over to a replica")
     for _ in range(5):  # breaker open: reads keep serving
         h.check_oracle(via=entry)
-    # write-path strictness unchanged: ClearRow touches every replica
-    # including the dead one and must refuse loudly, not half-apply
+    # write-path strictness (handoff disabled): ClearRow touches every
+    # replica including the dead one and must refuse loudly with the
+    # structured 503 (r13) — never half-apply
     try:
         h.client(entry).query(h.index, f"ClearRow({h.field}=0)")
     except (ClientError, OSError) as e:
-        if getattr(e, "status", 0) != 400:
+        if getattr(e, "status", 0) != 503:
             raise h._fail(f"strict write failed oddly: {e!r}")
     else:
-        raise h._fail("ClearRow succeeded with a replica dead")
+        raise h._fail("ClearRow succeeded with a replica dead and "
+                      "handoff disabled")
     h.check_oracle(via=entry)  # the refused clear mutated nothing
     # restart: the breaker must close via the heartbeat probe and the
     # node must serve its shards again
@@ -682,6 +759,147 @@ def scenario_breaker_lifecycle(cluster, seed: int) -> ChaosHarness:
     return h
 
 
+def scenario_clear_during_kill_handoff(cluster, seed: int) -> ChaosHarness:
+    """kill -9 one of replicas=2 MID-SERVE with durable hinted handoff
+    ON (the default): Set, Clear and ClearRow ALL keep serving — zero
+    refusals from the kill through breaker-open — with the dead
+    owner's copies durably hinted on the entry node.  After a restart
+    the heartbeat-triggered drain replays the hint log in order; every
+    node then answers oracle-exact, and a forced anti-entropy round on
+    every node resurrects nothing (AAE deferred union-merge while the
+    hints were pending — the r13 ordering rule)."""
+    h = ChaosHarness(cluster, seed, index="chaos_handoff")
+    h.setup()
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(24)
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = next(i for i in range(h.n) if i != victim)
+    victim_id = h.node_id(victim)
+    cluster.nodes[victim].kill9()
+    # serve writes THROUGH the corpse: every op class must keep acking
+    # (pre-breaker legs to the dead node fail mid-apply and hand off;
+    # post-open the split hints up front) — zero refusals allowed
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        row = h.rng.randrange(h.N_ROWS)
+        if not h.write(row, h.rng.randrange(h.MAX_COL), via=entry):
+            raise h._fail("Set refused with a replica dead")
+        if not h.clear(row, h.rng.randrange(h.MAX_COL), via=entry):
+            raise h._fail("Clear refused with a replica dead")
+        if h.breaker_state(entry, victim_id) == "open":
+            break
+    else:
+        raise h._fail("breaker never opened for the dead peer")
+    if not h.clear_row(2, via=entry):
+        raise h._fail("ClearRow refused with a replica dead")
+    # post-open writes keep serving too (handoff up front now)
+    if not h.write(2, 5, via=entry) or not h.clear(2, 5, via=entry):
+        raise h._fail("write refused after breaker opened")
+    # the missed copies are durably queued and visible on writeHealth
+    wh = h.client(entry).write_health()
+    if not wh.get("hintBacklogOps"):
+        raise h._fail(f"no hint backlog after serving through a dead "
+                      f"replica: {wh}")
+    if victim_id not in {p["id"] for p in wh.get("peers", [])}:
+        raise h._fail(f"dead peer missing from writeHealth: {wh}")
+    for i in (coord, entry):
+        h.check_oracle(via=i)  # live nodes exact while hints pend
+    # restart: rejoin triggers the drain; the log must empty and every
+    # node (the rejoined one included) answer oracle-exact
+    node = cluster.nodes[victim]
+    node.stop()  # reap the corpse + release handles
+    node.start()
+    node.await_up()
+    cluster.await_membership(3, timeout=120)
+    h.await_hints_drained(entry)
+    h.await_oracle()
+    if h.counter_total(entry, "hint_replay_total") < 1:
+        raise h._fail("hint_replay_total never incremented")
+    # the sharpest invariant: force AAE everywhere AFTER the drain —
+    # union-merge must not resurrect a single cleared bit
+    for i in range(h.n):
+        h.client(i)._json("POST", "/internal/aae/run", {})
+    h.check_oracle()
+    return h
+
+
+def scenario_coordinator_crash_hint_log(cluster, seed: int) -> ChaosHarness:
+    """kill -9 the WRITE COORDINATOR mid-hint-append (replicas=2, one
+    peer already dead and hinted): the ``hints.append`` torn-write
+    failpoint persists only a prefix of the record before the crash.
+    Recovery must yield a replayable-or-cleanly-truncated log — the
+    acked clears (the clean prefix) replay to the rejoined peer and
+    stay absent everywhere, while the torn op NEVER applies: its
+    un-acked Clear's bit remains present on every node (hint-before-
+    apply ordering means nothing mutated before the tear)."""
+    h = ChaosHarness(cluster, seed, index="chaos_hintcrash")
+    h.setup()
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(16)
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = next(i for i in range(h.n) if i != victim)
+    # shards the victim replicates: a strict Clear there must hint.
+    # torn_col (set in setup, still acked) is the victim of the torn
+    # append — the cleared loop below stays off offset 1 so it can
+    # never be legitimately cleared first.
+    held = sorted(h.client(victim)._json(
+        "GET", f"/internal/shards?index={h.index}")["shards"])
+    if not held:
+        raise h._fail("victim holds no shard — scenario invalid")
+    torn_col = held[0] * SHARD_WIDTH + 1
+    cluster.nodes[victim].kill9()
+    # acked clears while the peer is dead: these hints form the clean
+    # prefix that must survive the coordinator crash and replay
+    cleared_cols = []
+    deadline = time.monotonic() + 30
+    while len(cleared_cols) < 4 and time.monotonic() < deadline:
+        s = h.rng.choice(held)
+        col = s * SHARD_WIDTH + h.rng.randrange(2, 1000)
+        if h.write(0, col, via=entry) and h.clear(0, col, via=entry):
+            cleared_cols.append(col)
+    if len(cleared_cols) < 4:
+        raise h._fail("could not ack clears through the dead replica")
+    wh = h.client(entry).write_health()
+    if not wh.get("hintBacklogOps"):
+        raise h._fail("no hints pending before the coordinator crash")
+    # tear the NEXT hint append mid-record, then kill -9 the
+    # coordinator (the tear IS the crash; the kill makes it real
+    # before anything else can append behind the torn tail)
+    h.set_fault(entry, "hints.append", "torn_write", nth=1,
+                args={"offset": h.rng.randrange(1, 20)})
+    try:
+        h.client(entry).query(h.index, f"Clear({torn_col}, {h.field}=0)")
+    except (ClientError, OSError):
+        pass  # the op must FAIL: its hint never became durable
+    else:
+        raise h._fail("Clear acked despite a torn hint append")
+    cluster.nodes[entry].kill9()
+    # restart the coordinator FIRST (it recovers the hint log and
+    # advertises the backlog on its heartbeats — AAE gating resumes
+    # before the stale peer can sync), then the hinted peer
+    for i in (entry, victim):
+        node = cluster.nodes[i]
+        node.stop()
+        node.start()
+        node.await_up()
+    cluster.await_membership(3, timeout=120)
+    h.await_hints_drained(entry)
+    h.await_oracle()  # acked clears absent everywhere; torn-op bit
+    #                   still present everywhere (it stayed acked)
+    for i in range(h.n):
+        h.client(i)._json("POST", "/internal/aae/run", {})
+    h.check_oracle()
+    return h
+
+
 SCENARIOS = {
     "partition_during_resize": (scenario_partition_during_resize, 3),
     "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
@@ -692,10 +910,15 @@ SCENARIOS = {
         (scenario_dropped_internal_response_trace, 3),
     # r11 — serving through failure (the third element, when present,
     # is extra env the scenario's cluster must boot with)
-    "node_kill_failover": (scenario_node_kill_failover, 3),
+    "node_kill_failover": (scenario_node_kill_failover, 3,
+                           {"PILOSA_HINT_MAX_AGE": "0"}),
     "straggler_hedged_read": (scenario_straggler_hedged_read, 3,
                               {"PILOSA_HEDGE_AFTER": "0.15"}),
     "breaker_lifecycle": (scenario_breaker_lifecycle, 3),
+    # r13 — writes through failure (durable hinted handoff)
+    "clear_during_kill_handoff": (scenario_clear_during_kill_handoff, 3),
+    "coordinator_crash_hint_log": (scenario_coordinator_crash_hint_log,
+                                   3),
 }
 
 

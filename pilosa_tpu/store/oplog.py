@@ -31,7 +31,9 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
+from collections import OrderedDict
 from typing import Iterator
 
 import numpy as np
@@ -132,3 +134,119 @@ class OpLog:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+class IdWindow:
+    """Durable bounded set of applied operation ids — the receiver-side
+    dedup window behind the idempotent hint-replay endpoint (a
+    re-delivered or re-sent batch must be a no-op, or a replayed
+    ``Clear`` could land AFTER a newer direct ``Set`` and destroy it).
+
+    Same recovery discipline as :class:`OpLog`: CRC-framed appends
+    through the ``sys.write`` torn-write seam, clean-prefix replay on
+    open (a torn tail record truncates away).  Record layout::
+
+        u32 crc32 (of everything after this field)
+        u8  len   id byte length
+        id        utf-8 op id
+
+    The newest ``cap`` ids are held in memory; once the file carries
+    more than ``2 * cap`` records it is compacted (tmp + rename) down
+    to the in-memory window.  Ids are random 128-bit tokens, so a
+    window of thousands is far wider than any in-flight replay batch.
+    """
+
+    _HEAD = struct.Struct("<IB")
+
+    def __init__(self, path: str, cap: int = 8192):
+        self.path = path
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._ids: OrderedDict[str, None] = OrderedDict()
+        self._f = None
+        self._file_records = 0
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        pos = 0
+        good_end = 0
+        while pos + self._HEAD.size <= len(buf):
+            crc, ln = self._HEAD.unpack_from(buf, pos)
+            end = pos + self._HEAD.size + ln
+            if end > len(buf):
+                break
+            body = buf[pos + 4:end]
+            if zlib.crc32(body) != crc:
+                break
+            try:
+                op_id = buf[pos + self._HEAD.size:end].decode()
+            except UnicodeDecodeError:
+                break
+            self._ids[op_id] = None
+            self._ids.move_to_end(op_id)
+            self._file_records += 1
+            pos = end
+            good_end = end
+        if good_end < len(buf):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+        while len(self._ids) > self.cap:
+            self._ids.popitem(last=False)
+
+    def __contains__(self, op_id: str) -> bool:
+        with self._lock:
+            return op_id in self._ids
+
+    def add(self, op_id: str) -> bool:
+        """Record one applied id durably; False when already present
+        (the caller skips the op — dedup hit)."""
+        with self._lock:
+            if op_id in self._ids:
+                return False
+            raw = op_id.encode()
+            body = struct.pack("<B", len(raw)) + raw
+            record = struct.pack("<I", zlib.crc32(body)) + body
+            if self._f is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._f = open(self.path, "ab")
+            syswrap.checked_write(self._f, record)
+            self._f.flush()
+            self._ids[op_id] = None
+            self._file_records += 1
+            while len(self._ids) > self.cap:
+                self._ids.popitem(last=False)
+            if self._file_records > 2 * self.cap:
+                self._compact()
+            return True
+
+    def _compact(self) -> None:
+        """Rewrite the file down to the in-memory window (caller holds
+        the lock).  Atomic via tmp + rename; a crash leaves either file
+        and both recover cleanly."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for op_id in self._ids:
+                raw = op_id.encode()
+                body = struct.pack("<B", len(raw)) + raw
+                f.write(struct.pack("<I", zlib.crc32(body)) + body)
+        os.replace(tmp, self.path)
+        self._file_records = len(self._ids)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -273,3 +273,44 @@ def test_config22_availability_smoke():
     assert out["detail"]["breaker_transitions_total"] >= 1
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config24_write_availability_smoke():
+    """bench/config24 (WRITE availability through a kill -9 + rejoin,
+    r13 hinted handoff) in --smoke mode: 3-process cluster,
+    replicas=2, a replica-holding node killed MID-SERVE under mixed
+    95/5 and 80/20 read/write load — the headline acceptance bar is
+    pinned here: write availability 1.0 (ZERO refused or failed
+    writes through the failure window), reads stay clean too, the
+    rejoined node's hint backlog drains, and every node answers the
+    write lanes exactly (no lost op, no resurrected clear) — runs
+    under tier-1 so the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config24_write_availability.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("write_availability_node_kill")
+    assert out["unit"] == "ratio"
+    # the acceptance criterion: zero failed WRITES through the kill,
+    # for BOTH mixes
+    assert out["value"] == 1.0, out["detail"]["mixes"]
+    for mix in ("95/5", "80/20"):
+        m = out["detail"]["mixes"][mix]
+        assert m["failure"]["writes"]["failed"] == 0, m["failure"]
+        assert m["failure"]["reads"]["failed"] == 0, m["failure"]
+        assert m["rejoin"]["writes"]["failed"] == 0
+        # the kill actually produced hints, and they drained
+        assert m["hint_backlog_ops"] >= 1
+        assert m["exactness_checks"] > 0
+    assert out["detail"]["hint_replay_total"] >= 1
+    assert out["detail"]["hint_handoff_total"] >= 1
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

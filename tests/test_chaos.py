@@ -8,13 +8,21 @@ distributed invariant after faults clear:
 - dropped placement broadcast  → heartbeat pull-on-mismatch converges
 - dropped internal response    → the redelivered fan-out leg surfaces
                                  as a `retried` tag in the profile tree
-- node kill failover           → kill -9 mid-serve: zero read failures
-                                 (replica failover), breaker opens,
-                                 strict writes refuse, rejoin closes it
+- node kill failover           → kill -9 mid-serve (handoff off): zero
+                                 read failures (replica failover),
+                                 breaker opens, strict writes refuse
+                                 503, rejoin closes it
 - straggler hedged read        → hedging bounds a delayed leg; the
                                  winner carries the `hedged` trace tag
 - breaker lifecycle            → open→half_open→closed pinned through
                                  partition and heal
+- clear during kill handoff    → kill -9 mid-serve (handoff on):
+                                 Set/Clear/ClearRow all keep serving,
+                                 rejoin drains hints, oracle-exact
+                                 everywhere, AAE resurrects nothing
+- coordinator crash hint log   → kill -9 mid-hint-append: the torn op
+                                 never applies, the clean prefix
+                                 replays after restart
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -66,10 +74,31 @@ def test_crash_mid_oplog_append(tmp_path):
 
 def test_node_kill_failover(tmp_path):
     # own cluster: the scenario kill -9s and restarts a member — the
-    # shared trio must stay pristine for its other scenarios
+    # shared trio must stay pristine for its other scenarios.  Hinted
+    # handoff is disabled (the legacy strict-write pin).
+    env = dict(chaos.SCENARIOS["node_kill_failover"][2])
+    with run_process_cluster(3, str(tmp_path), replicas=2,
+                             anti_entropy=1.0,
+                             extra_env=env) as cluster:
+        chaos.scenario_node_kill_failover(cluster, SEED)
+
+
+def test_clear_during_kill_handoff(tmp_path):
+    # own cluster (kill -9 + restart); handoff on by default — the r13
+    # write-availability proof: every write class serves through the
+    # kill, the rejoin drain replays, forced AAE resurrects nothing
     with run_process_cluster(3, str(tmp_path), replicas=2,
                              anti_entropy=1.0) as cluster:
-        chaos.scenario_node_kill_failover(cluster, SEED)
+        chaos.scenario_clear_during_kill_handoff(cluster, SEED)
+
+
+def test_coordinator_crash_hint_log(tmp_path):
+    # own cluster: tears a hint append mid-record and kill -9s the
+    # write coordinator — recovery must truncate the torn op and
+    # replay the clean prefix
+    with run_process_cluster(3, str(tmp_path), replicas=2,
+                             anti_entropy=1.0) as cluster:
+        chaos.scenario_coordinator_crash_hint_log(cluster, SEED)
 
 
 def test_straggler_hedged_read(tmp_path):

@@ -47,6 +47,42 @@ class TestMembership:
         coords = {s.cluster.coordinator_id() for s in three_nodes.servers}
         assert len(coords) == 1
 
+    def test_unknown_heartbeat_sender_pulls_full_state(self, three_nodes):
+        """Regression (r13): membership re-learn must not depend on a
+        NEWER placementVersion.  Two nodes cold-restarted together
+        (the seed plus a peer, kill -9'd in the same failure) each
+        come back knowing only themselves while the PERSISTED
+        placement version equals their peers' — the version-gated
+        pull never fired, each re-learned only nodes that heartbeat
+        THEM, and the two restarts never learned each other: an
+        asymmetric membership split that wedged forever (surfaced by
+        chaos ``coordinator_crash_hint_log``).  An UNKNOWN heartbeat
+        sender is itself proof the receiver's view is stale and must
+        trigger the full-state pull, same version or not."""
+        import time
+        cl = three_nodes.servers[0].cluster
+        peer = three_nodes.servers[1].cluster.node_id
+        third = three_nodes.servers[2].cluster.node_id
+        # simulate the cold restart: node0 lost everyone but itself,
+        # placement version unchanged (it persists across restarts)
+        with cl._lock:
+            cl.nodes.pop(peer, None)
+            cl.nodes.pop(third, None)
+            cl._last_seen.pop(peer, None)
+            cl._last_seen.pop(third, None)
+        assert cl.member_ids() == [cl.node_id]
+        # one heartbeat from node1 at the SAME placement version must
+        # re-teach the full membership — node2 included — via the pull
+        cl.handle_heartbeat(peer, "NORMAL",
+                            placement_version=cl.placement_version)
+        want = {cl.node_id, peer, third}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if set(cl.member_ids()) == want:
+                break
+            time.sleep(0.05)
+        assert set(cl.member_ids()) == want
+
 
 class TestDistributedQueries:
     def test_schema_broadcast(self, three_nodes):
@@ -525,9 +561,12 @@ class TestTransportErrorClassification:
 
 
 class TestWriteSemanticsUnderNodeLoss:
-    """Set is best-effort over reachable owners (AAE repairs a dead
-    replica on rejoin); Clear-family ops are strict — a clear missed by
-    a down replica would be resurrected by union-merge AAE."""
+    """r13 contract: EVERY write serves through a dead replica — the op
+    applies on the live owners and the dead one's copy is durably
+    hinted for ordered replay on rejoin.  With handoff disabled
+    (hint_max_age=0) the legacy contract is pinned: Set best-effort,
+    Clear-family strict fail-fast (a clear missed by a down replica
+    would be resurrected by union-merge AAE)."""
 
     @staticmethod
     def _kill_non_coordinator(c):
@@ -545,9 +584,7 @@ class TestWriteSemanticsUnderNodeLoss:
             time.sleep(0.05)
         raise TimeoutError("node loss never detected")
 
-    def test_set_best_effort_clear_strict(self, tmp_path):
-        from pilosa_tpu.api.client import ClientError
-
+    def test_writes_serve_through_dead_replica_with_hints(self, tmp_path):
         with run_cluster(3, str(tmp_path), replicas=2,
                          heartbeat=0.1) as c:
             c.client(0).create_index("i")
@@ -567,19 +604,255 @@ class TestWriteSemanticsUnderNodeLoss:
                 assert cl.query(
                     "i", f"Set({s * SHARD_WIDTH + 7}, f=1)") == [True]
             assert cl.query("i", "Count(Row(f=1))") == [12]
-            # Clear on a shard the dead node owns is rejected loudly
+            # Clear on a shard the dead node owns now SERVES: applied
+            # on the live owner, hinted for the dead one
             victim_shards = [
                 s for s in range(6) if victim_id in
                 alive[0].cluster.shard_owners("i", s)]
             assert victim_shards, "victim owns no shard — test invalid"
             col = victim_shards[0] * SHARD_WIDTH + 7
-            with pytest.raises(ClientError, match="resurrected"):
+            assert cl.query("i", f"Clear({col}, f=1)") == [True]
+            assert cl.query("i", "Count(Row(f=1))") == [11]
+            # the dead owner's copies are durably queued and visible
+            wh = cl.write_health()
+            assert wh["hintedHandoff"] is True
+            assert wh["hintBacklogOps"] >= 1
+            peers = {p["id"]: p for p in wh["peers"]}
+            assert victim_id in peers
+            assert peers[victim_id]["overflowed"] is False
+            # the hinted peer is no longer write-reachable: new writes
+            # to it keep appending BEHIND the older hints (ordering)
+            entry = alive[0].cluster
+            assert victim_id not in entry.dist._write_reachable()
+            # hint metadata is advertised for AAE gating
+            assert victim_id in entry.hinted_peers()
+
+    def test_legacy_strictness_with_handoff_disabled(self, tmp_path):
+        """hint_max_age=0 pins the pre-r13 contract: Set best-effort,
+        Clear refused 503 with the structured writeUnavailable body
+        naming the down replica."""
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(3, str(tmp_path), replicas=2, heartbeat=0.1,
+                         hint_max_age=0.0) as c:
+            assert c.servers[0].cluster.hints is None
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6,
+                                    columnIDs=cols)
+            victim_id = self._kill_non_coordinator(c)
+            alive = [s for s in c.servers
+                     if s.cluster.node_id != victim_id]
+            from pilosa_tpu.api.client import Client
+            cl = Client("127.0.0.1", alive[0].http.address[1])
+            for s in range(6):
+                assert cl.query(
+                    "i", f"Set({s * SHARD_WIDTH + 7}, f=1)") == [True]
+            victim_shards = [
+                s for s in range(6) if victim_id in
+                alive[0].cluster.shard_owners("i", s)]
+            assert victim_shards, "victim owns no shard — test invalid"
+            col = victim_shards[0] * SHARD_WIDTH + 7
+            with pytest.raises(ClientError, match="resurrected") as ei:
                 cl.query("i", f"Clear({col}, f=1)")
+            assert ei.value.status == 503
             # on a fully-alive owner set, Clear still works
             healthy = [s for s in range(6) if s not in victim_shards]
             if healthy:
                 hcol = healthy[0] * SHARD_WIDTH + 7
                 assert cl.query("i", f"Clear({hcol}, f=1)") == [True]
+
+    def test_refusal_body_names_replica_at_public_edge(self, tmp_path):
+        """The 503 refusal carries Retry-After and the structured
+        writeUnavailable body (op, replica, reason) — satellite 1."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        with run_cluster(2, str(tmp_path), replicas=2, heartbeat=0.1,
+                         hint_max_age=0.0) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).query("i", "Set(1, f=1)")
+            victim = c.servers[1]
+            victim_id = victim.cluster.node_id
+            victim.close()
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(c.servers[0].cluster.alive_ids()) == 1:
+                    break
+                time.sleep(0.05)
+            port = c.servers[0].http.address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/i/query",
+                data=b"Clear(1, f=1)", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            err = ei.value
+            assert err.code == 503
+            assert err.headers.get("Retry-After") is not None
+            body = _json.loads(err.read())
+            wu = body["writeUnavailable"]
+            assert wu["op"] == "Clear"
+            assert wu["replica"] == victim_id
+            assert wu["reason"] == "replica_down"
+            assert victim_id in body["error"]
+
+    def test_saturated_replica_is_not_hinted(self, tmp_path):
+        """Regression (r13 review): an ALIVE replica that answered 503
+        (admission shed — the op never executed there) must NOT be
+        treated like a dead one and hinted.  The peer keeps serving
+        reads, so hinting would ack a strict Clear that a read on that
+        replica then contradicts — and would wrongly AAE-gate and
+        write-block a merely-busy node.  Strict writes refuse with the
+        structured 503 (``replica_busy``); best-effort Sets fall back
+        to the legacy miss (AAE repairs), no hint either way."""
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         heartbeat=0.1) as c:
+            coord, peer = c.servers
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            c.client(0).query("i", "Set(1, f=1)")
+            client = coord.cluster._client(peer.cluster.node_id)
+            real = client._do
+
+            def shed_queries(method, path, body=None, **kw):
+                if path.startswith("/internal/query"):
+                    raise ClientError("executor saturated", status=503)
+                return real(method, path, body, **kw)
+
+            client._do = shed_queries
+            try:
+                with pytest.raises(ClientError) as ei:
+                    c.client(0).query("i", "Clear(1, f=1)")
+                assert ei.value.status == 503
+                assert "shed Clear" in str(ei.value)
+                assert peer.cluster.node_id in str(ei.value)
+                # the busy leg makes Set a best-effort miss, not a hint
+                assert c.client(0).query("i", "Set(2, f=1)") == [True]
+            finally:
+                client._do = real
+            hints = coord.cluster.hints
+            assert hints is not None and not hints.pending_peers(), (
+                "an answered 503 must never produce a hint")
+            # nothing gated, peer still write-reachable once unpatched
+            # (the returned changed-bool is the primary's, and the
+            # primary may be the peer that missed the Set — assert the
+            # end state, not the bool)
+            c.client(0).query("i", "Clear(2, f=1)")
+            for cl in c.clients:
+                (row,) = cl.query("i", "Row(f=1)")
+                assert 2 not in row["columns"]
+
+    def test_all_targets_dead_mid_apply_refuses_not_acks(self, tmp_path):
+        """Regression (r13 review): when a write's every live target
+        dies MID-APPLY (each hinted via the handoff callback), nothing
+        applied now — acking would claim otherwise.  The op refuses
+        no_live_replica; the queued hint still replays once the peer
+        answers again (at-least-once for the un-acked op)."""
+        import time
+
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=1,
+                         heartbeat=0.1) as c:
+            coord, peer = c.servers
+            peer_id = peer.cluster.node_id
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            # a column whose ONLY owner (replicas=1) is the peer
+            shard = next((s for s in range(32)
+                          if coord.cluster.shard_owners("i", s)
+                          == [peer_id]), None)
+            assert shard is not None, "peer owns no shard — test invalid"
+            col = shard * SHARD_WIDTH + 3
+            assert c.client(0).query("i", f"Set({col}, f=1)") == [True]
+            client = coord.cluster._client(peer_id)
+            real = client._do
+
+            def die(method, path, body=None, **kw):
+                if (path.startswith("/internal/query")
+                        or path.startswith("/internal/hints/replay")):
+                    raise ClientError("connection reset", status=0,
+                                      kind="unreachable")
+                return real(method, path, body, **kw)
+
+            client._do = die
+            try:
+                with pytest.raises(ClientError) as ei:
+                    c.client(0).query("i", f"Clear({col}, f=1)")
+                assert ei.value.status == 503
+                assert "no live replica" in str(ei.value)
+                # the mid-apply handoff durably queued the op anyway
+                assert coord.cluster.hints.has_pending(peer_id)
+            finally:
+                client._do = real
+            # peer answers again: the next heartbeat's drain delivers
+            # the un-acked Clear (at-least-once), converging the bit
+            deadline = time.monotonic() + 10
+            while (coord.cluster.hints.has_pending(peer_id)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert not coord.cluster.hints.has_pending(peer_id)
+            (row,) = c.client(1).query("i", "Row(f=1)")
+            assert col not in row["columns"]
+
+    def test_clearrow_shard_without_live_apply_refuses(self, tmp_path):
+        """Regression (r13 review): the same zero-live-applies rule
+        per shard on the ClearRow/Store leg path — a shard whose only
+        reachable owner died mid-apply has no live copy carrying the
+        clear, so the op must refuse, not ack on the other legs."""
+        import time
+
+        from pilosa_tpu.api.client import ClientError
+
+        with run_cluster(2, str(tmp_path), replicas=1,
+                         heartbeat=0.1) as c:
+            coord, peer = c.servers
+            peer_id = peer.cluster.node_id
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6,
+                                    columnIDs=cols)
+            owners = {s: coord.cluster.shard_owners("i", s)
+                      for s in range(6)}
+            assert any(o == [peer_id] for o in owners.values()), \
+                "peer owns no shard — test invalid"
+            client = coord.cluster._client(peer_id)
+            real = client._do
+
+            def die(method, path, body=None, **kw):
+                if (path.startswith("/internal/query")
+                        or path.startswith("/internal/hints/replay")):
+                    raise ClientError("connection reset", status=0,
+                                      kind="unreachable")
+                return real(method, path, body, **kw)
+
+            client._do = die
+            try:
+                with pytest.raises(ClientError) as ei:
+                    c.client(0).query("i", "ClearRow(f=1)")
+                assert ei.value.status == 503
+                assert "no live replica" in str(ei.value)
+                assert coord.cluster.hints.has_pending(peer_id)
+            finally:
+                client._do = real
+            deadline = time.monotonic() + 10
+            while (coord.cluster.hints.has_pending(peer_id)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert not coord.cluster.hints.has_pending(peer_id)
+            # the un-acked ClearRow converged everywhere: the shards
+            # the coordinator cleared before refusing AND the hinted
+            # peer's replayed shards
+            for cl in c.clients:
+                (row,) = cl.query("i", "Row(f=1)")
+                assert row["columns"] == []
 
     def test_clearrow_applies_on_every_replica(self, tmp_path):
         with run_cluster(3, str(tmp_path), replicas=2) as c:
@@ -1679,12 +1952,15 @@ class TestReadFailover:
             finally:
                 fault.clear()
 
-    def test_write_strictness_untouched(self, tmp_path):
-        """Reads fail over; writes keep today's semantics: with a
-        replica unreachable, Clear-family ops refuse loudly (a clear
-        missed by a down replica would be resurrected by AAE)."""
+    def test_writes_hint_through_partition_and_drain_on_heal(
+            self, tmp_path):
+        """Reads fail over; writes now serve through the partition too
+        (r13): ClearRow applies on the reachable owners, hints the
+        severed one, and the hint drains once the partition heals —
+        the cleared row stays cleared on EVERY node (no resurrection)."""
+        import time
+
         from pilosa_tpu import fault
-        from pilosa_tpu.api.client import ClientError
 
         with run_cluster(3, str(tmp_path), replicas=2,
                          heartbeat=0.2) as c:
@@ -1702,13 +1978,33 @@ class TestReadFailover:
                 for row, cols in oracle.items():
                     (got,) = c.client(0).query("i", f"Row(f={row})")
                     assert set(got["columns"]) == cols
-                # strict write: refused while a replica is unreachable
-                with pytest.raises(ClientError) as ei:
-                    c.client(0).query("i", "ClearRow(f=1)")
-                assert ei.value.status == 400
-                assert "unreachable" in str(ei.value)
+                # strict write: SERVES, hinting the severed replica
+                assert c.client(0).query("i", "ClearRow(f=1)") == [True]
+                wh = c.client(0).write_health()
+                assert wh["hintBacklogOps"] >= 1
+                assert vid in {p["id"] for p in wh["peers"]}
+                (got,) = c.client(0).query("i", "Row(f=1)")
+                assert got["columns"] == []
             finally:
                 fault.clear()
+            # heal: heartbeat-triggered drain replays the ClearRow on
+            # the severed node; the row must be empty EVERYWHERE and
+            # stay empty (AAE deferred while hints were pending)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if not c.client(0).write_health().get("hintBacklogOps"):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("hint backlog never drained")
+            for cl in c.clients:
+                (got,) = cl.query("i", "Row(f=1)")
+                assert got["columns"] == []
+            for srv in c.servers:
+                srv.cluster.sync_once()
+            for cl in c.clients:
+                (got,) = cl.query("i", "Row(f=1)")
+                assert got["columns"] == [], "AAE resurrected a clear"
 
 
 class TestHedgedReads:
