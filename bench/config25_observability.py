@@ -1,0 +1,231 @@
+"""Config #25: full-instrumentation overhead on the concurrent path.
+
+r14 widens the metrics plane substantially: trace exemplars on every
+latency observation, window-occupancy/fill histograms, per-kernel
+dispatch-seconds + bytes-scanned, a live ``kernel_bandwidth_gbps``
+gauge, plane-cache hit/lease accounting, fused-program counters.  All
+of it rides the serving hot path, so its cost must be measured, not
+assumed: this config reruns the config18 concurrency workload (the
+product path, oracle-verified every call) twice —
+
+- **off**: ``NopStats`` — every registry verb a no-op (the
+  instrumentation floor);
+- **full**: a real ``Stats`` registry with every r14 family live —
+  exemplar presence and the device-plane families asserted WHILE
+  measuring, so the cost figure covers the semantics it claims.
+
+Both tiers serve the identical lite-tracing default (rate 0, no slow
+capture): the ONLY delta under measurement is the metrics plane.
+
+The acceptance bar: full instrumentation within 3% of metrics-off at
+the widest concurrency level (asserted in full runs; ``--smoke`` runs
+tiny planes on CPU where fixed costs dominate and noise swamps a 3%
+bar, so smoke only sanity-bounds the ratio and asserts the emission
+semantics).
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 4 rows, sweep 1/2/4 —
+tier-1 runs it (tests/test_bench_smoke.py) so this bench can never
+bitrot.
+
+Prints ONE JSON line: overhead percent at the widest level,
+vs_baseline = fully-instrumented qps there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 4 if SMOKE else int(os.environ.get("PILOSA_BENCH_ROWS", "32"))
+SWEEP = ((1, 2, 4) if SMOKE else (1, 2, 4, 8, 16, 32, 64))
+ITERS = 3 if SMOKE else 6
+WORDS = 32768  # words per shard (2^20 bits / 32)
+INDEX, FIELD = "i", "f"
+MAX_OVERHEAD = 0.03  # the r14 acceptance bar (full runs)
+
+
+def write_index(plane: np.ndarray, data_dir: str) -> None:
+    """A REAL on-disk index from the packed plane (the config18
+    recipe): schema through the Holder, one roaring snapshot per
+    shard."""
+    from pilosa_tpu.store import Holder, roaring
+
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+
+
+def burst(fn, n_threads: int, iters: int, queries_per_call: int):
+    """n_threads concurrent clients each calling fn() iters times;
+    returns qps (raises on any worker error — a wrong answer under
+    concurrency is a failure, not a statistic)."""
+    barrier = threading.Barrier(n_threads + 1)
+    errors: list = []
+
+    def worker():
+        barrier.wait()
+        for _ in range(iters):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — surface after join
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        raise AssertionError(f"burst errors: {errors[:3]}")
+    return queries_per_call * iters * n_threads / dt
+
+
+def measure(api, want, label: str) -> dict:
+    pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+    assert api.query(INDEX, pql)["results"] == want, \
+        f"{label}: counts diverge from oracle"
+
+    def call():
+        if api.query(INDEX, pql)["results"] != want:
+            raise AssertionError(f"{label}: count mismatch")
+
+    qps = {}
+    for c in SWEEP:
+        qps[c] = burst(call, c, ITERS, N_ROWS)
+        log(f"{label:>4} {c:>2} clients: {qps[c]:,.1f} qps")
+    return qps
+
+
+def assert_r14_families(stats) -> dict:
+    """The semantics the overhead figure pays for, asserted on the
+    instrumented tier's registry AFTER measurement: exemplars on the
+    stage histogram, the device-plane telemetry families, per-kernel
+    scan accounting."""
+    text = stats.prometheus_text(openmetrics=True)
+    assert "query_stage_seconds_bucket" in text, "stage histogram missing"
+    exemplars = [ln for ln in text.splitlines()
+                 if "query_stage_seconds_bucket" in ln
+                 and "# {trace_id=" in ln]
+    assert exemplars, "no trace exemplars on the stage histogram"
+    snap = stats.full_snapshot()
+    counters = snap["counters"]
+    hists = snap["histograms"]
+    assert "batcher_window_items" in hists, "window-occupancy missing"
+    assert "batcher_window_fill_ratio" in hists, "fill-ratio missing"
+    # count-scale buckets, not the latency defaults (the per-family
+    # bucket satellite): occupancy's first bound is 1 item
+    assert hists["batcher_window_items"]["buckets"][0] == 1.0
+    assert "kernel_dispatch_seconds" in hists, "kernel dispatch missing"
+    assert "kernel_bytes_scanned_total" in counters, "scan bytes missing"
+    gauges = snap["gauges"]
+    assert "kernel_bandwidth_gbps" in gauges, "bandwidth gauge missing"
+    bw = [s["value"] for s in gauges["kernel_bandwidth_gbps"]]
+    scanned = sum(s["value"] for s in counters["kernel_bytes_scanned_total"])
+    return {"exemplar_buckets": len(exemplars),
+            "kernel_bytes_scanned": int(scanned),
+            "kernel_bandwidth_gbps": round(max(bw), 3)}
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs import Stats
+    from pilosa_tpu.store import Holder
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    oracle = (np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+              if hasattr(np, "bitwise_count") else
+              np.array([int(np.unpackbits(
+                  plane[:, r].reshape(-1).view(np.uint8)).sum())
+                  for r in range(N_ROWS)], dtype=np.int64))
+    want = [int(c) for c in oracle]
+
+    data_dir = tempfile.mkdtemp(prefix="pilosa_c25_")
+    try:
+        write_index(plane, data_dir)
+        holder = Holder(data_dir).open()
+        # instrumentation is baked into the executor at construction
+        # (plane cache, batcher, fused cache all hold the registry), so
+        # the tiers are two executors over ONE holder; both warm their
+        # plane before measurement so build cost stays off the sweep
+        stats = Stats()
+        ex_off = Executor(holder)            # NopStats default
+        ex_full = Executor(holder, stats=stats)
+        api_off = API(holder, ex_off, trace_sample_rate=0.0,
+                      slow_query_threshold=0.0)
+        api_full = API(holder, ex_full, trace_sample_rate=0.0,
+                       slow_query_threshold=0.0)
+
+        pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+        t0 = time.perf_counter()
+        assert api_off.query(INDEX, pql)["results"] == want
+        assert api_full.query(INDEX, pql)["results"] == want
+        log(f"first product queries (plane build + compile): "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        qps_off = measure(api_off, want, "off")
+        qps_full = measure(api_full, want, "full")
+
+        top = SWEEP[-1]
+        overhead = 1.0 - qps_full[top] / qps_off[top]
+        families = assert_r14_families(stats)
+        log(f"full-instrumentation overhead at {top} clients: "
+            f"{overhead * 100:.2f}% (off {qps_off[top]:,.1f} qps / full "
+            f"{qps_full[top]:,.1f} qps; {families})")
+        if SMOKE:
+            # toy scale: fixed per-query costs dominate and run-to-run
+            # noise far exceeds 3% — bound catastrophe only
+            assert overhead < 0.5, \
+                f"smoke instrumentation overhead {overhead:.2%} is " \
+                f"pathological"
+        else:
+            assert overhead < MAX_OVERHEAD, \
+                (f"full instrumentation costs {overhead:.2%} at {top} "
+                 f"clients; the r14 bar is {MAX_OVERHEAD:.0%}")
+        holder.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": f"observability_overhead_pct_{platform}",
+        "value": round(overhead * 100, 2), "unit": "pct",
+        "vs_baseline": round(qps_full[top], 1),
+        "detail": {"qps_off": {str(k): round(v, 1)
+                               for k, v in qps_off.items()},
+                   "qps_full": {str(k): round(v, 1)
+                                for k, v in qps_full.items()},
+                   **families}}))
+
+
+if __name__ == "__main__":
+    main()

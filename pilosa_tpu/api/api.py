@@ -11,6 +11,7 @@ executor for queries.
 from __future__ import annotations
 
 import io
+import logging
 import os
 import random
 import time as _time
@@ -208,6 +209,7 @@ class API:
           PQL, shards and duration intact; full executor trees need
           sampling/profile/floor)."""
         from pilosa_tpu.obs import GLOBAL_TRACER, LiteTracer, Tracer
+        from pilosa_tpu.obs.tracing import set_current_trace_id
         self._index(index)
         cap = self.query_timeout
         if timeout is None or timeout == 0:
@@ -223,9 +225,16 @@ class API:
         stats = self.executor.stats
         if not trace:
             tracer = LiteTracer()
+            # publish the id as this thread's ACTIVE trace id so log
+            # lines emitted while serving join the query's exemplar
+            # (one thread-local write — the lite path stays lite)
+            set_current_trace_id(tracer.trace_id)
             t0 = _time.perf_counter()
-            out, err = self._run_query(index, pql, shards, tracer,
-                                       deadline, timeout, t0)
+            try:
+                out, err = self._run_query(index, pql, shards, tracer,
+                                           deadline, timeout, t0)
+            finally:
+                set_current_trace_id(None)
             duration = _time.perf_counter() - t0
             if (self.slow_query_threshold > 0
                     and duration >= self.slow_query_threshold):
@@ -242,6 +251,7 @@ class API:
                 self.slow_log.record(self._slow_entry(
                     index, pql, shards, duration, root, err))
                 GLOBAL_TRACER.record(root)
+                self._log_slow(index, pql, duration, tracer.trace_id)
             if err is not None:
                 raise err
             out["traceId"] = tracer.trace_id
@@ -258,8 +268,12 @@ class API:
                 else "local")
         t0 = _time.perf_counter()
         with tracer.span("query", index=index, node=node) as root:
-            out, err = self._run_query(index, pql, shards, tracer,
-                                       deadline, timeout, t0)
+            set_current_trace_id(root.trace_id)
+            try:
+                out, err = self._run_query(index, pql, shards, tracer,
+                                           deadline, timeout, t0)
+            finally:
+                set_current_trace_id(None)
             if err is not None:
                 root.tags["error"] = str(err)
         duration = _time.perf_counter() - t0
@@ -271,6 +285,7 @@ class API:
             stats.count("slow_query_total", 1)
             self.slow_log.record(self._slow_entry(
                 index, pql, shards, duration, root, err))
+            self._log_slow(index, pql, duration, root.trace_id)
         if sampled or slow or profile:
             # publish into the process ring so the trace id resolves
             # via GET /internal/traces?trace_id= after the request
@@ -325,6 +340,19 @@ class API:
             "traceId": root.trace_id,
             "error": str(err) if err is not None else None,
             "profile": root.to_json()}
+
+    def _log_slow(self, index: str, pql: str, duration: float,
+                  trace_id: str) -> None:
+        """One WARNING log line per slow-query capture, carrying the
+        query's trace id as a record attribute (the JSON formatter
+        emits it as ``traceId``): the correlated-logs leg of the
+        observability pane — a p99 bucket's exemplar, the retained
+        trace at ``/internal/traces?trace_id=``, and this line join on
+        one id."""
+        logging.getLogger("pilosa_tpu.api").warning(
+            "slow query %.3fs index=%s pql=%s",
+            duration, index, pql if len(pql) <= 200 else pql[:200] + "…",
+            extra={"traceId": trace_id})
 
     # -- imports ------------------------------------------------------------
 

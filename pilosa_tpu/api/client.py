@@ -510,5 +510,9 @@ class Client:
     def version(self) -> str:
         return self._json("GET", "/version")["version"]
 
-    def metrics_text(self) -> str:
-        return self._do("GET", "/metrics").decode()
+    def metrics_text(self, openmetrics: bool = False) -> str:
+        """/metrics exposition text; ``openmetrics`` negotiates the
+        OpenMetrics format (the only one that carries exemplars)."""
+        headers = ({"Accept": "application/openmetrics-text"}
+                   if openmetrics else None)
+        return self._do("GET", "/metrics", headers=headers).decode()

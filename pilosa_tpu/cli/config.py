@@ -20,6 +20,12 @@ class Config:
     bind: str = "127.0.0.1:10101"
     data_dir: str = "~/.pilosa_tpu"
     verbose: bool = False
+    # "text" (key=value lines) or "json": one JSON object per line
+    # with the active trace id injected as ``traceId`` — the
+    # correlated-logs leg of the observability pane (a latency
+    # exemplar, its /internal/traces tree, and its log lines join
+    # on one id)
+    log_format: str = "text"
     fsync: bool = False
     # cluster
     name: str = ""                      # node id; default derived from bind

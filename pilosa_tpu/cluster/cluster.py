@@ -1447,6 +1447,82 @@ class Cluster:
             "POST", f"/internal/fragment/merge?{qs}", blob,
             content_type="application/octet-stream")
 
+    # -- observability fan-in (r14: the single-pane cluster view) ------------
+
+    # per-peer budget for one observability fetch: a scrape of the
+    # whole fleet must finish inside a Prometheus scrape interval even
+    # when one peer is wedged mid-crash (the fetches run concurrently,
+    # so this bounds the WHOLE fan-in, not N× it)
+    OBS_FANIN_TIMEOUT = 2.0
+
+    def _obs_fanin(self, fetch) -> tuple[dict[str, dict], list[str]]:
+        """Breaker-aware concurrent fan-out of one observability fetch
+        per peer; returns ``({node_id: payload}, [stale node ids])``.
+
+        Partial-result contract: a suspect member, an open-breaker
+        peer, a failed fetch, or a fetch still running at the overall
+        deadline lands the node on the ``stale`` list — never an
+        error, and never a probe.  Scrapes OBSERVE the fleet; they
+        must not perturb routing, so outcomes here deliberately stay
+        out of the breaker accounting (a monitoring burst against a
+        half-open peer must not flap reads).
+
+        Each fetch thread writes ONLY its own slot dict: the client
+        timeout is per socket operation, not a deadline (connect +
+        read + an idempotent-GET retry can outlive the join budget),
+        so a thread may finish AFTER this method returned — a shared
+        dict would then mutate under the caller's render iteration.
+        Threads alive at the deadline are reported stale and their
+        late result is simply never read."""
+        alive = set(self.alive_ids())
+        peers = [nid for nid in self.member_ids() if nid != self.node_id]
+
+        def one(nid: str, slot: dict) -> None:
+            if nid not in alive or self.breakers.state(nid) == "open":
+                return  # empty slot = stale
+            try:
+                slot["payload"] = fetch(self._client(nid))
+            except Exception:  # noqa: BLE001 — degraded, never an error
+                pass
+
+        slots = [(nid, {}) for nid in peers]
+        threads = [threading.Thread(target=one, args=(nid, slot),
+                                    name="pilosa-obs-fanin", daemon=True)
+                   for nid, slot in slots]
+        for t in threads:
+            t.start()
+        # one overall deadline (not per-thread): a scrape of the whole
+        # fleet must finish inside a Prometheus scrape interval even
+        # when several peers are wedged mid-crash
+        deadline = time.monotonic() + self.OBS_FANIN_TIMEOUT + 1.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        results: dict[str, dict] = {}
+        stale: list[str] = []
+        for (nid, slot), t in zip(slots, threads):
+            payload = None if t.is_alive() else slot.get("payload")
+            if payload is None:
+                stale.append(nid)
+            else:
+                results[nid] = payload
+        return results, sorted(stale)
+
+    def metrics_snapshots(self) -> tuple[dict[str, dict], list[str]]:
+        """Per-peer :meth:`pilosa_tpu.obs.metrics.Stats.full_snapshot`
+        payloads for the ``GET /metrics/cluster`` fan-in (the caller
+        adds its own local snapshot after refreshing scrape-time
+        gauges)."""
+        return self._obs_fanin(
+            lambda client: client._do(
+                "GET", "/internal/metrics/snapshot",
+                timeout=self.OBS_FANIN_TIMEOUT)["snapshot"])
+
+    def status_snapshots(self) -> tuple[dict[str, dict], list[str]]:
+        """Per-peer ``/status`` payloads for ``GET /status/cluster``."""
+        return self._obs_fanin(
+            lambda client: client._do("GET", "/status",
+                                      timeout=self.OBS_FANIN_TIMEOUT))
+
     # -- introspection -------------------------------------------------------
 
     def health_payload(self) -> dict:

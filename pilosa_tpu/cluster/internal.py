@@ -149,6 +149,12 @@ def h_internal_query(self: Handler) -> None:
     ctx = (tracer.extract(self.headers, "internal.query",
                           node=node, index=index)
            if tracer is not None else nullcontext())
+    # propagated legs carry the coordinator's trace id in the header
+    # (flags "00" included — the lite path propagates identity): make
+    # it this thread's ACTIVE id so the peer's log lines join the same
+    # trace as the coordinator's exemplar and span tree
+    from pilosa_tpu.obs.tracing import set_current_trace_id
+    set_current_trace_id(parsed[0] if parsed is not None else None)
     try:
         with ctx as span:
             results = api.executor.execute(index, pql, shards=shards,
@@ -172,6 +178,10 @@ def h_internal_query(self: Handler) -> None:
         raise ApiError.write_unavailable(e)
     except (ParseError, ExecutionError) as e:
         raise ApiError(str(e), 400)
+    finally:
+        # handler threads serve keep-alive connections: a stale id
+        # must not bleed into the next request's log lines
+        set_current_trace_id(None)
     out = {"results": [result_to_json(r) for r in results]}
     if span is not None:
         # ship the finished subtree back for coordinator-side grafting

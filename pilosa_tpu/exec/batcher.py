@@ -78,12 +78,20 @@ class CountBatcher:
     def __init__(self, fused, window_s="adaptive", max_batch: int = 64,
                  stats=None):
         from pilosa_tpu.obs import NopStats
+        from pilosa_tpu.obs.metrics import (BYTE_BUCKETS, COUNT_BUCKETS,
+                                            RATIO_BUCKETS)
         self.fused = fused
         self.adaptive = window_s == "adaptive"
         self.window_s = 0.0 if self.adaptive else float(window_s)
         self._win = 0.0 if self.adaptive else self.window_s
         self.max_batch = max_batch
         self.stats = stats or NopStats()
+        # device-plane telemetry (r14): window occupancy and fill are
+        # item counts / ratios, not latencies — declare their bucket
+        # sets up front (idempotent; see Stats.set_buckets)
+        self.stats.set_buckets("batcher_window_items", COUNT_BUCKETS)
+        self.stats.set_buckets("batcher_window_fill_ratio", RATIO_BUCKETS)
+        self.stats.set_buckets("kernel_window_bytes", BYTE_BUCKETS)
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._kick = threading.Event()
@@ -210,6 +218,13 @@ class CountBatcher:
             self.stats.count("batcher_batches", 1)
             self.stats.count("batcher_items", len(batch))
             self.stats.gauge("batcher_window_seconds", self._win)
+            # window occupancy + fill ratio (r14 device telemetry):
+            # the coalescing histograms the config23 roofline reasons
+            # about — how many items a window actually collects and
+            # how close it runs to max_batch
+            self.stats.observe("batcher_window_items", float(len(batch)))
+            self.stats.observe("batcher_window_fill_ratio",
+                               len(batch) / self.max_batch)
             # stacked outputs need uniform shapes: group by kind + the
             # output-shaping leaf dimension (counts: n_shards — mixed
             # row/plane leaf ranks fuse fine, only the int32[S] outputs
@@ -241,6 +256,7 @@ class CountBatcher:
                         self._run_distinct, group))
                 else:
                     program_groups.append((key, group))
+            t_disp = time.perf_counter()
             if len(program_groups) == 1:
                 # the common (and solo-path) case skips the pool
                 # round-trip: one group, dispatch inline
@@ -263,7 +279,28 @@ class CountBatcher:
                         pending.append((key, group) + fut.result())
                     except Exception:  # noqa: BLE001 — per-item fallback
                         self._run_fallback(key, group)
+            # bytes the window's fused programs read from HBM (r14):
+            # per-kind scan-volume counters feed capacity math, and
+            # bytes / (dispatch -> readback-complete) is the LIVE
+            # bandwidth the config23 roofline bench measures offline —
+            # the gauge tracks how far serving sits from that roof
+            win_bytes = 0
+            for key, group, _, _ in pending:
+                nbytes = self._group_bytes(key[0], group)
+                if nbytes:
+                    self.stats.count("kernel_bytes_scanned_total",
+                                     nbytes, kind=key[0])
+                    win_bytes += nbytes
             self._readback(pending)
+            if win_bytes:
+                # per-window scan-volume distribution (byte-scale
+                # buckets) + the live bandwidth the window achieved
+                self.stats.observe("kernel_window_bytes",
+                                   float(win_bytes))
+                wall = time.perf_counter() - t_disp
+                if wall > 0:
+                    self.stats.gauge("kernel_bandwidth_gbps",
+                                     round(win_bytes / wall / 1e9, 4))
             for f in distinct_futs:
                 f.result()
 
@@ -271,14 +308,47 @@ class CountBatcher:
         """Build + enqueue one group's fused program; returns
         ``(device_out, finish)`` with the device->host read deferred to
         the window's single packed readback.  Raises on dispatch
-        failure (the caller falls back per item)."""
-        if key[0] == "count":
-            return self._dispatch_counts(group)
-        if key[0] == "rowcounts":
-            return self._dispatch_rowcounts(group)
-        if key[0] == "selcounts":
-            return self._dispatch_selcounts(group)
-        return self._dispatch_aggs(key[0], group)
+        failure (the caller falls back per item).  Dispatch time is
+        observed per kind — a first-time XLA compile shows up as a
+        spike in ``kernel_dispatch_seconds{kind=...}``, warm dispatches
+        as the enqueue floor."""
+        t0 = time.perf_counter()
+        kind = key[0]
+        if kind == "count":
+            ret = self._dispatch_counts(group)
+        elif kind == "rowcounts":
+            ret = self._dispatch_rowcounts(group)
+        elif kind == "selcounts":
+            ret = self._dispatch_selcounts(group)
+        else:
+            ret = self._dispatch_aggs(kind, group)
+        self.stats.observe("kernel_dispatch_seconds",
+                           time.perf_counter() - t0, kind=kind)
+        return ret
+
+    @staticmethod
+    def _group_bytes(kind: str, group: list[_Pending]) -> int:
+        """Estimated HBM bytes one group's fused program reads.  count
+        leaves each enter the program (sum of leaf footprints);
+        selcounts gathers only the UNION of requested rows; the
+        dedup'd kinds (rowcounts/sum/minmax/distinct) scan each unique
+        plane[, filter] once however many items share it."""
+        if kind == "selcounts":
+            plane = group[0].leaves[0]
+            rows = {s for p in group for s in p.nodes}
+            return len(rows) * plane.shape[0] * plane.shape[-1] * 4
+        if kind == "count":
+            return sum(getattr(a, "nbytes", 0)
+                       for p in group for a in p.leaves)
+        seen: set = set()
+        total = 0
+        for p in group:
+            k = tuple(id(a) for a in p.leaves)
+            if k in seen:
+                continue
+            seen.add(k)
+            total += sum(getattr(a, "nbytes", 0) for a in p.leaves)
+        return total
 
     def _run_fallback(self, key, group):
         if key[0] == "count":
@@ -451,6 +521,7 @@ class CountBatcher:
 
     def _run_distinct(self, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik
+        t0 = time.perf_counter()
         items, assign = self._dedupe(group)
         results: list = [None] * len(items)
         errors: list = [None] * len(items)
@@ -487,6 +558,15 @@ class CountBatcher:
             else:
                 p.result = results[slot]
             p.event.set()
+        # distinct can't join the packed readback (multi-dispatch host
+        # loop), so its dispatch observation covers the whole scan —
+        # read included — and its bytes land on the same counter
+        self.stats.observe("kernel_dispatch_seconds",
+                           time.perf_counter() - t0, kind="distinct")
+        nbytes = self._group_bytes("distinct", group)
+        if nbytes:
+            self.stats.count("kernel_bytes_scanned_total", nbytes,
+                             kind="distinct")
 
     def _dispatch_aggs(self, kind: str, group: list[_Pending]):
         from pilosa_tpu.engine import bsi as bsik

@@ -249,7 +249,7 @@ class Executor:
                                  sidecars=plane_sidecars, **kw)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
-        self.fused = FusedCache()
+        self.fused = FusedCache(stats=self.stats)
         # cross-request coalescing is the DEFAULT serving spine (r6):
         # the adaptive window costs a solo request nothing, and under
         # concurrency every dense family pays one dispatch + one read

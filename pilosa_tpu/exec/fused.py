@@ -130,14 +130,24 @@ class FusedCache:
 
     MAX_PROGRAMS = 256
 
-    def __init__(self):
+    def __init__(self, stats=None):
         import threading
         from pilosa_tpu.exec._lru import Stamps
+        from pilosa_tpu.obs import NopStats
         self._programs: dict = {}     # key -> jitted fn (GIL-atomic reads)
         self._stamps = Stamps()       # approx-LRU recency (lock-free touch)
         self._lock = threading.Lock()       # insert / evict only
         self._compiling: dict = {}          # key -> per-key compile lock
         self._threading = threading
+        # program-set telemetry (r14): built/evicted counters plus the
+        # fused_program_count scrape-time gauge make a recompile storm
+        # (the class that once collapsed 32 clients to ~23 qps, see
+        # pow2_bucket) visible on /metrics instead of only as latency
+        self._stats = stats or NopStats()
+
+    @property
+    def program_count(self) -> int:
+        return len(self._programs)
 
     def _get_fast(self, key):
         fn = self._programs.get(key)
@@ -146,6 +156,7 @@ class FusedCache:
         return fn
 
     def _insert(self, key, fn) -> None:
+        evicted = 0
         with self._lock:
             self._programs[key] = fn
             self._stamps.insert(key)
@@ -155,10 +166,14 @@ class FusedCache:
                 for k, _ in sorted(stamps, key=lambda kv: kv[1])[:excess]:
                     if k == key:
                         continue
-                    self._programs.pop(k, None)
+                    if self._programs.pop(k, None) is not None:
+                        evicted += 1
                     self._stamps.pop(k)
                     self._compiling.pop(k, None)
             self._stamps.cleanup(self._programs)
+        self._stats.count("fused_programs_built_total", 1)
+        if evicted:
+            self._stats.count("fused_programs_evicted_total", evicted)
 
     def _cached(self, key, build):
         fn = self._get_fast(key)

@@ -157,6 +157,15 @@ class PlaneCache:
         self.build_failures = 0
         self.build_seconds_total = 0.0
         self.build_bytes_total = 0
+        # serving-path residency accounting (r14 device telemetry):
+        # hit = a query answered from a resident entry (fast-path,
+        # locked revalidation, or in-place incremental refresh), miss
+        # = a full build or a streamed answer while a background build
+        # runs.  Plain int increments — racing serving threads may
+        # lose the odd count, which a RATIO gauge never notices; a
+        # lock here would sit on the lock-free fast path.
+        self.hits = 0
+        self.misses = 0
         self._failed_logged: set = set()
         # plain dict (NOT OrderedDict): the serving hot path revalidates
         # entries lock-free (GIL-atomic dict reads + a recency-stamp
@@ -255,6 +264,7 @@ class PlaneCache:
                                                          shards):
             self._touch(key)
             self._lease_fast(key)
+            self.hits += 1
             return hit[1]
         gens = self._gens(field, view_name, shards)
         with self._lock:
@@ -262,8 +272,10 @@ class PlaneCache:
             if hit is not None and hit[0] == gens:
                 self._touch(key)
                 self._lease(key)
+                self.hits += 1
                 return hit[1]
             if key in self._building:
+                self.misses += 1
                 return None
         if hit is not None:
             # a STALE resident plane usually needs only a journal-driven
@@ -273,12 +285,14 @@ class PlaneCache:
             if ps is not None:
                 with self._lock:
                     self._lease(key)
+                self.hits += 1
                 return ps
         if (self.plane_bytes(field, view_name, shards)
                 <= self.SYNC_BUILD_MAX or self.placement is not None):
             # small plane, or meshed placement (sharded device zeros +
             # donated updates aren't wired for mesh shardings): inline
             return self.field_plane(index, field, view_name, shards)
+        self.misses += 1
         with self._lock:
             if key in self._building:
                 return None
@@ -829,9 +843,18 @@ class PlaneCache:
         """Occupancy snapshot for /status and /metrics (one lock; the
         only supported external view of the cache's internals)."""
         with self._lock:
+            hits, misses = self.hits, self.misses
             return {"bytes": self._bytes, "budgetBytes": self.budget,
                     "entries": len(self._entries),
                     "pinnedEntries": len(self._pinned()),
+                    # HBM residency (r14): open lease sets = in-flight
+                    # queries holding device refs eviction must skip;
+                    # hitRatio = fraction of plane requests answered
+                    # from a resident entry (vs built or streamed)
+                    "leases": len(self._leases),
+                    "hits": hits, "misses": misses,
+                    "hitRatio": (round(hits / (hits + misses), 4)
+                                 if hits + misses else 0.0),
                     "incrementalRefreshes": self.incremental_applied,
                     # plane-build pipeline (r10): cold-build volume and
                     # the dense-sidecar warm cache's hit ratio
@@ -913,6 +936,7 @@ class PlaneCache:
                                                          shards):
             self._touch(key)
             self._lease_fast(key)
+            self.hits += 1
             return hit[1]
         gens = self._gens(field, view_name, shards)
         with self._lock:
@@ -920,13 +944,16 @@ class PlaneCache:
             if hit is not None and hit[0] == gens:
                 self._touch(key)
                 self._lease(key)
+                self.hits += 1
                 return hit[1]
         if hit is not None and key[0] in ("plane", "bsi", "rows", "row"):
             ps = self._incremental(key, field, view_name, shards, hit)
             if ps is not None:
                 with self._lock:
                     self._lease(key)
+                self.hits += 1
                 return ps
+        self.misses += 1
         ps = build(field, view_name, shards)
         nbytes = getattr(ps, "nbytes", None)
         if nbytes is None:

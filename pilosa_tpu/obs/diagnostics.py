@@ -42,6 +42,28 @@ def build_payload(holder, cluster=None, stats=None, slow_log=None) -> dict:
         "fieldTypes": field_types,
         "numNodes": len(cluster.member_ids()) if cluster else 1,
     }
+    if cluster is not None:
+        # counts-only summaries of the PR 6/8 subsystems (never peer
+        # ids/addresses — the payload stays anonymized): how many peers
+        # look sick, how much hinted-write backlog is pending
+        try:
+            peers = cluster.health_payload().get("peers", [])
+            payload["clusterHealth"] = {
+                "peers": len(peers),
+                "suspect": sum(1 for p in peers if p.get("suspect")),
+                "breakersOpen": sum(1 for p in peers
+                                    if p.get("breaker") == "open")}
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            wh = cluster.write_health_payload()
+            payload["writeHealth"] = {
+                "hintedHandoff": bool(wh.get("hintedHandoff")),
+                "backlogOps": int(wh.get("hintBacklogOps", 0)),
+                "hintedPeers": len(wh.get("hintedPeers", ())),
+                "oldestSeconds": float(wh.get("hintOldestSeconds", 0.0))}
+        except Exception:  # noqa: BLE001
+            pass
     if stats is not None:
         try:
             payload["queryStages"] = stats.histogram_summary(

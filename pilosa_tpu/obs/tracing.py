@@ -57,6 +57,22 @@ def fast_span_id() -> str:
     return f"{_id_rng().getrandbits(32):08x}"
 
 
+# The ACTIVE trace id of the request this thread is serving (set by
+# the API edge for every query — lite or traced — and by the internal
+# fan-out handler for propagated legs).  The JSON log formatter reads
+# it, so any log line emitted while serving a request carries the same
+# id its latency exemplar and span tree do: one id joins all three.
+_active = threading.local()
+
+
+def set_current_trace_id(trace_id: str | None) -> None:
+    _active.trace_id = trace_id
+
+
+def current_trace_id() -> str | None:
+    return getattr(_active, "trace_id", None)
+
+
 _HEX = frozenset("0123456789abcdefABCDEF")
 
 

@@ -18,7 +18,8 @@ from pilosa_tpu.store import Holder
 class PilosaTPUServer:
     def __init__(self, cfg: Config):
         self.cfg = cfg
-        self.logger = get_logger(verbose=cfg.verbose)
+        self.logger = get_logger(verbose=cfg.verbose,
+                                 fmt=cfg.log_format or None)
         if cfg.stats_backend == "statsd":
             # statsd emission rides ON TOP of the in-process registry
             # (subclass): /metrics keeps serving Prometheus text while

@@ -314,3 +314,35 @@ def test_config24_write_availability_smoke():
     assert out["detail"]["hint_handoff_total"] >= 1
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config25_observability_smoke():
+    """bench/config25 (full-instrumentation overhead vs metrics-off on
+    the config18 concurrency workload, r14) in --smoke mode: tiny
+    plane, CPU, sweep 1/2/4 — the r14 emission semantics (stage-
+    histogram exemplars, window occupancy/fill, per-kernel scan bytes,
+    live bandwidth gauge) are asserted INSIDE the bench while the cost
+    is measured, so the <3% full-scale bar can never report a number
+    for instrumentation that stopped emitting — runs under tier-1 so
+    the bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config25_observability.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("observability_overhead_pct")
+    assert out["unit"] == "pct" and out["vs_baseline"] > 0
+    # both tiers measured at every swept level
+    assert set(out["detail"]["qps_off"]) == {"1", "2", "4"}
+    assert set(out["detail"]["qps_full"]) == {"1", "2", "4"}
+    # the semantics the overhead pays for actually fired
+    assert out["detail"]["exemplar_buckets"] > 0
+    assert out["detail"]["kernel_bytes_scanned"] > 0
+    assert out["detail"]["kernel_bandwidth_gbps"] > 0

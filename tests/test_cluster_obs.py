@@ -1,0 +1,137 @@
+"""Cluster observability pane (r14 tentpole): ``GET /metrics/cluster``
+merges every live node's registry into ONE Prometheus document —
+counters/gauges as per-node series under a ``node`` label, histograms
+bucket-wise EXACT — and ``GET /status/cluster`` returns every node's
+``/status`` keyed by node id.  A dead peer degrades both to partial +
+``staleNodes``, never an error."""
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.testing import run_cluster
+
+
+class TestClusterPane:
+    def test_merged_document_is_bucket_exact(self, tmp_path):
+        with run_cluster(3, str(tmp_path), heartbeat=0.1) as c:
+            c.client(0).create_index("i")
+            c.client(0).create_field("i", "f")
+            cols = [s * SHARD_WIDTH for s in range(6)]
+            c.client(0).import_bits("i", "f", rowIDs=[1] * 6,
+                                    columnIDs=cols)
+            for cl in c.clients:  # every node serves (and observes)
+                (n,) = cl.query("i", "Count(Row(f=1))")
+                assert n == 6
+            # oracle: the per-node registry snapshots the fan-in merges
+            # (no queries run between here and the scrape, so the
+            # query_stage_seconds family is stable)
+            snaps = {}
+            for cl in c.clients:
+                body = cl._json("GET", "/internal/metrics/snapshot")
+                snaps[body["node"]] = body["snapshot"]
+            ids = c.node_ids()
+            assert set(snaps) == set(ids)
+
+            text = c.client(0)._do("GET", "/metrics/cluster").decode()
+            for nid in ids:
+                assert f'cluster_metrics_node_up{{node="{nid}"}} 1' in text
+            assert "cluster_metrics_stale_nodes 0" in text
+
+            # histogram merge is bucket-exact: per label set, the
+            # merged cumulative bucket counts equal the element-wise
+            # sum of every node's raw counts (pinned against the
+            # snapshots, not against the merge code)
+            # a node owning no shard of the index never ran the
+            # executor — the family is absent there, and the merge
+            # covers the nodes that do report it
+            fams = [s["histograms"]["query_stage_seconds"]
+                    for s in snaps.values()
+                    if "query_stage_seconds" in s["histograms"]]
+            assert len(fams) >= 2  # fan-out legs observed on >1 node
+            buckets = fams[0]["buckets"]
+            expected: dict = {}
+            for fam in fams:
+                assert fam["buckets"] == buckets  # one version: agree
+                for series in fam["series"]:
+                    key = tuple(sorted(series["labels"].items()))
+                    agg = expected.setdefault(
+                        key, [0] * (len(buckets) + 1) + [0])
+                    for i, cnt in enumerate(series["counts"]):
+                        agg[i] += cnt
+                    agg[-1] += series["count"]
+            assert expected  # the three Counts observed stages
+            for key, agg in expected.items():
+                labels = ",".join(f'{k}="{v}"' for k, v in key)
+                cum = 0
+                for i, ub in enumerate(buckets):
+                    cum += agg[i]
+                    assert (f'query_stage_seconds_bucket{{{labels},'
+                            f'le="{ub!r}"}} {cum}') in text
+                cum += agg[len(buckets)]
+                assert (f'query_stage_seconds_bucket{{{labels},'
+                        f'le="+Inf"}} {cum}') in text
+                assert (f'query_stage_seconds_count{{{labels}}} '
+                        f'{agg[-1]}') in text
+
+            # counters/gauges stay per-node under a node label
+            for nid in ids:
+                assert [ln for ln in text.splitlines()
+                        if ln.startswith("http_requests_total{")
+                        and f'node="{nid}"' in ln]
+
+    def test_dead_peer_degrades_to_partial(self, tmp_path):
+        with run_cluster(3, str(tmp_path), heartbeat=0.1) as c:
+            ids = c.node_ids()
+            st = c.client(0)._json("GET", "/status/cluster")
+            assert set(st["nodes"]) == set(ids)
+            assert st["staleNodes"] == []
+            assert st["coordinator"] == c.servers[0].cluster.coordinator_id()
+
+            victim = c.servers[2]
+            vid = victim.cluster.node_id
+            victim.close()
+            # no liveness wait needed: the fan-in's own fetch failure
+            # marks the peer stale (degraded, never an error)
+            import urllib.request
+            port = c.servers[0].http.address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics/cluster",
+                    timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Pilosa-Stale-Nodes"] == vid
+                text = resp.read().decode()
+            assert f'cluster_metrics_node_up{{node="{vid}"}} 0' in text
+            assert "cluster_metrics_stale_nodes 1" in text
+            for nid in ids:
+                if nid != vid:
+                    assert (f'cluster_metrics_node_up{{node="{nid}"}} 1'
+                            in text)
+
+            st = c.client(0)._json("GET", "/status/cluster")
+            assert st["staleNodes"] == [vid]
+            assert set(st["nodes"]) == set(ids) - {vid}
+
+    def test_single_node_serves_cluster_endpoints(self, tmp_path):
+        """Without a cluster layer the pane degrades to one node: the
+        endpoints answer (labelled ``local``) instead of 404ing — one
+        dashboard works at every deployment size."""
+        from pilosa_tpu.api import API, Client, Server
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.obs import Stats
+        from pilosa_tpu.store import Holder
+        holder = Holder(str(tmp_path)).open()
+        stats = Stats()
+        api = API(holder, Executor(holder, stats=stats))
+        server = Server(api, "127.0.0.1", 0, stats=stats).start()
+        c = Client("127.0.0.1", server.address[1])
+        try:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Set(1, f=1)")
+            text = c._do("GET", "/metrics/cluster").decode()
+            assert 'cluster_metrics_node_up{node="local"} 1' in text
+            assert "query_stage_seconds_bucket" in text
+            st = c._json("GET", "/status/cluster")
+            assert st["staleNodes"] == []
+            assert "local" in st["nodes"]
+        finally:
+            server.close()
+            holder.close()

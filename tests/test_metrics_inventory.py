@@ -1,0 +1,95 @@
+"""r14 satellite: metrics-inventory drift check.
+
+The README "Metrics inventory" table is the operator's contract; this
+test diffs it against the metric names the code actually emits
+(regex-extracted literal `.count/.gauge/.observe/.timing` call sites
+plus the module constants for synthetic cluster-document families) and
+fails on EITHER direction of drift — an undocumented family or a stale
+inventory row."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "pilosa_tpu"
+
+# literal emission sites: stats.count("name", ...), .gauge, .observe,
+# .timing — the only four verbs of the registry surface
+EMIT_RE = re.compile(r'\.(?:count|gauge|observe|timing)\(\s*"([a-zA-Z0-9_]+)"')
+
+
+def emitted_names() -> set:
+    names = set()
+    for path in PKG.rglob("*.py"):
+        names.update(EMIT_RE.findall(path.read_text()))
+    # families emitted through module constants, not literal call
+    # sites: the synthetic cluster-document rows and StageTimer's
+    # default family
+    from pilosa_tpu.obs import metrics as m
+    names.update({m.CLUSTER_NODE_UP, m.CLUSTER_STALE_NODES,
+                  m.STAGE_METRIC})
+    return names
+
+
+def documented() -> tuple[set, set]:
+    """(exact names, wildcard prefixes) from the README inventory
+    table.  Tokens expand: ``{labels}`` annotations strip; a slash
+    list inside one token (``plan_cache_hits/misses/invalidations``)
+    shares the first segment's prefix; a trailing ``*`` is a prefix
+    wildcard."""
+    text = (REPO / "README.md").read_text()
+    section = text[text.index("Metrics inventory"):]
+    rows = []
+    in_table = False
+    for line in section.splitlines():
+        if line.startswith("|"):
+            in_table = True
+            rows.append(line)
+        elif in_table:
+            break
+    assert len(rows) > 10, "inventory table not found where expected"
+    names, wildcards = set(), set()
+
+    def add(tok: str) -> None:
+        tok = tok.strip()
+        if not tok:
+            return
+        if tok.endswith("*"):
+            wildcards.add(tok[:-1])
+        else:
+            names.add(tok)
+
+    for row in rows:
+        first_cell = row.split("|")[1]
+        for tok in re.findall(r"`([^`]+)`", first_cell):
+            tok = re.sub(r"\{[^}]*\}", "", tok)
+            if "/" in tok:
+                parts = [p.strip() for p in tok.split("/")]
+                prefix = parts[0].rsplit("_", 1)[0] + "_"
+                for i, seg in enumerate(parts):
+                    add(seg if i == 0 or "_" in seg else prefix + seg)
+            else:
+                add(tok)
+    return names, wildcards
+
+
+def test_every_emitted_metric_is_documented():
+    names, wildcards = documented()
+    undocumented = sorted(
+        n for n in emitted_names()
+        if n not in names and not any(n.startswith(w) for w in wildcards))
+    assert not undocumented, (
+        f"emitted but missing from the README metrics inventory: "
+        f"{undocumented}")
+
+
+def test_every_inventory_row_is_emitted():
+    names, wildcards = documented()
+    emitted = emitted_names()
+    stale = sorted(n for n in names if n not in emitted)
+    assert not stale, (
+        f"documented in the README metrics inventory but never emitted "
+        f"in code: {stale}")
+    dead = sorted(w for w in wildcards
+                  if not any(e.startswith(w) for e in emitted))
+    assert not dead, f"wildcard rows matching nothing emitted: {dead}"

@@ -1,5 +1,9 @@
 """Observability tests: metrics registry + prometheus text, statsd
-emission, span tree + cross-node propagation (SURVEY.md §6)."""
+emission, span tree + cross-node propagation (SURVEY.md §6), and the
+r14 cluster pane: exposition escaping, per-family buckets, exemplars,
+fan-in merge, JSON logging with trace correlation."""
+
+import pytest
 
 from pilosa_tpu.obs import Stats, StatsdStats, Tracer
 
@@ -24,11 +28,17 @@ class TestStatsd:
         try:
             st.count("reqs", 2, method="GET", status="200")
             st.gauge("slots", 3)
-            st.timing("lat", 0.025, call="Count")
-            pkts = sorted(self._drain(sink, 3))
-            assert "pilosa.lat:25.0|ms|#call:Count" in pkts
+            st.timing("lat_seconds", 0.025, call="Count")
+            # only *_seconds families are timers (ms by statsd
+            # convention); count/ratio/byte histograms ship raw as |h
+            st.observe("batcher_window_items", 16)
+            st.observe("kernel_window_bytes", 1073741824)
+            pkts = sorted(self._drain(sink, 5))
+            assert "pilosa.lat_seconds:25.0|ms|#call:Count" in pkts
             assert "pilosa.reqs:2|c|#method:GET,status:200" in pkts
             assert "pilosa.slots:3|g" in pkts
+            assert "pilosa.batcher_window_items:16|h" in pkts
+            assert "pilosa.kernel_window_bytes:1073741824|h" in pkts
         finally:
             st.close()
             sink.close()
@@ -115,6 +125,244 @@ class TestStats:
         assert "lat_count 3" in text
 
 
+class TestExposition:
+    """r14 satellite: Prometheus exposition correctness — label-value
+    escaping and per-family bucket sets."""
+
+    def test_label_value_escaping(self):
+        from pilosa_tpu.obs.metrics import escape_label_value
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+        s = Stats()
+        s.count("reqs", 1, pql='Row(f="x")\nCount')
+        text = s.prometheus_text()
+        # one line, quotes and newline escaped — a hostile label value
+        # must not corrupt the scrape document
+        (line,) = [ln for ln in text.splitlines() if ln.startswith("reqs{")]
+        assert line == 'reqs{pql="Row(f=\\"x\\")\\nCount"} 1'
+
+    def test_per_family_buckets(self):
+        from pilosa_tpu.obs.metrics import BYTE_BUCKETS
+        s = Stats()
+        s.set_buckets("scan_bytes", BYTE_BUCKETS)
+        s.observe("scan_bytes", float(1 << 20))
+        s.observe("lat", 0.003)  # default latency buckets untouched
+        text = s.prometheus_text()
+        assert f'scan_bytes_bucket{{le="{float(1 << 10)!r}"}} 0' in text
+        assert f'scan_bytes_bucket{{le="{float(1 << 20)!r}"}} 1' in text
+        assert 'lat_bucket{le="0.0001"} 0' in text
+        # byte bounds never appear on the latency family
+        assert f'lat_bucket{{le="{float(1 << 10)!r}"}}' not in text
+
+    def test_set_buckets_idempotent_and_guarded(self):
+        from pilosa_tpu.obs.metrics import COUNT_BUCKETS
+        s = Stats()
+        s.set_buckets("win", COUNT_BUCKETS)
+        s.set_buckets("win", COUNT_BUCKETS)  # identical: fine
+        s.observe("win", 3.0)
+        with pytest.raises(ValueError):
+            s.set_buckets("win", (1.0, 2.0))  # re-bucket after obs
+        with pytest.raises(ValueError):
+            s.set_buckets("bad", (2.0, 1.0))  # not ascending
+        with pytest.raises(ValueError):
+            s.set_buckets("bad", ())  # empty
+        s2 = Stats()
+        s2.observe("lat", 0.1)  # latched to defaults at first obs
+        with pytest.raises(ValueError):
+            s2.set_buckets("lat", COUNT_BUCKETS)
+
+    def test_exemplar_on_bucket_line(self):
+        s = Stats()
+        s.observe("lat", 0.0002, trace_id="abc123", stage="read")
+        s.observe("lat", 0.0002, stage="read")  # untraced: keeps exemplar
+        text = s.prometheus_text(openmetrics=True)
+        (line,) = [ln for ln in text.splitlines()
+                   if 'le="0.00025"' in ln]
+        # OpenMetrics exemplar suffix: `# {trace_id="..."} value ts`
+        assert '# {trace_id="abc123"} 0.0002 ' in line
+        assert text.endswith("# EOF\n")  # mandatory OpenMetrics marker
+        # the exemplar names the LATEST traced observation of the bucket
+        s.observe("lat", 0.0002, trace_id="def456", stage="read")
+        text = s.prometheus_text(openmetrics=True)
+        (line,) = [ln for ln in text.splitlines() if 'le="0.00025"' in ln]
+        assert 'trace_id="def456"' in line and "abc123" not in line
+        # +Inf bucket records its own exemplar
+        s.observe("lat", 99.0, trace_id="inf789", stage="read")
+        text = s.prometheus_text(openmetrics=True)
+        (inf_line,) = [ln for ln in text.splitlines() if 'le="+Inf"' in ln]
+        assert 'trace_id="inf789"' in inf_line
+
+    def test_classic_text_format_never_carries_exemplars(self):
+        """The 0.0.4 text format allows only `metric value [ts]` per
+        sample line — an exemplar suffix is a PARSE ERROR that fails
+        the entire scrape, so the default rendering must omit them."""
+        s = Stats()
+        s.observe("lat", 0.0002, trace_id="abc123", stage="read")
+        text = s.prometheus_text()
+        assert "trace_id" not in text
+        assert "# EOF" not in text
+        for ln in text.splitlines():
+            if not ln.startswith("#"):
+                assert len(ln.split(" ")) == 2  # metric value, nothing else
+
+    def test_histogram_summary_empty_family(self):
+        assert Stats().histogram_summary("nope") == {}
+
+    def test_histogram_summary_single_inf_observation(self):
+        s = Stats()
+        s.observe("lat", 1e9, stage="read")  # beyond every bound
+        out = s.histogram_summary("lat")
+        assert out == {"stage=read": {"count": 1, "sum": 1e9,
+                                      "mean": 1e9}}
+
+    def test_histogram_summary_label_collision_merges(self):
+        """Distinct label SETS stringifying to one display label must
+        merge counts/sums, not silently drop one."""
+        s = Stats()
+        s.observe("lat", 1.0, a="1", b="2")
+        s.observe("lat", 3.0, a="1,b=2")
+        out = s.histogram_summary("lat")
+        assert out == {"a=1,b=2": {"count": 2, "sum": 4.0, "mean": 2.0}}
+
+
+class TestClusterMerge:
+    """r14 tentpole: the fan-in merge — per-node snapshots into ONE
+    Prometheus document."""
+
+    def _two_nodes(self):
+        a, b = Stats(), Stats()
+        for st, n in ((a, 3), (b, 5)):
+            st.count("reqs", n, method="GET")
+            st.gauge("slots", n)
+            for i in range(n):
+                st.observe("lat", 0.0002 * (i + 1), stage="read")
+        return a, b
+
+    def test_histograms_merge_bucket_exact(self):
+        from pilosa_tpu.obs.metrics import render_cluster_metrics
+        a, b = self._two_nodes()
+        text = render_cluster_metrics(
+            {"n1": a.full_snapshot(), "n2": b.full_snapshot()})
+        # oracle: merge the two registries by hand — a third registry
+        # fed BOTH observation streams must render the same histogram
+        oracle = Stats()
+        for n in (3, 5):
+            for i in range(n):
+                oracle.observe("lat", 0.0002 * (i + 1), stage="read")
+        want = [ln for ln in oracle.prometheus_text().splitlines()
+                if ln.startswith("lat_")]
+        got = [ln for ln in text.splitlines() if ln.startswith("lat_")]
+        assert got == want  # bucket-exact, no node label when merged
+        assert "lat_count{stage=\"read\"} 8" in text
+
+    def test_counters_and_gauges_keep_node_series(self):
+        from pilosa_tpu.obs.metrics import render_cluster_metrics
+        a, b = self._two_nodes()
+        text = render_cluster_metrics(
+            {"n1": a.full_snapshot(), "n2": b.full_snapshot()})
+        assert 'reqs{method="GET",node="n1"} 3' in text
+        assert 'reqs{method="GET",node="n2"} 5' in text
+        assert 'slots{node="n1"} 3' in text
+        assert 'slots{node="n2"} 5' in text
+        assert 'cluster_metrics_node_up{node="n1"} 1' in text
+        assert "cluster_metrics_stale_nodes 0" in text
+
+    def test_stale_nodes_render_down_rows(self):
+        from pilosa_tpu.obs.metrics import render_cluster_metrics
+        a, _ = self._two_nodes()
+        text = render_cluster_metrics({"n1": a.full_snapshot()},
+                                      stale=["n2", "n3"])
+        assert 'cluster_metrics_node_up{node="n1"} 1' in text
+        assert 'cluster_metrics_node_up{node="n2"} 0' in text
+        assert 'cluster_metrics_node_up{node="n3"} 0' in text
+        assert "cluster_metrics_stale_nodes 2" in text
+
+    def test_bucket_disagreement_degrades_to_node_series(self):
+        from pilosa_tpu.obs.metrics import (COUNT_BUCKETS,
+                                            render_cluster_metrics)
+        a, b = Stats(), Stats()
+        a.observe("win", 3.0)                  # default latency buckets
+        b.set_buckets("win", COUNT_BUCKETS)    # version skew
+        b.observe("win", 3.0)
+        text = render_cluster_metrics(
+            {"n1": a.full_snapshot(), "n2": b.full_snapshot()})
+        # no fabricated merge: per-node series under a node label
+        assert 'win_count{node="n1"} 1' in text
+        assert 'win_count{node="n2"} 1' in text
+        assert "win_count 2" not in text
+
+    def test_node_label_wins_collision(self):
+        from pilosa_tpu.obs.metrics import render_cluster_metrics
+        a = Stats()
+        a.count("reqs", 7, node="spoofed")
+        text = render_cluster_metrics({"real": a.full_snapshot()})
+        assert 'reqs{node="real"} 7' in text
+        assert "spoofed" not in text
+
+
+class TestJsonLogging:
+    """r14: structured JSON log lines carrying the active trace id."""
+
+    def _fresh_logger(self, name, fmt, buf):
+        from pilosa_tpu.obs import get_logger
+        return get_logger(name, stream=buf, fmt=fmt)
+
+    def test_json_lines_carry_active_trace_id(self):
+        import io
+        import json
+        from pilosa_tpu.obs.tracing import set_current_trace_id
+        buf = io.StringIO()
+        log = self._fresh_logger("t_json_active", "json", buf)
+        try:
+            set_current_trace_id("deadbeef")
+            log.info("serving shard=%d", 3)
+        finally:
+            set_current_trace_id(None)
+        log.info("idle")
+        line1, line2 = buf.getvalue().splitlines()
+        rec1, rec2 = json.loads(line1), json.loads(line2)
+        assert rec1["message"] == "serving shard=3"
+        assert rec1["traceId"] == "deadbeef"
+        assert rec1["level"] == "INFO"
+        assert "traceId" not in rec2  # no request active
+
+    def test_record_level_trace_id_wins(self):
+        import io
+        import json
+        buf = io.StringIO()
+        log = self._fresh_logger("t_json_extra", "json", buf)
+        log.warning("slow query", extra={"traceId": "feedface"})
+        rec = json.loads(buf.getvalue())
+        assert rec["traceId"] == "feedface"
+
+    def test_exceptions_serialized(self):
+        import io
+        import json
+        buf = io.StringIO()
+        log = self._fresh_logger("t_json_exc", "json", buf)
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("failed")
+        rec = json.loads(buf.getvalue())
+        assert rec["message"] == "failed"
+        assert "ValueError: boom" in rec["exc"]
+
+    def test_format_knob_validated(self):
+        from pilosa_tpu.obs import get_logger
+        with pytest.raises(ValueError):
+            get_logger("t_json_bad", fmt="xml")
+
+    def test_text_format_unchanged(self):
+        import io
+        buf = io.StringIO()
+        log = self._fresh_logger("t_text", "text", buf)
+        log.info("hello")
+        assert "hello" in buf.getvalue()
+        assert not buf.getvalue().startswith("{")
+
+
 class TestTracer:
     def test_span_nesting(self):
         t = Tracer()
@@ -168,6 +416,42 @@ class TestDiagnostics:
         assert p["numIndexes"] == 1 and p["numFields"] == 2
         assert p["fieldTypes"] == {"set": 1, "int": 1}
         assert p["numShards"] >= 1 and p["version"]
+
+    def test_cluster_and_write_health_summaries(self, tmp_path):
+        """r14 satellite: the snapshot carries counts-only summaries of
+        the PR 6 (breakers/suspects) and PR 8 (hinted handoff)
+        subsystems — never peer ids or addresses."""
+        from pilosa_tpu.obs.diagnostics import build_payload
+        from pilosa_tpu.store import Holder
+        h = Holder(str(tmp_path)).open()
+
+        class FakeCluster:
+            def member_ids(self):
+                return ["a", "b", "c"]
+
+            def health_payload(self):
+                return {"suspectAfterSeconds": 6.0, "peers": [
+                    {"id": "b", "suspect": True, "breaker": "open"},
+                    {"id": "c", "suspect": False, "breaker": "closed"}]}
+
+            def write_health_payload(self):
+                return {"hintedHandoff": True, "hintMaxAgeSeconds": 300.0,
+                        "hintBacklogOps": 4, "hintOldestSeconds": 1.5,
+                        "peers": [{"id": "b", "pendingOps": 4,
+                                   "oldestSeconds": 1.5,
+                                   "overflowed": False}],
+                        "hintedPeers": ["b"]}
+
+        p = build_payload(h, cluster=FakeCluster())
+        assert p["clusterHealth"] == {"peers": 2, "suspect": 1,
+                                      "breakersOpen": 1}
+        assert p["writeHealth"] == {"hintedHandoff": True,
+                                    "backlogOps": 4, "hintedPeers": 1,
+                                    "oldestSeconds": 1.5}
+        # anonymized: counts only, no peer identifiers anywhere
+        import json
+        dumped = json.dumps(p)
+        assert '"b"' not in dumped
 
     def test_periodic_reporting(self, tmp_path):
         import time

@@ -435,3 +435,80 @@ class TestDistributedProfile:
             cl.servers[0].cluster.dist.execute_json(
                 "i", "Count(Row(f=1))", tracer=lt)
             assert any(n.startswith("cluster.") for n, _ in lt.marks)
+
+
+class TestSinglePaneJoin:
+    """r14 acceptance: a slow query is traceable end-to-end — a
+    ``query_stage_seconds`` exemplar → ``/internal/traces?trace_id=`` →
+    JSON log lines carrying the same trace id."""
+
+    def _boot(self, tmp_path, **api_kw):
+        from pilosa_tpu.exec import Executor
+        holder = Holder(str(tmp_path)).open()
+        stats = Stats()
+        api = API(holder, Executor(holder, stats=stats), **api_kw)
+        server = Server(api, "127.0.0.1", 0, stats=stats).start()
+        return holder, server, Client("127.0.0.1", server.address[1])
+
+    def test_exemplar_trace_and_logs_join_on_one_id(self, tmp_path):
+        import io
+        import logging as _logging
+        holder, server, c = self._boot(
+            tmp_path, trace_sample_rate=0.0, slow_query_threshold=1e-9)
+        # route the pilosa_tpu logger through the JSON formatter into a
+        # buffer (fresh handler so other tests' config can't interfere)
+        from pilosa_tpu.obs import get_logger
+        logger = _logging.getLogger("pilosa_tpu")
+        saved = logger.handlers[:]
+        logger.handlers = []
+        buf = io.StringIO()
+        get_logger(stream=buf, fmt="json")
+        try:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Set(1, f=1)")
+            _, headers = _post_query(server.address[1], "Count(Row(f=1))")
+            tid = headers["X-Pilosa-Trace-Id"]
+            # leg 1: a latency bucket's exemplar names the trace (the
+            # Count is the LATEST observation of every stage series,
+            # so its id is the one the exemplars carry)
+            assert [ln for ln in
+                    c.metrics_text(openmetrics=True).splitlines()
+                    if ln.startswith("query_stage_seconds_bucket")
+                    and f'trace_id="{tid}"' in ln]
+            # the classic 0.0.4 rendering must NOT carry the exemplar
+            # (its parser rejects the suffix and fails the scrape)
+            assert "trace_id" not in c.metrics_text()
+            # leg 2: the id resolves to the retained span tree
+            traces = c._json(
+                "GET", f"/internal/traces?trace_id={tid}")["traces"]
+            assert traces and traces[0]["traceId"] == tid
+            # leg 3: the slow-capture log line carries the same id
+            recs = [json.loads(ln)
+                    for ln in buf.getvalue().splitlines()]
+            slow = [r for r in recs if "slow query" in r["message"]]
+            assert any(r.get("traceId") == tid for r in slow)
+        finally:
+            logger.handlers = saved
+            server.close()
+            holder.close()
+
+    def test_lite_path_exemplar_carries_cheap_id(self, tmp_path):
+        """The zero-span serving path still feeds exemplars: the
+        LiteTracer's cheap id rides every stage observation (the
+        config20 overhead bar holds because nothing else changes)."""
+        holder, server, c = self._boot(
+            tmp_path, trace_sample_rate=0.0, slow_query_threshold=1.0)
+        try:
+            c.create_index("i")
+            c.create_field("i", "f")
+            c.query("i", "Set(1, f=1)")
+            _, headers = _post_query(server.address[1], "Count(Row(f=1))")
+            tid = headers["X-Pilosa-Trace-Id"]
+            assert [ln for ln in
+                    c.metrics_text(openmetrics=True).splitlines()
+                    if ln.startswith("query_stage_seconds_bucket")
+                    and f'trace_id="{tid}"' in ln]
+        finally:
+            server.close()
+            holder.close()
