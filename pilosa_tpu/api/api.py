@@ -359,12 +359,18 @@ class API:
     def import_bits(self, index: str, field: str, *,
                     row_ids=None, col_ids=None, row_keys=None, col_keys=None,
                     timestamps=None, clear: bool = False,
-                    direct: bool = False) -> int:
+                    direct: bool = False, op_id: str | None = None) -> int:
         """Bulk bit import (reference: ``API.Import``): ID or key form;
         timestamps are epoch-seconds or ISO strings.  In cluster mode
-        batches are routed to the shard-owning nodes (reference:
-        ``api.go`` import orchestration); ``direct`` marks an
-        already-routed forwarded batch."""
+        batches route through the breaker-aware bulk-import coordinator
+        (:class:`pilosa_tpu.ingest.BulkImporter` — hinted handoff and
+        op-id dedup cover bulk ops, r15); ``direct`` marks an
+        already-routed forwarded batch, ``op_id`` its dedup identity
+        (a re-delivered batch is a no-op).  Local applies are
+        oplog-batched: one fsync-coalesced append per batch per
+        fragment, counted on ``ingest_bits_total`` and timed on
+        ``import_batch_seconds``."""
+        t0 = _time.perf_counter()
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
@@ -373,18 +379,40 @@ class API:
         cols = self._translate_cols(idx, col_ids, col_keys, direct)
         if len(rows) != len(cols):
             raise ApiError("rows and columns length mismatch")
+        stats = self.executor.stats
         if self.cluster is not None and not direct:
-            return self._route_import_bits(index, field, rows, cols,
-                                           timestamps, clear)
-        ts = self._parse_timestamps(timestamps, len(cols))
-        if clear:
-            changed = 0
-            for r, c in zip(rows, cols):
-                changed += f.clear_bit(int(r), int(c))
+            changed = self._bulk().import_bits(index, field, rows, cols,
+                                               timestamps, clear)
+            stats.observe("import_batch_seconds",
+                          _time.perf_counter() - t0)
             return changed
-        changed = f.import_bits(rows, cols, ts)
-        idx.note_columns(cols)
+        if (op_id is not None and self.cluster is not None
+                and op_id in self.cluster.applied_ops):
+            return 0  # duplicate delivery (retry / replayed hint)
+        ts = self._parse_timestamps(timestamps, len(cols))
+        from pilosa_tpu.store.oplog import SyncBatch
+        sb = SyncBatch()
+        if clear:
+            changed = f.clear_import(rows, cols, sync_batch=sb)
+        else:
+            changed = f.import_bits(rows, cols, ts, sync_batch=sb)
+            idx.note_columns(cols)
+        sb.flush()
+        if op_id is not None and self.cluster is not None:
+            self.cluster.applied_ops.add(op_id)
+        if changed:
+            stats.count("ingest_bits_total", changed)
+        stats.observe("import_batch_seconds", _time.perf_counter() - t0)
         return changed
+
+    def _bulk(self):
+        """The cluster bulk-import coordinator (lazy: the cluster is
+        attached after construction)."""
+        bulk = getattr(self, "_bulk_importer", None)
+        if bulk is None or bulk.cluster is not self.cluster:
+            from pilosa_tpu.ingest import BulkImporter
+            bulk = self._bulk_importer = BulkImporter(self, self.cluster)
+        return bulk
 
     def import_values(self, index: str, field: str, *,
                       col_ids=None, col_keys=None, values=None,
@@ -450,32 +478,6 @@ class API:
                                 headers={"X-Pilosa-Direct": "1"})["changed"]
         return remote
 
-    def _route_import_bits(self, index: str, field: str, rows, cols,
-                           timestamps, clear: bool) -> int:
-        from pilosa_tpu.api import proto
-        shards = cols // np.uint64(SHARD_WIDTH)
-        changed = 0
-        for shard in np.unique(shards):
-            m = shards == shard
-            sub_rows = [int(r) for r in rows[m]]
-            sub_cols = [int(c) for c in cols[m]]
-            sub_ts = ([timestamps[i] for i in np.nonzero(m)[0]]
-                      if timestamps is not None else None)
-            remote = self._proto_or_json_forward(
-                f"/index/{index}/field/{field}/import",
-                lambda: proto.encode_import_request(
-                    row_ids=sub_rows, col_ids=sub_cols,
-                    timestamps=sub_ts, clear=clear),
-                lambda: {"rowIDs": sub_rows, "columnIDs": sub_cols,
-                         "timestamps": sub_ts, "clear": clear})
-            changed += self._route_to_owners(
-                index, int(shard),
-                lambda: self.import_bits(
-                    index, field, row_ids=sub_rows, col_ids=sub_cols,
-                    timestamps=sub_ts, clear=clear, direct=True),
-                remote)
-        return changed
-
     def _route_import_values(self, index: str, field: str, cols,
                              values) -> int:
         from pilosa_tpu.api import proto
@@ -500,9 +502,13 @@ class API:
 
     def import_roaring(self, index: str, field: str, shard: int, blob: bytes,
                        view: str = VIEW_STANDARD, clear: bool = False,
-                       direct: bool = False) -> int:
+                       direct: bool = False,
+                       op_id: str | None = None) -> int:
         """Pre-encoded roaring import — the bulk-loader fast path
-        (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
+        (reference: ``API.ImportRoaring``, SURVEY.md §4.5).  Cluster
+        routing, op-id dedup and fsync coalescing mirror
+        :meth:`import_bits` (r15)."""
+        t0 = _time.perf_counter()
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
@@ -514,22 +520,22 @@ class API:
             raise ApiError(
                 "import-roaring supports set/time fields, not "
                 f"{f.options.type!r}; use the pair import", 400)
+        stats = self.executor.stats
         if self.cluster is not None and not direct:
-            qs = f"?view={view}" + ("&clear=1" if clear else "")
-            return self._route_to_owners(
-                index, shard,
-                lambda: self.import_roaring(index, field, shard, blob,
-                                            view=view, clear=clear,
-                                            direct=True),
-                lambda client: client._do(
-                    "POST",
-                    f"/index/{index}/field/{field}/import-roaring/"
-                    f"{shard}{qs}", blob,
-                    content_type="application/octet-stream",
-                    headers={"X-Pilosa-Direct": "1"})["changed"])
+            changed = self._bulk().import_roaring(index, field, shard,
+                                                  blob, view, clear)
+            stats.observe("import_batch_seconds",
+                          _time.perf_counter() - t0)
+            return changed
+        if (op_id is not None and self.cluster is not None
+                and op_id in self.cluster.applied_ops):
+            return 0  # duplicate delivery (retry / replayed hint)
+        from pilosa_tpu.store.oplog import SyncBatch
+        sb = SyncBatch()
         frag = f.view(view, create=True).fragment(shard, create=True)
         try:
-            changed = f_changed = frag.import_roaring(blob, clear=clear)
+            changed = f_changed = frag.import_roaring(blob, clear=clear,
+                                                      sync_batch=sb)
         except ValueError as e:
             raise ApiError(f"bad roaring payload: {e}")
         if f_changed and idx.track_existence and not clear:
@@ -538,6 +544,12 @@ class API:
             cols = (np.unique(positions % np.uint64(SHARD_WIDTH))
                     + np.uint64(shard * SHARD_WIDTH))
             idx.note_columns(cols)
+        sb.flush()
+        if op_id is not None and self.cluster is not None:
+            self.cluster.applied_ops.add(op_id)
+        if changed:
+            stats.count("ingest_bits_total", changed)
+        stats.observe("import_batch_seconds", _time.perf_counter() - t0)
         return changed
 
     # -- export -------------------------------------------------------------
@@ -684,9 +696,30 @@ class API:
             # oldest age vs the hint_max_age bound, per-peer drains
             write_health = self.cluster.write_health_payload()
         ex = self.executor
-        shed = ex.stats.snapshot()["counters"].get("query_shed_total", {})
+        snap_counters = ex.stats.snapshot()["counters"]
+        shed = snap_counters.get("query_shed_total", {})
         pc = ex.planes.stats()
+        delta = pc.get("delta", {})
+        ingested = snap_counters.get("ingest_bits_total", {})
         return {"state": state, "nodes": nodes,
+                # ingest visibility (r15): device delta overlays
+                # (fill %, compaction backlog + last duration) and
+                # bulk-import volume — the mixed read/write serving
+                # pane (bench/config26)
+                "ingest": {
+                    "deltaFillRatio": delta.get("deltaFillRatio", 0.0),
+                    "deltaCells": delta.get("deltaCells", 0),
+                    "deltaCap": delta.get("deltaCap", 0),
+                    "deltaOverlayBits": delta.get("deltaOverlayBits", 0),
+                    "absorbs": delta.get("absorbs", 0),
+                    "compactions": delta.get("compactions", 0),
+                    "pendingCompactions": delta.get(
+                        "pendingCompactions", 0),
+                    "lastCompactionSeconds": delta.get(
+                        "lastCompactionSeconds", 0.0),
+                    "importedBits": int(sum(ingested.values())),
+                    "importBatch": ex.stats.histogram_summary(
+                        "import_batch_seconds")},
                 **({"clusterHealth": cluster_health}
                    if cluster_health is not None else {}),
                 **({"writeHealth": write_health}

@@ -309,6 +309,13 @@ class Handler(BaseHTTPRequestHandler):
         """Forwarded-batch marker: skip cluster re-routing."""
         return self.headers.get("X-Pilosa-Direct") == "1"
 
+    @property
+    def _op_id(self) -> str | None:
+        """Bulk-op dedup identity (r15): forwarded import batches
+        carry it so duplicate delivery — internode retries, replayed
+        hints — is a no-op against the durable IdWindow."""
+        return self.headers.get("X-Pilosa-Op-Id") or None
+
     def h_import(self, index: str, field: str) -> None:
         # content negotiation like the query endpoint: protobuf bodies
         # carry 100k-batch id arrays at a fraction of the JSON
@@ -332,7 +339,8 @@ class Handler(BaseHTTPRequestHandler):
                       timestamps=b.get("timestamps"),
                       clear=b.get("clear", False) or "clear" in self.query)
         changed = self.server.api.import_bits(index, field,
-                                              direct=self._direct, **kw)
+                                              direct=self._direct,
+                                              op_id=self._op_id, **kw)
         self._reply_import(changed)
 
     def h_import_value(self, index: str, field: str) -> None:
@@ -365,7 +373,7 @@ class Handler(BaseHTTPRequestHandler):
         clear = "clear" in self.query
         changed = self.server.api.import_roaring(
             index, field, int(shard), self._body(), view=view, clear=clear,
-            direct=self._direct)
+            direct=self._direct, op_id=self._op_id)
         self._reply({"changed": changed})
 
     def h_export(self) -> None:
@@ -427,6 +435,10 @@ class Handler(BaseHTTPRequestHandler):
         stats.gauge("plane_cache_pinned_entries", pc["pinnedEntries"])
         stats.gauge("plane_lease_count", pc["leases"])
         stats.gauge("plane_cache_hit_ratio", pc["hitRatio"])
+        # ingest overlays (r15): set bits pending in device delta
+        # overlays — base⊕delta serving depth before compaction folds
+        stats.gauge("delta_overlay_bits",
+                    pc.get("delta", {}).get("deltaOverlayBits", 0))
         # serving-spine gauges (r6): plan-cache occupancy and the
         # batcher's current adaptive window
         stats.gauge("plan_cache_entries", len(ex._plans))

@@ -87,6 +87,16 @@ class Config:
     query_timeout: float = 0.0         # seconds per query; 0 = unlimited
                                        # (?timeout= overrides per request)
     plane_budget_bytes: int = 4 << 30
+    # Ingest delta planes (r15): writes to a resident whole-view plane
+    # absorb into a bounded device-side overlay the query kernels
+    # merge at dispatch time (base⊕delta) — reads keep serving at the
+    # ceiling with zero generation-stale rebuild stalls.
+    # delta_buffer_cells bounds the overlay (changed 32-bit plane
+    # words per plane; 0 disables = pre-r15 incremental scatter);
+    # past delta_compact_fraction of that, a background compactor
+    # folds the overlay into the base and swaps generations.
+    delta_buffer_cells: int = 65536
+    delta_compact_fraction: float = 0.5
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

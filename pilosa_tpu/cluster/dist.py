@@ -815,6 +815,27 @@ class DistributedExecutor:
                                             "no_live_replica")
         return any(bool(r) for _o, r, _ok in legs)
 
+    @staticmethod
+    def write_failure_class(e) -> str | None:
+        """Classify a write leg's ClientError — the ONE copy of the
+        rule the PQL write path (:meth:`_run_on`) and the bulk-import
+        coordinator (``ingest.bulk``) share.  Only never-delivered
+        failures mean ``"down"``: connection refused/reset, TLS
+        handshake alerts ("transport" — the handshake precedes any
+        request processing).  An answered 503 is an ALIVE peer that
+        shed the request pre-execution (``"busy"``): it keeps serving
+        reads, so hinting it would ack a strict op that a read on that
+        replica then contradicts — busy legs never hand off.  None =
+        propagate: a timeout is "state unknown" (the peer may still
+        apply — a hinted replay could reorder behind a newer direct
+        write), and any other 5xx from an alive peer is a real failed
+        write, not AAE-repairable noise."""
+        if e.status == 503:
+            return "busy"
+        if e.status == 0 and e.kind != "timeout":
+            return "down"
+        return None
+
     def _write_reachable(self) -> set[str]:
         """The node set a write may target DIRECTLY: alive, breaker-
         closed, and — with handoff enabled — holding no pending hints.
@@ -831,15 +852,20 @@ class DistributedExecutor:
             out -= hints.pending_peers()
         return out
 
-    def _split_write_targets(self, op: str,
-                             owners) -> tuple[list[str], list[str]]:
+    def _split_write_targets(self, op: str, owners,
+                             additive: bool | None = None
+                             ) -> tuple[list[str], list[str]]:
         """(apply-now targets, hand-off peers) for one shard's owner
         set, refusing when the split cannot serve: no live replica at
         all, or a hand-off peer whose backlog overflowed
-        ``hint_max_age`` (Set falls back to the legacy best-effort
-        miss there instead — AAE union-merge repairs additive
-        divergence, so boundedness never costs Set availability)."""
+        ``hint_max_age`` (additive ops — Set, and r15 non-clearing
+        bulk imports — fall back to the legacy best-effort miss there
+        instead: AAE union-merge repairs additive divergence, so
+        boundedness never costs them availability).  ``additive``
+        defaults from the op name for the PQL write path."""
         hints = self.cluster.hints
+        if additive is None:
+            additive = op == "Set"
         reachable = self._write_reachable()
         targets = [o for o in owners if o in reachable]
         dead = [o for o in owners if o not in reachable]
@@ -849,12 +875,12 @@ class DistributedExecutor:
         handed = []
         for o in dead:
             if hints.overflowed(o):
-                if op == "Set":
+                if additive:
                     self.cluster.stats.count("write_replicas_missed", 1)
                     self.cluster.logger.warning(
-                        "Set not hinted for %s (backlog older than "
+                        "%s not hinted for %s (backlog older than "
                         "hint_max_age=%gs); AAE repairs on rejoin",
-                        o, hints.max_age)
+                        op, o, hints.max_age)
                     continue
                 raise self._unavailable(op, o, "hint_overflow")
             handed.append(o)
@@ -1009,23 +1035,10 @@ class DistributedExecutor:
             try:
                 return ("ok", one(node_id))
             except ClientError as e:
-                # only never-delivered failures mean "node DOWN":
-                # connection refused/reset, TLS handshake alerts
-                # ("transport" — the handshake precedes any request
-                # processing).  An answered 503 is an ALIVE peer that
-                # shed the request pre-execution ("busy"): it keeps
-                # serving reads, so hinting it would ack a strict
-                # Clear that a read on that replica then contradicts —
-                # busy legs keep the pre-r13 semantics (best-effort
-                # miss / strict refusal) and never hand off.  Any
-                # other 5xx from an alive peer is a real failed write
-                # and must propagate, not be waved off as
-                # AAE-repairable
-                if e.status == 503:
-                    return ("busy", (node_id, e))
-                if e.status == 0 and e.kind != "timeout":
-                    return ("down", (node_id, e))
-                raise
+                tag = self.write_failure_class(e)
+                if tag is None:
+                    raise
+                return (tag, (node_id, e))
 
         node_ids = list(node_ids)
         if len(node_ids) == 1:

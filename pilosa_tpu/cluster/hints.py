@@ -418,12 +418,16 @@ class HintBoard:
         peers = []
         for peer, records in sorted(items):
             age = now - records[0][1]
+            bulk = sum(1 for _seq, _ts, rec in records
+                       if rec.get("kind") == "import")
             peers.append({"id": peer, "pendingOps": len(records),
+                          "bulkOps": bulk,
                           "oldestSeconds": round(max(0.0, age), 3),
                           "overflowed": (self.max_age > 0
                                          and age > self.max_age)})
         self._export()
         return {"hintBacklogOps": sum(p["pendingOps"] for p in peers),
+                "hintBulkOps": sum(p["bulkOps"] for p in peers),
                 "hintOldestSeconds": (max(p["oldestSeconds"]
                                           for p in peers) if peers
                                       else 0.0),

@@ -319,10 +319,29 @@ def h_hints_replay(self: Handler) -> None:
                 503, retry_after=1.0)
         shards = op.get("shards")
         try:
-            api.executor.execute(
-                op["index"], op["pql"],
-                shards=([int(s) for s in shards] if shards else None),
-                translate_output=False)
+            if op.get("kind") == "import":
+                # bulk-import hint (r15): apply the batch payload
+                # straight into fragments — same dedup/order contract
+                # as PQL hints, no PQL round trip.  Un-applyable
+                # payloads (field gone → 404, malformed roaring/ids)
+                # reclassify as ExecutionError so ONLY this leg takes
+                # the drop path — PQL replay errors keep their pre-r15
+                # classes (an unexpected ValueError there must stay a
+                # retryable 500, not a permanent applied-marked drop)
+                from pilosa_tpu.ingest import apply_import_hint
+                try:
+                    apply_import_hint(api, op)
+                except ApiError as e:
+                    if e.status == 503:
+                        raise  # deferred: sender retries the batch
+                    raise ExecutionError(str(e)) from e
+                except (ValueError, KeyError) as e:
+                    raise ExecutionError(str(e)) from e
+            else:
+                api.executor.execute(
+                    op["index"], op["pql"],
+                    shards=([int(s) for s in shards] if shards else None),
+                    translate_output=False)
         except ExecutorSaturatedError as e:
             raise ApiError(str(e), 503, retry_after=e.retry_after)
         except (ParseError, ExecutionError) as e:

@@ -48,15 +48,18 @@ from pilosa_tpu.engine import kernels
 
 
 class _Pending:
-    __slots__ = ("kind", "nodes", "leaves", "event", "result", "error")
+    __slots__ = ("kind", "nodes", "leaves", "delta", "event", "result",
+                 "error")
 
-    def __init__(self, kind, nodes, leaves):
+    def __init__(self, kind, nodes, leaves, delta=None):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
         #                       | "selcounts" | "distinct"
         self.nodes = nodes    # count: tuple of plan trees;
         #                       selcounts: tuple of plane row slots;
         #                       others: None
         self.leaves = leaves  # count: plan leaves; others: plane[, filter]
+        self.delta = delta    # rowcounts/selcounts: the plane's
+        #                       DeltaOverlay (base⊕delta merge, r15)
         self.event = threading.Event()
         self.result = None
         self.error: Exception | None = None
@@ -160,28 +163,37 @@ class CountBatcher:
         leaves = (plane,) if filter_words is None else (plane, filter_words)
         return self._submit(_Pending("minmax", None, leaves))
 
-    def submit_rowcounts(self, plane, filter_words=None) -> np.ndarray:
+    def submit_rowcounts(self, plane, filter_words=None,
+                         delta=None) -> np.ndarray:
         """Whole-plane per-row totals int64[R_pad] (cross-shard reduce
         on device — callers gate on the int32-exact shard bound).
         Identical concurrent items (same plane/filter objects) share
-        one computation."""
-        return self.wait(self.enqueue_rowcounts(plane, filter_words))
+        one computation.  ``delta`` (the plane's DeltaOverlay) makes
+        the answer base⊕delta — items over the same (plane, overlay)
+        pair still dedupe to one scan."""
+        return self.wait(self.enqueue_rowcounts(plane, filter_words,
+                                                delta))
 
-    def enqueue_rowcounts(self, plane, filter_words=None) -> _Pending:
+    def enqueue_rowcounts(self, plane, filter_words=None,
+                          delta=None) -> _Pending:
         """Non-blocking variant: returns a handle for :meth:`wait`, so
         a request needing several row-count reads (filtered TopN with
         tanimoto) lands them all in ONE collection window."""
         leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._enqueue(_Pending("rowcounts", None, leaves))
+        return self._enqueue(_Pending("rowcounts", None, leaves,
+                                      delta=delta))
 
-    def submit_selected(self, plane, slots: tuple) -> np.ndarray:
+    def submit_selected(self, plane, slots: tuple,
+                        delta=None) -> np.ndarray:
         """Selected-row Counts (the multi-query fused popcount): the
         window's items over the SAME resident plane merge into one
         row-gather + popcount program — one pass over the UNION of
         requested rows, N accumulators — and the per-item answers come
         back int64[len(slots)] in the caller's slot order.  Duplicate
-        slots across concurrent requests are computed once."""
-        return self._submit(_Pending("selcounts", tuple(slots), (plane,)))
+        slots across concurrent requests are computed once.  ``delta``
+        merges the plane's pending write overlay at dispatch time."""
+        return self._submit(_Pending("selcounts", tuple(slots), (plane,),
+                                     delta=delta))
 
     def submit_distinct(self, plane, filter_words):
         """BSI Distinct presence: host (pos bool[2^d], neg bool[2^d]).
@@ -235,7 +247,15 @@ class CountBatcher:
                 if p.kind == "count":
                     key = ("count", p.leaves[0].shape[0])
                 elif p.kind == "selcounts":
-                    key = ("selcounts", id(p.leaves[0]))
+                    # delta identity joins the key: items over the
+                    # same (plane, overlay) pair slot-union into one
+                    # gather; a fresher overlay is a different answer
+                    key = ("selcounts", id(p.leaves[0]),
+                           id(p.delta) if p.delta is not None else 0)
+                elif p.kind == "rowcounts" and p.delta is not None:
+                    key = ("rowcounts-delta", id(p.leaves[0]),
+                           id(p.delta),
+                           id(p.leaves[1]) if len(p.leaves) == 2 else 0)
                 else:
                     key = (p.kind, p.leaves[0].shape)
                 groups.setdefault(key, []).append(p)
@@ -318,6 +338,8 @@ class CountBatcher:
             ret = self._dispatch_counts(group)
         elif kind == "rowcounts":
             ret = self._dispatch_rowcounts(group)
+        elif kind == "rowcounts-delta":
+            ret = self._dispatch_rowcounts_delta(group)
         elif kind == "selcounts":
             ret = self._dispatch_selcounts(group)
         else:
@@ -337,6 +359,13 @@ class CountBatcher:
             plane = group[0].leaves[0]
             rows = {s for p in group for s in p.nodes}
             return len(rows) * plane.shape[0] * plane.shape[-1] * 4
+        if kind == "rowcounts-delta":
+            # one base scan + the overlay gather per unique (plane,
+            # overlay, filter) key — items in this group are identical
+            p0 = group[0]
+            d = p0.delta
+            return (sum(getattr(a, "nbytes", 0) for a in p0.leaves)
+                    + (d.nbytes if d is not None else 0))
         if kind == "count":
             return sum(getattr(a, "nbytes", 0)
                        for p in group for a in p.leaves)
@@ -353,7 +382,7 @@ class CountBatcher:
     def _run_fallback(self, key, group):
         if key[0] == "count":
             self._fallback_counts(group)
-        elif key[0] == "rowcounts":
+        elif key[0] in ("rowcounts", "rowcounts-delta"):
             self._fallback_rowcounts(group)
         elif key[0] == "selcounts":
             self._fallback_selcounts(group)
@@ -441,14 +470,17 @@ class CountBatcher:
         UNION of every item's requested slots once (N concurrent
         requests over overlapping rows pay one pass over the union,
         the multi-query analogue of the rowcounts dedup), popcount,
-        reduce shards on device."""
+        reduce shards on device.  The group key carries the delta
+        identity, so every item here shares one (plane, overlay) pair
+        and the merge happens once for the union."""
         plane = group[0].leaves[0]
         pos: dict[int, int] = {}
         for p in group:
             for s in p.nodes:
                 if s not in pos:
                     pos[s] = len(pos)
-        out = self.fused.run_selected_counts(plane, tuple(pos))
+        out = self.fused.run_selected_counts(plane, tuple(pos),
+                                             delta=group[0].delta)
 
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
@@ -457,13 +489,37 @@ class CountBatcher:
                 p.event.set()
         return out, finish
 
+    def _dispatch_rowcounts_delta(self, group: list[_Pending]):
+        """Whole-plane row counts of base⊕delta: the group key is the
+        (plane, overlay, filter) identity triple, so the whole group
+        is ONE scan + one overlay adjustment shared by every item."""
+        p0 = group[0]
+        flt = p0.leaves[1] if len(p0.leaves) == 2 else None
+        out = self.fused.run_rowcounts_delta(p0.leaves[0], p0.delta,
+                                             filter_words=flt)
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
+            for p in group:
+                p.result = host
+                p.event.set()
+        return out, finish
+
     def _fallback_selcounts(self, group: list[_Pending]) -> None:
         import jax.numpy as jnp
         for p in group:
             try:
                 idx = jnp.asarray(p.nodes, dtype=jnp.int32)
-                p.result = kernels.shard_totals(
-                    kernels.selected_row_counts(p.leaves[0], idx))
+                if p.delta is not None:
+                    from pilosa_tpu.ingest.delta import \
+                        adjusted_selected_counts
+                    d = p.delta
+                    p.result = np.asarray(adjusted_selected_counts(
+                        p.leaves[0], idx, d.rows, d.words,
+                        d.vals)).astype(np.int64)
+                else:
+                    p.result = kernels.shard_totals(
+                        kernels.selected_row_counts(p.leaves[0], idx))
             except Exception as e2:  # noqa: BLE001
                 p.error = e2
             finally:
@@ -512,8 +568,17 @@ class CountBatcher:
         for p in group:
             try:
                 flt = p.leaves[1] if len(p.leaves) == 2 else None
-                p.result = kernels.shard_totals(
-                    kernels.row_counts(p.leaves[0], flt))
+                if p.delta is not None:
+                    from pilosa_tpu.ingest.delta import \
+                        adjusted_row_counts
+                    d = p.delta
+                    p.result = np.asarray(adjusted_row_counts(
+                        p.leaves[0], d.rows, d.words, d.vals, flt,
+                        reduce_shards=False)).astype(np.int64).sum(
+                            axis=0)
+                else:
+                    p.result = kernels.shard_totals(
+                        kernels.row_counts(p.leaves[0], flt))
             except Exception as e2:  # noqa: BLE001
                 p.error = e2
             finally:

@@ -194,6 +194,13 @@ class _PlanEntry:
     # OUTSIDE this entry's shard subset (generations over entry.shards
     # won't see it), so validity must check the depth itself
     bsi_depths: tuple = ()
+    # "plane" plans over an UNKEYED field bake nothing a write can
+    # stale: row ids are the literal PQL integers and the PlaneSet
+    # revalidates its own generations (delta overlays absorb writes,
+    # r15).  Such entries skip the per-hit generation compare — under
+    # sustained ingest the generations move every batch, and dropping
+    # the plan per write put parse+plan back on every request.
+    unkeyed_plane: bool = False
 
 
 class QueryTimeoutError(ExecutionError):
@@ -225,7 +232,9 @@ class Executor:
                  place=None, plane_budget: int | None = None, placement=None,
                  stats=None, tracer=None,
                  count_batch_window: float | str = "adaptive",
-                 max_concurrent: int = 8, plane_sidecars: bool = True):
+                 max_concurrent: int = 8, plane_sidecars: bool = True,
+                 delta_cells: int = 65536,
+                 delta_compact_fraction: float = 0.5):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -246,7 +255,10 @@ class Executor:
         self.stats = stats or NopStats()
         self.planes = PlaneCache(place, placement=placement,
                                  stats=self.stats,
-                                 sidecars=plane_sidecars, **kw)
+                                 sidecars=plane_sidecars,
+                                 delta_cells=delta_cells,
+                                 delta_compact_fraction=(
+                                     delta_compact_fraction), **kw)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache(stats=self.stats)
@@ -633,13 +645,17 @@ class Executor:
         """slot -> int64 total for the selected plane rows: one
         row-gather + popcount program, shard axis reduced on device
         (callers gate on ``_REDUCE_SHARD_MAX``), coalesced across
-        concurrent requests via the batcher."""
+        concurrent requests via the batcher.  A delta-dirty plane
+        (``ps.delta``, r15 ingest) answers base⊕delta in the same
+        program — writes never force a rebuild here."""
         if self.batcher is not None:
-            vals = self.batcher.submit_selected(ps.plane, slots)
+            vals = self.batcher.submit_selected(ps.plane, slots,
+                                                delta=ps.delta)
             if timer is not None:
                 timer.mark("read")  # coalesced wait: window+dispatch+read
         else:
-            out = self.fused.run_selected_counts(ps.plane, slots)
+            out = self.fused.run_selected_counts(ps.plane, slots,
+                                                 delta=ps.delta)
             if timer is not None:
                 timer.mark("dispatch")
             vals = np.asarray(out).astype(np.int64)[:len(slots)]
@@ -659,26 +675,35 @@ class Executor:
         per-shard counts and finish in int64 on host (engine int32
         policy)."""
         small = len(ps.shards) <= self._REDUCE_SHARD_MAX
+        delta = ps.delta
         if self.batcher is not None and small:
-            totals = self.batcher.submit_rowcounts(ps.plane)
+            totals = self.batcher.submit_rowcounts(ps.plane, delta=delta)
             if timer is not None:
                 timer.mark("read")  # coalesced wait: window+dispatch+read
             return totals
         if small:
-            key = (("countbatch-plane-reduced", ps.plane.shape), "count")
-            fn = self.fused._cached(
-                key, lambda: (lambda p: jnp.sum(
-                    kernels.row_counts(p), axis=0, dtype=jnp.int32)))
-            out = fn(ps.plane)
+            if delta is not None:
+                out = self.fused.run_rowcounts_delta(ps.plane, delta)
+            else:
+                key = (("countbatch-plane-reduced", ps.plane.shape),
+                       "count")
+                fn = self.fused._cached(
+                    key, lambda: (lambda p: jnp.sum(
+                        kernels.row_counts(p), axis=0, dtype=jnp.int32)))
+                out = fn(ps.plane)
             if timer is not None:
                 timer.mark("dispatch")
             totals = np.asarray(out).astype(np.int64)  # one read
             if timer is not None:
                 timer.mark("read")
         else:
-            key = (("countbatch-plane", ps.plane.shape), "count")
-            fn = self.fused._cached(key, lambda: kernels.row_counts)
-            out = fn(ps.plane)
+            if delta is not None:
+                out = self.fused.run_rowcounts_delta(ps.plane, delta,
+                                                     reduce=False)
+            else:
+                key = (("countbatch-plane", ps.plane.shape), "count")
+                fn = self.fused._cached(key, lambda: kernels.row_counts)
+                out = fn(ps.plane)
             if timer is not None:
                 timer.mark("dispatch")
             host = np.asarray(out).astype(np.int64)
@@ -750,10 +775,22 @@ class Executor:
         # validity: current shard set + dependency generations must
         # match what the plan was built against — a write to any source
         # fragment (or a shard appearing) invalidates here, and the
-        # normal path re-plans on the next request
+        # normal path re-plans on the next request.  Unkeyed-plane
+        # entries skip the generation compare (nothing in them can
+        # stale; the PlaneSet revalidates independently) so the plan
+        # cache keeps hitting under sustained ingest.
         if (self._shards_for(index, shards, None) != entry.shards
-                or self._dep_gens(index, entry.deps,
-                                  entry.shards) != entry.gens
+                or (not entry.unkeyed_plane
+                    and self._dep_gens(index, entry.deps,
+                                       entry.shards) != entry.gens)
+                or (entry.unkeyed_plane
+                    # the field must still be the unkeyed set field
+                    # the plan baked literal row ids against — a
+                    # drop + recreate as keyed/BSI at the same name
+                    # would otherwise keep serving those literals
+                    and ((pf := index.field(entry.field_name)) is None
+                         or pf.options.keys
+                         or pf.options.type in BSI_TYPES))
                 or any((f := index.field(fname)) is None
                        or f.options.bit_depth != d
                        for fname, d in entry.bsi_depths)):
@@ -842,7 +879,8 @@ class Executor:
         return _PlanEntry("plane", ctx.shards, deps,
                           self._dep_gens(ctx.index, deps, ctx.shards),
                           len(calls), field_name=field.name,
-                          row_ids=row_ids)
+                          row_ids=row_ids,
+                          unkeyed_plane=not field.options.keys)
 
     def _dep_gens(self, index, deps: tuple, shards: tuple) -> tuple:
         out = []
@@ -1852,15 +1890,29 @@ class Executor:
         #    gather+segment-sum program answers each filtered TopN
         #    (engine/sparse.py), no per-query re-streaming;
         # 4. last resort: stream fixed-shape row blocks per query.
-        est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
         row_totals = None
         ps = None
-        if est <= self.planes.budget:
-            # nowait: while a big plane builds in the background
-            # (serve-while-build, VERDICT r4 weak #6) this query falls
-            # through to the streaming path instead of stalling minutes
+        tried_nowait = False
+        if self.planes.has_entry(ctx.index.name, field, VIEW_STANDARD,
+                                 ctx.shards):
+            # a resident entry (fresh or delta-dirty) serves without
+            # the per-request plane_bytes fragment walk — under
+            # sustained ingest the generations move every batch and
+            # the walk would land on every TopN (the r3 warm-path
+            # metadata class)
             ps = self.planes.field_plane_nowait(ctx.index.name, field,
                                                 VIEW_STANDARD, ctx.shards)
+            tried_nowait = True
+        if ps is None:
+            est = self.planes.plane_bytes(field, VIEW_STANDARD,
+                                          ctx.shards)
+            if est <= self.planes.budget and not tried_nowait:
+                # nowait: while a big plane builds in the background
+                # (serve-while-build, VERDICT r4 weak #6) this query
+                # falls through to the streaming path instead of
+                # stalling minutes
+                ps = self.planes.field_plane_nowait(
+                    ctx.index.name, field, VIEW_STANDARD, ctx.shards)
         if ps is not None:
             if ps.n_rows == 0:
                 return ({"pairs": [], "srcCount": src_count} if want_partial
@@ -1873,14 +1925,27 @@ class Executor:
                 # outright; the int32 device reduce needs the same
                 # shard bound as _plane_totals).  Both reads enqueue
                 # BEFORE either wait, so a tanimoto request pays one
-                # collection window, not two in series.
+                # collection window, not two in series.  A delta-dirty
+                # plane (r15 ingest) answers base⊕delta in-window.
                 h1 = self.batcher.enqueue_rowcounts(ps.plane,
-                                                    filter_words)
-                h2 = (self.batcher.enqueue_rowcounts(ps.plane)
+                                                    filter_words,
+                                                    delta=ps.delta)
+                h2 = (self.batcher.enqueue_rowcounts(ps.plane,
+                                                     delta=ps.delta)
                       if need_row_counts else None)
                 totals = self.batcher.wait(h1)[:ps.n_rows]
                 if h2 is not None:
                     row_totals = self.batcher.wait(h2)[:ps.n_rows]
+            elif ps.delta is not None:
+                counts = self.fused.run_rowcounts_delta(
+                    ps.plane, ps.delta, filter_words=filter_words,
+                    reduce=False)
+                totals = kernels.shard_totals(counts)[:ps.n_rows]
+                if need_row_counts:
+                    row_totals = kernels.shard_totals(
+                        self.fused.run_rowcounts_delta(
+                            ps.plane, ps.delta,
+                            reduce=False))[:ps.n_rows]
             else:
                 counts = kernels.row_counts(ps.plane, filter_words)
                 totals = kernels.shard_totals(counts)[:ps.n_rows]

@@ -243,7 +243,7 @@ class FusedCache:
         return self._cached(
             (flags, leaves[0].shape, "rowcounts-batch"), build)(*leaves)
 
-    def run_selected_counts(self, plane, slots) -> jax.Array:
+    def run_selected_counts(self, plane, slots, delta=None) -> jax.Array:
         """N selected-row Counts over one resident plane in ONE
         program: gather the requested rows, popcount, reduce the shard
         axis on device -> int32[N] (callers gate on the int32-exact
@@ -254,10 +254,26 @@ class FusedCache:
         a traced int32 operand, so any row selection of the same width
         bucket reuses one executable.  Returns the device array
         un-read: the batcher packs it into the window's single
-        readback."""
+        readback.
+
+        ``delta`` (an ``ingest.delta.DeltaOverlay``) merges the
+        plane's pending write cells at dispatch time (base⊕delta):
+        the overlay arrays are traced operands, so one program serves
+        any overlay of the same pow2 cell bucket."""
         bucket = pow2_bucket(len(slots))
         padded = tuple(slots) + (slots[0],) * (bucket - len(slots))
         idx = jnp.asarray(padded, dtype=jnp.int32)
+        if delta is not None:
+            from pilosa_tpu.ingest.delta import adjusted_selected_counts
+            key = (("selcounts-delta", plane.shape, bucket,
+                    delta.rows.shape[0]), "count")
+
+            def build_delta():
+                def program(p, ix, dr, dw, dv):
+                    return adjusted_selected_counts(p, ix, dr, dw, dv)
+                return program
+            return self._cached(key, build_delta)(
+                plane, idx, delta.rows, delta.words, delta.vals)
 
         def build():
             def program(p, ix):
@@ -266,6 +282,35 @@ class FusedCache:
             return program
         key = (("selcounts", plane.shape, bucket), "count")
         return self._cached(key, build)(plane, idx)
+
+    def run_rowcounts_delta(self, plane, delta, filter_words=None,
+                            reduce: bool = True) -> jax.Array:
+        """Whole-plane per-row counts of base⊕delta in ONE program:
+        the clean ``row_counts`` scan of the immutable base plus a
+        gather + scatter-add adjustment over the overlay cells —
+        int32[R_pad] (``reduce``, callers gate on the int32-exact
+        shard bound) or int32[S, R_pad].  Overlay arrays are traced
+        operands; the program set is bounded per (plane shape, overlay
+        bucket, filtered, reduce)."""
+        from pilosa_tpu.ingest.delta import adjusted_row_counts
+        has_filter = filter_words is not None
+        key = (("rowcounts-delta", plane.shape, delta.rows.shape[0],
+                has_filter, reduce), "count")
+
+        def build():
+            if has_filter:
+                def program(p, dr, dw, dv, fw):
+                    return adjusted_row_counts(p, dr, dw, dv, fw,
+                                               reduce_shards=reduce)
+            else:
+                def program(p, dr, dw, dv):
+                    return adjusted_row_counts(p, dr, dw, dv, None,
+                                               reduce_shards=reduce)
+            return program
+        args = (plane, delta.rows, delta.words, delta.vals)
+        if has_filter:
+            args += (filter_words,)
+        return self._cached(key, build)(*args)
 
     def run_readback_pack(self, arrays: tuple) -> jax.Array:
         """Concatenate the flattened int32 outputs of a collection

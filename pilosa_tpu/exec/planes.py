@@ -89,12 +89,20 @@ def merge_row_cards(frags) -> tuple[np.ndarray, np.ndarray]:
 
 @dataclass
 class PlaneSet:
-    """One materialized (field, view): device plane + row-slot mapping."""
+    """One materialized (field, view): device plane + row-slot mapping.
+
+    ``delta`` (r15 ingest): a bounded device-side write overlay
+    (:class:`pilosa_tpu.ingest.delta.DeltaOverlay`) carrying cells
+    written since the base plane was built.  ``plane`` itself is the
+    IMMUTABLE base; delta-aware kernels answer base⊕delta at dispatch
+    time, and consumers that need a clean plane go through
+    :meth:`PlaneCache.field_plane`, which folds first."""
 
     plane: jax.Array          # uint32[n_shards, R_pad, W]
     shards: tuple[int, ...]   # axis-0 ids, PAD_SHARD entries are zeros
     row_ids: np.ndarray       # uint64[R] real rows (slots beyond are pad)
     slot_of: dict[int, int]
+    delta: object | None = None  # ingest.delta.DeltaOverlay when dirty
 
     @property
     def n_rows(self) -> int:
@@ -132,7 +140,9 @@ class SparseSet:
 
 class PlaneCache:
     def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET,
-                 placement=None, stats=None, sidecars: bool = True):
+                 placement=None, stats=None, sidecars: bool = True,
+                 delta_cells: int = 65536,
+                 delta_compact_fraction: float = 0.5):
         """``place(np_array) -> jax.Array`` controls device placement /
         mesh sharding; default is plain ``jax.device_put``.
         ``placement`` (the MeshPlacement the executor runs under, if
@@ -140,7 +150,16 @@ class PlaneCache:
         ``stats`` (an obs registry) receives the plane-build metrics;
         ``sidecars`` toggles the warm dense-plane cache (``<fragment>
         .dense`` images written on cold builds, loaded at near
-        raw-copy speed after a restart)."""
+        raw-copy speed after a restart).
+
+        ``delta_cells`` (r15 ingest) bounds the per-plane device delta
+        overlay: writes to a resident whole-view plane absorb into a
+        (cell → word value) overlay the query kernels merge at
+        dispatch time instead of rebuilding or re-scattering the base;
+        0 disables (pre-r15 incremental-scatter behavior).
+        ``delta_compact_fraction``: overlay fill ratio past which the
+        background compactor folds the overlay into the base plane and
+        swaps generations atomically."""
         from pilosa_tpu.exec._lru import Stamps
         from pilosa_tpu.obs import NopStats
         self.place = place or (placement.place if placement is not None
@@ -179,6 +198,20 @@ class PlaneCache:
         self._bytes = 0
         self._lock = threading.RLock()
         self.incremental_applied = 0  # delta-scatter refreshes (stats)
+        # device delta overlays (r15 ingest): host mirrors of each
+        # resident plane's pending write cells, keyed like _entries;
+        # the stored tuple is (base plane array, DeltaMirror) — a
+        # rebuilt base invalidates its mirror by identity.  Meshed
+        # placements keep the pre-r15 incremental-scatter path (the
+        # overlay's flat-index math assumes one logical device array).
+        self.delta_cells = (int(delta_cells)
+                            if placement is None else 0)
+        self.delta_compact_fraction = float(delta_compact_fraction)
+        self._delta_mirrors: dict[tuple, tuple] = {}
+        self._compacting: dict[tuple, threading.Thread] = {}
+        self.delta_absorbs = 0
+        self.delta_compactions = 0
+        self.last_compaction_seconds = 0.0
         # keys leased to in-flight queries, per serving thread: eviction
         # must skip these — the query's frames hold live device refs, so
         # evicting frees no HBM and only forces a rebuild on next use
@@ -219,6 +252,7 @@ class PlaneCache:
             for key in [k for k in self._entries if k not in pinned]:
                 _, _, nbytes = self._entries.pop(key)
                 self._stamps.pop(key)
+                self._delta_mirrors.pop(key, None)
                 self._bytes -= nbytes
 
     # -- public -------------------------------------------------------------
@@ -278,17 +312,25 @@ class PlaneCache:
                 self.misses += 1
                 return None
         if hit is not None:
-            # a STALE resident plane usually needs only a journal-driven
-            # delta-scatter — never spawn a full GB-scale rebuild (and
-            # degrade to streaming) for a few written cells
-            ps = self._incremental(key, field, view_name, shards, hit)
+            # a STALE resident plane absorbs its write gap into the
+            # device delta overlay (base⊕delta answered at dispatch,
+            # zero base rewrites) or folds — never spawn a full
+            # GB-scale rebuild (and degrade to streaming) for a few
+            # written cells
+            ps = self._delta_update(key, field, view_name, shards, hit)
             if ps is not None:
                 with self._lock:
                     self._lease(key)
                 self.hits += 1
                 return ps
-        if (self.plane_bytes(field, view_name, shards)
-                <= self.SYNC_BUILD_MAX or self.placement is not None):
+        est = self.plane_bytes(field, view_name, shards)
+        if est > self.budget:
+            # the entry-resident fast checks upstream skip the budget
+            # walk, so growth past budget is caught here: never spawn
+            # a build the cache would refuse to keep
+            self.misses += 1
+            return None
+        if est <= self.SYNC_BUILD_MAX or self.placement is not None:
             # small plane, or meshed placement (sharded device zeros +
             # donated updates aren't wired for mesh shardings): inline
             return self.field_plane(index, field, view_name, shards)
@@ -580,15 +622,28 @@ class PlaneCache:
 
     def has_plane(self, index: str, field: Field, view_name: str,
                   shards: tuple[int, ...]) -> bool:
-        """Whether a FRESH whole-view plane is resident (generations
-        match).  Callers skip their admission/budget walks on True —
-        so a stale hit must return False: the field may have grown
-        past the budget since admission, and ``field_plane`` would
-        rebuild it at the new size unconditionally."""
+        """Whether a whole-view plane entry can serve: fresh
+        (generations match) or — with delta overlays on — stale but
+        absorbable (the nowait fetch folds the write gap into the
+        overlay without a rebuild).  Callers skip their admission/
+        budget walks on True; growth past the budget is re-checked by
+        ``field_plane_nowait`` before any rebuild spawns."""
         key = ("plane", index, field.name, view_name, shards)
         hit = self._entries.get(key)  # GIL-atomic; no lock needed
-        return hit is not None and hit[0] == self._gens_fast(
-            field, view_name, shards)
+        if hit is None:
+            return False
+        if hit[0] == self._gens_fast(field, view_name, shards):
+            return True
+        return self.delta_cells > 0
+
+    def has_entry(self, index: str, field: Field, view_name: str,
+                  shards: tuple[int, ...]) -> bool:
+        """A whole-view plane entry exists (fresh, delta-dirty, or
+        stale).  The TopN admission path uses this to keep the
+        per-request ``plane_bytes`` fragment walk off the hot path
+        under sustained writes."""
+        return ("plane", index, field.name, view_name,
+                shards) in self._entries
 
     def rows_plane(self, index: str, field: Field, view_name: str,
                    row_ids: np.ndarray,
@@ -863,7 +918,10 @@ class PlaneCache:
                     "buildBytes": self.build_bytes_total,
                     "buildFailures": self.build_failures,
                     "warmHits": self.warm_hits,
-                    "warmMisses": self.warm_misses}
+                    "warmMisses": self.warm_misses,
+                    # r15 ingest: device delta overlays (writes served
+                    # as base⊕delta without rebuild stalls)
+                    "delta": self.delta_stats()}
 
     def invalidate(self, index: str | None = None) -> None:
         with self._lock:
@@ -875,11 +933,13 @@ class PlaneCache:
             if index is None:
                 self._entries.clear()
                 self._stamps.clear()
+                self._delta_mirrors.clear()
                 self._bytes = 0
                 return
             for key in [k for k in self._entries if k[1] == index]:
                 _, _, nbytes = self._entries.pop(key)
                 self._stamps.pop(key)
+                self._delta_mirrors.pop(key, None)
                 self._bytes -= nbytes
 
     # -- internal -----------------------------------------------------------
@@ -930,10 +990,14 @@ class PlaneCache:
              shards: tuple[int, ...], build) -> PlaneSet:
         # lock-free fast path: the common serving case is a fresh
         # resident plane — one dict read + one generation compare,
-        # no cache lock, no view lock
+        # no cache lock, no view lock.  Delta-dirty entries never
+        # return here: every _get caller needs a CLEAN plane (the
+        # delta-aware consumers go through field_plane_nowait), so a
+        # pending overlay folds first.
         hit = self._entries.get(key)
         if hit is not None and hit[0] == self._gens_fast(field, view_name,
-                                                         shards):
+                                                         shards) \
+                and getattr(hit[1], "delta", None) is None:
             self._touch(key)
             self._lease_fast(key)
             self.hits += 1
@@ -941,12 +1005,21 @@ class PlaneCache:
         gens = self._gens(field, view_name, shards)
         with self._lock:
             hit = self._entries.get(key)
-            if hit is not None and hit[0] == gens:
+            if hit is not None and hit[0] == gens \
+                    and getattr(hit[1], "delta", None) is None:
                 self._touch(key)
                 self._lease(key)
                 self.hits += 1
                 return hit[1]
-        if hit is not None and key[0] in ("plane", "bsi", "rows", "row"):
+        if hit is not None and key[0] == "plane":
+            # fold overlay + journal gap into the base in one scatter
+            ps = self._fold(key, field, view_name, shards, hit)
+            if ps is not None:
+                with self._lock:
+                    self._lease(key)
+                self.hits += 1
+                return ps
+        elif hit is not None and key[0] in ("bsi", "rows", "row"):
             ps = self._incremental(key, field, view_name, shards, hit)
             if ps is not None:
                 with self._lock:
@@ -973,6 +1046,10 @@ class PlaneCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[2]
+            # a rebuilt base supersedes any pending overlay: the
+            # fresh build re-read every fragment, so the mirror's
+            # cells are already IN the new plane
+            self._delta_mirrors.pop(key, None)
             self._entries[key] = (gens, ps, nbytes)
             self._stamps.insert(key)
             self._bytes += nbytes
@@ -994,6 +1071,7 @@ class PlaneCache:
                         continue
                     _, _, old_bytes = self._entries.pop(k)
                     self._stamps.pop(k)
+                    self._delta_mirrors.pop(k, None)
                     self._bytes -= old_bytes
             self._stamps.cleanup(self._entries)
 
@@ -1092,6 +1170,258 @@ class PlaneCache:
                 self._stamps.insert(key)
         self.incremental_applied += 1
         return new_ps
+
+    # -- delta overlays (r15 ingest) ----------------------------------------
+
+    def _collect_changes(self, field: Field, view_name: str,
+                         shards: tuple[int, ...], hit, cap: int):
+        """Gather the write gap between a "plane" entry's covered
+        generations and fragment truth as overwrite cells:
+        ``({(flat_row, word): current word value}, [(flat_row,
+        full-row words)] resets, covered-through gens)``, or None when
+        the journal can't cover it (gap, new rows, over cap) — the
+        caller compacts or rebuilds."""
+        old_gens, ps, _nbytes = hit
+        view = field.view(view_name)
+        if view is None or len(old_gens) != len(shards):
+            return None
+        r_pad = ps.plane.shape[1]
+        cells: dict = {}
+        resets: list = []
+        actual = list(old_gens)
+        for si, s in enumerate(shards):
+            if s == PAD_SHARD:
+                continue
+            frag = view.fragment(s)
+            if frag is None:
+                if old_gens[si] != -1:
+                    return None  # fragment vanished: rebuild
+                continue
+            with frag.lock:
+                if old_gens[si] == -1:
+                    return None  # new fragment: row set unknown
+                if frag.generation == old_gens[si]:
+                    continue
+                changed = frag.changed_cells_since(old_gens[si])
+                if changed is None:
+                    return None
+                for r, words in changed.items():
+                    slot = ps.slot_of.get(int(r))
+                    if slot is None:
+                        return None  # new row: shape/row set changed
+                    flat = si * r_pad + slot
+                    row_words = np.asarray(frag.row(int(r)).words(),
+                                           np.uint32)
+                    if words is None:
+                        resets.append((flat, row_words))
+                    else:
+                        w_arr = np.fromiter(words, np.int64, len(words))
+                        for w, v in zip(w_arr.tolist(),
+                                        row_words[w_arr].tolist()):
+                            cells[(flat, int(w))] = int(v)
+                    if len(cells) + 64 * len(resets) > cap:
+                        return None
+                actual[si] = frag.generation
+        return cells, resets, tuple(actual)
+
+    def _delta_update(self, key, field: Field, view_name: str,
+                      shards: tuple[int, ...], hit):
+        """Bring a stale "plane" entry back to serving truth without a
+        rebuild: absorb the gap into the device overlay (base stays
+        immutable; queries answer base⊕delta), or fold overlay+gap
+        into the base when the overlay can't take it.  None = rebuild
+        (journal gap / new rows)."""
+        ps = self._delta_absorb(key, field, view_name, shards, hit)
+        if ps is not None:
+            return ps
+        hit = self._entries.get(key)
+        if hit is None:
+            return None
+        return self._fold(key, field, view_name, shards, hit)
+
+    def _delta_absorb(self, key, field: Field, view_name: str,
+                      shards: tuple[int, ...], hit):
+        """Absorb journal cells into the plane's bounded device
+        overlay and advance the entry's covered generations — the
+        serving-path write step: no base-plane rewrite, no
+        generation-stale window.  None = can't absorb (disabled,
+        whole-row ops, overlay full, journal gap)."""
+        if self.delta_cells <= 0:
+            return None
+        old_gens, ps, nbytes = hit
+        got = self._collect_changes(field, view_name, shards, hit,
+                                    self.delta_cells)
+        if got is None:
+            return None
+        cells, resets, actual = got
+        if resets:
+            return None  # whole-row replacements fold instead
+        from pilosa_tpu.ingest.delta import DeltaMirror
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is None or cur[1] is not ps:
+                # raced another absorb/fold/rebuild: report the
+                # current entry if it is already serving-fresh
+                if cur is not None and cur[0] == actual:
+                    return cur[1]
+                return None
+            if actual == tuple(old_gens):
+                return ps  # no real gap (benign generation race)
+            mir = self._delta_mirrors.get(key)
+            if mir is None or mir[0] is not ps.plane:
+                mir = (ps.plane, DeltaMirror(self.delta_cells))
+                self._delta_mirrors[key] = mir
+            mirror = mir[1]
+            if not mirror.would_fit(cells):
+                return None  # overlay full: fold/compact
+            mirror.absorb(cells)
+            overlay = mirror.build_overlay(
+                jax.device_put,
+                ps.plane.shape[0] * ps.plane.shape[1])
+            new_ps = PlaneSet(ps.plane, ps.shards, ps.row_ids,
+                              ps.slot_of, delta=overlay)
+            self._entries[key] = (actual, new_ps, nbytes)
+            self._stamps.insert(key)
+            fill = len(mirror) / max(1, self.delta_cells)
+        self.delta_absorbs += 1
+        if fill >= self.delta_compact_fraction:
+            self._compact_async(key, field, view_name, shards)
+        return new_ps
+
+    # raced sentinel: a concurrent absorb/fold replaced the entry
+    # mid-fold — retry against the new entry (NOT a rebuild signal)
+    _RACED = object()
+
+    def _fold(self, key, field: Field, view_name: str,
+              shards: tuple[int, ...], hit):
+        """Fold the entry's overlay plus any remaining journal gap
+        into the base plane in ONE scatter (the existing
+        ``dynamic_update_slice``/scatter machinery) and atomically
+        swap the entry to a clean PlaneSet at the new generations —
+        the compaction step.  Retries when a concurrent absorb swaps
+        the entry mid-fold (under sustained writes the race is the
+        common case, and giving up would force a spurious rebuild).
+        None = the gap genuinely isn't coverable (rebuild)."""
+        for _ in range(4):
+            out = self._fold_once(key, field, view_name, shards, hit)
+            if out is not self._RACED:
+                return out
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+        return None
+
+    def _fold_once(self, key, field: Field, view_name: str,
+                   shards: tuple[int, ...], hit):
+        import time as _time
+        old_gens, ps, nbytes = hit
+        got = self._collect_changes(field, view_name, shards, hit,
+                                    self.delta_cells
+                                    + self.MAX_INCR_CELLS)
+        if got is None:
+            return None
+        cells, resets, actual = got
+        t0 = _time.perf_counter()
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is None or cur[1] is not ps:
+                if cur is not None and cur[0] == actual \
+                        and getattr(cur[1], "delta", None) is None:
+                    return cur[1]
+                return self._RACED if cur is not None else None
+            mir = self._delta_mirrors.get(key)
+            mirror_cells = (mir[1].snapshot()
+                            if mir is not None and mir[0] is ps.plane
+                            else {})
+        if ps.delta is not None and not mirror_cells:
+            # overlay without its mirror (dropped out from under us —
+            # e.g. an invalidate raced): the cells can't be recovered
+            # host-side, so rebuild rather than silently lose them
+            return None
+        if not cells and not resets and not mirror_cells:
+            if actual == tuple(old_gens):
+                return ps
+            # generations advanced with empty journal coverage — swap
+            # the covered gens forward without touching the plane
+            new_ps = ps
+        else:
+            reset_rows = [fr for fr, _ in resets]
+            reset_set = set(reset_rows)
+            merged = {k: v for k, v in mirror_cells.items()
+                      if k[0] not in reset_set}
+            merged.update(cells)  # journal truth supersedes the mirror
+            new_plane = _apply_plane_cells(
+                ps.plane,
+                np.fromiter((k[0] for k in merged), np.int64,
+                            len(merged)).astype(np.int32),
+                np.fromiter((k[1] for k in merged), np.int64,
+                            len(merged)).astype(np.int32),
+                np.fromiter(merged.values(), np.uint32, len(merged)),
+                np.asarray(reset_rows, np.int32),
+                (np.stack([rv for _, rv in resets]) if resets
+                 else np.zeros((0, ps.plane.shape[-1]), np.uint32)))
+            new_ps = PlaneSet(new_plane, ps.shards, ps.row_ids,
+                              ps.slot_of)
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is None or cur[1] is not ps:
+                return self._RACED if cur is not None else None
+            self._entries[key] = (actual, new_ps, nbytes)
+            self._stamps.insert(key)
+            self._delta_mirrors.pop(key, None)
+        self.incremental_applied += 1
+        if mirror_cells or ps.delta is not None:
+            self.delta_compactions += 1
+            self.last_compaction_seconds = _time.perf_counter() - t0
+            self._stats.count("delta_compactions_total", 1)
+        return new_ps
+
+    def _compact_async(self, key, field: Field, view_name: str,
+                       shards: tuple[int, ...]) -> None:
+        """Kick the background compactor for one plane (single-flight
+        per key): folds the overlay into the base off the serving path
+        and swaps generations atomically."""
+        with self._lock:
+            if key in self._compacting:
+                return
+            t = threading.Thread(
+                target=self._compact_run,
+                args=(key, field, view_name, shards),
+                name="delta-compact", daemon=True)
+            self._compacting[key] = t
+        t.start()
+
+    def _compact_run(self, key, field: Field, view_name: str,
+                     shards: tuple[int, ...]) -> None:
+        try:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._fold(key, field, view_name, shards, hit)
+        except Exception:  # noqa: BLE001 — compaction ≠ serving
+            import logging
+            logging.getLogger("pilosa_tpu.exec").exception(
+                "delta compaction failed for %s (queries keep "
+                "answering base⊕delta; next trigger retries)", key)
+        finally:
+            with self._lock:
+                self._compacting.pop(key, None)
+
+    def delta_stats(self) -> dict:
+        """The /status ``ingest`` block's overlay half."""
+        with self._lock:
+            cells = sum(len(m) for _, m in self._delta_mirrors.values())
+            bits = sum(m.bits for _, m in self._delta_mirrors.values())
+            pending = len(self._compacting)
+        cap = max(1, self.delta_cells)
+        return {"deltaCells": cells, "deltaCap": self.delta_cells,
+                "deltaOverlayBits": bits,
+                "deltaFillRatio": (round(cells / cap, 4)
+                                   if self.delta_cells else 0.0),
+                "absorbs": self.delta_absorbs,
+                "compactions": self.delta_compactions,
+                "pendingCompactions": pending,
+                "lastCompactionSeconds": round(
+                    self.last_compaction_seconds, 6)}
 
     def _build_plane(self, field: Field, view_name: str,
                      shards: tuple[int, ...]) -> PlaneSet:

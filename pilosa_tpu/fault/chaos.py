@@ -281,6 +281,35 @@ class ChaosHarness:
             acked += bool(self.write(row, col, via=via))
         return acked
 
+    def bulk_import(self, pairs, via: int = 0,
+                    clear: bool = False) -> bool:
+        """One bulk-import batch (r15): all pairs in ONE request over
+        the pair-import endpoint.  Oracle updates mirror
+        :meth:`write`/:meth:`clear` per pair — a failed batch may have
+        partially applied (per-shard commits), which ``attempted``
+        absorbs."""
+        for r, c in pairs:
+            if clear:
+                self.acked.setdefault(r, set()).discard(c)
+            else:
+                self.attempted.setdefault(r, set()).add(c)
+                self.cleared.setdefault(r, set()).discard(c)
+        try:
+            self.client(via)._json(
+                "POST", f"/index/{self.index}/field/{self.field}/import",
+                {"rowIDs": [int(r) for r, _ in pairs],
+                 "columnIDs": [int(c) for _, c in pairs],
+                 "clear": clear})
+        except (ClientError, OSError):
+            return False
+        for r, c in pairs:
+            if clear:
+                self.attempted.setdefault(r, set()).discard(c)
+                self.cleared.setdefault(r, set()).add(c)
+            else:
+                self.acked.setdefault(r, set()).add(c)
+        return True
+
     # -- invariants ----------------------------------------------------------
 
     def check_oracle(self, via: int | None = None) -> None:
@@ -900,6 +929,87 @@ def scenario_coordinator_crash_hint_log(cluster, seed: int) -> ChaosHarness:
     return h
 
 
+def scenario_bulk_import_kill_handoff(cluster, seed: int) -> ChaosHarness:
+    """kill -9 one of replicas=2 MID-BULK-IMPORT (r15 ingest): import
+    batches keep acking straight through the corpse — the dead owner's
+    shard batches are durably hinted as ``kind: "import"`` records
+    (visible as ``bulkOps`` on writeHealth) — and a CLEARING import
+    (the strict class) serves through too.  After restart the
+    heartbeat drain replays the import hints in order; every node then
+    answers oracle-exact, forced AAE resurrects nothing that a
+    clearing import removed, and a re-delivered replay batch is a
+    NO-OP (op-id dedup covers bulk ops: the double-POST pin)."""
+    h = ChaosHarness(cluster, seed, index="chaos_bulk")
+    h.setup()
+    # seed three shards via ONE bulk batch
+    seed_pairs = [(r, s * SHARD_WIDTH + h.rng.randrange(1, 1000))
+                  for s in range(3) for r in range(h.N_ROWS)]
+    if not h.bulk_import(seed_pairs):
+        raise h._fail("seed bulk import did not ack")
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = next(i for i in range(h.n) if i != victim)
+    victim_id = h.node_id(victim)
+    cluster.nodes[victim].kill9()
+    # bulk-import THROUGH the corpse: every batch must keep acking
+    # (pre-breaker legs fail mid-apply and hand off; post-open the
+    # split hints up front) — zero refusals allowed
+    acked_pairs: list = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        batch = [(h.rng.randrange(h.N_ROWS),
+                  h.rng.randrange(h.MAX_COL)) for _ in range(8)]
+        if not h.bulk_import(batch, via=entry):
+            raise h._fail("bulk import refused with a replica dead")
+        acked_pairs.extend(batch)
+        if h.breaker_state(entry, victim_id) == "open":
+            break
+    else:
+        raise h._fail("breaker never opened for the dead peer")
+    # a CLEARING import (strict class — a replica that missed it would
+    # resurrect via AAE) must ALSO serve through, hinted
+    if not h.bulk_import(acked_pairs[:4], via=entry, clear=True):
+        raise h._fail("clearing import refused with a replica dead")
+    # the missed batches are durably queued and counted as BULK ops
+    wh = h.client(entry).write_health()
+    if not wh.get("hintBulkOps"):
+        raise h._fail(f"no bulk ops in the hint backlog: {wh}")
+    for i in (coord, entry):
+        h.check_oracle(via=i)  # live nodes exact while hints pend
+    # op-id dedup pin: the SAME replay batch delivered twice applies
+    # once — the second POST dedups every op
+    held = h.client(entry)._json(
+        "GET", f"/internal/shards?index={h.index}")["shards"]
+    dedup_col = int(sorted(held)[0]) * SHARD_WIDTH + 1001
+    ops = [{"id": "bulkdedup-" + format(seed, "x"), "index": h.index,
+            "op": "Import", "field": h.field, "shards": [int(sorted(held)[0])],
+            "kind": "import",
+            "import": {"mode": "bits", "rows": [0], "cols": [dedup_col],
+                       "clear": False}}]
+    h.attempted.setdefault(0, set()).add(dedup_col)
+    r1 = h.client(entry)._json("POST", "/internal/hints/replay",
+                               {"ops": ops})
+    r2 = h.client(entry)._json("POST", "/internal/hints/replay",
+                               {"ops": ops})
+    if r1.get("applied") != 1 or r2.get("deduped") != 1 \
+            or r2.get("applied"):
+        raise h._fail(f"bulk op-id dedup broken: first={r1} second={r2}")
+    # restart: rejoin triggers the drain; every node answers
+    # oracle-exact and forced AAE resurrects nothing cleared
+    node = cluster.nodes[victim]
+    node.stop()
+    node.start()
+    node.await_up()
+    cluster.await_membership(3, timeout=120)
+    h.await_hints_drained(entry)
+    h.await_oracle()
+    for i in range(h.n):
+        h.client(i)._json("POST", "/internal/aae/run", {})
+    h.check_oracle()
+    return h
+
+
 SCENARIOS = {
     "partition_during_resize": (scenario_partition_during_resize, 3),
     "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
@@ -919,6 +1029,8 @@ SCENARIOS = {
     "clear_during_kill_handoff": (scenario_clear_during_kill_handoff, 3),
     "coordinator_crash_hint_log": (scenario_coordinator_crash_hint_log,
                                    3),
+    # r15 — ingest (bulk imports through failure, op-id dedup)
+    "bulk_import_kill_handoff": (scenario_bulk_import_kill_handoff, 3),
 }
 
 

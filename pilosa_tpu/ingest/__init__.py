@@ -1,0 +1,31 @@
+"""Ingest subsystem (r15): bulk import pipeline + device-side delta
+planes — serve reads at the ceiling while writes stream in.
+
+Two halves (ROADMAP item 4, SURVEY.md §4.5 "host delta queues → device
+scatter"):
+
+- :mod:`pilosa_tpu.ingest.bulk` — the replicated bulk-import
+  coordinator: batched (row, col) and roaring imports apply straight
+  into fragments in one oplog-batched, fsync-coalesced append per
+  batch, routed through the breaker-aware write path so hinted handoff
+  and idempotent op-id replay cover bulk ops exactly like PQL writes.
+
+- :mod:`pilosa_tpu.ingest.delta` — device-side delta overlays: recent
+  writes accumulate as bounded (cell → word value) buffers beside the
+  resident base plane; query kernels merge base⊕delta at dispatch time
+  (Count / selected-counts / TopN row counts) so a write never marks
+  the plane generation-stale on the serving path, while a background
+  compactor folds full overlays into the base and atomically swaps
+  generations (:class:`pilosa_tpu.exec.planes.PlaneCache` hosts the
+  state and drives both).
+"""
+
+from pilosa_tpu.ingest.bulk import BulkImporter, apply_import_hint
+from pilosa_tpu.ingest.delta import (DeltaMirror, DeltaOverlay,
+                                     adjusted_row_counts,
+                                     adjusted_selected_counts)
+
+__all__ = [
+    "BulkImporter", "apply_import_hint", "DeltaMirror", "DeltaOverlay",
+    "adjusted_row_counts", "adjusted_selected_counts",
+]

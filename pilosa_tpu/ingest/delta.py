@@ -1,0 +1,216 @@
+"""Device-side delta planes: bounded write overlays merged at dispatch.
+
+The device half of SURVEY.md §4.5 ingest (host delta queues → device
+scatter), rebuilt so the QUERY path never rewrites the base plane:
+
+- the **mirror** (:class:`DeltaMirror`) is the host-side truth of the
+  overlay: an insertion-ordered ``(flat_row, word) → current word
+  value`` map, absorbed from fragment mutation journals
+  (``Fragment.changed_cells_since``) when a resident plane's
+  generations fall behind.  A cell's value is the word's CURRENT
+  contents, so sets AND clears are both "overwrite this word" — no
+  separate set/clear masks, no ordering hazard.
+
+- the **overlay** (:class:`DeltaOverlay`) is the mirror's device form:
+  three pow2-padded arrays (flat row index, word index, value) the
+  merge kernels consume.  Padding uses an out-of-range row index so
+  scatter-adds drop pad lanes and gathers mask them.
+
+- the **merge kernels** (:func:`adjusted_row_counts`,
+  :func:`adjusted_selected_counts`) answer base⊕delta in one program:
+  scan the UNCHANGED base plane exactly as the clean path does, gather
+  the overlay's base words, and adjust each touched row's count by
+  ``popcount(new) − popcount(old)``.  The base plane is read-only —
+  no donation, no 4 GB re-scatter — so the marginal cost per query is
+  one small gather + scatter-add over the overlay, and concurrent
+  readers share the same immutable arrays.
+
+Capacity is bounded (``PlaneCache.delta_cells``); past the compaction
+threshold a background compactor folds the overlay into the base plane
+via the existing ``dynamic_update_slice``/scatter machinery and swaps
+the cache entry's generation atomically (exec/planes.py owns that).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+@dataclass
+class DeltaOverlay:
+    """Device form of one plane's pending write cells.
+
+    ``rows`` are FLAT row indices (``shard_axis * R_pad + row_slot``)
+    into ``plane.reshape(S * R_pad, W)``; pad lanes carry
+    ``rows == S * R_pad`` (out of range → dropped/masked by the merge
+    kernels).  ``vals`` are the cells' current word values — base⊕delta
+    is "replace these words"."""
+
+    rows: jax.Array   # int32[C_pad]
+    words: jax.Array  # int32[C_pad]
+    vals: jax.Array   # uint32[C_pad]
+    n: int            # live cells (<= C_pad)
+    bits: int         # set bits carried by live cells (gauge fodder)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.rows.size) * 12
+
+
+class DeltaMirror:
+    """Host mirror of one resident plane's overlay cells.
+
+    Mutated only under the owning ``PlaneCache``'s lock; the built
+    :class:`DeltaOverlay` is immutable, so serving threads read a
+    fully-formed object or none.  ``cap`` bounds cells — absorb refuses
+    past it and the caller compacts/rebuilds instead.
+
+    Backing store is three parallel numpy arrays plus a cell→slot
+    index: absorbing a batch costs one append/overwrite per BATCH cell
+    (not a rebuild of the whole mirror), and :meth:`build_overlay` is
+    a vectorized pad-copy — the work done under the cache lock scales
+    with the write batch, not the overlay's fill."""
+
+    _GROW = 1024
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self._index: dict[tuple[int, int], int] = {}
+        size = min(self._GROW, max(1, self.cap))
+        self._rows = np.empty(size, np.int64)
+        self._words = np.empty(size, np.int64)
+        self._vals = np.empty(size, np.uint32)
+        self.bits = 0  # sum of bit_count over live cell values
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def would_fit(self, new_cells) -> bool:
+        """Whether absorbing ``new_cells`` keeps the mirror at/under
+        cap (overwrites of existing cells don't grow it)."""
+        grow = sum(1 for k in new_cells if k not in self._index)
+        return len(self._index) + grow <= self.cap
+
+    def absorb(self, new_cells: dict[tuple[int, int], int]) -> None:
+        """Overwrite-merge journal cells (values are current word
+        truth, so later absorbs supersede earlier ones per word)."""
+        for key, val in new_cells.items():
+            slot = self._index.get(key)
+            if slot is None:
+                slot = len(self._index)
+                if slot >= len(self._rows):
+                    grow = min(max(len(self._rows) * 2, self._GROW),
+                               max(self.cap, slot + 1))
+                    for name in ("_rows", "_words", "_vals"):
+                        arr = getattr(self, name)
+                        new = np.empty(grow, arr.dtype)
+                        new[:len(arr)] = arr
+                        setattr(self, name, new)
+                self._index[key] = slot
+                self._rows[slot], self._words[slot] = key
+            else:
+                self.bits -= int(self._vals[slot]).bit_count()
+            self._vals[slot] = val
+            self.bits += val.bit_count()
+
+    def snapshot(self) -> dict:
+        """{(flat_row, word): value} copy (the fold path's input)."""
+        n = len(self._index)
+        return dict(zip(zip(self._rows[:n].tolist(),
+                            self._words[:n].tolist()),
+                        self._vals[:n].tolist()))
+
+    def build_overlay(self, place, flat_total: int) -> DeltaOverlay:
+        """Materialize the device overlay (pow2-padded; pad rows =
+        ``flat_total`` → masked/dropped by the kernels).  ``place`` is
+        the device placement callable."""
+        n = len(self._index)
+        c_pad = _pow2(max(1, n))
+        rows = np.full(c_pad, flat_total, np.int32)
+        words = np.zeros(c_pad, np.int32)
+        vals = np.zeros(c_pad, np.uint32)
+        rows[:n] = self._rows[:n]
+        words[:n] = self._words[:n]
+        vals[:n] = self._vals[:n]
+        return DeltaOverlay(place(rows), place(words), place(vals),
+                            n=n, bits=self.bits)
+
+
+# ---------------------------------------------------------------------------
+# Merge kernels (pure jnp; jitted through FusedCache — one program per
+# (plane shape, overlay bucket[, filter]) like every other fused family)
+# ---------------------------------------------------------------------------
+
+
+def _cell_diffs(plane: jax.Array, d_rows: jax.Array, d_words: jax.Array,
+                d_vals: jax.Array, filter_words: jax.Array | None):
+    """Per-cell popcount deltas vs the base plane: int32[C_pad] (pad
+    lanes 0) plus each cell's plane row slot (pad lanes out of range)."""
+    s, r, w = plane.shape
+    total = s * r
+    flat = plane.reshape(total, w)
+    rc = jnp.clip(d_rows, 0, total - 1)
+    base = flat[rc, d_words]
+    val = d_vals
+    if filter_words is not None:
+        fflat = filter_words.reshape(s * w)
+        f = fflat[jnp.clip((rc // r) * w + d_words, 0, s * w - 1)]
+        base = jnp.bitwise_and(base, f)
+        val = jnp.bitwise_and(val, f)
+    diff = (jax.lax.population_count(val).astype(jnp.int32)
+            - jax.lax.population_count(base).astype(jnp.int32))
+    valid = d_rows < total
+    diff = jnp.where(valid, diff, 0)
+    slot = jnp.where(valid, rc % r, r)  # pad → R (dropped)
+    return diff, slot
+
+
+def adjusted_row_counts(plane: jax.Array, d_rows: jax.Array,
+                        d_words: jax.Array, d_vals: jax.Array,
+                        filter_words: jax.Array | None = None,
+                        reduce_shards: bool = True) -> jax.Array:
+    """Whole-plane per-row popcounts of base⊕delta.
+
+    plane uint32[S, R, W]; overlay arrays int32/uint32[C_pad] →
+    int32[R] (``reduce_shards``) or int32[S, R].  The base scan is
+    byte-identical to the clean ``row_counts`` path; delta cells only
+    adjust the touched (shard, row) entries, so N concurrent queries
+    over the same (plane, overlay) pair still dedupe to one scan."""
+    from pilosa_tpu.engine import kernels
+    s, r, _ = plane.shape
+    counts = kernels.row_counts(plane, filter_words)  # int32[S, R]
+    diff, _slot = _cell_diffs(plane, d_rows, d_words, d_vals,
+                              filter_words)
+    flat = counts.reshape(s * r)
+    flat = flat.at[jnp.where(d_rows < s * r, d_rows, s * r)].add(
+        diff, mode="drop")
+    counts = flat.reshape(s, r)
+    if reduce_shards:
+        return jnp.sum(counts, axis=0, dtype=jnp.int32)
+    return counts
+
+
+def adjusted_selected_counts(plane: jax.Array, row_idx: jax.Array,
+                             d_rows: jax.Array, d_words: jax.Array,
+                             d_vals: jax.Array) -> jax.Array:
+    """Selected-row popcounts of base⊕delta, shard axis reduced on
+    device: int32[N] for ``row_idx`` int32[N] (plane row slots, the
+    multi-query fused gather).  Each overlay cell contributes its diff
+    to EVERY matching output lane (duplicate slots answer
+    independently, like the clean gather)."""
+    from pilosa_tpu.engine import kernels
+    sel = jnp.sum(kernels.selected_row_counts(plane, row_idx), axis=-2,
+                  dtype=jnp.int32)                       # int32[N]
+    diff, slot = _cell_diffs(plane, d_rows, d_words, d_vals, None)
+    match = slot[:, None] == row_idx[None, :]            # [C_pad, N]
+    add = jnp.sum(jnp.where(match, diff[:, None], 0), axis=0,
+                  dtype=jnp.int32)
+    return sel + add

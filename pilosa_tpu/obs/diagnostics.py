@@ -60,6 +60,9 @@ def build_payload(holder, cluster=None, stats=None, slow_log=None) -> dict:
             payload["writeHealth"] = {
                 "hintedHandoff": bool(wh.get("hintedHandoff")),
                 "backlogOps": int(wh.get("hintBacklogOps", 0)),
+                # r15 ingest: hinted BULK ops (import batches) pending
+                # replay — counts only, never payloads
+                "bulkOps": int(wh.get("hintBulkOps", 0)),
                 "hintedPeers": len(wh.get("hintedPeers", ())),
                 "oldestSeconds": float(wh.get("hintOldestSeconds", 0.0))}
         except Exception:  # noqa: BLE001

@@ -104,7 +104,9 @@ class PilosaTPUServer:
             plane_budget=self.cfg.plane_budget_bytes,
             count_batch_window=self.cfg.count_batch_window,
             max_concurrent=self.cfg.max_concurrent_queries,
-            plane_sidecars=self.cfg.plane_sidecars)
+            plane_sidecars=self.cfg.plane_sidecars,
+            delta_cells=self.cfg.delta_buffer_cells,
+            delta_compact_fraction=self.cfg.delta_compact_fraction)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

@@ -204,9 +204,13 @@ class Field:
         return changed > 0
 
     def import_bits(self, row_ids: np.ndarray, cols: np.ndarray,
-                    timestamps: list[datetime | None] | None = None) -> int:
+                    timestamps: list[datetime | None] | None = None,
+                    sync_batch=None) -> int:
         """Bulk (row, col[, ts]) writes routed to standard + time views
-        (reference: ``field.Import`` → view fan-out, SURVEY.md §4.5)."""
+        (reference: ``field.Import`` → view fan-out, SURVEY.md §4.5).
+        ``sync_batch`` (an :class:`~pilosa_tpu.store.oplog.SyncBatch`)
+        coalesces durable op-log fsyncs to one per touched fragment at
+        the batch boundary (the caller flushes)."""
         from pilosa_tpu.engine.words import SHARD_WIDTH
         opts = self.options
         if opts.type in BSI_TYPES:
@@ -236,7 +240,7 @@ class Field:
                 changed += self._set_mutex(int(shard), r, c)
             else:
                 frag = self.standard_view(create=True).fragment(int(shard), create=True)
-                changed += frag.set_bits(r, c)
+                changed += frag.set_bits(r, c, sync_batch=sync_batch)
             if opts.type == TYPE_TIME and timestamps is not None and opts.time_quantum:
                 idx = order[lo:hi]
                 for j, (rr, cc) in enumerate(zip(r, c)):
@@ -245,7 +249,46 @@ class Field:
                         continue
                     for vname in timeq.views_by_time(VIEW_STANDARD, ts, opts.time_quantum):
                         tf = self.view(vname, create=True).fragment(int(shard), create=True)
-                        tf.set_bits(np.array([rr], np.uint64), np.array([cc], np.uint64))
+                        tf.set_bits(np.array([rr], np.uint64),
+                                    np.array([cc], np.uint64),
+                                    sync_batch=sync_batch)
+        return changed
+
+    def clear_import(self, row_ids: np.ndarray, cols: np.ndarray,
+                     sync_batch=None) -> int:
+        """Bulk clear of (row, col) pairs — the ``clear=true`` half of
+        the import endpoint, batched per fragment (one op-log record +
+        one deferred fsync per touched fragment instead of a
+        ``clear_bit`` round trip per pair).  Clears apply to EVERY view
+        (a time-view copy left set would resurface in range queries),
+        like :meth:`clear_bit`."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        if self.options.type in BSI_TYPES:
+            raise ValueError(f"field {self.name}: bit clear on BSI field")
+        row_ids = np.asarray(row_ids, np.uint64)
+        cols = np.asarray(cols, np.uint64)
+        if len(row_ids) != len(cols):
+            raise ValueError(
+                f"clear_import: {len(row_ids)} rows vs {len(cols)} columns")
+        shards = cols // np.uint64(SHARD_WIDTH)
+        offs = cols % np.uint64(SHARD_WIDTH)
+        order = np.argsort(shards, kind="stable")
+        shards_s, rows_s, offs_s = shards[order], row_ids[order], offs[order]
+        uniq = np.unique(shards_s)
+        bounds = np.append(np.searchsorted(shards_s, uniq), len(shards_s))
+        changed = 0
+        with self._lock:
+            views = list(self.views.values())
+        for i, shard in enumerate(uniq):
+            lo, hi = bounds[i], bounds[i + 1]
+            for v in views:
+                frag = v.fragment(int(shard))
+                if frag is not None:
+                    changed_v = frag.clear_bits(rows_s[lo:hi],
+                                                offs_s[lo:hi],
+                                                sync_batch=sync_batch)
+                    if v.name == VIEW_STANDARD:
+                        changed += changed_v
         return changed
 
     def _set_mutex(self, shard: int, row_ids: np.ndarray, cols: np.ndarray) -> int:

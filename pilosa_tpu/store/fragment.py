@@ -87,9 +87,12 @@ class Fragment:
 
     # journal bounds: entries beyond RECENT_MAX or ops touching more
     # cells than RECENT_CELL_CAP evict history (planes falls back to a
-    # full rebuild — bulk imports SHOULD rebuild)
+    # compaction/rebuild).  The cell cap covers import-batch-sized ops
+    # (r15 delta planes absorb bulk writes into device overlays —
+    # positions-form entries alias the batch's already-allocated array,
+    # so the cap bounds only the dict-form classic path's word lists)
     RECENT_MAX = 128
-    RECENT_CELL_CAP = 8192
+    RECENT_CELL_CAP = 65536
 
     # pending tier: flush to per-row RowBits at this many buffered bits
     # (bounds pending memory at 8 B/bit and keeps the per-batch sorted
@@ -695,24 +698,31 @@ class Fragment:
         return self.clear_bits(np.array([row_id], np.uint64),
                                np.array([col], np.uint64)) > 0
 
-    def set_bits(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
+    def set_bits(self, row_ids: np.ndarray, cols: np.ndarray,
+                 sync_batch=None) -> int:
         """Bulk set; returns number of newly-set bits (reference:
-        ``fragment.bulkImport``, SURVEY.md §4.5)."""
+        ``fragment.bulkImport``, SURVEY.md §4.5).  ``sync_batch`` (an
+        :class:`~pilosa_tpu.store.oplog.SyncBatch`) defers the op-log
+        fsync to the import batch boundary — one fsync per batch per
+        touched fragment, not one per record."""
         positions = (np.asarray(row_ids, np.uint64) * _SW
                      + np.asarray(cols, np.uint64))
         with self.lock:
             changed = self._apply(OP_SET_BITS, 0, positions)
             if changed:
-                self._log(OP_SET_BITS, 0, positions)
+                self._log(OP_SET_BITS, 0, positions,
+                          sync_batch=sync_batch)
             return changed
 
-    def clear_bits(self, row_ids: np.ndarray, cols: np.ndarray) -> int:
+    def clear_bits(self, row_ids: np.ndarray, cols: np.ndarray,
+                   sync_batch=None) -> int:
         positions = (np.asarray(row_ids, np.uint64) * _SW
                      + np.asarray(cols, np.uint64))
         with self.lock:
             changed = self._apply(OP_CLEAR_BITS, 0, positions)
             if changed:
-                self._log(OP_CLEAR_BITS, 0, positions)
+                self._log(OP_CLEAR_BITS, 0, positions,
+                          sync_batch=sync_batch)
             return changed
 
     def set_bits_grouped(self, groups: list[tuple[int, np.ndarray]]) -> int:
@@ -785,7 +795,8 @@ class Fragment:
             self._log(OP_SET_ROW, row_id, positions)
             return True
 
-    def import_roaring(self, blob: bytes, clear: bool = False) -> int:
+    def import_roaring(self, blob: bytes, clear: bool = False,
+                       sync_batch=None) -> int:
         """Union (or clear) an already-roaring-encoded bit set — the bulk
         loader fast path (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
         positions = roaring.deserialize(blob)
@@ -793,7 +804,7 @@ class Fragment:
         with self.lock:
             changed = self._apply(op, 0, positions)
             if changed:
-                self._log(op, 0, positions)
+                self._log(op, 0, positions, sync_batch=sync_batch)
             return changed
 
     # -- durability ---------------------------------------------------------
@@ -1071,8 +1082,9 @@ class Fragment:
         if len(positions) and int(positions.max() // _SW) >= (1 << 40):
             raise ValueError("row id out of range (>= 2^40)")
 
-    def _log(self, op: int, aux: int, positions: np.ndarray | None) -> None:
-        self._oplog.append(op, aux, positions)
+    def _log(self, op: int, aux: int, positions: np.ndarray | None,
+             sync_batch=None) -> None:
+        self._oplog.append(op, aux, positions, sync_batch=sync_batch)
         self.op_n += 1
         if self.op_n > self.max_op_n:
             if self._snapshot_submit is not None:

@@ -346,3 +346,44 @@ def test_config25_observability_smoke():
     assert out["detail"]["exemplar_buckets"] > 0
     assert out["detail"]["kernel_bytes_scanned"] > 0
     assert out["detail"]["kernel_bandwidth_gbps"] > 0
+
+
+def test_config26_ingest_serving_smoke():
+    """bench/config26 (read qps under sustained ingest — delta planes,
+    r15) in --smoke mode: one server process, 95/5 and 80/20 bulk-
+    import mixes into the SAME plane the readers scan.  The ingest
+    acceptance criteria are pinned here on every run: reads stay
+    oracle-exact LIVE (read rows bit-exact, write row never below the
+    acked-import floor — base⊕delta serving truth), quiesced write-row
+    counts equal every acked column, ZERO base-plane rebuilds during
+    the mixed phases, and the delta overlay actually absorbed writes.
+    The qps ratio itself is gated at full scale only (CPU smoke noise)
+    but must be wired through the regression guard."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config26_ingest_serving.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("read_qps_under_ingest_ratio")
+    assert out["unit"] == "ratio" and out["value"] > 0
+    d = out["detail"]
+    # the no-rebuild-stalls criterion, as a hard number
+    assert d["plane_rebuilds_during_serving"] == 0
+    # delta overlays served the writes (absorbs moved; compactions may
+    # or may not fire inside a short smoke window)
+    assert d["ingest_status"]["absorbs"] >= 1
+    assert d["ingest_status"]["importedBits"] > 0
+    for mix in ("95/5", "80/20"):
+        m = d["mixes"][mix]["under_ingest"]
+        assert m["reads"]["failed"] == 0, m["reads"]
+        assert m["writes"]["failed"] == 0, m["writes"]
+        assert m["writes"]["bits"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

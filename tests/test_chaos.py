@@ -23,6 +23,10 @@ distributed invariant after faults clear:
 - coordinator crash hint log   → kill -9 mid-hint-append: the torn op
                                  never applies, the clean prefix
                                  replays after restart
+- bulk import kill handoff     → kill -9 mid-bulk-import: batches keep
+                                 acking (hinted as import records),
+                                 the drain replays, op-id dedup no-ops
+                                 redelivery, AAE resurrects nothing
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -90,6 +94,17 @@ def test_clear_during_kill_handoff(tmp_path):
     with run_process_cluster(3, str(tmp_path), replicas=2,
                              anti_entropy=1.0) as cluster:
         chaos.scenario_clear_during_kill_handoff(cluster, SEED)
+
+
+def test_bulk_import_kill_handoff(tmp_path):
+    # own cluster (kill -9 + restart): the r15 ingest proof — bulk
+    # import batches serve through a dead replica (hinted as import
+    # records), the rejoin drain replays them in order, op-id dedup
+    # no-ops a re-delivered batch, forced AAE resurrects nothing a
+    # clearing import removed
+    with run_process_cluster(3, str(tmp_path), replicas=2,
+                             anti_entropy=1.0) as cluster:
+        chaos.scenario_bulk_import_kill_handoff(cluster, SEED)
 
 
 def test_coordinator_crash_hint_log(tmp_path):

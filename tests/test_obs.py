@@ -446,7 +446,8 @@ class TestDiagnostics:
         assert p["clusterHealth"] == {"peers": 2, "suspect": 1,
                                       "breakersOpen": 1}
         assert p["writeHealth"] == {"hintedHandoff": True,
-                                    "backlogOps": 4, "hintedPeers": 1,
+                                    "backlogOps": 4, "bulkOps": 0,
+                                    "hintedPeers": 1,
                                     "oldestSeconds": 1.5}
         # anonymized: counts only, no peer identifiers anywhere
         import json

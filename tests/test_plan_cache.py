@@ -10,6 +10,7 @@ import pytest
 
 import pilosa_tpu.pql.parser as parser_mod
 from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.executor import ExecutionError
 from pilosa_tpu.pql.parser import parse_cached
 from pilosa_tpu.store import FieldOptions, Holder
 
@@ -60,16 +61,42 @@ def test_plan_cache_hit_skips_parsing(ex, monkeypatch):
     assert _counters(ex, "plan_cache_hits") >= 1
 
 
-def test_generation_bump_invalidates(ex):
+def test_generation_bump_serves_fresh_truth(ex):
+    """A write must never let a cached plan serve a stale count.
+    r15: unkeyed-plane entries SURVIVE the write (nothing in them can
+    stale — row ids are literal integers and the PlaneSet revalidates
+    its own generations via the delta overlay), so the fresh answer
+    arrives withOUT an invalidation + re-plan per write — the property
+    that keeps parse+plan off every request under sustained ingest."""
     pql = "Count(Row(f=0))"
     assert ex.execute("i", pql) == [5]
     assert ex.execute("i", pql) == [5]  # plan-cached
+    hits_before = _counters(ex, "plan_cache_hits")
     ex.execute("i", "Set(100, f=0)")    # bumps the source generation
     assert ex.execute("i", pql) == [6], \
         "stale plan served a stale count"
-    assert _counters(ex, "plan_cache_invalidations") >= 1
-    # the re-planned entry serves the new truth
+    # the surviving entry keeps serving the new truth from the cache
     assert ex.execute("i", pql) == [6]
+    assert _counters(ex, "plan_cache_hits") > hits_before, \
+        "the unkeyed-plane plan should survive the write"
+
+
+def test_field_recreated_as_keyed_drops_surviving_plan(ex):
+    """The surviving unkeyed-plane entry must still die when the field
+    is dropped and recreated with a different identity (keyed/BSI) —
+    its literal row ids would otherwise probe the wrong namespace."""
+    pql = "Count(Row(f=0))"
+    assert ex.execute("i", pql) == [5]
+    assert ex.execute("i", pql) == [5]  # plan-cached, write-surviving
+    idx = ex.holder.index("i")
+    idx.delete_field("f")
+    ex.planes.invalidate("i")  # what API.delete_field does (plans NOT
+    #                            dropped here: the hazard under test)
+    idx.create_field("f", FieldOptions(keys=True))
+    with pytest.raises(ExecutionError):
+        # integer row on a keyed field must fail like a fresh plan
+        # would — not serve the stale literal-row-id plan
+        ex.execute("i", pql)
 
 
 def test_missing_row_then_created(ex):

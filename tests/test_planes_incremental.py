@@ -32,10 +32,14 @@ def test_set_clear_refresh_incrementally(env):
     assert [(x.id, x.count) for x in p.pairs] == [(10, 2), (20, 1)]
     before = ex.planes.incremental_applied
 
+    before_absorbs = ex.planes.delta_absorbs
     ex.execute("i", f"Set(3, f=10) Clear(1, f=10) Set({c2 + 1}, f=20)")
     (p,) = ex.execute("i", "TopN(f)")
-    assert ex.planes.incremental_applied > before, \
-        "small mutations must take the delta-scatter path"
+    assert (ex.planes.incremental_applied > before
+            or ex.planes.delta_absorbs > before_absorbs), \
+        "small mutations must take the delta-overlay/scatter path"
+    assert ex.planes.stats()["builds"] == 1, \
+        "small mutations must not rebuild the plane"
     assert [(x.id, x.count) for x in p.pairs] == \
         [(x.id, x.count) for x in fresh(holder).execute("i", "TopN(f)")[0].pairs]
 
