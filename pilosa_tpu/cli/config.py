@@ -97,6 +97,14 @@ class Config:
     # folds the overlay into the base and swaps generations.
     delta_buffer_cells: int = 65536
     delta_compact_fraction: float = 0.5
+    # Whole-tree query compilation (r16): compound boolean PQL
+    # (Intersect/Union/Difference/Xor/Not/UnionRows trees, BSI range
+    # leaf filters) compiles to ONE fused XLA program — rows gathered
+    # from the resident plane as traced operands, ops folded as a
+    # postfix program — with concurrent requests sharing one memory
+    # pass per plane through the batcher window.  False restores the
+    # pre-r16 op-at-a-time/generic path (the bench baseline).
+    tree_fusion: bool = True
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

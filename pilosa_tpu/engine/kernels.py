@@ -133,6 +133,86 @@ def selected_row_counts(plane: jax.Array,
     return count(sel)
 
 
+# ---------------------------------------------------------------------------
+# Whole-tree boolean programs (compound PQL compilation, ROADMAP item 3)
+# ---------------------------------------------------------------------------
+#
+# A compound boolean query (``Count(Intersect(Row, Union(Row, Row),
+# Not(Row)))``) evaluates here as ONE kernel: the operand bitmaps are a
+# stacked ``uint32[G, ..., W]`` array (rows gathered from a resident
+# plane plus any extra bitmaps), and each query's call tree is a small
+# POSTFIX program folded word-wise over them.  The fold splits the
+# program into a STATIC skeleton (the opcode sequence — part of the
+# compiled-program cache key, like every other fused family's shape)
+# and TRACED push arguments (which operand each push reads), so any
+# tree of the same skeleton — any row ids, any predicate values —
+# reuses one executable, and XLA fuses the whole fold into a single
+# bitwise+popcount pass with no interpreter machinery at run time.
+# ``Not`` needs no opcode: the planner lowers it to ``ANDNOT(exists,
+# x)`` with the existence row pushed as an operand.
+
+TREE_NOP = 0     # padding: no-op (pow2 program-length buckets)
+TREE_PUSH = 1    # push rows[arg] (gathered plane rows)
+TREE_PUSHX = 2   # push extras[arg] (exists / other-field / predicate)
+TREE_ZERO = 3    # push an all-zero bitmap (empty Union, absent rows)
+TREE_AND = 4
+TREE_OR = 5
+TREE_ANDNOT = 6  # a & ~b (Difference; Not via ANDNOT(exists, x))
+TREE_XOR = 7
+
+# postfix evaluation of a depth-d call tree needs ~d+1 live values;
+# the planner rejects (falls back past) this bound so a hostile tree
+# cannot explode the fused expression
+TREE_STACK_DEPTH = 8
+
+_TREE_BIN = {TREE_AND: intersect, TREE_OR: union,
+             TREE_ANDNOT: difference, TREE_XOR: xor}
+
+
+def tree_fold(rows, skeleton: tuple, row_args: jax.Array,
+              extras: jax.Array | None = None,
+              extra_args: jax.Array | None = None,
+              zero: jax.Array | None = None) -> jax.Array:
+    """Fold ONE postfix boolean program over operand bitmaps.
+
+    ``rows``: uint32[G, ..., W] gathered plane rows, OR a callable
+    ``rows(arg) -> uint32[..., W]`` that materializes one row per
+    push (the solo path passes a direct plane indexer so XLA fuses
+    each row read straight into the bitwise chain — no intermediate
+    gathered array); ``extras``: uint32[E, ..., W] extra bitmaps or
+    None; ``skeleton``: the STATIC opcode tuple (``TREE_*`` values;
+    binary ops pop two, push one); ``row_args``/``extra_args``:
+    traced int32 operand indices consumed in order by the
+    ``TREE_PUSH``/``TREE_PUSHX`` ops; ``zero``: the empty-bitmap
+    template for ``TREE_ZERO`` (defaults to ``zeros_like(rows[0])``
+    when ``rows`` is an array).  Returns the final uint32[..., W]
+    bitmap.  The skeleton is trace-time structure — the emitted XLA
+    is a plain fused bitwise expression chain; only the operand
+    CHOICE is a runtime gather, so any tree of the same skeleton
+    (any row ids, any predicate values) reuses one compiled
+    program."""
+    fetch = rows if callable(rows) else (lambda a: rows[a])
+    stack: list = []
+    ri = xi = 0
+    for op in skeleton:
+        if op == TREE_PUSH:
+            stack.append(fetch(row_args[ri]))
+            ri += 1
+        elif op == TREE_PUSHX:
+            stack.append(extras[extra_args[xi]])
+            xi += 1
+        elif op == TREE_ZERO:
+            stack.append(jnp.zeros_like(rows[0]) if zero is None
+                         else zero)
+        elif op == TREE_NOP:
+            continue
+        else:
+            b = stack.pop()
+            a = stack.pop()
+            stack.append(_TREE_BIN[op](a, b))
+    return stack[-1]
+
+
 def top_n(counts: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
     """(values, row_ids) of the n largest counts (reference: two-phase
     ``executeTopN`` merge, SURVEY.md §4.3 — exact by construction here).

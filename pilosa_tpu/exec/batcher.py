@@ -53,9 +53,10 @@ class _Pending:
 
     def __init__(self, kind, nodes, leaves, delta=None):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
-        #                       | "selcounts" | "distinct"
+        #                       | "selcounts" | "tree" | "distinct"
         self.nodes = nodes    # count: tuple of plan trees;
         #                       selcounts: tuple of plane row slots;
+        #                       tree: (slots, postfix prog, extras);
         #                       others: None
         self.leaves = leaves  # count: plan leaves; others: plane[, filter]
         self.delta = delta    # rowcounts/selcounts: the plane's
@@ -195,6 +196,26 @@ class CountBatcher:
         return self._submit(_Pending("selcounts", tuple(slots), (plane,),
                                      delta=delta))
 
+    def submit_tree(self, plane, slots: tuple, prog: tuple,
+                    extras: tuple = (), delta=None) -> int:
+        """One compound-tree Count (whole-tree compilation, r16): the
+        window's tree items over the SAME (plane, overlay) pair union
+        their gathered row slots into ONE in-program gather and fold
+        every item's postfix program in one fused dispatch — N
+        concurrent compound queries cost one memory pass and join the
+        window's single packed readback."""
+        return self.wait(self.enqueue_tree(plane, slots, prog, extras,
+                                           delta))
+
+    def enqueue_tree(self, plane, slots: tuple, prog: tuple,
+                     extras: tuple = (), delta=None) -> _Pending:
+        """Non-blocking :meth:`submit_tree`: a request carrying K
+        compound Counts enqueues them ALL into one collection window
+        before waiting on any."""
+        return self._enqueue(_Pending(
+            "tree", (tuple(slots), tuple(prog), tuple(extras)),
+            (plane,), delta=delta))
+
     def submit_distinct(self, plane, filter_words):
         """BSI Distinct presence: host (pos bool[2^d], neg bool[2^d]).
         Coalescing here is DEDUPLICATION only — the presence scan is a
@@ -251,6 +272,11 @@ class CountBatcher:
                     # same (plane, overlay) pair slot-union into one
                     # gather; a fresher overlay is a different answer
                     key = ("selcounts", id(p.leaves[0]),
+                           id(p.delta) if p.delta is not None else 0)
+                elif p.kind == "tree":
+                    # same (plane, overlay) pair → one gather of the
+                    # slot UNION serves every item's program
+                    key = ("tree", id(p.leaves[0]),
                            id(p.delta) if p.delta is not None else 0)
                 elif p.kind == "rowcounts" and p.delta is not None:
                     key = ("rowcounts-delta", id(p.leaves[0]),
@@ -342,6 +368,8 @@ class CountBatcher:
             ret = self._dispatch_rowcounts_delta(group)
         elif kind == "selcounts":
             ret = self._dispatch_selcounts(group)
+        elif kind == "tree":
+            ret = self._dispatch_tree(group)
         else:
             ret = self._dispatch_aggs(kind, group)
         self.stats.observe("kernel_dispatch_seconds",
@@ -359,6 +387,16 @@ class CountBatcher:
             plane = group[0].leaves[0]
             rows = {s for p in group for s in p.nodes}
             return len(rows) * plane.shape[0] * plane.shape[-1] * 4
+        if kind == "tree":
+            # one gather of the slot UNION + each unique extra once
+            plane = group[0].leaves[0]
+            rows = {s for p in group for s in p.nodes[0]}
+            extras = {id(a): a for p in group for a in p.nodes[2]}
+            d = group[0].delta
+            return (len(rows) * plane.shape[0] * plane.shape[-1] * 4
+                    + sum(getattr(a, "nbytes", 0)
+                          for a in extras.values())
+                    + (d.nbytes if d is not None else 0))
         if kind == "rowcounts-delta":
             # one base scan + the overlay gather per unique (plane,
             # overlay, filter) key — items in this group are identical
@@ -386,6 +424,8 @@ class CountBatcher:
             self._fallback_rowcounts(group)
         elif key[0] == "selcounts":
             self._fallback_selcounts(group)
+        elif key[0] == "tree":
+            self._fallback_tree(group)
         else:
             self._fallback_aggs(key[0], group)
 
@@ -488,6 +528,37 @@ class CountBatcher:
                 p.result = host[[pos[s] for s in p.nodes]]
                 p.event.set()
         return out, finish
+
+    def _dispatch_tree(self, group: list[_Pending]):
+        """The window's compound-tree Counts over one (plane, overlay)
+        pair: union every item's gathered slots and extra operands
+        (``exec.tree.assemble_items``), remap the postfix programs
+        into the shared operand space and run ONE fused program — one
+        memory pass over the union, K answers, packed readback."""
+        from pilosa_tpu.exec.tree import assemble_items
+        plane = group[0].leaves[0]
+        slots, progs, extras = assemble_items([p.nodes for p in group])
+        out = self.fused.run_tree_counts(plane, slots, progs, extras,
+                                         delta=group[0].delta)
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
+            for k, p in enumerate(group):
+                p.result = int(host[k])
+                p.event.set()
+        return out, finish
+
+    def _fallback_tree(self, group: list[_Pending]) -> None:
+        for p in group:
+            try:
+                slots, prog, extras = p.nodes
+                out = self.fused.run_tree_counts(
+                    p.leaves[0], slots, (prog,), extras, delta=p.delta)
+                p.result = int(np.asarray(out).astype(np.int64)[0])
+            except Exception as e2:  # noqa: BLE001
+                p.error = e2
+            finally:
+                p.event.set()
 
     def _dispatch_rowcounts_delta(self, group: list[_Pending]):
         """Whole-plane row counts of base⊕delta: the group key is the

@@ -87,6 +87,23 @@ _BITMAP_CALLS = frozenset({
 _SCALAR_TO_KEY = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge",
                   "==": "eq", "!=": "ne"}
 
+# eager word-wise kernels by the canonical op token
+# (pql.ast.BOOL_CALLS names → tokens; exec.tree.fold_bool_call folds)
+_EAGER_OPS = {"or": kernels.union, "and": kernels.intersect,
+              "andnot": kernels.difference, "xor": kernels.xor}
+
+
+def _bsi_signature(options) -> tuple:
+    """Everything a baked BSI predicate depends on.  A cached plan
+    resolved its offsets (``to_stored(value) - base``) and saturation
+    verdicts against these options, so validity must drop the plan
+    when ANY of them changes — comparing ``bit_depth`` alone misses a
+    drop + recreate with the same depth but a different
+    base/scale/epoch, which would serve skewed predicates forever on
+    entries that skip the generation compare."""
+    return (options.type, options.bit_depth, options.base,
+            options.scale, options.epoch, options.time_unit)
+
 
 def _is_device_oom(e: Exception) -> bool:
     """XLA device-memory exhaustion, by status string.  jax wraps the
@@ -172,6 +189,11 @@ class _PlanEntry:
     - ``"generic"`` — arbitrary fusable Count trees: ``nodes`` (leaf
       indices local to ``leaf_specs``) re-materialize through the
       plane cache each hit.
+    - ``"tree"`` — compound boolean trees compiled whole (r16):
+      ``tree_specs`` are canonical :class:`exec.tree.TreeSpec`\\ s;
+      rows re-resolve to plane slots and extras re-materialize per
+      hit, and the anchor plane's delta overlay keeps answers fresh
+      under sustained ingest.
 
     Validity: ``shards`` must equal the current shard set and ``gens``
     must equal the dependency views' generations — a write to any
@@ -189,18 +211,27 @@ class _PlanEntry:
     leaf_specs: tuple = ()
     field_name: str | None = None
     row_ids: tuple = ()
-    # (field_name, bit_depth) per BSI field whose predicate masks /
-    # saturation verdicts the plan baked: depth can GROW via a write
-    # OUTSIDE this entry's shard subset (generations over entry.shards
-    # won't see it), so validity must check the depth itself
-    bsi_depths: tuple = ()
-    # "plane" plans over an UNKEYED field bake nothing a write can
-    # stale: row ids are the literal PQL integers and the PlaneSet
+    # (field_name, _bsi_signature(options)) per BSI field whose
+    # predicate masks / saturation verdicts the plan baked: depth can
+    # GROW via a write OUTSIDE this entry's shard subset (generations
+    # over entry.shards won't see it), and a drop + recreate with the
+    # SAME depth but a different base/scale/epoch would silently skew
+    # every baked offset on entries that skip the gens compare — so
+    # validity re-checks the full predicate-relevant option signature
+    bsi_sigs: tuple = ()
+    # "plane"/"tree" plans over UNKEYED fields bake nothing a write
+    # can stale: row ids are the literal PQL integers and the PlaneSet
     # revalidates its own generations (delta overlays absorb writes,
     # r15).  Such entries skip the per-hit generation compare — under
     # sustained ingest the generations move every batch, and dropping
     # the plan per write put parse+plan back on every request.
+    # ``unkeyed_fields`` lists the set fields whose identity (exists,
+    # unkeyed, non-BSI) the per-hit validity check re-verifies so a
+    # drop + recreate under the same name still kills the entry.
     unkeyed_plane: bool = False
+    unkeyed_fields: tuple = ()
+    # "tree" entries: canonical specs, one per Count call (r16)
+    tree_specs: tuple = ()
 
 
 class QueryTimeoutError(ExecutionError):
@@ -234,7 +265,8 @@ class Executor:
                  count_batch_window: float | str = "adaptive",
                  max_concurrent: int = 8, plane_sidecars: bool = True,
                  delta_cells: int = 65536,
-                 delta_compact_fraction: float = 0.5):
+                 delta_compact_fraction: float = 0.5,
+                 tree_fusion: bool = True):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -262,6 +294,14 @@ class Executor:
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache(stats=self.stats)
+        # whole-tree compilation (r16): compound boolean Counts gather
+        # rows from the resident plane and fold a postfix program in
+        # one fused XLA dispatch.  Off (`tree_fusion=False`) restores
+        # the pre-r16 op-at-a-time/generic path — the bench baseline
+        # and the escape hatch the runbook documents.
+        self.tree_fusion = tree_fusion
+        from pilosa_tpu.obs.metrics import DEPTH_BUCKETS
+        self.stats.set_buckets("tree_fusion_depth", DEPTH_BUCKETS)
         # cross-request coalescing is the DEFAULT serving spine (r6):
         # the adaptive window costs a solo request nothing, and under
         # concurrency every dense family pays one dispatch + one read
@@ -507,6 +547,9 @@ class Executor:
         fast = self._count_batch_plane(ctx, calls)
         if fast is not None:
             return fast
+        fast = self._count_batch_tree(ctx, calls)
+        if fast is not None:
+            return fast
         from pilosa_tpu.exec.fused import Unfusable, shift_leaves
         nodes, all_leaves = [], []
         try:
@@ -604,6 +647,176 @@ class Executor:
             return None
         return self._plane_count_rows(
             ps, row_ids, getattr(self._tls, "stage_timer", None))
+
+    # -------------------------------------------------- whole-tree (r16)
+
+    def _count_batch_tree(self, ctx: _Ctx,
+                          calls: list[Call]) -> list[int] | None:
+        """Compound Count runs through the whole-tree compiler (r16
+        tentpole): every child lowers to a canonical
+        :class:`exec.tree.TreeSpec` and the request's trees dispatch
+        as batcher items sharing ONE collection window — one gather of
+        the slot union per anchor plane, one packed readback joined
+        with any concurrent requests' trees.  None = not a tree shape
+        or not runnable right now (anchor plane not resident /
+        admittable) — callers fall through to the generic fused path,
+        which answers identically."""
+        from pilosa_tpu.exec import tree as treemod
+        from pilosa_tpu.exec.fused import Unfusable
+        if not self.tree_fusion or not ctx.shards:
+            return None
+        if not any(c.children[0].name in treemod.TREE_CALLS
+                   for c in calls):
+            return None
+        try:
+            specs = [treemod.lower_count_tree(self, ctx, c.children[0])
+                     for c in calls]
+        except Unfusable:
+            return None
+        return self._run_tree_specs(
+            ctx, specs, getattr(self._tls, "stage_timer", None))
+
+    def _tree_stats(self, spec) -> None:
+        self.stats.observe("tree_fusion_depth", float(spec.depth))
+        if spec.cse_hits:
+            self.stats.count("tree_cse_hits_total", spec.cse_hits)
+
+    def _run_tree_specs(self, ctx: _Ctx, specs, timer) -> list[int] | None:
+        """Materialize + dispatch lowered tree specs: row ids resolve
+        to plane slots FRESH per hit (so plan-cached specs keep
+        serving current truth), extras re-fetch through the plane
+        cache, and a delta-dirty anchor plane answers base⊕delta
+        inside the same program.  None = an anchor plane isn't
+        resident/admittable or a field vanished — admission decisions
+        stay on the un-cached path."""
+        resolved = []
+        for spec in specs:
+            hit = self._tree_item(ctx, spec)
+            if hit is None:
+                return None
+            resolved.append(hit)
+        for spec in specs:
+            self._tree_stats(spec)
+        if timer is not None:
+            timer.mark("plan")
+        if self.batcher is not None:
+            # enqueue ALL trees before waiting on any: the whole
+            # request lands in one collection window
+            handles = [self.batcher.enqueue_tree(ps.plane, *item,
+                                                 delta=ps.delta)
+                       for ps, item in resolved]
+            out = [self.batcher.wait(h) for h in handles]
+            if timer is not None:
+                timer.mark("read")  # coalesced window+dispatch+read
+            return out
+        # no batcher: one fused program per (plane, overlay) group
+        from pilosa_tpu.exec.tree import assemble_items
+        groups: dict[tuple, list[int]] = {}
+        group_ps: dict[tuple, object] = {}
+        for i, (ps, _item) in enumerate(resolved):
+            k = (id(ps.plane),
+                 id(ps.delta) if ps.delta is not None else 0)
+            groups.setdefault(k, []).append(i)
+            group_ps[k] = ps
+        out = [0] * len(resolved)
+        for k, idxs in groups.items():
+            ps = group_ps[k]
+            slots, progs, extras = assemble_items(
+                [resolved[i][1] for i in idxs])
+            dev = self.fused.run_tree_counts(ps.plane, slots, progs,
+                                             extras, delta=ps.delta)
+            if timer is not None:
+                timer.mark("dispatch")
+            vals = np.asarray(dev).astype(np.int64)
+            for j, i in enumerate(idxs):
+                out[i] = int(vals[j])
+        if timer is not None:
+            timer.mark("read")
+        return out
+
+    def _tree_item(self, ctx: _Ctx, spec):
+        """One spec's runtime form: ``(PlaneSet, (slots, prog,
+        extras))`` with PUSH args rewritten against the LIVE slot map
+        (absent rows become zero pushes) and extra operands
+        materialized.  None = not runnable on the device path right
+        now (caller falls back / invalidates)."""
+        from pilosa_tpu.engine.kernels import TREE_PUSH, TREE_ZERO
+        field = ctx.index.field(spec.field)
+        if field is None or field.options.type in BSI_TYPES:
+            return None
+        if len(ctx.shards) > self._REDUCE_SHARD_MAX:
+            return None  # device int32 shard reduce must stay exact
+        if not self.planes.has_plane(ctx.index.name, field,
+                                     VIEW_STANDARD, ctx.shards):
+            # admission mirrors _count_batch_plane: budget walk only
+            # when the plane isn't resident, and skip whole-plane
+            # residency for a tiny slice of a huge row set
+            est = self.planes.plane_bytes(field, VIEW_STANDARD,
+                                          ctx.shards)
+            if est > self.planes.budget:
+                return None
+            r_est = max(1, est // (len(ctx.shards) * WORDS_PER_SHARD * 4))
+            if max(1, len(spec.rows)) * 4 < r_est:
+                return None
+        ps = self.planes.field_plane_nowait(ctx.index.name, field,
+                                            VIEW_STANDARD, ctx.shards)
+        if ps is None:
+            return None
+        slots: list[int] = []
+        slot_arg: list[int | None] = []
+        for s in ps.slots_for(spec.rows):
+            if s is None:
+                slot_arg.append(None)
+            else:
+                slot_arg.append(len(slots))
+                slots.append(s)
+        extras = []
+        for espec in spec.extras:
+            arr = self._tree_extra(ctx, espec)
+            if arr is None:
+                return None
+            extras.append(arr)
+        prog: list[tuple] = []
+        for op, arg in spec.prog:
+            if op == TREE_PUSH:
+                new = slot_arg[arg]
+                if new is None:  # row has no bits anywhere → empty
+                    prog.append((TREE_ZERO, 0))
+                    continue
+                arg = new
+            prog.append((op, arg))
+        return ps, (tuple(slots), tuple(prog), tuple(extras))
+
+    def _tree_extra(self, ctx: _Ctx, spec) -> "jax.Array | None":
+        """Materialize one extra tree operand (uint32[S, W]): the
+        existence row, another set field's row, or a BSI predicate
+        bitmap (masks re-derive from the spec's baked offset and the
+        CURRENT bit depth — the plan validity rules pin the depth)."""
+        kind = spec[0]
+        if kind == "exists":
+            return self._exists(ctx)
+        if kind == "row":
+            _, fname, vname, rid = spec
+            field = ctx.index.field(fname)
+            if field is None or field.options.type in BSI_TYPES:
+                return None
+            return self.planes.row_words(ctx.index.name, field, vname,
+                                         rid, ctx.shards)
+        fname = spec[1]
+        field = ctx.index.field(fname)
+        if field is None or field.options.type not in BSI_TYPES:
+            return None
+        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        if kind == "bsi-exists":
+            return ps.plane[..., bsik.EXISTS_ROW, :]
+        _, _, op_key, offset = spec
+        masks = jnp.asarray(bsik.predicate_masks(
+            abs(offset), field.options.bit_depth))
+        # one cached predicate program per op_key; masks/sign are
+        # traced, so any offset of the same comparison reuses it
+        return self.fused.run(("bsi", 0, 1, 2, op_key),
+                              (ps.plane, masks, jnp.asarray(offset < 0)),
+                              "words")
 
     # int32 cross-shard reduce stays exact while n_shards·2^20 < 2^31
     _REDUCE_SHARD_MAX = (1 << 31) // SHARD_WIDTH - 1
@@ -784,16 +997,17 @@ class Executor:
                     and self._dep_gens(index, entry.deps,
                                        entry.shards) != entry.gens)
                 or (entry.unkeyed_plane
-                    # the field must still be the unkeyed set field
-                    # the plan baked literal row ids against — a
-                    # drop + recreate as keyed/BSI at the same name
-                    # would otherwise keep serving those literals
-                    and ((pf := index.field(entry.field_name)) is None
-                         or pf.options.keys
-                         or pf.options.type in BSI_TYPES))
+                    # every baked field must still be the unkeyed set
+                    # field the plan resolved literal row ids against
+                    # — a drop + recreate as keyed/BSI at the same
+                    # name would otherwise keep serving those literals
+                    and any((pf := index.field(fn)) is None
+                            or pf.options.keys
+                            or pf.options.type in BSI_TYPES
+                            for fn in entry.unkeyed_fields))
                 or any((f := index.field(fname)) is None
-                       or f.options.bit_depth != d
-                       for fname, d in entry.bsi_depths)):
+                       or _bsi_signature(f.options) != sig
+                       for fname, sig in entry.bsi_sigs)):
             self._drop_plan(skey, entry)
             return None
         return self._run_plan(index, index_name, entry, translate_output,
@@ -822,9 +1036,12 @@ class Executor:
             entry = self._plan_plane_entry(ctx, calls)
             if entry is not None:
                 return entry
+            entry = self._plan_tree_entry(ctx, calls)
+            if entry is not None:
+                return entry
             specs: list = []
             deps: dict[tuple, None] = {}
-            depths: dict[str, int] = {}
+            depths: dict[str, tuple] = {}
             nodes = []
             for call in calls:
                 nodes.append(self._plan_spec(ctx, call.children[0],
@@ -840,7 +1057,7 @@ class Executor:
                           self._dep_gens(index, deps, ctx.shards),
                           len(calls), nodes=tuple(nodes),
                           leaf_specs=tuple(specs),
-                          bsi_depths=tuple(depths.items()))
+                          bsi_sigs=tuple(depths.items()))
 
     def _plan_plane_entry(self, ctx: _Ctx, calls) -> "_PlanEntry | None":
         """Match the same-field plain-row batch shape that
@@ -880,7 +1097,69 @@ class Executor:
                           self._dep_gens(ctx.index, deps, ctx.shards),
                           len(calls), field_name=field.name,
                           row_ids=row_ids,
-                          unkeyed_plane=not field.options.keys)
+                          unkeyed_plane=not field.options.keys,
+                          unkeyed_fields=(field.name,))
+
+    def _plan_tree_entry(self, ctx: _Ctx, calls) -> "_PlanEntry | None":
+        """Tree-shaped plans (r16): every Count child lowers to a
+        canonical :class:`exec.tree.TreeSpec` — the plan cache's unit
+        for arbitrary compound shapes.  Survival under writes mirrors
+        the r15 unkeyed-plane rule: literal-int rows over unkeyed set
+        fields re-resolve against planes that absorb writes into
+        delta overlays (BSI predicates re-derive from the depth the
+        ``bsi_sigs`` check pins; exists/other-field rows re-fetch
+        fresh), so such entries skip the per-hit generation compare
+        and parse+plan stays off every request under sustained
+        ingest.  Keyed rows and data-dependent row sets (UnionRows)
+        stay generation-checked."""
+        from pilosa_tpu.exec import tree as treemod
+        from pilosa_tpu.exec.fused import Unfusable
+        if not self.tree_fusion or not ctx.shards:
+            return None
+        if not any(c.children[0].name in treemod.TREE_CALLS
+                   for c in calls):
+            return None
+        try:
+            specs = tuple(treemod.lower_count_tree(self, ctx,
+                                                   c.children[0])
+                          for c in calls)
+        except Unfusable:
+            return None
+        index = ctx.index
+        deps: dict[tuple, None] = {}
+        sigs: dict[str, tuple] = {}
+        set_fields: dict[str, None] = {}
+        survivable = True
+        for spec in specs:
+            set_fields[spec.field] = None
+            deps[(spec.field, VIEW_STANDARD)] = None
+            if spec.volatile or spec.keyed_rows:
+                survivable = False
+            for fname, _depth in spec.bsi_depths:
+                f = index.field(fname)
+                if f is None:
+                    return None
+                sigs[fname] = _bsi_signature(f.options)
+                deps[(fname, f.bsi_view_name)] = None
+            for espec in spec.extras:
+                if espec[0] == "exists":
+                    deps[("\x00exists", VIEW_STANDARD)] = None
+                elif espec[0] == "row":
+                    set_fields[espec[1]] = None
+                    deps[(espec[1], espec[2])] = None
+        for fname in set_fields:
+            f = index.field(fname)
+            if f is None:
+                return None
+            if f.options.keys:
+                survivable = False
+        deps = tuple(deps)
+        return _PlanEntry("tree", ctx.shards, deps,
+                          self._dep_gens(index, deps, ctx.shards),
+                          len(calls), tree_specs=specs,
+                          bsi_sigs=tuple(sigs.items()),
+                          unkeyed_plane=survivable,
+                          unkeyed_fields=tuple(set_fields))
 
     def _dep_gens(self, index, deps: tuple, shards: tuple) -> tuple:
         out = []
@@ -929,24 +1208,24 @@ class Executor:
         if name == "All":
             deps[("\x00exists", VIEW_STANDARD)] = None
             return leaf(("exists",))
-        if name == "Not":
-            if len(call.children) != 1:
-                raise ExecutionError("Not: exactly one child required")
-            child = self._plan_spec(ctx, call.children[0], specs, deps,
-                                    depths)
+        from pilosa_tpu.exec.tree import fold_bool_call, is_not_bool
+
+        def exists_spec() -> int:
             deps[("\x00exists", VIEW_STANDARD)] = None
             specs.append(("exists",))
-            return ("not", child, len(specs) - 1)
-        kids = call.children
-        if name == "Union" and not kids:
-            return leaf(("zeros",))
-        if name in ("Union", "Intersect", "Difference", "Xor"):
-            if not kids:
-                raise ExecutionError(f"{name}: at least one child required")
-            op = {"Union": "or", "Intersect": "and",
-                  "Difference": "andnot", "Xor": "xor"}[name]
-            return (op, tuple(self._plan_spec(ctx, k, specs, deps, depths)
-                              for k in kids))
+            return len(specs) - 1
+
+        out = fold_bool_call(
+            call,
+            recurse=lambda c: self._plan_spec(ctx, c, specs, deps,
+                                              depths),
+            zeros=lambda: leaf(("zeros",)),
+            exists=exists_spec,
+            combine=lambda op, kids: (op, tuple(k() for k in kids)),
+            complement=lambda exists, child:
+                (lambda ch: ("not", ch, exists()))(child()))
+        if not is_not_bool(out):
+            return out
         if name == "Shift":
             if len(call.children) != 1:
                 raise ExecutionError("Shift: exactly one child required")
@@ -962,7 +1241,7 @@ class Executor:
             raise ExecutionError(
                 f"field {field.name!r}: condition on non-BSI field")
         deps[(field.name, field.bsi_view_name)] = None
-        depths[field.name] = field.options.bit_depth
+        depths[field.name] = _bsi_signature(field.options)
         if cond.op in BETWEEN_OPS:
             lo_op = "gt" if cond.op.startswith("<>") else "ge"
             hi_op = "lt" if cond.op.endswith("><") else "le"
@@ -1057,6 +1336,14 @@ class Executor:
 
     def _run_plan_inner(self, ctx: _Ctx, entry: "_PlanEntry",
                         timer) -> list | None:
+        if entry.kind == "tree":
+            if not self.tree_fusion:  # knob flipped after caching
+                return None
+            out = self._run_tree_specs(ctx, list(entry.tree_specs),
+                                       timer)
+            if out is not None and timer is not None:
+                timer.mark("assemble")
+            return out
         if entry.kind == "plane":
             field = ctx.index.field(entry.field_name)
             if field is None:
@@ -1242,6 +1529,26 @@ class Executor:
         §8 "one compiled function per call-shape"); falls back to the
         eager per-op path for shapes the planner doesn't cover."""
         from pilosa_tpu.exec.fused import Unfusable
+        from pilosa_tpu.exec import tree as treemod
+        if (self.tree_fusion and ctx.shards
+                and call.name in treemod.TREE_CALLS):
+            # bitmap-valued compound trees ride the whole-tree program
+            # too: one in-program gather from the resident plane, one
+            # postfix fold — no per-leaf arrays (r16)
+            hit = None
+            try:
+                spec = treemod.lower_count_tree(self, ctx, call)
+                hit = self._tree_item(ctx, spec)
+            except Unfusable:
+                hit = None
+            if hit is not None:
+                ps, (slots, prog, extras) = hit
+                self._tree_stats(spec)
+                words = self.fused.run_tree_words(
+                    ps.plane, slots, prog, extras, delta=ps.delta)
+                if want == "count":
+                    return kernels.count(words)
+                return words
         try:
             leaves: list = []
             node = self._plan(ctx, call, leaves)
@@ -1265,21 +1572,25 @@ class Executor:
             return self._plan_row(ctx, call, leaves, leaf)
         if name == "All":
             return leaf(self._exists(ctx))
-        if name == "Not":
-            if len(call.children) != 1:
-                raise ExecutionError("Not: exactly one child required")
-            child = self._plan(ctx, call.children[0], leaves)
+        from pilosa_tpu.exec.tree import fold_bool_call, is_not_bool
+
+        def exists_leaf() -> int:
             leaves.append(self._exists(ctx))
-            return ("not", child, len(leaves) - 1)
-        kids = call.children
-        if name == "Union" and not kids:
-            return leaf(self._zeros(ctx))
-        if name in ("Union", "Intersect", "Difference", "Xor"):
-            if not kids:
-                raise ExecutionError(f"{name}: at least one child required")
-            op = {"Union": "or", "Intersect": "and",
-                  "Difference": "andnot", "Xor": "xor"}[name]
-            return (op, tuple(self._plan(ctx, k, leaves) for k in kids))
+            return len(leaves) - 1
+
+        out = fold_bool_call(
+            call,
+            recurse=lambda c: self._plan(ctx, c, leaves),
+            zeros=lambda: leaf(self._zeros(ctx)),
+            exists=exists_leaf,
+            # ONE flat n-ary node — a nested pair per child would
+            # recurse once per child in _build/shift_leaves and blow
+            # the recursion limit on wide flat Unions
+            combine=lambda op, kids: (op, tuple(k() for k in kids)),
+            complement=lambda exists, child:
+                (lambda ch: ("not", ch, exists()))(child()))
+        if not is_not_bool(out):
+            return out
         if name == "Shift":
             if len(call.children) != 1:
                 raise ExecutionError("Shift: exactly one child required")
@@ -1465,40 +1776,24 @@ class Executor:
             return self._row_bitmap(ctx, call)
         if name == "All":
             return self._exists(ctx)
-        if name == "Not":
-            if len(call.children) != 1:
-                raise ExecutionError("Not: exactly one child required")
-            return kernels.complement(self._bitmap(ctx, call.children[0]),
-                                      self._exists(ctx))
+        from pilosa_tpu.exec.tree import fold_bool_call, is_not_bool
+        def eager_fold(op, kids):
+            acc = kids[0]()
+            for child in kids[1:]:
+                acc = _EAGER_OPS[op](acc, child())
+            return acc
+
+        out = fold_bool_call(
+            call,
+            recurse=lambda c: self._bitmap(ctx, c),
+            zeros=lambda: self._zeros(ctx),
+            exists=lambda: self._exists(ctx),
+            combine=eager_fold,
+            complement=lambda exists, child: kernels.complement(
+                child(), exists()))
+        if not is_not_bool(out):
+            return out
         kids = call.children
-        if name == "Union":
-            if not kids:
-                return self._zeros(ctx)
-            acc = self._bitmap(ctx, kids[0])
-            for k in kids[1:]:
-                acc = kernels.union(acc, self._bitmap(ctx, k))
-            return acc
-        if name == "Intersect":
-            if not kids:
-                raise ExecutionError("Intersect: at least one child required")
-            acc = self._bitmap(ctx, kids[0])
-            for k in kids[1:]:
-                acc = kernels.intersect(acc, self._bitmap(ctx, k))
-            return acc
-        if name == "Difference":
-            if not kids:
-                raise ExecutionError("Difference: at least one child required")
-            acc = self._bitmap(ctx, kids[0])
-            for k in kids[1:]:
-                acc = kernels.difference(acc, self._bitmap(ctx, k))
-            return acc
-        if name == "Xor":
-            if not kids:
-                raise ExecutionError("Xor: at least one child required")
-            acc = self._bitmap(ctx, kids[0])
-            for k in kids[1:]:
-                acc = kernels.xor(acc, self._bitmap(ctx, k))
-            return acc
         if name == "Shift":
             if len(kids) != 1:
                 raise ExecutionError("Shift: exactly one child required")
@@ -1703,6 +1998,11 @@ class Executor:
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
+        # compound boolean trees compile whole (r16): one in-program
+        # row gather + postfix fold, windowed with concurrent requests
+        fused_tree = self._count_batch_tree(ctx, [call])
+        if fused_tree is not None:
+            return fused_tree[0]
         if self.batcher is not None:
             # cross-request coalescing: plan here, let the batcher run
             # one program + one read for every concurrent Count

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pilosa_tpu.engine import bsi as bsik
 from pilosa_tpu.engine import kernels
@@ -113,6 +114,24 @@ def shift_leaves(node, offset: int):
                 node[3] + offset, node[4], node[5] + offset,
                 node[6] + offset, node[7])
     raise AssertionError(f"bad node {node!r}")
+
+
+def _pad_skeleton(prog: tuple) -> tuple:
+    """A postfix program's STATIC opcode skeleton, NOP-padded to the
+    pow2 length bucket — the one bucketing rule every tree entry
+    (solo, window item) keys on, so the paths cannot drift apart."""
+    p_pad = pow2_bucket(max(1, len(prog)))
+    return (tuple(op for op, _ in prog)
+            + (kernels.TREE_NOP,) * (p_pad - len(prog)))
+
+
+def _pad_extras(extras: tuple) -> tuple:
+    """Extra-operand tuple padded to its pow2 bucket by repeating
+    element 0 (pad lanes are never addressed by programs)."""
+    if not extras:
+        return ()
+    e_pad = pow2_bucket(len(extras))
+    return tuple(extras) + (extras[0],) * (e_pad - len(extras))
 
 
 class FusedCache:
@@ -311,6 +330,194 @@ class FusedCache:
         if has_filter:
             args += (filter_words,)
         return self._cached(key, build)(*args)
+
+    def _tree_cached(self, key, build):
+        """``_cached`` + tree-family build telemetry: a climbing
+        ``tree_programs_built_total`` under a REPEATING mix means the
+        skeleton/bucket keying is not containing the program set (the
+        recompile-storm class, r16 runbook)."""
+        built = []
+
+        def counting_build():
+            built.append(True)
+            return build()
+
+        fn = self._cached(key, counting_build)
+        if built:
+            self._stats.count("tree_programs_built_total", 1)
+        return fn
+
+    def _tree_gather(self, plane, slots: tuple, delta) -> jax.Array:
+        """The window's ONE memory pass over the plane: gather the
+        union of requested row slots (traced int32, pow2-width
+        bucket) and overlay pending delta cells (base⊕delta) →
+        uint32[G_pad, S, W].  Every item program in the window reads
+        from this shared array instead of touching the plane again."""
+        g = len(slots)
+        g_pad = pow2_bucket(max(1, g))
+        padded = (tuple(slots) or (0,)) + \
+            ((slots[0] if slots else 0),) * (g_pad - max(1, g))
+        has_delta = delta is not None
+        key = (("tree-gather", plane.shape, g_pad,
+                delta.rows.shape[0] if has_delta else None), "words")
+
+        def build():
+            def program(p, ix, *dl):
+                sel = jnp.take(p, ix, axis=-2)       # [S, G_pad, W]
+                if has_delta:
+                    from pilosa_tpu.ingest.delta import \
+                        overlay_gathered_rows
+                    sel = overlay_gathered_rows(sel, ix, *dl,
+                                                p.shape[-2])
+                return jnp.moveaxis(sel, -2, 0)      # [G_pad, S, W]
+            return program
+
+        args = (plane, jnp.asarray(padded, dtype=jnp.int32))
+        if has_delta:
+            args += (delta.rows, delta.words, delta.vals)
+        return self._tree_cached(key, build)(*args)
+
+    def _tree_item(self, rows, ex_stack, prog: tuple, want: str):
+        """One tree's postfix program against the window's gathered
+        rows: the cache key is the item's opcode SKELETON (NOP-padded
+        to a pow2 length bucket) — per-QUERY-shape, never
+        per-window-combination — while the push args (which gathered
+        row / which extra each push reads) stay traced, so any tree
+        of the same skeleton reuses one compiled program.  ``want``
+        "count" → int32[1] total (shard axis reduced on device);
+        "words" → uint32[S, W]."""
+        skeleton = _pad_skeleton(prog)
+        row_args = [arg for op, arg in prog
+                    if op == kernels.TREE_PUSH]
+        ex_args = [arg for op, arg in prog
+                   if op == kernels.TREE_PUSHX]
+        has_ex = ex_stack is not None
+        key = (("tree-item", rows.shape,
+                ex_stack.shape if has_ex else None, skeleton), want)
+
+        def build():
+            def program(r, ra, xa, *ex):
+                words = kernels.tree_fold(
+                    r, skeleton, ra, ex[0] if has_ex else None, xa)
+                if want == "words":
+                    return words
+                return jnp.sum(kernels.count(words),
+                               dtype=jnp.int32)[None]
+            return program
+
+        args = (rows,
+                jnp.asarray(np.asarray(row_args or [0], np.int32)),
+                jnp.asarray(np.asarray(ex_args or [0], np.int32)))
+        if has_ex:
+            args += (ex_stack,)
+        return self._tree_cached(key, build)(*args)
+
+    def _tree_solo(self, plane, slots: tuple, prog: tuple,
+                   extras: tuple, delta, want: str):
+        """A SINGLE tree in one end-to-end program: each push reads
+        its row STRAIGHT off the plane (a traced dynamic index XLA
+        fuses into the bitwise chain — no intermediate gathered
+        array), the delta overlay merges row-wise in the same chain,
+        and counts popcount-reduce before leaving the device.  The
+        solo serving path pays one round trip and one pass over
+        exactly the rows the tree touches.  Push args carry SLOT
+        values directly; the cache key is the skeleton + pow2 arg
+        buckets, so any same-shape tree reuses the program."""
+        extras = _pad_extras(extras)
+        skeleton = _pad_skeleton(prog)
+        # push args carry the slot VALUES (traced); the slots tuple's
+        # role here is only dedup bookkeeping for the batcher union
+        row_args = [slots[arg] for op, arg in prog
+                    if op == kernels.TREE_PUSH]
+        ex_args = [arg for op, arg in prog if op == kernels.TREE_PUSHX]
+        has_delta = delta is not None
+        key = (("tree-solo", plane.shape, len(extras), skeleton,
+                delta.rows.shape[0] if has_delta else None), want)
+
+        def build():
+            def program(p, ra, xa, *rest):
+                if has_delta:
+                    dr, dw, dv = rest[:3]
+                    ex_arrays = rest[3:]
+                else:
+                    ex_arrays = rest
+                r_pad = p.shape[-2]
+
+                def row(slot):
+                    val = jax.lax.dynamic_index_in_dim(
+                        p, jnp.clip(slot, 0, r_pad - 1), p.ndim - 2,
+                        keepdims=False)              # [S, W]
+                    if has_delta:
+                        from pilosa_tpu.ingest.delta import overlay_row
+                        val = overlay_row(val, slot, dr, dw, dv, r_pad)
+                    return val
+
+                ex = jnp.stack(ex_arrays) if ex_arrays else None
+                zero = jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                 jnp.uint32)
+                words = kernels.tree_fold(row, skeleton, ra, ex, xa,
+                                          zero=zero)
+                if want == "words":
+                    return words
+                return jnp.sum(kernels.count(words),
+                               dtype=jnp.int32)[None]
+            return program
+
+        args = (plane,
+                jnp.asarray(np.asarray(row_args or [0], np.int32)),
+                jnp.asarray(np.asarray(ex_args or [0], np.int32)))
+        if has_delta:
+            args += (delta.rows, delta.words, delta.vals)
+        args += tuple(extras)
+        return self._tree_cached(key, build)(*args)
+
+    def _tree_program(self, plane, slots: tuple, progs: tuple,
+                      extras: tuple, delta, want: str):
+        """Shared assembly for the whole-tree entries (r16 tentpole).
+        A single tree (the solo path, and every "words" call) fuses
+        end-to-end into one program.  A multi-item window splits into
+        ONE gather pass over the plane (slot union, pow2-width
+        bucket, delta overlay merged in-program) plus one cached
+        program per item SKELETON reading the gathered rows, with the
+        item outputs packed into one device array so the window still
+        costs a single readback.  Splitting gather from items keeps
+        the compiled-program key space per-query-shape: a window key
+        spanning every member's structure would compile one program
+        per item COMBINATION, which collapsed a 4-way diverse mix to
+        ~18 qps on CPU (measured) — the recompile-storm class.
+
+        ``progs``' PUSH args address the ``slots`` union and PUSHX
+        args the ``extras`` tuple (see ``exec.tree.assemble_items``)."""
+        if len(progs) == 1:
+            return self._tree_solo(plane, slots, progs[0], extras,
+                                   delta, want)
+        rows = self._tree_gather(plane, slots, delta)
+        padded = _pad_extras(extras)
+        ex_stack = jnp.stack(padded) if padded else None
+        outs = tuple(self._tree_item(rows, ex_stack, prog, want)
+                     for prog in progs)
+        return self.run_readback_pack(outs)
+
+    def run_tree_counts(self, plane, slots: tuple, progs: tuple,
+                        extras: tuple = (), delta=None) -> jax.Array:
+        """K compound-tree Counts over ONE resident plane in ONE fused
+        XLA program: gather the union of requested row slots, overlay
+        pending delta cells (base⊕delta — fused trees stay
+        rebuild-free under sustained ingest), stack the extra operands
+        (exists row, other-field rows, BSI predicate bitmaps) and fold
+        each item's postfix program over the words.  Returns the
+        device int32[K] totals un-read: the batcher packs them into
+        the window's single readback."""
+        return self._tree_program(plane, slots, progs, extras, delta,
+                                  "count")
+
+    def run_tree_words(self, plane, slots: tuple, prog: tuple,
+                       extras: tuple = (), delta=None) -> jax.Array:
+        """One compound tree's final BITMAP (uint32[S, W]) in one
+        program — the ``want="words"`` form for bitmap-valued compound
+        calls (Row trees, Store/filter sources)."""
+        return self._tree_program(plane, slots, (prog,), extras, delta,
+                                  "words")
 
     def run_readback_pack(self, arrays: tuple) -> jax.Array:
         """Concatenate the flattened int32 outputs of a collection

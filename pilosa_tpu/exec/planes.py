@@ -108,6 +108,16 @@ class PlaneSet:
     def n_rows(self) -> int:
         return len(self.row_ids)
 
+    def slots_for(self, row_ids) -> list:
+        """Plane row slots for ``row_ids`` (None per absent row — a
+        row with no set bit anywhere has no slot and callers lower it
+        as an all-zero operand).  Resolution happens fresh per query:
+        a row that gains its first bit after the plane was built
+        reaches the plane through the normal staleness machinery
+        (delta absorb / rebuild) before this map is consulted."""
+        return [self.slot_of.get(int(r)) if r is not None else None
+                for r in row_ids]
+
 
 @dataclass
 class SparseSet:

@@ -1,0 +1,379 @@
+"""Whole-tree PQL compilation: compound boolean queries as ONE program.
+
+PAPER.md frames the rebuild as "container ops become XLA
+bitwise+popcount kernels", but until r16 only leaf Count/TopN/
+selected-count shapes rode the fused/batched device path — a compound
+``Count(Intersect(Row, Union(Row, Row), Not(Row)))``, the bread and
+butter of segmentation queries at 1B cols, materialized one per-row
+cache entry per leaf and compiled one program per distinct tree
+STRUCTURE.  This module is the tree planner: it lowers a parsed
+compound call to a canonical kernel spec —
+
+- **rows gathered as traced operands**: every plain ``Row`` leaf of the
+  anchor field becomes a slot index into the ONE resident field plane
+  (``uint32[S, R_pad, W]``); the kernel gathers them in-program, so no
+  per-leaf arrays are built and the gather rides the plane's delta
+  overlay (base⊕delta, rebuild-free under sustained ingest);
+- **ops as a small postfix/ALU program** (:mod:`engine.kernels` tree
+  opcodes) the kernel folds over each word block — the program is a
+  traced ``int32[K, P, 2]`` operand, so ANY tree shape whose pow2
+  buckets (gathered width, program length, item count) match reuses
+  one compiled executable;
+- **common-subexpression elimination inside one request**: duplicate
+  leaves (same row, same BSI predicate, repeated ``All``/exists)
+  collapse to one operand; across concurrent requests the batcher's
+  tree kind unions slot sets (:func:`assemble_items`), so N windowed
+  compound queries over the same plane still cost one memory pass and
+  one packed readback.
+
+What lowers: ``Intersect/Union/Difference/Xor/Not/UnionRows`` trees
+over plain set-field rows, with BSI range conditions as leaf row
+filters (predicate bitmaps enter as extra operands) and ``All`` as the
+existence row.  What falls back (``Unfusable`` → the generic fused /
+eager paths, identical answers): time-range rows, ``Shift``/``Limit``/
+``ConstRow``, trees with no plain-row leaf to anchor the gather, and
+trees deeper than the fixed operand stack or longer than
+``TREE_MAX_PROG`` steps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from pilosa_tpu.engine.kernels import (TREE_AND, TREE_PUSH, TREE_PUSHX,
+                                       TREE_STACK_DEPTH, TREE_ZERO)
+from pilosa_tpu.exec.fused import Unfusable
+from pilosa_tpu.pql.ast import BETWEEN_OPS, BOOL_CALLS, Call, Condition
+from pilosa_tpu.store.field import BSI_TYPES
+from pilosa_tpu.store.view import VIEW_STANDARD
+
+# the compound-call names the tree compiler owns (a bare Row/All Count
+# keeps the existing selected/whole-plane serving spine)
+TREE_CALLS = frozenset(BOOL_CALLS) | {"Not", "UnionRows"}
+
+# program-length cap: a UnionRows over thousands of rows would explode
+# the postfix program (and its pow2 bucket); past this the tree falls
+# back to the generic path, which unions through rows_plane
+TREE_MAX_PROG = 96
+
+# op token -> tree opcode (TREE_AND + offset into the shared order;
+# pql.ast.BOOL_CALLS is the name->token source of truth)
+_OP_CODE = {"and": TREE_AND, "or": TREE_AND + 1,
+            "andnot": TREE_AND + 2, "xor": TREE_AND + 3}
+
+_NOT_BOOL = object()
+
+
+def fold_bool_call(call: Call, recurse, zeros, exists, combine,
+                   complement):
+    """Shared structure + edge semantics of the boolean-algebra
+    operators — with :data:`pql.ast.BOOL_CALLS`, THE single source of
+    truth the eager path (``Executor._bitmap``), both fused planners
+    (``_plan``/``_plan_spec``) and this tree compiler all fold
+    through:
+
+    - ``Union()`` with no children is the empty bitmap (``zeros()``);
+    - every other operator requires >= 1 child, and with exactly one
+      child IS that child (``Difference(x) == x``);
+    - ``Not`` is unary and evaluates as ``andnot(exists, x)``;
+      ``complement(exists_thunk, child_thunk)`` controls evaluation
+      ORDER (the postfix lowering must push ``exists`` first);
+    - n-ary operators call ``combine(op, child_thunks)`` ONCE with
+      every child as a thunk — sites fold pairwise (eager, postfix)
+      or build one FLAT n-ary node (the planners): a per-child nested
+      pair would recurse once per child downstream and blow the
+      recursion limit on wide flat calls (a 1000-child Union is
+      legal PQL).
+
+    Returns the folded site-specific value, or :data:`_NOT_BOOL` when
+    ``call`` is not a boolean-algebra operator (use
+    :func:`is_not_bool` to test; callers fall through to their leaf
+    handling)."""
+    from pilosa_tpu.exec.executor import ExecutionError
+    name = call.name
+    if name == "Not":
+        if len(call.children) != 1:
+            raise ExecutionError("Not: exactly one child required")
+        return complement(exists, lambda: recurse(call.children[0]))
+    op = BOOL_CALLS.get(name)
+    if op is None:
+        return _NOT_BOOL
+    kids = call.children
+    if not kids:
+        if name == "Union":
+            return zeros()
+        raise ExecutionError(f"{name}: at least one child required")
+    return combine(op, tuple((lambda k=kid: recurse(k))
+                             for kid in kids))
+
+
+def is_not_bool(value) -> bool:
+    return value is _NOT_BOOL
+
+
+@dataclass(frozen=True)
+class TreeSpec:
+    """One compound Count tree as a canonical, hashable kernel spec —
+    the plan-cache unit for tree shapes (r16).  Nothing here is a
+    device array: ``rows`` re-resolve to plane slots and ``extras``
+    re-materialize through the plane cache on every hit, so the spec
+    survives writes exactly as far as its validity flags allow."""
+
+    field: str        # anchor set field whose plane rows are gathered
+    rows: tuple       # gathered row ids (first-use order, CSE-deduped)
+    extras: tuple     # extra operand specs (see _Lower._extra)
+    prog: tuple       # ((opcode, arg), ...) postfix; args: rows ++ extras
+    depth: int        # operator nesting depth (tree_fusion_depth)
+    cse_hits: int     # duplicate leaves collapsed inside this request
+    volatile: bool    # row-set resolution depends on data (UnionRows)
+    keyed_rows: bool  # some row id came from a key translation
+    bsi_depths: tuple  # ((field, bit_depth), ...) predicate bakes
+
+
+class _Lower:
+    """One lowering pass: call tree → postfix program + operand pools.
+
+    Emission tracks the simulated stack pointer; a tree that would
+    exceed the kernel's fixed :data:`TREE_STACK_DEPTH` or
+    :data:`TREE_MAX_PROG` raises :class:`Unfusable` (falls back)."""
+
+    def __init__(self, ex, ctx):
+        self.ex = ex
+        self.ctx = ctx
+        self.field = None              # anchor Field (first set leaf)
+        self.rows: dict[int, int] = {}          # row id -> arg pos
+        self.extras: dict[tuple, int] = {}      # extra spec -> pos
+        self.prog: list = []
+        self.sp = 0
+        self.max_sp = 0
+        self.depth = 0
+        self.cse_hits = 0
+        self.volatile = False
+        self.keyed_rows = False
+        self.bsi_depths: dict[str, int] = {}
+
+    # -- emission -----------------------------------------------------------
+
+    def _emit(self, op: int, arg=0) -> None:
+        if op >= TREE_AND:
+            self.sp -= 1
+        else:  # PUSH / ZERO
+            self.sp += 1
+        self.max_sp = max(self.max_sp, self.sp)
+        if self.max_sp > TREE_STACK_DEPTH:
+            raise Unfusable("tree deeper than the fused operand stack")
+        if len(self.prog) >= TREE_MAX_PROG:
+            raise Unfusable("tree program longer than TREE_MAX_PROG")
+        self.prog.append((op, arg))
+
+    def _extra(self, spec: tuple):
+        pos = self.extras.get(spec)
+        if pos is None:
+            pos = self.extras[spec] = len(self.extras)
+        else:
+            self.cse_hits += 1
+        return ("e", pos)
+
+    def _push_exists(self) -> None:
+        from pilosa_tpu.exec.executor import ExecutionError
+        if self.ctx.index.existence_field is None:
+            # same query error, same text, as the eager path's _exists
+            raise ExecutionError(
+                f"index {self.ctx.index.name!r} does not track existence "
+                "(required for Not/All)")
+        self._emit(TREE_PUSH, self._extra(("exists",)))
+
+    def _push_field_row(self, field, row_id: int) -> None:
+        if self.field is None:
+            self.field = field
+        if field.name == self.field.name:
+            pos = self.rows.get(row_id)
+            if pos is None:
+                pos = self.rows[row_id] = len(self.rows)
+            else:
+                self.cse_hits += 1
+            self._emit(TREE_PUSH, ("r", pos))
+            return
+        # rows of OTHER set fields enter as extra operands
+        # (row_words re-fetches fresh through the plane cache per hit)
+        self._emit(TREE_PUSH, self._extra(
+            ("row", field.name, VIEW_STANDARD, row_id)))
+
+    # -- call walk ----------------------------------------------------------
+
+    def lower(self, call: Call, depth: int) -> None:
+        name = call.name
+        if name in ("Row", "Range"):
+            self._leaf(call)
+            return
+        if name == "All":
+            self._push_exists()
+            return
+        self.depth = max(self.depth, depth)
+        if name == "UnionRows":
+            self._union_rows(call)
+            return
+        def emit_fold(op, kids):
+            kids[0]()
+            for child in kids[1:]:
+                child()
+                self._emit(_OP_CODE[op])
+
+        out = fold_bool_call(
+            call,
+            recurse=lambda c: self.lower(c, depth + 1),
+            zeros=lambda: self._emit(TREE_ZERO),
+            exists=self._push_exists,
+            combine=emit_fold,
+            complement=lambda exists, child: (exists(), child(),
+                                              self._emit(
+                                                  _OP_CODE["andnot"])))
+        if is_not_bool(out):
+            raise Unfusable(f"{name} is not tree-compiled")
+
+    def _leaf(self, call: Call) -> None:
+        from pilosa_tpu.exec.executor import ExecutionError, _field_arg
+        hit = _field_arg(call)
+        if hit is None:
+            raise ExecutionError(f"{call.name}: missing field argument")
+        fname, value = hit
+        field = self.ex._field(self.ctx, fname)
+        if isinstance(value, Condition) or field.options.type in BSI_TYPES:
+            cond = (value if isinstance(value, Condition)
+                    else Condition("==", value))
+            self._bsi(field, cond)
+            return
+        if ("from" in call.args or "to" in call.args
+                or "_timestamp" in call.args):
+            raise Unfusable("time-range rows stay on the generic path")
+        if field.options.keys:
+            self.keyed_rows = True
+        row_id = self.ex._row_id(self.ctx, field, value, create=False)
+        if row_id is None:
+            self._emit(TREE_ZERO)
+            return
+        self._push_field_row(field, int(row_id))
+
+    def _union_rows(self, call: Call) -> None:
+        from pilosa_tpu.exec.executor import ExecutionError
+        bad = [c.name for c in call.children if c.name != "Rows"]
+        if bad:
+            raise ExecutionError(
+                f"UnionRows: children must be Rows calls, got {bad}")
+        if not call.children:
+            raise ExecutionError("UnionRows: Rows children required")
+        # the row SET comes from data, not query text: the spec cannot
+        # survive writes (a new row must join the union on next plan)
+        self.volatile = True
+        n = 0
+        for rc in call.children:
+            fname = rc.args.get("_field") or rc.args.get("field")
+            field = self.ex._field(self.ctx, str(fname))
+            for r in self.ex._rows_of(self.ctx, field, rc):
+                self._push_field_row(field, int(r))
+                n += 1
+                if n > 1:
+                    self._emit(_OP_CODE["or"])
+        if n == 0:
+            self._emit(TREE_ZERO)
+
+    def _bsi(self, field, cond: Condition) -> None:
+        from pilosa_tpu.exec.executor import (_SCALAR_TO_KEY,
+                                              ExecutionError)
+        if field.options.type not in BSI_TYPES:
+            raise ExecutionError(
+                f"field {field.name!r}: condition on non-BSI field")
+        self.bsi_depths[field.name] = field.options.bit_depth
+        if cond.op in BETWEEN_OPS:
+            lo_op = "gt" if cond.op.startswith("<>") else "ge"
+            hi_op = "lt" if cond.op.endswith("><") else "le"
+            self._bsi_cmp(field, lo_op, cond.value[0])
+            self._bsi_cmp(field, hi_op, cond.value[1])
+            self._emit(_OP_CODE["and"])
+            return
+        self._bsi_cmp(field, _SCALAR_TO_KEY[cond.op], cond.value)
+
+    def _bsi_cmp(self, field, op_key: str, value) -> None:
+        opts = field.options
+        depth = opts.bit_depth
+        offset = field.to_stored(value) - opts.base
+        bound = (1 << depth) - 1
+        if offset > bound or offset < -bound:
+            # saturated predicate: everything-not-null or nothing.
+            # The baked verdict depends on bit_depth — bsi_depths
+            # validity drops the spec when a write grows the depth.
+            all_hit = ((op_key in ("lt", "le", "ne")) if offset > bound
+                       else (op_key in ("gt", "ge", "ne")))
+            if all_hit:
+                self._emit(TREE_PUSH, self._extra(
+                    ("bsi-exists", field.name)))
+            else:
+                self._emit(TREE_ZERO)
+            return
+        # masks/sign re-derive from (offset, depth) per hit — pure
+        # functions of query text + the depth the validity rules pin
+        self._emit(TREE_PUSH, self._extra(
+            ("bsi", field.name, op_key, int(offset))))
+
+
+def lower_count_tree(ex, ctx, call: Call) -> TreeSpec:
+    """Lower one compound bitmap call (a ``Count`` child) to a
+    canonical :class:`TreeSpec`.  Raises :class:`Unfusable` for shapes
+    the tree path doesn't cover (callers fall back to the generic
+    fused / eager paths) and ``ExecutionError`` for genuine query
+    errors — identically to the other planners, so fused and
+    op-at-a-time agree on edge semantics."""
+    low = _Lower(ex, ctx)
+    low.lower(call, 1)
+    if low.field is None:
+        raise Unfusable("no plain-row leaf to anchor the plane gather")
+    # resolve symbolic push args: rows stay TREE_PUSH (arg = row
+    # position), extras become TREE_PUSHX (arg = extra position) —
+    # statically distinct opcodes so the fused skeleton knows which
+    # operand stack each push reads
+    prog = tuple(
+        ((TREE_PUSH, arg[1]) if arg[0] == "r" else (TREE_PUSHX, arg[1]))
+        if isinstance(arg, tuple) else (op, arg)
+        for op, arg in low.prog)
+    return TreeSpec(field=low.field.name, rows=tuple(low.rows),
+                    extras=tuple(low.extras), prog=prog,
+                    depth=low.depth, cse_hits=low.cse_hits,
+                    volatile=low.volatile, keyed_rows=low.keyed_rows,
+                    bsi_depths=tuple(low.bsi_depths.items()))
+
+
+def assemble_items(items) -> tuple:
+    """Union the items' gathered plane slots and extra arrays and
+    remap every postfix program into the shared operand space — the
+    cross-request half of CSE: N windowed compound queries over
+    overlapping rows of one plane pay ONE gather of the slot union
+    (``exec.batcher`` tree kind) and duplicate extra arrays (same
+    exists row, same predicate bitmap) enter once.
+
+    ``items``: sequence of ``(slots, prog, extras)`` where ``slots``
+    are plane row slots, PUSH args address that item's ``slots`` and
+    PUSHX args its ``extras``.  Returns ``(slot_union, progs,
+    extra_arrays)`` in :meth:`FusedCache.run_tree_counts` operand
+    order (PUSH args index the union; PUSHX args the extra list)."""
+    slot_pos: dict[int, int] = {}
+    extra_pos: dict[int, int] = {}
+    extra_arrays: list = []
+    for slots, _prog, extras in items:
+        for s in slots:
+            if s not in slot_pos:
+                slot_pos[s] = len(slot_pos)
+        for a in extras:
+            if id(a) not in extra_pos:
+                extra_pos[id(a)] = len(extra_arrays)
+                extra_arrays.append(a)
+    progs = []
+    for slots, prog, extras in items:
+        out = []
+        for op, arg in prog:
+            if op == TREE_PUSH:
+                arg = slot_pos[slots[arg]]
+            elif op == TREE_PUSHX:
+                arg = extra_pos[id(extras[arg])]
+            out.append((op, arg))
+        progs.append(tuple(out))
+    return tuple(slot_pos), tuple(progs), tuple(extra_arrays)

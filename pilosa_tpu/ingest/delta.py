@@ -198,6 +198,46 @@ def adjusted_row_counts(plane: jax.Array, d_rows: jax.Array,
     return counts
 
 
+def overlay_gathered_rows(sel: jax.Array, row_idx: jax.Array,
+                          d_rows: jax.Array, d_words: jax.Array,
+                          d_vals: jax.Array, r_pad: int) -> jax.Array:
+    """Apply the overlay's word overwrites to a row GATHER: ``sel``
+    uint32[S, G, W] is ``jnp.take(plane, row_idx, axis=-2)``, and each
+    overlay cell whose (shard, row slot) lands in the gathered set
+    overwrites its word with the cell's current value — the base⊕delta
+    form the whole-tree kernels consume (the tree folds over gathered
+    WORDS, so counts-only adjustment doesn't apply; the words
+    themselves must be fresh).  ``row_idx`` lanes past the live width
+    may repeat slot 0 (pow2 padding); a cell matches its FIRST lane
+    only, and programs never address pad lanes, so stale pad words are
+    unobservable.  Pad cells (``d_rows >= S * r_pad``) drop."""
+    s, g, _ = sel.shape
+    total = s * r_pad
+    valid = d_rows < total
+    cell_s = jnp.where(valid, d_rows // r_pad, s)  # pad → out of range
+    cell_slot = d_rows % r_pad
+    match = (cell_slot[:, None] == row_idx[None, :]) & valid[:, None]
+    lane = jnp.where(jnp.any(match, axis=1),
+                     jnp.argmax(match, axis=1), g)  # no lane → drop
+    return sel.at[cell_s, lane, d_words].set(d_vals, mode="drop")
+
+
+def overlay_row(val: jax.Array, slot, d_rows: jax.Array,
+                d_words: jax.Array, d_vals: jax.Array,
+                r_pad: int) -> jax.Array:
+    """Apply the overlay's word overwrites to ONE plane row: ``val``
+    uint32[S, W] is ``plane[:, slot, :]`` (``slot`` traced); every
+    overlay cell whose row slot matches overwrites its word.  The
+    per-push form of :func:`overlay_gathered_rows` — the solo tree
+    program reads rows straight off the plane, so the merge happens
+    row-wise inside the same fused chain."""
+    s = val.shape[0]
+    total = s * r_pad
+    match = (d_rows % r_pad == slot) & (d_rows < total)
+    cell_s = jnp.where(match, d_rows // r_pad, s)  # non-match → drop
+    return val.at[cell_s, d_words].set(d_vals, mode="drop")
+
+
 def adjusted_selected_counts(plane: jax.Array, row_idx: jax.Array,
                              d_rows: jax.Array, d_words: jax.Array,
                              d_vals: jax.Array) -> jax.Array:

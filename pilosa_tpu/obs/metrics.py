@@ -45,6 +45,9 @@ BYTE_BUCKETS = (1 << 10, 1 << 14, 1 << 17, 1 << 20, 1 << 23, 1 << 26,
                 1 << 28, 1 << 30, 1 << 32, 1 << 34, 1 << 36)
 COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 RATIO_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+# small structural depths (compound-tree nesting, r16): the interesting
+# range is 1..8 with single-level resolution at the shallow end
+DEPTH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 # synthetic families emitted only in the CLUSTER document (rendered by
 # render_cluster_metrics, not observed through a registry).  Module

@@ -23,6 +23,15 @@ from typing import Any
 SCALAR_OPS = ("==", "!=", "<", "<=", ">", ">=")
 BETWEEN_OPS = ("<><", "<=><", "<><=", "<=><=")  # lo(op)x(op)hi: <>< means lo<x<hi
 
+# the n-ary boolean-algebra calls and their canonical word-wise op
+# tokens (reference: executeIntersect/executeUnion/... dispatch in
+# executor.go).  This mapping is THE source of truth for operator
+# semantics: the executor's eager path, both fused planners and the
+# whole-tree compiler (exec/tree.py) all fold through it — `Not` is
+# not listed because it is unary and lowers to `andnot(exists, x)`.
+BOOL_CALLS = {"Union": "or", "Intersect": "and",
+              "Difference": "andnot", "Xor": "xor"}
+
 
 @dataclass(frozen=True)
 class Condition:

@@ -106,7 +106,8 @@ class PilosaTPUServer:
             max_concurrent=self.cfg.max_concurrent_queries,
             plane_sidecars=self.cfg.plane_sidecars,
             delta_cells=self.cfg.delta_buffer_cells,
-            delta_compact_fraction=self.cfg.delta_compact_fraction)
+            delta_compact_fraction=self.cfg.delta_compact_fraction,
+            tree_fusion=self.cfg.tree_fusion)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

@@ -387,3 +387,36 @@ def test_config26_ingest_serving_smoke():
         assert m["writes"]["bits"] > 0
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config27_compound_smoke():
+    """bench/config27 (compound-query compilation, r16) in --smoke
+    mode: the depth-2..4 segmentation mix measured fused vs
+    op-at-a-time on the same data.  Pinned on every run: every answer
+    in BOTH modes oracle-exact, the tree path actually engaged (tree
+    programs built — a silent fallback would make the comparison
+    vacuous), and the concurrency multiplier holds the noise-adjusted
+    smoke bar (>= 1.5x; full scale gates 2.0x concurrent and 1.3x
+    single-stream inside the bench)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config27_compound.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("fused_tree_qps_compound_mix")
+    assert out["unit"] == "qps" and out["value"] > 0
+    d = out["detail"]
+    assert d["tree_programs_built"] >= 1
+    assert d["ratio_concurrent"] >= 1.5
+    for mode in ("fused", "op_at_a_time"):
+        assert d["modes"][mode]["concurrent"]["ok"] > 0
+        assert d["modes"][mode]["single_stream"]["ok"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -130,6 +130,121 @@ def test_composed_tree_plans(ex):
     assert got == [want[0] - 1]
 
 
+def test_tree_plan_hit_skips_parsing(ex, monkeypatch):
+    """r16: a repeated COMPOUND request rides a tree-kind plan entry —
+    parse AND plan skipped, answered by the whole-tree program."""
+    pql = ("Count(Intersect(Row(f=1), Union(Row(f=2), Row(f=3)), "
+           "Not(Row(f=0))))")
+    want = ex.execute("i", pql)
+    assert ex.execute("i", pql) == want  # plan + plane settled
+
+    tokenize_calls = []
+    real_tokenize = parser_mod.lx.tokenize
+
+    def counting(src):
+        tokenize_calls.append(src)
+        return real_tokenize(src)
+
+    monkeypatch.setattr(parser_mod.lx, "tokenize", counting)
+    parse_cached.cache_clear()
+    hits_before = _counters(ex, "plan_cache_hits")
+    assert ex.execute("i", pql) == want
+    assert tokenize_calls == [], \
+        "tree-plan hit must not touch the parser"
+    assert _counters(ex, "plan_cache_hits") > hits_before
+    # and the serving entry really is the tree kind
+    assert any(getattr(e, "kind", None) == "tree"
+               for e in ex._plans.values())
+
+
+def test_tree_plan_survives_writes_via_delta_overlay(ex):
+    """r16: tree entries over unkeyed set fields skip the per-hit
+    generation compare (nothing in them can stale — row ids are
+    literal ints, slots re-resolve, the plane absorbs writes into its
+    delta overlay), so parse+plan stays off every request under
+    sustained ingest AND every answer is fresh."""
+    pql = "Count(Difference(Union(Row(f=1), Row(f=2)), Row(f=3)))"
+    want = ex.execute("i", pql)
+    assert ex.execute("i", pql) == want  # plan-cached
+    hits_before = _counters(ex, "plan_cache_hits")
+    ex.execute("i", "Set(150, f=1)")  # bumps the source generation
+    assert ex.execute("i", pql) == [want[0] + 1], \
+        "stale tree plan served a stale count"
+    assert ex.execute("i", pql) == [want[0] + 1]
+    assert _counters(ex, "plan_cache_hits") > hits_before, \
+        "the unkeyed tree plan should survive the write"
+
+
+def test_tree_plan_drops_on_field_recreation(ex):
+    """The surviving tree entry must still die when a baked field is
+    dropped and recreated with different options (keyed) — its
+    literal row ids would otherwise probe the wrong namespace."""
+    pql = "Count(Union(Row(f=1), Row(f=2)))"
+    want = ex.execute("i", pql)
+    assert ex.execute("i", pql) == want  # cached, write-surviving
+    idx = ex.holder.index("i")
+    idx.delete_field("f")
+    ex.planes.invalidate("i")  # what API.delete_field does (plans NOT
+    #                            dropped here: the hazard under test)
+    idx.create_field("f", FieldOptions(keys=True))
+    with pytest.raises(ExecutionError):
+        ex.execute("i", pql)
+
+
+def test_bsi_recreated_same_depth_drops_surviving_tree_plan(tmp_path):
+    """A surviving tree plan bakes BSI predicate OFFSETS against the
+    field's base (`to_stored(v) - base`); a drop + recreate with the
+    SAME bit depth but a shifted base must still drop the plan — a
+    depth-only validity check would let the stale offset serve a
+    skewed predicate forever (review fix: validity compares the full
+    predicate-relevant option signature)."""
+    from pilosa_tpu.obs import Stats
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=127))
+    e = Executor(holder, stats=Stats())
+    for c in range(10):
+        e.execute("i", f"Set({c}, f=1)")
+        e.execute("i", f"Set({c}, v={c * 10})")
+    pql = "Count(Intersect(Row(f=1), Row(v > 50)))"
+    assert e.execute("i", pql) == [4]  # 60, 70, 80, 90
+    assert e.execute("i", pql) == [4]  # cached, write-surviving
+    idx.delete_field("v")
+    e.planes.invalidate("i")  # what API.delete_field does (plans NOT
+    #                           dropped: the peer-node hazard)
+    # same bit depth (span 127), base shifted to 100
+    idx.create_field("v", FieldOptions(type="int", min=100, max=227))
+    for c in range(10):
+        e.execute("i", f"Set({c}, v={100 + c * 10})")
+    # every value (100..190) is > 50; a stale offset (50 against the
+    # old base 0) would answer v > 150 instead → 4
+    assert e.execute("i", pql) == [10], \
+        "stale BSI offset served a skewed predicate"
+    holder.close()
+
+
+def test_keyed_tree_plan_stays_generation_checked(tmp_path):
+    """Tree entries with KEYED rows never take the survival shortcut:
+    a write (e.g. creating a row key that planned as missing)
+    invalidates through the generation compare, exactly like the
+    generic kind."""
+    from pilosa_tpu.obs import Stats
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("k", FieldOptions(keys=True))
+    e = Executor(holder, stats=Stats())
+    for c in range(16):
+        e.execute("i", f'Set({c}, k="{"ab"[c % 2]}")')
+    pql = 'Count(Union(Row(k="a"), Row(k="zzz")))'
+    assert e.execute("i", pql) == [8]
+    assert e.execute("i", pql) == [8]
+    e.execute("i", 'Set(100, k="zzz")')  # the missing key appears
+    assert e.execute("i", pql) == [9], \
+        "keyed tree plan must re-plan after the key is created"
+    holder.close()
+
+
 def test_unplannable_shapes_fall_through(ex):
     """Writes and non-Count calls negative-cache and keep serving
     through the normal path, repeatedly and exactly — the pre-write

@@ -1,0 +1,442 @@
+"""Whole-tree PQL compilation (r16 tentpole): compound boolean trees
+compile to ONE fused XLA program — rows gathered from the resident
+plane as traced operands, ops folded as a postfix program — and must
+agree BIT-EXACTLY with the op-at-a-time path (the eager per-node
+``_bitmap`` evaluator) and a pure-python set oracle on every shape:
+pinned edge semantics, seeded random trees (the repo carries no
+hypothesis), and interleaved writes riding the delta overlay.  The
+batcher acceptance — concurrent compound queries over one plane share
+one memory pass and one packed readback per window — is asserted via
+batcher metrics."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine import kernels
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.executor import ExecutionError, _Ctx
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.pql.ast import Condition
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.store import FieldOptions, Holder
+
+N_SHARDS = 3
+F_ROWS = 6       # rows 6..7 stay absent (zeros leaves)
+G_ROWS = 3
+V_MIN, V_MAX = -100, 100
+
+
+class Truth:
+    """Host-side set oracle: the bits the fixture wrote."""
+
+    def __init__(self):
+        self.rows: dict[tuple, set] = {}   # (field, row) -> cols
+        self.vals: dict[int, int] = {}     # BSI col -> value
+        self.all_cols: set = set()
+
+    def set_bit(self, field, row, col):
+        self.rows.setdefault((field, row), set()).add(col)
+        self.all_cols.add(col)
+
+    def clear_bit(self, field, row, col):
+        self.rows.get((field, row), set()).discard(col)
+
+    def row(self, field, row) -> set:
+        return set(self.rows.get((field, row), set()))
+
+    def cond(self, cond: Condition) -> set:
+        return {c for c, v in self.vals.items() if cond.matches(v)}
+
+    def eval(self, call) -> set:
+        name = call.name
+        if name in ("Row", "Range"):
+            (fname, value), = [(k, v) for k, v in call.args.items()
+                               if not k.startswith("_")]
+            if fname == "v" or isinstance(value, Condition):
+                cond = (value if isinstance(value, Condition)
+                        else Condition("==", value))
+                return self.cond(cond)
+            return self.row(fname, int(value))
+        if name == "All":
+            return set(self.all_cols)
+        if name == "Not":
+            return self.all_cols - self.eval(call.children[0])
+        if name == "UnionRows":
+            out: set = set()
+            for rc in call.children:
+                fname = str(rc.args.get("_field") or rc.args.get("field"))
+                for (f, _r), cols in self.rows.items():
+                    if f == fname and cols:
+                        out |= cols
+            return out
+        kids = [self.eval(k) for k in call.children]
+        if name == "Union":
+            out = set()
+            for k in kids:
+                out |= k
+            return out
+        acc = kids[0]
+        for k in kids[1:]:
+            if name == "Intersect":
+                acc = acc & k
+            elif name == "Difference":
+                acc = acc - k
+            else:
+                acc = acc ^ k
+        return acc
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    holder = Holder(str(tmp_path_factory.mktemp("tree"))).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("v", FieldOptions(type="int", min=V_MIN, max=V_MAX))
+    stats = Stats()
+    ex = Executor(holder, stats=stats)
+    # the op-at-a-time baseline: whole-tree compilation OFF restores
+    # the pre-r16 path; _bitmap on it is the per-node eager evaluator
+    ex_eager = Executor(holder, tree_fusion=False, count_batch_window=0)
+    truth = Truth()
+    rng = np.random.default_rng(16)
+    cols = sorted(int(s) * SHARD_WIDTH + int(o)
+                  for s in range(N_SHARDS)
+                  for o in rng.choice(SHARD_WIDTH, size=60, replace=False))
+    for c in cols:
+        r = int(rng.integers(0, F_ROWS))
+        ex.execute("i", f"Set({c}, f={r})")
+        truth.set_bit("f", r, c)
+        if rng.random() < 0.5:
+            gr = int(rng.integers(0, G_ROWS))
+            ex.execute("i", f"Set({c}, g={gr})")
+            truth.set_bit("g", gr, c)
+        if rng.random() < 0.7:
+            v = int(rng.integers(V_MIN // 2, V_MAX // 2))
+            ex.execute("i", f"Set({c}, v={v})")
+            truth.vals[c] = v
+    # make the anchor planes resident up front: the tree path's
+    # admission (like _count_batch_plane's) declines to build a whole
+    # plane for a tiny row slice, and these tests pin the FUSED path
+    from pilosa_tpu.store.view import VIEW_STANDARD
+    shards = tuple(idx.available_shards())
+    ex.planes.field_plane("i", idx.field("f"), VIEW_STANDARD, shards)
+    ex.planes.field_plane("i", idx.field("g"), VIEW_STANDARD, shards)
+    yield holder, idx, ex, ex_eager, truth, stats
+    holder.close()
+
+
+def eager_count(ex, idx, tree_pql: str) -> int:
+    """Op-at-a-time evaluation: the per-node eager ``_bitmap`` fold —
+    one kernel dispatch per AST node, no fusion anywhere."""
+    call = parse(f"Count({tree_pql})").calls[0].children[0]
+    ctx = _Ctx(idx, tuple(idx.available_shards()), True)
+    ex.planes.begin_query()
+    try:
+        words = ex._bitmap(ctx, call)
+        return int(kernels.shard_totals(kernels.count(words)))
+    finally:
+        ex.planes.end_query()
+
+
+def three_way(env_t, tree_pql: str):
+    """fused-tree vs generic-fused (tree off) vs eager op-at-a-time
+    vs the set oracle — all four must agree bit-exactly."""
+    holder, idx, ex, ex_eager, truth, _ = env_t
+    want = len(truth.eval(parse(f"Count({tree_pql})").calls[0]
+                          .children[0]))
+    got_tree = ex.execute("i", f"Count({tree_pql})")[0]
+    got_generic = ex_eager.execute("i", f"Count({tree_pql})")[0]
+    got_eager = eager_count(ex_eager, idx, tree_pql)
+    assert got_tree == got_generic == got_eager == want, \
+        (tree_pql, got_tree, got_generic, got_eager, want)
+    return want
+
+
+class TestEdgeSemantics:
+    """Satellite: pinned compound-tree edge semantics — fused and
+    op-at-a-time must agree on every one of them."""
+
+    def test_union_no_children(self, env):
+        assert three_way(env, "Union()") == 0
+
+    def test_union_empty_inside_intersect(self, env):
+        assert three_way(env, "Intersect(Row(f=0), Union())") == 0
+
+    def test_difference_single_child(self, env):
+        _, _, ex, _, truth, _ = env
+        want = three_way(env, "Difference(Row(f=1))")
+        assert want == len(truth.row("f", 1))
+
+    def test_not_requires_existence_both_paths(self, env):
+        holder, _, ex, ex_eager, _, _ = env
+        holder.create_index("noex", track_existence=False)
+        holder.index("noex").create_field("f")
+        ex.execute("noex", "Set(1, f=1)")
+        for e in (ex, ex_eager):
+            with pytest.raises(ExecutionError, match="track existence"):
+                e.execute("noex", "Count(Not(Row(f=1)))")
+
+    def test_duplicate_row_cse(self, env):
+        _, _, ex, _, truth, stats = env
+        before = sum(stats.snapshot()["counters"]
+                     .get("tree_cse_hits_total", {}).values())
+        want = three_way(env, "Union(Row(f=1), Row(f=1), Row(f=1))")
+        assert want == len(truth.row("f", 1))
+        after = sum(stats.snapshot()["counters"]
+                    .get("tree_cse_hits_total", {}).values())
+        assert after > before, "duplicate leaves must CSE to one operand"
+
+    def test_absent_row_is_zeros(self, env):
+        assert three_way(env, "Union(Row(f=7), Row(f=7))") == 0
+        three_way(env, "Difference(Row(f=0), Row(f=7))")
+
+    def test_wide_flat_union_stays_iterative(self, env):
+        """A 1500-child flat Union is legal PQL and lands on the
+        generic path (past TREE_MAX_PROG): the shared fold must build
+        ONE n-ary plan node — a nested pair per child recursed once
+        per child in _build/shift_leaves and blew the recursion limit
+        at ~966 children (review regression, pinned)."""
+        _, _, ex, _, truth, _ = env
+        rows = [int(r) for r in
+                np.random.default_rng(5).integers(0, F_ROWS, 1500)]
+        pql = "Count(Union(" + ", ".join(f"Row(f={r})"
+                                         for r in rows) + "))"
+        want = len(set().union(*(truth.row("f", r) for r in rows)))
+        assert ex.execute("i", pql) == [want]
+
+    def test_bsi_saturated_predicates(self, env):
+        # beyond ±(2^depth - 1): everything-not-null vs nothing
+        three_way(env, f"Intersect(Row(f=0), Row(v < {V_MAX * 10}))")
+        three_way(env, f"Intersect(Row(f=0), Row(v > {V_MAX * 10}))")
+
+    def test_bitmap_tree_columns_match(self, env):
+        """Bitmap-valued compound trees (want=words) return the same
+        column set through the tree program and the eager path."""
+        holder, idx, ex, ex_eager, truth, _ = env
+        pql = "Difference(Union(Row(f=0), Row(f=1)), Row(g=0))"
+        (got,) = ex.execute("i", pql)
+        (got2,) = ex_eager.execute("i", pql)
+        want = sorted((truth.row("f", 0) | truth.row("f", 1))
+                      - truth.row("g", 0))
+        assert [int(c) for c in got.columns] == want
+        assert [int(c) for c in got2.columns] == want
+
+
+def gen_tree(rng, depth: int) -> str:
+    """One random PQL tree: mixed ops, duplicate leaves (tiny row
+    space), BSI range leaves (incl. saturating values and betweens),
+    absent rows, empty Unions."""
+    if depth == 0 or rng.random() < 0.35:
+        kind = int(rng.integers(0, 6))
+        if kind == 0:
+            return f"Row(f={int(rng.integers(0, F_ROWS + 2))})"
+        if kind == 1:
+            return f"Row(g={int(rng.integers(0, G_ROWS + 1))})"
+        if kind == 2:
+            op = str(rng.choice(["<", "<=", ">", ">=", "==", "!="]))
+            k = int(rng.integers(V_MIN * 2, V_MAX * 2))
+            return f"Row(v {op} {k})"
+        if kind == 3:
+            lo = int(rng.integers(V_MIN, 0))
+            hi = int(rng.integers(0, V_MAX))
+            return f"Row({lo} < v < {hi})"
+        if kind == 4:
+            return "All()"
+        return f"Row(f={int(rng.integers(0, 3))})"  # duplicates likely
+    op = str(rng.choice(["Union", "Intersect", "Difference", "Xor",
+                         "Not", "Union", "Intersect"]))
+    if op == "Not":
+        return f"Not({gen_tree(rng, depth - 1)})"
+    lo = 0 if op == "Union" else 1
+    n = int(rng.integers(lo, 4))
+    kids = ", ".join(gen_tree(rng, depth - 1) for _ in range(n))
+    return f"{op}({kids})"
+
+
+class TestPropertyFusedVsOracle:
+    """Satellite: random PQL trees (depth <= 4), fused vs op-at-a-time
+    vs set oracle, bit-exact — seeded exhaustively instead of
+    hypothesis (absent from the image)."""
+
+    def test_random_trees_three_way(self, env):
+        rng = np.random.default_rng(27)
+        for trial in range(60):
+            depth = int(rng.integers(1, 5))
+            three_way(env, gen_tree(rng, depth))
+
+    def test_random_trees_under_interleaved_writes(self, env):
+        """Writes between queries ride the resident plane's delta
+        overlay: answers stay three-way exact with ZERO base-plane
+        rebuilds (the r15 zero-rebuild guarantee extended to fused
+        trees)."""
+        holder, idx, ex, ex_eager, truth, _ = env
+        rng = np.random.default_rng(28)
+        # warm the anchor plane so writes absorb instead of building
+        three_way(env, "Intersect(Row(f=0), Row(f=1))")
+        builds0 = ex.planes.stats()["builds"]
+        absorbs0 = ex.planes.delta_stats()["absorbs"]
+        universe = sorted(truth.all_cols)
+        for _step in range(8):
+            for _w in range(4):
+                c = int(rng.choice(universe))
+                r = int(rng.integers(0, F_ROWS))
+                if rng.random() < 0.3 and c in truth.row("f", r):
+                    ex.execute("i", f"Clear({c}, f={r})")
+                    truth.clear_bit("f", r, c)
+                else:
+                    ex.execute("i", f"Set({c}, f={r})")
+                    truth.set_bit("f", r, c)
+            for _q in range(3):
+                three_way(env, gen_tree(rng, int(rng.integers(1, 4))))
+        st = ex.planes.stats()
+        assert st["builds"] == builds0, \
+            "interleaved writes must absorb into the delta overlay, " \
+            "not rebuild the base plane"
+        assert ex.planes.delta_stats()["absorbs"] > absorbs0, \
+            "the write gap should have ridden the delta overlay"
+
+
+class TestWindowSharing:
+    """Acceptance: concurrent compound queries over the same plane
+    share one memory pass (one tree-kind group dispatch, slot-union
+    bytes) and one packed readback per batch window."""
+
+    def _tree_counters(self, stats):
+        snap = stats.snapshot()["counters"]
+
+        def total(name):
+            return sum(snap.get(name, {}).values())
+        full = stats.full_snapshot()
+        disp = 0
+        for series in (full["histograms"]
+                       .get("kernel_dispatch_seconds", {})
+                       .get("series", [])):
+            if series["labels"].get("kind") == "tree":
+                disp += series["count"]
+        return (total("batcher_batches"), total("batcher_items"), disp,
+                sum(v for k, v in snap
+                    .get("kernel_bytes_scanned_total", {}).items()
+                    if dict(k).get("kind") == "tree"))
+
+    def test_concurrent_trees_one_pass_one_window(self, env):
+        holder, idx, ex, _, truth, _ = env
+        stats = Stats()
+        exw = Executor(holder, stats=stats, count_batch_window=0.05)
+        pqls = ["Count(Intersect(Row(f=0), Union(Row(f=1), Row(f=2))))",
+                "Count(Difference(Union(Row(f=1), Row(f=2)), Row(f=3)))"]
+        wants = [len(truth.eval(parse(p).calls[0].children[0]))
+                 for p in pqls]
+        assert [exw.execute("i", p)[0] for p in pqls] == wants  # warm
+        got: dict = {}
+        errors: list = []
+        for _attempt in range(20):
+            b0, i0, d0, by0 = self._tree_counters(stats)
+            barrier = threading.Barrier(2)
+
+            def worker(k, p):
+                try:
+                    barrier.wait()
+                    got[k] = exw.execute("i", p)[0]
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+            ts = [threading.Thread(target=worker, args=(k, p))
+                  for k, p in enumerate(pqls)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            b1, i1, d1, by1 = self._tree_counters(stats)
+            if b1 - b0 == 1 and i1 - i0 == 2:
+                # both landed in ONE window: the group must have
+                # dispatched ONE fused tree program (one memory pass)
+                assert d1 - d0 == 1, \
+                    "two same-plane trees in one window must share " \
+                    "one fused dispatch"
+                # and the scanned bytes are the slot UNION (4 distinct
+                # rows + exists-free extras), not the 6-leaf sum
+                plane = exw.planes.field_plane_nowait(
+                    "i", idx.field("f"), "standard",
+                    tuple(idx.available_shards()))
+                per_row = plane.plane.shape[0] * plane.plane.shape[-1] * 4
+                assert by1 - by0 == 4 * per_row, (by1 - by0, per_row)
+                break
+        else:
+            pytest.fail("two concurrent trees never landed in one window")
+        assert [got[0], got[1]] == wants
+
+    def test_mixed_window_packs_to_one_read(self, env):
+        """A window holding a tree item AND a whole-plane rowcounts
+        item comes back through ONE packed device→host read."""
+        from pilosa_tpu.engine.kernels import TREE_AND, TREE_PUSH
+        from pilosa_tpu.store.view import VIEW_STANDARD
+        holder, idx, ex, _, truth, _ = env
+        stats = Stats()
+        exw = Executor(holder, stats=stats, count_batch_window=0.05)
+        fld = idx.field("f")
+        shards = tuple(idx.available_shards())
+        ps = exw.planes.field_plane("i", fld, VIEW_STANDARD, shards)
+        s0, s1 = ps.slot_of[0], ps.slot_of[1]
+        prog = ((TREE_PUSH, 0), (TREE_PUSH, 1), (TREE_AND, 0))
+        results: dict = {}
+        errors: list = []
+        barrier = threading.Barrier(2)
+
+        def tree():
+            try:
+                barrier.wait()
+                results["tree"] = exw.batcher.submit_tree(
+                    ps.plane, (s0, s1), prog)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def rows():
+            try:
+                barrier.wait()
+                results["rows"] = exw.batcher.submit_rowcounts(ps.plane)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        packed = 0
+        for _ in range(20):
+            before = sum(stats.snapshot()["counters"]
+                         .get("batcher_readback_packed", {}).values())
+            ts = [threading.Thread(target=tree),
+                  threading.Thread(target=rows)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            packed = sum(stats.snapshot()["counters"]
+                         .get("batcher_readback_packed", {}).values()) \
+                - before
+            if packed:
+                break
+        assert packed >= 1, "mixed tree+rowcounts window never packed"
+        assert results["tree"] == len(truth.row("f", 0)
+                                      & truth.row("f", 1))
+        np.testing.assert_array_equal(
+            np.asarray(results["rows"])[:1],
+            np.array([len(truth.row("f", 0))]))
+
+
+class TestTreeMetrics:
+    def test_depth_histogram_and_build_counter(self, env):
+        holder, _, _, _, _, _ = env
+        stats = Stats()
+        exm = Executor(holder, stats=stats, count_batch_window=0)
+        exm.execute("i", "Count(Intersect(Row(f=0), Union(Row(f=1), "
+                         "Row(f=2)), Not(Row(f=3))))")
+        snap = stats.snapshot()["counters"]
+        assert sum(snap.get("tree_programs_built_total", {}).values()) >= 1
+        full = stats.full_snapshot()
+        fam = full["histograms"].get("tree_fusion_depth")
+        assert fam is not None and fam["series"], \
+            "tree_fusion_depth must be observed"
+        assert fam["series"][0]["count"] >= 1
