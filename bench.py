@@ -87,12 +87,11 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def regression_guard(metric: str, value: float) -> list[dict]:
-    """Compare the headline against the newest prior ``BENCH_r*.json``
-    whose recorded metric matches ``metric`` exactly (a CPU smoke run
-    never judges itself against a TPU round).  Returns the (possibly
-    empty) ``regressions`` list for the output JSON; never raises — a
-    malformed artifact must not cost the round its benchmark."""
+def _prior_rounds(metric: str):
+    """Yield prior ``BENCH_r*.json`` ``parsed`` payloads carrying the
+    SAME metric name, newest first (a CPU smoke run never judges
+    itself against a TPU round).  Malformed artifacts are skipped —
+    they must not cost the round its benchmark."""
     import glob
     import re
 
@@ -109,22 +108,86 @@ def regression_guard(metric: str, value: float) -> list[dict]:
                 parsed = json.load(f).get("parsed") or {}
             if parsed.get("metric") != metric:
                 continue
-            prev = float(parsed.get("value") or 0)
         except (OSError, ValueError, TypeError, AttributeError):
             continue  # malformed artifact: try the next round
+        yield os.path.basename(path), parsed
+
+
+def _dig(tree, path: tuple):
+    """Walk nested dicts by key path; None on any miss / non-number."""
+    cur = tree
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return float(cur) if isinstance(cur, (int, float)) else None
+
+
+def detail_regression_guard(metric: str, detail: dict, tracked: dict,
+                            ratio: float = REGRESSION_RATIO) -> list[dict]:
+    """Sub-metric regression guard (r17): compare named values INSIDE
+    a config's ``detail`` payload against the newest prior round of
+    the same headline metric that recorded a detail.  ``tracked`` maps
+    a label to its key path in the detail tree, e.g.
+    ``{"single_stream_qps": ("solo", "fastlane_qps")}`` — so a future
+    change that tanks the solo floor or one kernel kind's GB/s fails
+    the guard even while the concurrent headline hides it.  Rounds
+    whose artifacts carry no detail (pre-r17) simply don't match;
+    never raises."""
+    prev_detail = None
+    prev_name = None
+    for name, parsed in _prior_rounds(metric):
+        d = parsed.get("detail")
+        if isinstance(d, dict) and any(
+                _dig(d, path) is not None for path in tracked.values()):
+            prev_detail, prev_name = d, name
+            break
+    if prev_detail is None:
+        log(f"detail guard: no prior round carries detail for "
+            f"{metric!r}; skipped")
+        return []
+    out = []
+    for label, path in tracked.items():
+        cur = _dig(detail, path)
+        prev = _dig(prev_detail, path)
+        if cur is None or not prev or prev <= 0:
+            continue
+        r = cur / prev
+        if r < ratio:
+            log(f"REGRESSION: {label} {cur:,.1f} is {r:.2f}x of "
+                f"{prev_name}'s {prev:,.1f}")
+            out.append({"metric": label, "value": round(cur, 2),
+                        "previous": round(prev, 2),
+                        "previous_round": prev_name,
+                        "ratio": round(r, 3)})
+        else:
+            log(f"detail guard: {label} at {r:.2f}x of {prev_name} "
+                f"— OK")
+    return out
+
+
+def regression_guard(metric: str, value: float) -> list[dict]:
+    """Compare the headline against the newest prior ``BENCH_r*.json``
+    whose recorded metric matches ``metric`` exactly.  Returns the
+    (possibly empty) ``regressions`` list for the output JSON; never
+    raises."""
+    for path_name, parsed in _prior_rounds(metric):
+        try:
+            prev = float(parsed.get("value") or 0)
+        except (ValueError, TypeError):
+            continue
         if prev <= 0:
             continue
         ratio = value / prev
         if ratio < REGRESSION_RATIO:
             log(f"REGRESSION: {metric} {value:,.1f} qps is "
-                f"{ratio:.2f}x of {os.path.basename(path)}'s "
-                f"{prev:,.1f} qps")
+                f"{ratio:.2f}x of {path_name}'s {prev:,.1f} qps")
             return [{"metric": metric, "value": round(value, 2),
                      "previous": round(prev, 2),
-                     "previous_round": os.path.basename(path),
+                     "previous_round": path_name,
                      "ratio": round(ratio, 3)}]
         log(f"regression guard: {metric} at {ratio:.2f}x of "
-            f"{os.path.basename(path)} — OK")
+            f"{path_name} — OK")
         return []
     log(f"regression guard: no prior round carries {metric!r}; skipped")
     return []

@@ -23,9 +23,11 @@ failure at any scale.
 
 ``--smoke`` (or PILOSA_BENCH_SMOKE=1): 3 shards, short windows —
 tier-1 runs it (tests/test_bench_smoke.py): exactness and
-tree-path-engagement assertions are pinned on every run (the qps
-ratios are reported but not gated at smoke scale — CPU noise, the
-config26 precedent).
+tree-path-engagement assertions are pinned on every run, the
+concurrency ratio gates at a noise-adjusted 1.5x, and — since the r17
+solo fast lane removed the dispatch-overhead floor that had left the
+solo bar ungated at 0.7x — fused solo must BEAT op-at-a-time solo
+(>=1.0x, re-measured once on a miss for load tolerance) at smoke too.
 
 Prints ONE JSON line (same shape as bench.py) plus the shared
 regression-guard verdict for this metric.
@@ -215,22 +217,42 @@ def main():
     # the concurrency multiplier is the tentpole claim (one memory
     # pass + one packed readback per window vs per-item leaf scans):
     # full bar 2.0x, smoke noise-adjusted 1.5x (config20 precedent;
-    # measured 3–10x on CPU smoke).  The single-stream bar holds
-    # where round-trips and leaf-entry walks dominate (full scale /
-    # real transport); CPU smoke is dispatch-overhead bound, so it is
-    # reported but gated full-scale only.
+    # measured 3–10x on CPU smoke).  The single-stream bar: 1.3x at
+    # full scale, and — now that solo requests ride the r17 fast lane
+    # (inline dispatch, no window formation) instead of being
+    # dispatch-overhead bound at 0.7x — fused solo must at least BEAT
+    # op-at-a-time solo at smoke too.  Smoke re-measures on a miss
+    # before failing: a loaded tier-1 box can starve one window
+    # (config26 precedent for load-tolerant smoke assertions).
     bar_conc = 1.5 if SMOKE else 2.0
     assert ratio_conc >= bar_conc, \
         (f"fused trees {ratio_conc:.2f}x op-at-a-time at "
          f"{CLIENTS}-way (bar: {bar_conc}x)")
-    if not SMOKE:
-        assert ratio_solo >= 1.3, \
-            f"fused trees {ratio_solo:.2f}x solo (bar: 1.3x)"
+    bar_solo = 1.0 if SMOKE else 1.3
+    if SMOKE:
+        for _ in range(2):
+            if ratio_solo >= bar_solo:
+                break
+            log(f"solo ratio {ratio_solo:.2f}x under the smoke bar; "
+                f"re-measuring (load tolerance)")
+            s_f = measure(ex_fused, queries, 1, WINDOW / 2)
+            s_o = measure(ex_op, queries, 1, WINDOW / 2)
+            ratio_solo = max(ratio_solo,
+                             s_f["qps"] / max(1e-9, s_o["qps"]))
+    assert ratio_solo >= bar_solo, \
+        f"fused trees {ratio_solo:.2f}x solo (bar: {bar_solo}x)"
+    # the solo fast lane must actually have engaged for the fused solo
+    # phase — a silent fall-back to window formation would make the
+    # re-gated solo bar measure the wrong path
+    fastlane = sum(stats.snapshot()["counters"]
+                   .get("solo_fastlane_hits_total", {}).values())
+    assert fastlane >= 1, "solo fast lane never engaged"
 
     value = modes["fused"]["concurrent"]["qps"]
     detail = {"modes": modes,
               "ratio_single_stream": round(ratio_solo, 3),
               "ratio_concurrent": round(ratio_conc, 3),
+              "solo_fastlane_hits": fastlane,
               "tree_programs_built": built,
               "clients": CLIENTS, "shards": N_SHARDS,
               "window_s": WINDOW, "mix_size": len(queries)}

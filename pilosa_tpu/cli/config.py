@@ -105,6 +105,17 @@ class Config:
     # pass per plane through the batcher window.  False restores the
     # pre-r16 op-at-a-time/generic path (the bench baseline).
     tree_fusion: bool = True
+    # Persistent dispatch pipeline (r17): how many dispatched-but-
+    # unread collection windows the batcher may run ahead — window N's
+    # device compute overlaps window N-1's packed device→host read.
+    # <=1 restores the serial dispatch→read loop.
+    dispatch_pipeline_depth: int = 2
+    # Solo fast lane (r17): width-1 requests with no queue pressure
+    # skip window formation and dispatch inline on the caller thread
+    # over donated ping-pong chains (pre-bound slot operands, standing
+    # output slots) — the attack on the one-RPC-per-query solo floor.
+    # False restores the always-windowed pre-r17 path.
+    solo_fastlane: bool = True
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

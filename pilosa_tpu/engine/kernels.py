@@ -64,9 +64,32 @@ def popcount(words: jax.Array) -> jax.Array:
     return lax.population_count(words)
 
 
+# words per inner reduce tile of the popcount chain (r17 roofline
+# chase).  A flat jnp.sum over a 32K-word trailing axis emits one long
+# serial int32 accumulation chain per (shard, row); splitting the axis
+# into COUNT_TILE-word tiles reduced innermost-first gives the
+# vectorizer W/COUNT_TILE independent partial sums to interleave
+# (measured per-kind in bench/config23's before/after detail).  Exact
+# at any tiling: every partial sum of per-word popcounts (<=32 each)
+# stays far under int32.
+COUNT_TILE = 512
+
+
+def count_ref(words: jax.Array) -> jax.Array:
+    """Flat single-pass reduce — the pre-r17 :func:`count`, kept as
+    the before-side of config23's per-kernel before/after sweep."""
+    return jnp.sum(popcount(words), axis=-1, dtype=jnp.int32)
+
+
 def count(words: jax.Array) -> jax.Array:
     """Total set bits over the trailing word axis -> int32[...] (exact:
     one shard's 2^20 bits << 2^31)."""
+    w = words.shape[-1]
+    if w >= 2 * COUNT_TILE and w % COUNT_TILE == 0:
+        tiles = words.reshape(words.shape[:-1] + (w // COUNT_TILE,
+                                                  COUNT_TILE))
+        inner = jnp.sum(popcount(tiles), axis=-1, dtype=jnp.int32)
+        return jnp.sum(inner, axis=-1, dtype=jnp.int32)
     return jnp.sum(popcount(words), axis=-1, dtype=jnp.int32)
 
 
@@ -113,8 +136,8 @@ def row_counts(plane: jax.Array, filter_words: jax.Array | None = None) -> jax.A
     return count(plane)
 
 
-def selected_row_counts(plane: jax.Array,
-                        row_idx: jax.Array) -> jax.Array:
+def selected_row_counts(plane: jax.Array, row_idx: jax.Array,
+                        sorted_idx: bool = False) -> jax.Array:
     """Popcounts of N SELECTED rows in one pass over only their memory.
 
     plane: uint32[..., R, W]; row_idx: int32[N] -> int32[..., N].
@@ -128,8 +151,16 @@ def selected_row_counts(plane: jax.Array,
     program serves any row selection of the same width.  Duplicate
     indices are fine (each answers independently); indices must be in
     range (callers resolve through the plane's slot map first).
+
+    ``sorted_idx`` is a STATIC promise (part of the compiled program)
+    that the traced indices arrive in non-decreasing order, letting
+    the gather walk the row axis in ascending memory stride instead of
+    request order (r17 roofline chase — the batcher sorts its slot
+    unions before dispatch).  A program compiled with the promise must
+    never be fed unsorted indices.
     """
-    sel = jnp.take(plane, row_idx, axis=-2)
+    sel = jnp.take(plane, row_idx, axis=-2,
+                   indices_are_sorted=sorted_idx)
     return count(sel)
 
 

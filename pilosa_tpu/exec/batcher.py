@@ -35,10 +35,28 @@ r12 changes (the roofline work, ISSUE 7):
   the window's outputs pack into ONE device array read with ONE
   device->host transfer — the window pays the per-read RPC floor once
   total, not once per kind/shape group.
+
+r17 changes (the solo-floor/roofline work, ISSUE 12):
+
+- **pipelined readback**: the collector hands each dispatched window
+  to a dedicated readback worker, so window N's device compute
+  overlaps window N-1's packed read instead of serializing behind it
+  (``pipeline_depth`` bounds run-ahead; ``dispatch_pipeline_depth`` /
+  ``readback_overlap_ratio`` on /metrics);
+- **solo fast lane**: a width-1 request with no queue pressure skips
+  window formation and dispatches inline on the CALLER's thread over
+  pre-bound operands (``solo_fastlane_hits_total``) — the attack on
+  the one-RPC-per-query solo floor;
+- **donated ping-pong chains**: the window and fast-lane dispatch
+  paths pass retired output buffers back as donated scratch
+  (``fused.PingPong``), so consecutive dispatches re-use two standing
+  output slots instead of allocating per window, and the selcounts
+  union gathers in SORTED slot order (ascending memory stride).
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 
@@ -80,7 +98,9 @@ class CountBatcher:
     ADAPT_MAX = 0.005
 
     def __init__(self, fused, window_s="adaptive", max_batch: int = 64,
-                 stats=None):
+                 stats=None, pipeline_depth: int = 2,
+                 solo_fastlane: bool = True):
+        from pilosa_tpu.exec.fused import PingPong
         from pilosa_tpu.obs import NopStats
         from pilosa_tpu.obs.metrics import (BYTE_BUCKETS, COUNT_BUCKETS,
                                             RATIO_BUCKETS)
@@ -96,11 +116,50 @@ class CountBatcher:
         self.stats.set_buckets("batcher_window_items", COUNT_BUCKETS)
         self.stats.set_buckets("batcher_window_fill_ratio", RATIO_BUCKETS)
         self.stats.set_buckets("kernel_window_bytes", BYTE_BUCKETS)
+        self.stats.set_buckets("readback_overlap_ratio", RATIO_BUCKETS)
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._kick = threading.Event()
         self._thread: threading.Thread | None = None
         self._pool = None  # persistent group-dispatch pool (lazy)
+        # pipelined readback (r17 tentpole): the collector hands each
+        # dispatched window to a dedicated readback worker, so window
+        # N's device compute overlaps window N-1's packed device->host
+        # read instead of serializing behind it.  ``pipeline_depth``
+        # bounds dispatched-but-unread windows via the _pipe_slots
+        # semaphore (taken before a window dispatches, released when
+        # its readback finishes); depth <= 1 restores the pre-r17
+        # inline readback.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._readq: queue.Queue | None = (
+            queue.Queue() if self.pipeline_depth > 1 else None)
+        # the actual run-ahead bound: a slot is taken BEFORE a
+        # window's groups dispatch and released when its readback
+        # finishes, so dispatched-but-unread windows can never exceed
+        # pipeline_depth (a queue-size bound alone would let the
+        # collector dispatch one extra window past the put)
+        self._pipe_slots = threading.Semaphore(self.pipeline_depth)
+        self._read_thread: threading.Thread | None = None
+        # dispatched-but-unread windows; collector increments, reader
+        # decrements — locked, a lost update would permanently skew
+        # the depth gauge and the overlap observations
+        self._inflight_windows = 0
+        self._pipe_lock = threading.Lock()
+        # solo fast lane (r17 tentpole): with no queue pressure, a
+        # width-1 request skips window formation entirely and rides a
+        # pre-bound dispatch chain on the CALLER's thread — no enqueue,
+        # no worker wakeup, no cross-thread event round-trip
+        self.solo_fastlane = bool(solo_fastlane)
+        # concurrent fast-lane dispatches in flight: the lane admits
+        # only when it is ZERO, so overlapping callers fall into the
+        # collection window instead — that pile-up is the adaptive
+        # window's pressure signal, and coalescing (dedupe + one scan
+        # per window) must keep winning under real concurrency
+        self._fl_active = 0
+        self._fl_lock = threading.Lock()
+        # donated ping-pong output chains shared by the windowed and
+        # fast-lane dispatch paths (see fused.PingPong)
+        self._pp = PingPong()
 
     def _group_pool(self):
         # persistent: a pool built and torn down per collection window
@@ -143,16 +202,146 @@ class CountBatcher:
     def _submit(self, p: _Pending):
         return self.wait(self._enqueue(p))
 
+    # -- solo fast lane (r17) ------------------------------------------------
+
+    def _fl_try_enter(self) -> bool:
+        """Atomically admit ONE fast-lane dispatch: fast lane enabled,
+        adaptive window currently snapped to 0 (traffic is solo —
+        under queue pressure the window grows and coalescing wins),
+        nothing already queued to join, and no other fast-lane
+        dispatch in flight — the admission check and the in-flight
+        increment happen under one lock, so two simultaneous callers
+        can never both take the lane (the loser lands in the window,
+        which is the adaptive pressure signal).  A True return must
+        be paired with :meth:`_fl_leave`."""
+        if not (self.solo_fastlane and self.adaptive
+                and self._win == 0.0 and not self._queue):
+            return False
+        with self._fl_lock:
+            if self._fl_active:
+                return False
+            self._fl_active += 1
+        return True
+
+    def _fl_leave(self) -> None:
+        with self._fl_lock:
+            self._fl_active -= 1
+
+    def _fastlane_done(self, kind: str, nbytes: int) -> None:
+        # NO kernel_dispatch_seconds here: that family observes
+        # enqueue-only on the windowed path (the read is deferred to
+        # the packed readback), while a fast-lane call spans dispatch
+        # PLUS the host read — mixing the two would corrupt the
+        # compile-spike/enqueue-floor analysis the metric exists for.
+        # Fast-lane latency is visible end-to-end in query_seconds /
+        # query_stage_seconds.
+        self.stats.count("solo_fastlane_hits_total", 1, kind=kind)
+        if nbytes:
+            self.stats.count("kernel_bytes_scanned_total", nbytes,
+                             kind=kind)
+
+    def _fastlane_counts(self, nodes: tuple, leaves: tuple):
+        """One request's Count run dispatched inline on the caller
+        thread: same padding rule as the windowed `_dispatch_counts`
+        (offset-0 single item), donated ping-pong scratch for the
+        int32[K_pad, S] output.  None = fall back to the window."""
+        from pilosa_tpu.exec.fused import pow2_bucket
+        try:
+            padded = tuple(nodes) + (nodes[0],) * (
+                pow2_bucket(len(nodes)) - len(nodes))
+            scratch = self._pp.scratch(
+                (len(padded), leaves[0].shape[0]), "int32")
+            out = self.fused.run_count_batch(padded, leaves,
+                                             scratch=scratch)
+            host = np.asarray(out).astype(np.int64)
+            self._pp.retire(out)
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            return None
+        self._fastlane_done("count",
+                            sum(getattr(a, "nbytes", 0) for a in leaves))
+        return [int(row.sum()) for row in host[:len(nodes)]]
+
+    def _fastlane_selected(self, plane, slots: tuple, delta):
+        """Width-N selected counts inline: sorted-unique slot gather
+        (ascending stride), pre-bound device slot indices, donated
+        int32[bucket] output slot.  None = fall back to the window."""
+        from pilosa_tpu.exec.fused import pow2_bucket
+        order = sorted(set(slots))
+        pos = {s: i for i, s in enumerate(order)}
+        try:
+            scratch = self._pp.scratch(
+                (pow2_bucket(len(order)),), "int32")
+            out = self.fused.run_selected_counts(
+                plane, tuple(order), delta=delta, scratch=scratch,
+                sorted_idx=True)
+            host = np.asarray(out).astype(np.int64)
+            self._pp.retire(out)
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            return None
+        nbytes = (len(order) * plane.shape[0] * plane.shape[-1] * 4
+                  + (delta.nbytes if delta is not None else 0))
+        self._fastlane_done("selcounts", nbytes)
+        return host[[pos[s] for s in slots]]
+
+    def _fastlane_rowcounts(self, plane, filter_words, delta):
+        try:
+            if delta is not None:
+                out = self.fused.run_rowcounts_delta(
+                    plane, delta, filter_words=filter_words)
+                host = np.asarray(out).astype(np.int64)
+            else:
+                flags = (filter_words is not None,)
+                leaves = ((plane,) if filter_words is None
+                          else (plane, filter_words))
+                scratch = self._pp.scratch((1, plane.shape[-2]),
+                                           "int32")
+                out = self.fused.run_rowcounts_batch(flags, leaves,
+                                                     scratch=scratch)
+                host = np.asarray(out).astype(np.int64)[0]
+                self._pp.retire(out)
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            return None
+        self._fastlane_done(
+            "rowcounts",
+            plane.nbytes + (getattr(filter_words, "nbytes", 0) or 0)
+            + (delta.nbytes if delta is not None else 0))
+        return host
+
+    def _fastlane_tree(self, plane, slots: tuple, prog: tuple,
+                       extras: tuple, delta):
+        try:
+            out = self.fused.run_tree_counts(plane, tuple(slots),
+                                             (tuple(prog),),
+                                             tuple(extras), delta=delta)
+            val = int(np.asarray(out).astype(np.int64)[0])
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            return None
+        nbytes = (len(slots) * plane.shape[0] * plane.shape[-1] * 4
+                  + sum(getattr(a, "nbytes", 0) for a in extras)
+                  + (delta.nbytes if delta is not None else 0))
+        self._fastlane_done("tree", nbytes)
+        return val
+
+    # -- blocking submits ----------------------------------------------------
+
     def submit(self, node, leaves) -> int:
         """Block until the coalesced batch containing this Count runs;
         returns the host-finished int64 total."""
-        return self._submit(_Pending("count", (node,), tuple(leaves)))[0]
+        return self.submit_many((node,), leaves)[0]
 
     def submit_many(self, nodes, leaves) -> list[int]:
         """A whole request's Count run as ONE batch item (the nodes
         share one leaf list); N concurrent requests coalesce into one
         program regardless of how many Counts each carries."""
-        return self._submit(_Pending("count", tuple(nodes), tuple(leaves)))
+        nodes, leaves = tuple(nodes), tuple(leaves)
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_counts(nodes, leaves)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
+        return self._submit(_Pending("count", nodes, leaves))
 
     def submit_sum(self, plane, filter_words) -> tuple[int, int]:
         """BSI Sum: (sum of offsets, non-null count), host-finished."""
@@ -172,6 +361,14 @@ class CountBatcher:
         one computation.  ``delta`` (the plane's DeltaOverlay) makes
         the answer base⊕delta — items over the same (plane, overlay)
         pair still dedupe to one scan."""
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_rowcounts(plane, filter_words,
+                                               delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
         return self.wait(self.enqueue_rowcounts(plane, filter_words,
                                                 delta))
 
@@ -193,6 +390,14 @@ class CountBatcher:
         back int64[len(slots)] in the caller's slot order.  Duplicate
         slots across concurrent requests are computed once.  ``delta``
         merges the plane's pending write overlay at dispatch time."""
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_selected(plane, tuple(slots),
+                                              delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
         return self._submit(_Pending("selcounts", tuple(slots), (plane,),
                                      delta=delta))
 
@@ -204,6 +409,14 @@ class CountBatcher:
         every item's postfix program in one fused dispatch — N
         concurrent compound queries cost one memory pass and join the
         window's single packed readback."""
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_tree(plane, slots, prog, extras,
+                                          delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
         return self.wait(self.enqueue_tree(plane, slots, prog, extras,
                                            delta))
 
@@ -302,6 +515,15 @@ class CountBatcher:
                         self._run_distinct, group))
                 else:
                     program_groups.append((key, group))
+            # run-ahead bound BEFORE dispatching: at pipeline_depth
+            # dispatched-but-unread windows the collector waits here,
+            # so device output held by in-flight windows never exceeds
+            # the documented knob
+            slot_held = False
+            if self._readq is not None and (program_groups
+                                            or distinct_futs):
+                self._pipe_slots.acquire()
+                slot_held = True
             t_disp = time.perf_counter()
             if len(program_groups) == 1:
                 # the common (and solo-path) case skips the pool
@@ -327,9 +549,11 @@ class CountBatcher:
                         self._run_fallback(key, group)
             # bytes the window's fused programs read from HBM (r14):
             # per-kind scan-volume counters feed capacity math, and
-            # bytes / (dispatch -> readback-complete) is the LIVE
-            # bandwidth the config23 roofline bench measures offline —
-            # the gauge tracks how far serving sits from that roof
+            # bytes / (readback-start -> readback-complete) is the
+            # LIVE bandwidth the config23 roofline bench measures
+            # offline — the gauge tracks how far serving sits from
+            # that roof (see _finish_window for why the clock starts
+            # at the read, not the dispatch)
             win_bytes = 0
             for key, group, _, _ in pending:
                 nbytes = self._group_bytes(key[0], group)
@@ -337,18 +561,73 @@ class CountBatcher:
                     self.stats.count("kernel_bytes_scanned_total",
                                      nbytes, kind=key[0])
                     win_bytes += nbytes
-            self._readback(pending)
-            if win_bytes:
-                # per-window scan-volume distribution (byte-scale
-                # buckets) + the live bandwidth the window achieved
-                self.stats.observe("kernel_window_bytes",
-                                   float(win_bytes))
-                wall = time.perf_counter() - t_disp
-                if wall > 0:
-                    self.stats.gauge("kernel_bandwidth_gbps",
-                                     round(win_bytes / wall / 1e9, 4))
-            for f in distinct_futs:
+            item = (pending, distinct_futs, win_bytes)
+            if slot_held and (pending or distinct_futs):
+                # PIPELINED READBACK (r17): hand the dispatched window
+                # to the readback worker and immediately collect the
+                # next one — window N's device compute overlaps window
+                # N-1's packed device->host read.
+                with self._pipe_lock:
+                    overlapped = self._inflight_windows > 0
+                    self._inflight_windows += 1
+                    depth = self._inflight_windows
+                self.stats.observe("readback_overlap_ratio",
+                                   1.0 if overlapped else 0.0)
+                self.stats.gauge("dispatch_pipeline_depth", depth)
+                self._ensure_reader()
+                self._readq.put(item)
+            else:
+                if slot_held:  # every dispatch fell back: nothing to read
+                    self._pipe_slots.release()
+                self._finish_window(item)
+
+    def _ensure_reader(self) -> None:
+        if self._read_thread is None or not self._read_thread.is_alive():
+            self._read_thread = threading.Thread(
+                target=self._read_loop, name="pilosa-batch-readback",
+                daemon=True)
+            self._read_thread.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            item = self._readq.get()
+            try:
+                self._finish_window(item)
+            except Exception:  # noqa: BLE001 — per-item state is set by
+                pass           # _readback's fallbacks; the worker lives on
+            finally:
+                with self._pipe_lock:
+                    self._inflight_windows -= 1
+                    depth = self._inflight_windows
+                self.stats.gauge("dispatch_pipeline_depth", depth)
+                self._pipe_slots.release()
+
+    def _finish_window(self, item) -> None:
+        """Read one dispatched window back and finish its items — the
+        half of the old loop tail that runs on the readback worker
+        when pipelining is on (inline when off)."""
+        pending, distinct_futs, win_bytes = item
+        # bandwidth wall clock starts HERE, not at dispatch: a
+        # pipelined window's queue wait overlaps the previous window's
+        # read (the feature working as intended) and must not deflate
+        # the gauge — the read itself still blocks on any residual
+        # compute, so bytes/wall remains the live achieved bandwidth
+        t0 = time.perf_counter()
+        self._readback(pending)
+        if win_bytes:
+            # per-window scan-volume distribution (byte-scale
+            # buckets) + the live bandwidth the window achieved
+            self.stats.observe("kernel_window_bytes",
+                               float(win_bytes))
+            wall = time.perf_counter() - t0
+            if wall > 0:
+                self.stats.gauge("kernel_bandwidth_gbps",
+                                 round(win_bytes / wall / 1e9, 4))
+        for f in distinct_futs:
+            try:
                 f.result()
+            except Exception:  # noqa: BLE001 — _run_distinct sets its
+                pass           # items' events/errors itself
 
     def _dispatch_one(self, key, group):
         """Build + enqueue one group's fused program; returns
@@ -444,19 +723,29 @@ class CountBatcher:
                 finish(np.asarray(out))
             except Exception:  # noqa: BLE001 — per-item fallback
                 self._run_fallback(key, group)
+            else:
+                # only after a delivered finish (which copied): a
+                # retire failure must never re-run a group whose
+                # results callers are already reading
+                self._pp.retire(out)
             return
         # canonical pack order: groups arrive in batch order, so the
         # same kinds in a different order would otherwise compile a
         # fresh concatenate program per PERMUTATION of shapes —
         # churning the shared program LRU for zero benefit
         pending.sort(key=lambda item: (item[0][0], str(item[2].shape)))
+        packed_dev = None
         try:
-            packed = np.asarray(self.fused.run_readback_pack(
-                tuple(out for _, _, out, _ in pending)))
+            total = sum(int(np.prod(out.shape, dtype=np.int64))
+                        for _, _, out, _ in pending)
+            packed_dev = self.fused.run_readback_pack(
+                tuple(out for _, _, out, _ in pending),
+                scratch=self._pp.scratch((total,), "int32"))
+            packed = np.asarray(packed_dev)
             self.stats.count("batcher_readback_packed", 1)
             self.stats.count("batcher_readback_groups", len(pending))
         except Exception:  # noqa: BLE001 — per-group reads
-            packed = None
+            packed = packed_dev = None
         off = 0
         for key, group, out, finish in pending:
             try:
@@ -469,6 +758,9 @@ class CountBatcher:
                 finish(host)
             except Exception:  # noqa: BLE001 — per-item fallback
                 self._run_fallback(key, group)
+        # every finish copied out of `packed` (astype/int/fancy-index),
+        # so the packed device buffer can re-enter the donated chain
+        self._pp.retire(packed_dev)
 
     def _dispatch_counts(self, group: list[_Pending]):
         from pilosa_tpu.exec.fused import pow2_bucket, shift_leaves
@@ -484,7 +776,10 @@ class CountBatcher:
         n = len(all_nodes)
         all_nodes.extend([all_nodes[0]] * (pow2_bucket(n) - n))
         per_shard = self.fused.run_count_batch(
-            tuple(all_nodes), tuple(all_leaves))
+            tuple(all_nodes), tuple(all_leaves),
+            scratch=self._pp.scratch(
+                (len(all_nodes), group[0].leaves[0].shape[0]),
+                "int32"))
 
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
@@ -512,15 +807,18 @@ class CountBatcher:
         the multi-query analogue of the rowcounts dedup), popcount,
         reduce shards on device.  The group key carries the delta
         identity, so every item here shares one (plane, overlay) pair
-        and the merge happens once for the union."""
+        and the merge happens once for the union.  The union gathers
+        in SORTED slot order (ascending memory stride, r17) with a
+        donated ping-pong output slot."""
+        from pilosa_tpu.exec.fused import pow2_bucket
         plane = group[0].leaves[0]
-        pos: dict[int, int] = {}
-        for p in group:
-            for s in p.nodes:
-                if s not in pos:
-                    pos[s] = len(pos)
-        out = self.fused.run_selected_counts(plane, tuple(pos),
-                                             delta=group[0].delta)
+        order = sorted({s for p in group for s in p.nodes})
+        pos = {s: i for i, s in enumerate(order)}
+        out = self.fused.run_selected_counts(
+            plane, tuple(order), delta=group[0].delta,
+            scratch=self._pp.scratch((pow2_bucket(len(order)),),
+                                     "int32"),
+            sorted_idx=True)
 
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
@@ -626,7 +924,10 @@ class CountBatcher:
                                        - len(items))
         flags = tuple(len(p.leaves) == 2 for p in padded)
         leaves = tuple(a for p in padded for a in p.leaves)
-        out = self.fused.run_rowcounts_batch(flags, leaves)
+        out = self.fused.run_rowcounts_batch(
+            flags, leaves,
+            scratch=self._pp.scratch(
+                (len(flags), leaves[0].shape[-2]), "int32"))
 
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)

@@ -266,7 +266,9 @@ class Executor:
                  max_concurrent: int = 8, plane_sidecars: bool = True,
                  delta_cells: int = 65536,
                  delta_compact_fraction: float = 0.5,
-                 tree_fusion: bool = True):
+                 tree_fusion: bool = True,
+                 dispatch_pipeline_depth: int = 2,
+                 solo_fastlane: bool = True):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -276,7 +278,13 @@ class Executor:
         ``count_batch_window``: ``"adaptive"`` (default) coalesces
         concurrent dense reads with a window that grows under queue
         pressure and shrinks to 0 when solo; a float fixes the window
-        (pre-r6 behavior); 0 disables coalescing."""
+        (pre-r6 behavior); 0 disables coalescing.
+        ``dispatch_pipeline_depth`` (r17): dispatched-but-unread
+        collection windows the batcher may run ahead (window N's
+        compute overlaps window N-1's readback); <=1 restores serial
+        dispatch->read.  ``solo_fastlane`` (r17): width-1 requests
+        with no queue pressure dispatch inline on the caller thread
+        over donated ping-pong chains instead of forming a window."""
         self.holder = holder
         self.translate = translate or TranslateStore(holder.path)
         self.placement = placement
@@ -323,8 +331,10 @@ class Executor:
                         f"number of seconds, or 'off', got {window!r}")
         if window == "adaptive" or window > 0:
             from pilosa_tpu.exec.batcher import CountBatcher
-            self.batcher = CountBatcher(self.fused, window_s=window,
-                                        stats=self.stats)
+            self.batcher = CountBatcher(
+                self.fused, window_s=window, stats=self.stats,
+                pipeline_depth=dispatch_pipeline_depth,
+                solo_fastlane=solo_fastlane)
         # query-plan cache (r6 tentpole): (index, normalized PQL,
         # shards, translate flag) -> planned tree + leaf specs, so a
         # repeated serving shape skips parse AND plan entirely (PQL
@@ -700,12 +710,19 @@ class Executor:
         if timer is not None:
             timer.mark("plan")
         if self.batcher is not None:
-            # enqueue ALL trees before waiting on any: the whole
-            # request lands in one collection window
-            handles = [self.batcher.enqueue_tree(ps.plane, *item,
-                                                 delta=ps.delta)
-                       for ps, item in resolved]
-            out = [self.batcher.wait(h) for h in handles]
+            if len(resolved) == 1:
+                # single tree: the blocking submit rides the solo fast
+                # lane when traffic is solo (inline dispatch, no window)
+                ps, item = resolved[0]
+                out = [self.batcher.submit_tree(ps.plane, *item,
+                                                delta=ps.delta)]
+            else:
+                # enqueue ALL trees before waiting on any: the whole
+                # request lands in one collection window
+                handles = [self.batcher.enqueue_tree(ps.plane, *item,
+                                                     delta=ps.delta)
+                           for ps, item in resolved]
+                out = [self.batcher.wait(h) for h in handles]
             if timer is not None:
                 timer.mark("read")  # coalesced window+dispatch+read
             return out

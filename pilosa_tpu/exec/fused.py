@@ -116,6 +116,59 @@ def shift_leaves(node, offset: int):
     raise AssertionError(f"bad node {node!r}")
 
 
+class PingPong:
+    """Retired-output pool for donated dispatch chains (r17).
+
+    The chain families (selected counts, rowcounts batches, the
+    window's readback pack) pass a RETIRED output buffer back as a
+    donated scratch argument, so consecutive dispatches reuse its
+    device memory instead of allocating a fresh output per window.
+    Depth 2 per (shape, dtype) — ping-pong — so window N can dispatch
+    against one buffer while window N-1's readback still owns the
+    other; a buffer is only retired AFTER its host read completed
+    (every consumer copies out), so donating it can never clobber
+    bytes a reader still wants.
+
+    ``scratch`` POPS (the same buffer must never reach two concurrent
+    dispatches); returns None when no retired buffer of that shape
+    exists — callers then run the un-donated program variant.  The
+    pool is bounded (``MAX_SHAPES`` shapes LRU) so churning window
+    shapes cannot pin arbitrary device memory."""
+
+    MAX_SHAPES = 8
+    DEPTH = 2
+
+    def __init__(self):
+        import threading
+        from collections import OrderedDict
+        self._pools: "OrderedDict[tuple, list]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def scratch(self, shape: tuple, dtype) -> "jax.Array | None":
+        key = (tuple(shape), str(dtype))
+        with self._lock:
+            pool = self._pools.get(key)
+            if pool:
+                self._pools.move_to_end(key)
+                return pool.pop()
+        return None
+
+    def retire(self, arr) -> None:
+        """Hand a read-back output's device buffer to the pool.  The
+        caller must not touch ``arr`` again — a later dispatch may
+        donate (invalidate) it."""
+        if arr is None:
+            return
+        key = (tuple(arr.shape), str(arr.dtype))
+        with self._lock:
+            pool = self._pools.setdefault(key, [])
+            self._pools.move_to_end(key)
+            if len(pool) < self.DEPTH:
+                pool.append(arr)
+            while len(self._pools) > self.MAX_SHAPES:
+                self._pools.popitem(last=False)
+
+
 def _pad_skeleton(prog: tuple) -> tuple:
     """A postfix program's STATIC opcode skeleton, NOP-padded to the
     pow2 length bucket — the one bucketing rule every tree entry
@@ -154,6 +207,7 @@ class FusedCache:
         from pilosa_tpu.exec._lru import Stamps
         from pilosa_tpu.obs import NopStats
         self._programs: dict = {}     # key -> jitted fn (GIL-atomic reads)
+        self._idx_cache: dict = {}    # padded slot tuple -> device int32
         self._stamps = Stamps()       # approx-LRU recency (lock-free touch)
         self._lock = threading.Lock()       # insert / evict only
         self._compiling: dict = {}          # key -> per-key compile lock
@@ -194,7 +248,7 @@ class FusedCache:
         if evicted:
             self._stats.count("fused_programs_evicted_total", evicted)
 
-    def _cached(self, key, build):
+    def _cached(self, key, build, donate: tuple = ()):
         fn = self._get_fast(key)
         if fn is not None:
             return fn
@@ -204,7 +258,13 @@ class FusedCache:
         with lock:
             fn = self._programs.get(key)
             if fn is None:
-                fn = jax.jit(build())
+                # ``donate``: argument positions donated to the
+                # program (the r17 ping-pong scratch slots) — XLA
+                # aliases the output onto the donated buffer, so a
+                # chained dispatch writes into the retired output of
+                # two windows ago instead of allocating.  Donation is
+                # part of the program, hence part of the key.
+                fn = jax.jit(build(), donate_argnums=donate)
                 self._insert(key, fn)
         return fn
 
@@ -226,19 +286,30 @@ class FusedCache:
 
         return self._cached(key, build)(*leaves)
 
-    def run_count_batch(self, nodes: tuple, leaves):
+    def run_count_batch(self, nodes: tuple, leaves, scratch=None):
         """K Count trees in ONE program: returns int32[K, n_shards] —
         one dispatch and one host read amortize fixed per-read costs
         across every Count in the request (critical on transports with
-        a per-read floor; see BASELINE.md)."""
+        a per-read floor; see BASELINE.md).  ``scratch`` (r17): a
+        retired int32[K, n_shards] output to donate for the
+        chained-dispatch form."""
+        n_leaves = len(leaves)
+        out_shape = (len(nodes), leaves[0].shape[0])
+        donate_ok = (scratch is not None
+                     and tuple(scratch.shape) == out_shape)
+
         def build():
             def program(*ls):
                 return jnp.stack([kernels.count(_build(n, ls))
                                   for n in nodes])
             return program
-        return self._cached((nodes, "count-batch"), build)(*leaves)
+        key = ((nodes, donate_ok), "count-batch")
+        if donate_ok:
+            return self._cached(key, build,
+                                donate=(n_leaves,))(*leaves, scratch)
+        return self._cached(key, build)(*leaves)
 
-    def run_rowcounts_batch(self, flags: tuple, leaves):
+    def run_rowcounts_batch(self, flags: tuple, leaves, scratch=None):
         """K whole-plane row-count items (same plane shape) in ONE
         program: per item, ``row_counts`` over the plane (AND a filter
         bitmap when flagged) reduced over the shard axis in int32 —
@@ -246,7 +317,14 @@ class FusedCache:
         ``flags[k]`` = item k has a filter leaf; leaves alternate
         plane[, filter] per item.  Returns int32[K, R_pad]: one stacked
         array = one read for the whole coalescing window (the dense
-        TopN / same-field count-batch serving spine)."""
+        TopN / same-field count-batch serving spine).  ``scratch``
+        (r17): a retired int32[K, R_pad] output to donate for the
+        chained-dispatch form."""
+        n_leaves = len(leaves)
+        out_shape = (len(flags), leaves[0].shape[-2])
+        donate_ok = (scratch is not None
+                     and tuple(scratch.shape) == out_shape)
+
         def build():
             def program(*ls):
                 rows = []
@@ -259,10 +337,33 @@ class FusedCache:
                                         axis=0, dtype=jnp.int32))
                 return jnp.stack(rows)
             return program
-        return self._cached(
-            (flags, leaves[0].shape, "rowcounts-batch"), build)(*leaves)
+        key = (flags, leaves[0].shape, donate_ok, "rowcounts-batch")
+        # (donate flag inside the key, tag kept LAST — callers
+        # introspect the program set by trailing tag)
+        if donate_ok:
+            return self._cached(key, build,
+                                donate=(n_leaves,))(*leaves, scratch)
+        return self._cached(key, build)(*leaves)
 
-    def run_selected_counts(self, plane, slots, delta=None) -> jax.Array:
+    # bounded device-resident slot-index cache (r17 solo fast lane):
+    # a repeating solo query shape re-dispatches the same slot tuple
+    # every request — keep its padded int32 operand resident so a
+    # chained dispatch never re-uploads (re-lays-out) the indices
+    _IDX_CACHE_MAX = 256
+
+    def _slot_idx(self, padded: tuple) -> jax.Array:
+        idx = self._idx_cache.get(padded)
+        if idx is None:
+            idx = jnp.asarray(padded, dtype=jnp.int32)
+            with self._lock:
+                self._idx_cache[padded] = idx
+                while len(self._idx_cache) > self._IDX_CACHE_MAX:
+                    self._idx_cache.pop(next(iter(self._idx_cache)))
+        return idx
+
+    def run_selected_counts(self, plane, slots, delta=None,
+                            scratch=None,
+                            sorted_idx: bool = False) -> jax.Array:
         """N selected-row Counts over one resident plane in ONE
         program: gather the requested rows, popcount, reduce the shard
         axis on device -> int32[N] (callers gate on the int32-exact
@@ -278,28 +379,49 @@ class FusedCache:
         ``delta`` (an ``ingest.delta.DeltaOverlay``) merges the
         plane's pending write cells at dispatch time (base⊕delta):
         the overlay arrays are traced operands, so one program serves
-        any overlay of the same pow2 cell bucket."""
+        any overlay of the same pow2 cell bucket.
+
+        ``scratch`` (r17): a retired int32[bucket] output buffer to
+        donate — the chained-dispatch form (see :class:`PingPong`).
+        ``sorted_idx`` statically promises ascending slot order
+        (ascending-stride gather); the batcher's slot unions and the
+        solo fast lane sort before calling."""
         bucket = pow2_bucket(len(slots))
-        padded = tuple(slots) + (slots[0],) * (bucket - len(slots))
-        idx = jnp.asarray(padded, dtype=jnp.int32)
+        # pad with the LAST slot, not slot 0: keeps the padded tuple
+        # non-decreasing when the live slots are sorted
+        padded = tuple(slots) + (slots[-1],) * (bucket - len(slots))
+        idx = self._slot_idx(padded)
+        donate_ok = (scratch is not None
+                     and tuple(scratch.shape) == (bucket,))
         if delta is not None:
             from pilosa_tpu.ingest.delta import adjusted_selected_counts
             key = (("selcounts-delta", plane.shape, bucket,
-                    delta.rows.shape[0]), "count")
+                    delta.rows.shape[0], sorted_idx, donate_ok),
+                   "count")
 
             def build_delta():
-                def program(p, ix, dr, dw, dv):
-                    return adjusted_selected_counts(p, ix, dr, dw, dv)
+                def program(p, ix, dr, dw, dv, *sc):
+                    return adjusted_selected_counts(
+                        p, ix, dr, dw, dv, sorted_idx=sorted_idx)
                 return program
-            return self._cached(key, build_delta)(
-                plane, idx, delta.rows, delta.words, delta.vals)
+            args = (plane, idx, delta.rows, delta.words, delta.vals)
+            if donate_ok:
+                return self._cached(key, build_delta,
+                                    donate=(5,))(*args, scratch)
+            return self._cached(key, build_delta)(*args)
 
         def build():
-            def program(p, ix):
-                return jnp.sum(kernels.selected_row_counts(p, ix),
-                               axis=0, dtype=jnp.int32)
+            def program(p, ix, *sc):
+                return jnp.sum(
+                    kernels.selected_row_counts(p, ix,
+                                                sorted_idx=sorted_idx),
+                    axis=0, dtype=jnp.int32)
             return program
-        key = (("selcounts", plane.shape, bucket), "count")
+        key = (("selcounts", plane.shape, bucket, sorted_idx,
+                donate_ok), "count")
+        if donate_ok:
+            return self._cached(key, build, donate=(2,))(plane, idx,
+                                                         scratch)
         return self._cached(key, build)(plane, idx)
 
     def run_rowcounts_delta(self, plane, delta, filter_words=None,
@@ -372,7 +494,7 @@ class FusedCache:
                 return jnp.moveaxis(sel, -2, 0)      # [G_pad, S, W]
             return program
 
-        args = (plane, jnp.asarray(padded, dtype=jnp.int32))
+        args = (plane, self._slot_idx(tuple(padded)))
         if has_delta:
             args += (delta.rows, delta.words, delta.vals)
         return self._tree_cached(key, build)(*args)
@@ -406,8 +528,8 @@ class FusedCache:
             return program
 
         args = (rows,
-                jnp.asarray(np.asarray(row_args or [0], np.int32)),
-                jnp.asarray(np.asarray(ex_args or [0], np.int32)))
+                self._slot_idx(tuple(row_args) or (0,)),
+                self._slot_idx(tuple(ex_args) or (0,)))
         if has_ex:
             args += (ex_stack,)
         return self._tree_cached(key, build)(*args)
@@ -463,9 +585,12 @@ class FusedCache:
                                dtype=jnp.int32)[None]
             return program
 
+        # push/extra args ride the device-resident idx cache: a
+        # repeating solo tree shape re-binds ZERO operands per dispatch
+        # (the pre-bound chain the r17 fast lane rides)
         args = (plane,
-                jnp.asarray(np.asarray(row_args or [0], np.int32)),
-                jnp.asarray(np.asarray(ex_args or [0], np.int32)))
+                self._slot_idx(tuple(row_args) or (0,)),
+                self._slot_idx(tuple(ex_args) or (0,)))
         if has_delta:
             args += (delta.rows, delta.words, delta.vals)
         args += tuple(extras)
@@ -519,19 +644,32 @@ class FusedCache:
         return self._tree_program(plane, slots, (prog,), extras, delta,
                                   "words")
 
-    def run_readback_pack(self, arrays: tuple) -> jax.Array:
+    def run_readback_pack(self, arrays: tuple,
+                          scratch=None) -> jax.Array:
         """Concatenate the flattened int32 outputs of a collection
         window's programs into ONE device array — the whole window
         then costs a single device->host read instead of one per
         kind/shape group (on transports with a fixed per-read RPC
-        floor, the read count IS the serving floor; BASELINE.md)."""
+        floor, the read count IS the serving floor; BASELINE.md).
+        ``scratch`` (r17): a retired packed output of the same total
+        size to donate — consecutive windows of the same shape mix
+        ping-pong through two standing packed buffers instead of
+        allocating one per window."""
         shapes = tuple(a.shape for a in arrays)
+        total = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+        donate_ok = (scratch is not None
+                     and tuple(scratch.shape) == (total,))
 
         def build():
             def program(*xs):
-                return jnp.concatenate([x.reshape(-1) for x in xs])
+                return jnp.concatenate(
+                    [x.reshape(-1) for x in xs[:len(shapes)]])
             return program
-        return self._cached((shapes, "readback-pack"), build)(*arrays)
+        key = (shapes, donate_ok, "readback-pack")
+        if donate_ok:
+            return self._cached(key, build,
+                                donate=(len(arrays),))(*arrays, scratch)
+        return self._cached(key, build)(*arrays)
 
     def run_sum_batch(self, flags: tuple, leaves):
         """K BSI Sum items (same bit depth) in ONE program.  ``flags[k]``

@@ -1310,9 +1310,11 @@ class PlaneCache:
         swap the entry to a clean PlaneSet at the new generations —
         the compaction step.  Retries when a concurrent absorb swaps
         the entry mid-fold (under sustained writes the race is the
-        common case, and giving up would force a spurious rebuild).
+        common case, and giving up would force a spurious rebuild; on
+        a starved CPU the swaps come slower than the retries, so the
+        bound is sized for an oversubscribed box, not the happy path).
         None = the gap genuinely isn't coverable (rebuild)."""
-        for _ in range(4):
+        for _ in range(8):
             out = self._fold_once(key, field, view_name, shards, hit)
             if out is not self._RACED:
                 return out

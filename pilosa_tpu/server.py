@@ -107,7 +107,9 @@ class PilosaTPUServer:
             plane_sidecars=self.cfg.plane_sidecars,
             delta_cells=self.cfg.delta_buffer_cells,
             delta_compact_fraction=self.cfg.delta_compact_fraction,
-            tree_fusion=self.cfg.tree_fusion)
+            tree_fusion=self.cfg.tree_fusion,
+            dispatch_pipeline_depth=self.cfg.dispatch_pipeline_depth,
+            solo_fastlane=self.cfg.solo_fastlane)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

@@ -1,0 +1,369 @@
+"""r17 tentpole: persistent dispatch pipeline + donated ping-pong
+chains — correctness pins.
+
+Donation reuses a retired output's device memory for a later
+dispatch's output, the readback pipeline lets window N dispatch while
+window N-1 is still being read, and the solo fast lane binds standing
+operand/output slots per plane.  Every one of those is an aliasing
+hazard class: a donated buffer serving a result someone still reads, a
+standing slot surviving a plane generation swap, a delta overlay
+merged onto a donated output.  These tests pin each of them
+oracle-exact — a reuse-after-swap bug must die here, not in a bench.
+"""
+
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine import kernels
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.fused import FusedCache, PingPong
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.store import Holder
+
+WORDS = SHARD_WIDTH // 32
+
+
+def _np_row_counts(plane: np.ndarray) -> np.ndarray:
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+    return np.array([int(np.unpackbits(
+        plane[:, r].reshape(-1).view(np.uint8)).sum())
+        for r in range(plane.shape[1])], dtype=np.int64)
+
+
+def _counter(stats, name: str) -> int:
+    return int(sum(stats.snapshot()["counters"].get(name, {}).values()))
+
+
+class TestPingPong:
+    def test_scratch_pops_and_retire_bounds(self):
+        pp = PingPong()
+        a = jnp.zeros(4, jnp.int32)
+        b = jnp.ones(4, jnp.int32)
+        c = jnp.full(4, 2, jnp.int32)
+        for arr in (a, b, c):
+            pp.retire(arr)
+        # depth 2: c was dropped, and each scratch hands a buffer out
+        # exactly once (the same buffer must never reach two dispatches)
+        s1 = pp.scratch((4,), "int32")
+        s2 = pp.scratch((4,), "int32")
+        assert s1 is not None and s2 is not None and s1 is not s2
+        assert pp.scratch((4,), "int32") is None
+        # unknown shapes miss instead of handing back a wrong buffer
+        assert pp.scratch((8,), "int32") is None
+
+    def test_shape_lru_bounded(self):
+        pp = PingPong()
+        for i in range(PingPong.MAX_SHAPES + 3):
+            pp.retire(jnp.zeros(i + 1, jnp.int32))
+        assert len(pp._pools) <= PingPong.MAX_SHAPES
+
+
+class TestDonatedChainExact:
+    def test_selected_counts_donated_chain_no_leak(self):
+        """A chain of donated dispatches over CHANGING slot sets and
+        planes: every answer must match numpy — stale bytes from the
+        donated buffer (the previous window's counts) must never
+        surface."""
+        rng = np.random.default_rng(42)
+        fused = FusedCache()
+        pp = PingPong()
+        planes = [rng.integers(0, 1 << 32, size=(2, 8, 64),
+                               dtype=np.uint32) for _ in range(3)]
+        devs = [jnp.asarray(p) for p in planes]
+        oracles = [np.bitwise_count(p).sum(axis=(0, 2), dtype=np.int64)
+                   if hasattr(np, "bitwise_count") else
+                   _np_row_counts(p) for p in planes]
+        slot_sets = [(0,), (1, 3), (0, 2, 5, 7), (4,), (1, 3), (0,)]
+        for step in range(24):
+            k = step % len(planes)
+            slots = slot_sets[step % len(slot_sets)]
+            from pilosa_tpu.exec.fused import pow2_bucket
+            scratch = pp.scratch((pow2_bucket(len(slots)),), "int32")
+            out = fused.run_selected_counts(devs[k], slots,
+                                            scratch=scratch,
+                                            sorted_idx=True)
+            host = np.asarray(out).astype(np.int64)
+            pp.retire(out)
+            np.testing.assert_array_equal(
+                host[:len(slots)], oracles[k][list(slots)],
+                err_msg=f"step {step}: donated chain leaked")
+
+    def test_count_batch_donated_chain_no_leak(self):
+        rng = np.random.default_rng(7)
+        fused = FusedCache()
+        pp = PingPong()
+        rows = [jnp.asarray(rng.integers(0, 1 << 32, size=(3, 32),
+                                         dtype=np.uint32))
+                for _ in range(4)]
+        wants = [int(np.bitwise_count(np.asarray(r)).sum())
+                 if hasattr(np, "bitwise_count") else
+                 int(np.unpackbits(np.asarray(r).view(np.uint8)).sum())
+                 for r in rows]
+        node = ("leaf", 0)
+        for step in range(16):
+            k = step % len(rows)
+            scratch = pp.scratch((1, 3), "int32")
+            out = fused.run_count_batch((node,), (rows[k],),
+                                        scratch=scratch)
+            host = np.asarray(out).astype(np.int64)
+            pp.retire(out)
+            assert int(host[0].sum()) == wants[k], f"step {step}"
+
+
+@pytest.fixture
+def served_index(tmp_path):
+    """A 2-shard, 16-row on-disk field (the test_multiquery recipe)."""
+    from pilosa_tpu.store import roaring
+
+    n_shards, n_rows = 2, 16
+    rng = np.random.default_rng(23)
+    plane = rng.integers(0, 1 << 32, size=(n_shards, n_rows, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("f")
+    h.close()
+    frag_dir = os.path.join(str(tmp_path), "i", "f", "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(n_shards):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+    holder = Holder(str(tmp_path)).open()
+    yield holder, _np_row_counts(plane), n_rows
+    holder.close()
+
+
+class TestSoloFastlane:
+    def test_solo_counts_ride_fastlane_exact(self, served_index):
+        holder, oracle, n_rows = served_index
+        stats = Stats()
+        ex = Executor(holder, stats=stats)
+        for r in (3, 3, 7, 3, 0, 15):
+            assert ex.execute("i", f"Count(Row(f={r}))") == \
+                [int(oracle[r])]
+        assert _counter(stats, "solo_fastlane_hits_total") >= 1, \
+            "solo Counts never took the fast lane"
+
+    def test_fastlane_off_knob(self, served_index):
+        holder, oracle, _ = served_index
+        stats = Stats()
+        ex = Executor(holder, stats=stats, solo_fastlane=False)
+        for r in (3, 5):
+            assert ex.execute("i", f"Count(Row(f={r}))") == \
+                [int(oracle[r])]
+        assert _counter(stats, "solo_fastlane_hits_total") == 0
+
+    def test_fastlane_after_write_and_generation_swap(self, tmp_path):
+        """The reuse-after-swap pin: a standing solo chain must serve
+        fresh truth after (a) a write absorbed into the delta overlay
+        (same base plane, new overlay identity) and (b) a fold that
+        REPLACES the base plane (generation swap — new array identity,
+        any pre-bound operand or donated slot keyed to the old plane
+        is dead).  delta_cells is tiny so step (b) happens within a
+        few writes."""
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        stats = Stats()
+        ex = Executor(holder, stats=stats, delta_cells=4,
+                      delta_compact_fraction=1.1)  # no async compactor:
+        # the overlay fills and the serving path folds inline — a
+        # deterministic mid-chain generation swap
+        want = 0
+        for c in range(6):
+            ex.execute("i", f"Set({c}, f=1)")
+            want += 1
+            # solo read immediately after every write: each one must
+            # observe the bit through whichever state the plane is in
+            # (fresh build / base⊕delta / folded base)
+            assert ex.execute("i", "Count(Row(f=1))") == [want], \
+                f"after write {c}"
+        assert _counter(stats, "solo_fastlane_hits_total") >= 1
+        holder.close()
+
+
+class TestPipelinedReadback:
+    def test_mixed_windows_pipeline_metrics_and_exactness(
+            self, served_index):
+        """Fixed-window batcher (fast lane off by construction) under
+        concurrent mixed-kind submits: answers exact, windows flow
+        through the readback worker (dispatch_pipeline_depth gauge
+        seen), and overlap is observed."""
+        holder, oracle, n_rows = served_index
+        stats = Stats()
+        ex = Executor(holder, stats=stats, count_batch_window=0.002,
+                      dispatch_pipeline_depth=2)
+        idx = holder.index("i")
+        fld = idx.field("f")
+        shards = tuple(idx.available_shards())
+        ps = ex.planes.field_plane("i", fld, "standard", shards)
+        batcher = ex.batcher
+        errors = []
+        start = threading.Barrier(8)
+
+        def sel(i):
+            try:
+                start.wait()
+                for k in range(6):
+                    slots = ((i + k) % n_rows, (i * 3 + k) % n_rows)
+                    got = np.asarray(
+                        batcher.submit_selected(ps.plane, slots))
+                    np.testing.assert_array_equal(
+                        got, oracle[list(slots)])
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def rows(i):
+            try:
+                start.wait()
+                for _ in range(6):
+                    got = np.asarray(batcher.submit_rowcounts(ps.plane))
+                    np.testing.assert_array_equal(got[:n_rows], oracle)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=(sel if i % 2 else rows),
+                                    args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        snap = stats.full_snapshot()
+        assert "dispatch_pipeline_depth" in snap["gauges"], \
+            "no window ever flowed through the readback pipeline"
+        assert "readback_overlap_ratio" in snap["histograms"]
+
+    def test_pipeline_depth_one_inline(self, served_index):
+        """depth<=1 restores the inline dispatch->read loop — no
+        reader thread, answers unchanged."""
+        holder, oracle, n_rows = served_index
+        ex = Executor(holder, stats=Stats(), count_batch_window=0.001,
+                      dispatch_pipeline_depth=1)
+        for r in (2, 9):
+            assert ex.execute("i", f"Count(Row(f={r}))") == \
+                [int(oracle[r])]
+        assert ex.batcher._readq is None
+        assert ex.batcher._read_thread is None
+
+
+class TestConcurrentMixedIngest:
+    def test_32way_mixed_kinds_interleaved_ingest_exact(self, tmp_path):
+        """The satellite acceptance pin: 32 concurrent clients of
+        mixed kinds (selected counts, whole-plane rowcounts via TopN,
+        compound trees) while writers stream bits into a write row of
+        the SAME plane — delta overlays merge on donated buffers and
+        tiny delta_cells force generation swaps mid-chain.  Read rows
+        stay bit-exact throughout; the write row is monotone >= the
+        acked floor and exact at quiesce."""
+        holder = Holder(str(tmp_path)).open()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        stats = Stats()
+        ex = Executor(holder, stats=stats, delta_cells=32)
+        n_read_rows = 4
+        write_row = 9
+        rng = np.random.default_rng(17)
+        counts = [0] * n_read_rows
+        f = holder.index("i").field("f")
+        rows_l, cols_l = [], []
+        for s in range(2):
+            offs = rng.choice(SHARD_WIDTH // 2, size=64, replace=False)
+            rr = rng.integers(0, n_read_rows, size=64)
+            for r, o in zip(rr, offs):
+                rows_l.append(int(r))
+                cols_l.append(s * SHARD_WIDTH + int(o))
+                counts[int(r)] += 1
+            rows_l.append(write_row)
+            cols_l.append(s * SHARD_WIDTH)
+        f.import_bits(np.asarray(rows_l, np.uint64),
+                      np.asarray(cols_l, np.uint64))
+        holder.index("i").note_columns(np.asarray(cols_l, np.uint64))
+        tree_pql = ("Count(Intersect(Row(f=0), "
+                    "Union(Row(f=1), Row(f=2))))")
+        # host oracle for the tree over the read rows
+        sets = [set() for _ in range(n_read_rows)]
+        for r, c in zip(rows_l, cols_l):
+            if r < n_read_rows:
+                sets[r].add(c)
+        tree_want = len(sets[0] & (sets[1] | sets[2]))
+        # warm both formations
+        for r in range(n_read_rows):
+            assert ex.execute("i", f"Count(Row(f={r}))") == [counts[r]]
+        assert ex.execute("i", tree_pql) == [tree_want]
+
+        acked_lock = threading.Lock()
+        acked: set = set()
+        errors: list = []
+        stop = time.monotonic() + 3.0
+        start = threading.Barrier(33)
+
+        def reader(i):
+            kind = i % 3
+            try:
+                start.wait()
+                while time.monotonic() < stop:
+                    if kind == 0:
+                        r = i % n_read_rows
+                        got = ex.execute("i", f"Count(Row(f={r}))")
+                        assert got == [counts[r]], got
+                    elif kind == 1:
+                        got = ex.execute("i", tree_pql)
+                        assert got == [tree_want], got
+                    else:
+                        with acked_lock:
+                            floor = len(acked)
+                        (got,) = ex.execute(
+                            "i", f"Count(Row(f={write_row}))")
+                        assert got >= floor + 2, (got, floor)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def writer(w):
+            wrng = np.random.default_rng(100 + w)
+            try:
+                start.wait()
+                while time.monotonic() < stop:
+                    s = int(wrng.integers(0, 2))
+                    c = (s * SHARD_WIDTH + SHARD_WIDTH // 2
+                         + int(wrng.integers(0, SHARD_WIDTH // 2)))
+                    ex.execute("i", f"Set({c}, f={write_row})")
+                    with acked_lock:
+                        acked.add(c)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = ([threading.Thread(target=reader, args=(i,))
+                    for i in range(30)]
+                   + [threading.Thread(target=writer, args=(w,))
+                      for w in range(2)])
+        for t in threads:
+            t.start()
+        start.wait()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:5]
+        # quiesced exactness: the write row answers every acked column
+        with acked_lock:
+            want_write = len(acked) + 2  # + seed bits
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            (got,) = ex.execute("i", f"Count(Row(f={write_row}))")
+            if got == want_write:
+                break
+            time.sleep(0.1)
+        assert got == want_write
+        # coalescing engaged under 32-way load (the fast lane admits
+        # only solo traffic, so windows must have formed)
+        assert _counter(stats, "batcher_batches") >= 1, \
+            "no collection window ever formed under 32-way load"
+        holder.close()
