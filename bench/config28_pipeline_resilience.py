@@ -1,0 +1,363 @@
+"""Config #28: pipeline resilience — serving THROUGH a sick device
+(r18, ISSUE 13).
+
+The dispatch pipeline (exec/batcher.py) is one shared device stream;
+this bench measures what a stall on it costs, with the r18 watchdog +
+window quarantine + device-health governor armed:
+
+- **healthy baseline**: concurrent single-Count qps + p99 against
+  index B (the unaffected plane), every answer oracle-checked;
+- **injected stall**: ``exec.dispatch_hang`` stalls index A's
+  whole-plane row-count dispatch (the kind a multi-Count request
+  rides) for longer than the watchdog bound while B traffic keeps
+  flowing — **availability for the unaffected work is asserted
+  == 1.0 in-bench, smoke and full**, and the wedged A caller must
+  receive a structured 504/500 naming the stalled stage within its
+  deadline + one watchdog period + grace;
+- **degraded serving**: ``exec.dispatch_error`` faults consecutive
+  fused dispatches until the governor degrades; qps is measured on
+  the per-item fallback path (answers still oracle-exact) —
+  ``degraded_qps_ratio`` = degraded/healthy is the price of serving
+  through a flaky device;
+- **recovery**: the fault clears, a probe window restores HEALTHY,
+  and the post-scenario thread census asserts zero leaked pipeline
+  threads (one collector, ≤1 readback worker, ≤1 watchdog).
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 8 rows on CPU —
+tier-1 runs it (tests/test_bench_smoke.py) so the bench can never
+bitrot.
+
+Prints ONE JSON line: healthy-baseline qps; vs_baseline = the
+degraded/healthy qps ratio.  ``regressions`` carries the shared
+headline guard plus the r18 DETAIL guard rows (``stall_availability``,
+``degraded_qps_ratio``) so a future PR that lets a stall leak into
+unaffected work — or craters degraded-mode throughput — fails the
+guard even while the healthy headline hides it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "64"))
+N_ROWS = 8
+WORDS = 32768
+INDEX_A, INDEX_B, FIELD = "ia", "ib", "f"
+N_CLIENTS = 4 if SMOKE else 8
+WATCHDOG_S = 0.3
+PROBE_S = 0.3
+CALLER_TIMEOUT_S = 0.6
+HANG_S = 1.0
+GRACE_S = 1.5 if SMOKE else 1.0
+
+
+def write_index(holder_dir: str, name: str, plane: np.ndarray) -> None:
+    from pilosa_tpu.store import Holder, roaring
+    h = Holder(holder_dir).open()
+    idx = h.index(name) or h.create_index(name, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(holder_dir, name, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+
+
+def row_oracle(plane: np.ndarray) -> np.ndarray:
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+    return np.array([int(np.unpackbits(
+        plane[:, r].reshape(-1).view(np.uint8)).sum())
+        for r in range(plane.shape[1])], np.int64)
+
+
+def serve_burst(api, oracle_b, seconds: float, errors: list,
+                latencies: list | None = None) -> tuple[int, int]:
+    """N_CLIENTS threads of single-Count traffic against index B for
+    ``seconds``; every answer oracle-checked.  Returns (ok, total)."""
+    stop = time.monotonic() + seconds
+    ok = [0] * N_CLIENTS
+    total = [0] * N_CLIENTS
+
+    def worker(i: int) -> None:
+        row = i % N_ROWS
+        while time.monotonic() < stop:
+            total[i] += 1
+            t0 = time.perf_counter()
+            try:
+                got = api.query(INDEX_B,
+                                f"Count(Row({FIELD}={row}))")["results"]
+            except Exception as e:  # noqa: BLE001 — counted, surfaced
+                errors.append(f"B query failed: {e!r}")
+                continue
+            if latencies is not None:
+                latencies.append(time.perf_counter() - t0)
+            if got != [int(oracle_b[row])]:
+                errors.append(f"B diverged: {got} != [{oracle_b[row]}]")
+                continue
+            ok[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(ok), sum(total)
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu import fault
+    from pilosa_tpu.api import API
+    from pilosa_tpu.api.api import ApiError
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs import Stats
+    from pilosa_tpu.store import Holder
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
+    plane_a = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                           dtype=np.uint32)
+    plane_a &= rng.integers(0, 1 << 32, size=plane_a.shape,
+                            dtype=np.uint32)
+    plane_b = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                           dtype=np.uint32)
+    plane_b &= rng.integers(0, 1 << 32, size=plane_b.shape,
+                            dtype=np.uint32)
+    oracle_a = row_oracle(plane_a)
+    oracle_b = row_oracle(plane_b)
+    data_dir = tempfile.mkdtemp(prefix="pilosa_cfg28_")
+    baseline_threads = threading.active_count()
+    try:
+        write_index(data_dir, INDEX_A, plane_a)
+        write_index(data_dir, INDEX_B, plane_b)
+        holder = Holder(data_dir).open()
+        stats = Stats()
+        fault.set_stats(stats)
+        # fixed window + fast lane off: the injected hang must land in
+        # the WINDOWED dispatch the watchdog governs (the fast lane
+        # runs on caller threads the watchdog cannot reclaim — the
+        # governor turns it off the moment the device looks sick)
+        ex = Executor(holder, stats=stats, count_batch_window=0.002,
+                      solo_fastlane=False, dispatch_pipeline_depth=2,
+                      dispatch_watchdog_seconds=WATCHDOG_S,
+                      device_health_probe_seconds=PROBE_S)
+        api = API(holder, ex)
+        # warm both planes; the A request must ride the resident
+        # whole-plane rowcounts path before the hang is armed.
+        # Retried through ApiError: a first-time XLA compile outliving
+        # the tight 0.3s watchdog just earns a quarantine 500 — the
+        # retry hits the now-cached program.
+        pql_a = "".join(f"Count(Row({FIELD}={r}))" for r in range(3))
+        want_a = [int(oracle_a[r]) for r in range(3)]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if api.query(INDEX_A, pql_a)["results"] == want_a:
+                    break
+            except ApiError:
+                pass
+            time.sleep(0.1)
+        else:
+            raise AssertionError("index A plane never warmed")
+        for r in range(N_ROWS):
+            while True:
+                try:
+                    got = api.query(INDEX_B,
+                                    f"Count(Row({FIELD}={r}))")["results"]
+                    break
+                except ApiError:
+                    time.sleep(0.05)
+            assert got == [int(oracle_b[r])], got
+        # a warm-up quarantine may have degraded the governor — probe
+        # back to healthy before the measured baseline
+        deadline = time.monotonic() + 30
+        while (ex.batcher.governor.state != "healthy"
+               and time.monotonic() < deadline):
+            api.query(INDEX_B, f"Count(Row({FIELD}=0))")
+            time.sleep(0.05)
+        assert ex.batcher.governor.state == "healthy"
+        log("planes warm; answers oracle-exact")
+
+        # ---- phase 1: healthy baseline -------------------------------------
+        errors: list = []
+        lats: list = []
+        ok, total = serve_burst(api, oracle_b,
+                                1.0 if SMOKE else 2.0, errors, lats)
+        assert not errors, errors[:3]
+        healthy_qps = total / (1.0 if SMOKE else 2.0)
+        p99 = float(np.percentile(lats, 99)) if lats else 0.0
+        log(f"healthy baseline: {healthy_qps:,.1f} qps, "
+            f"p99 {p99 * 1e3:.1f} ms ({total} queries)")
+
+        # ---- phase 2: injected stall ---------------------------------------
+        stall_errors: list = []
+        stall_lats: list = []
+        stall_result: dict = {}
+
+        def stall_burst() -> None:
+            stall_result["served"] = serve_burst(
+                api, oracle_b, HANG_S + 1.0, stall_errors, stall_lats)
+
+        bt = threading.Thread(target=stall_burst)
+        bt.start()
+        time.sleep(0.25)  # readers established through the healthy path
+        fault.set_fault("exec.dispatch_hang", "delay", times=1,
+                        match={"kind": "rowcounts"},
+                        args={"seconds": HANG_S})
+        t0 = time.monotonic()
+        caller = {"status": None, "stage": None, "elapsed": None}
+        try:
+            api.query(INDEX_A, pql_a, timeout=CALLER_TIMEOUT_S)
+        except ApiError as e:
+            caller["status"] = e.status
+            caller["elapsed"] = round(time.monotonic() - t0, 3)
+            extra = e.extra or {}
+            caller["stage"] = (extra.get("pipelineStall", {}).get("stage")
+                               or extra.get("timeout", {}).get("stage"))
+        else:
+            raise AssertionError(
+                "query through a hung dispatch succeeded inside its "
+                f"{CALLER_TIMEOUT_S}s deadline against a {HANG_S}s stall")
+        finally:
+            fault.clear("exec.dispatch_hang")
+        bt.join()
+        ok, total = stall_result["served"]
+        availability = ok / total if total else 0.0
+        log(f"stall: unaffected work served {ok}/{total} "
+            f"(availability {availability:.4f}); wedged caller got "
+            f"{caller['status']} naming stage={caller['stage']!r} in "
+            f"{caller['elapsed']}s")
+        # THE acceptance bar, asserted at smoke AND full scale: a stall
+        # on one plane's dispatch costs unaffected work nothing
+        assert availability == 1.0, \
+            (f"unaffected-work availability {availability:.4f} != 1.0 "
+             f"through the stall: {stall_errors[:3]}")
+        assert not stall_errors, stall_errors[:3]
+        assert caller["status"] in (500, 504), caller
+        assert caller["stage"] in ("dispatch", "queued", "readback"), \
+            f"structured error did not name the stalled stage: {caller}"
+        assert caller["elapsed"] <= CALLER_TIMEOUT_S + WATCHDOG_S \
+            + GRACE_S, f"wedged caller held too long: {caller}"
+        stall_p99 = (float(np.percentile(stall_lats, 99))
+                     if stall_lats else 0.0)
+
+        # ---- phase 3: degraded serving -------------------------------------
+        fault.set_fault("exec.dispatch_error", "error", times=100000)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            for r in range(3):
+                got = api.query(INDEX_B,
+                                f"Count(Row({FIELD}={r}))")["results"]
+                assert got == [int(oracle_b[r])], \
+                    f"degraded answer diverged: {got}"
+            if ex.batcher.governor.state == "degraded":
+                break
+        else:
+            raise AssertionError("governor never degraded under "
+                                 "consecutive dispatch faults")
+        deg_errors: list = []
+        deg_secs = 0.8 if SMOKE else 1.5
+        ok, total = serve_burst(api, oracle_b, deg_secs, deg_errors)
+        assert not deg_errors, deg_errors[:3]
+        assert ok == total, "degraded serving dropped queries"
+        degraded_qps = total / deg_secs
+        ratio = degraded_qps / healthy_qps if healthy_qps else 0.0
+        log(f"degraded serving: {degraded_qps:,.1f} qps = "
+            f"{ratio:.3f}x healthy (answers exact throughout)")
+
+        # ---- phase 4: recovery + thread census -----------------------------
+        fault.clear()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            api.query(INDEX_B, f"Count(Row({FIELD}=0))")
+            if ex.batcher.governor.state == "healthy":
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("governor never probed back to healthy")
+        log(f"governor recovered: {ex.batcher.health_payload()}")
+        # zero leaked pipeline threads once the hang's zombie unwedges
+        deadline = time.monotonic() + 15
+        census = {}
+        while time.monotonic() < deadline:
+            names = [t.name for t in threading.enumerate()]
+            census = {n: sum(1 for x in names if x.startswith(n))
+                      for n in ("pilosa-count-batcher",
+                                "pilosa-batch-readback",
+                                "pilosa-pipeline-watchdog")}
+            if (census["pilosa-count-batcher"] == 1
+                    and census["pilosa-batch-readback"] <= 1
+                    and census["pilosa-pipeline-watchdog"] <= 1
+                    and threading.active_count()
+                    <= baseline_threads + 12):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"pipeline threads leaked after recovery: {census}, "
+                f"active={threading.active_count()} vs baseline "
+                f"{baseline_threads}")
+        holder.close()
+    finally:
+        fault.clear()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    metric = f"pipeline_resilience_qps_{platform}"
+    detail = {
+        "healthy": {"qps": round(healthy_qps, 2),
+                    "p99_ms": round(p99 * 1e3, 3)},
+        "stall": {"availability": availability,
+                  "p99_ms": round(stall_p99 * 1e3, 3),
+                  "caller_status": caller["status"],
+                  "caller_stage": caller["stage"],
+                  "caller_seconds": caller["elapsed"],
+                  "watchdog_seconds": WATCHDOG_S},
+        "degraded": {"qps": round(degraded_qps, 2),
+                     "qps_ratio": round(ratio, 4)},
+    }
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # headline + r18 detail guard: stall availability and the
+    # degraded/healthy ratio are tracked round over round — a future
+    # PR that lets a stall leak into unaffected work or craters
+    # degraded throughput fails the guard even while the healthy
+    # headline hides it
+    regressions = (
+        mod.regression_guard(metric, healthy_qps)
+        + mod.detail_regression_guard(metric, detail, {
+            "stall_availability": ("stall", "availability"),
+            "degraded_qps_ratio": ("degraded", "qps_ratio"),
+        }))
+    print(json.dumps({
+        "metric": metric,
+        "value": round(healthy_qps, 2), "unit": "qps",
+        "vs_baseline": round(ratio, 3),
+        "regressions": regressions,
+        "detail": detail}))
+
+
+if __name__ == "__main__":
+    main()

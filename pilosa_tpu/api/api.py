@@ -23,6 +23,7 @@ from pilosa_tpu.engine.words import SHARD_WIDTH
 from pilosa_tpu.exec import Executor, result_to_json
 from pilosa_tpu.exec.executor import (ExecutionError,
                                       ExecutorSaturatedError,
+                                      PipelineStalledError,
                                       QueryTimeoutError,
                                       WriteUnavailableError)
 from pilosa_tpu.pql.parser import ParseError
@@ -56,7 +57,22 @@ class ApiError(Exception):
             "elapsedSeconds": round(elapsed, 6),
             "deadlineSeconds": deadline or None,
             "shardsOutstanding": getattr(exc, "shards_outstanding",
-                                         None)}})
+                                         None),
+            # r18: when the deadline expired while blocked on the
+            # dispatch pipeline, name the stage (queued/dispatch/
+            # readback) so a wedged caller's 504 says WHAT stalled
+            "stage": getattr(exc, "stage", None)}})
+
+    @classmethod
+    def pipeline_stall(cls, exc) -> "ApiError":
+        """The quarantined-window contract (r18), shared by the public
+        and ``/internal/query`` edges: HTTP 500 with a structured
+        ``pipelineStall`` body naming the stalled stage and how long
+        the watchdog let it age — a sick device costs the wedged
+        caller a loud, attributable error, never a hung thread."""
+        return cls(str(exc), 500, extra={"pipelineStall": {
+            "stage": getattr(exc, "stage", None),
+            "elapsedSeconds": round(getattr(exc, "elapsed", 0.0), 3)}})
 
     @classmethod
     def write_unavailable(cls, exc) -> "ApiError":
@@ -316,6 +332,12 @@ class API:
             # never a generic 500, and distinct from client errors
             return {}, ApiError.timeout(e, _time.perf_counter() - t0,
                                         timeout)
+        except PipelineStalledError as e:
+            # a quarantined dispatch-pipeline window (r18): server-side
+            # unavailability with a structured body naming the stalled
+            # stage — distinct from client errors AND from timeouts
+            # (the caller's own budget may not have expired yet)
+            return {}, ApiError.pipeline_stall(e)
         except ExecutorSaturatedError as e:
             # admission shedding (VERDICT advice #6): a saturated
             # executor is overload, not a client mistake — 503 with a
@@ -720,6 +742,11 @@ class API:
                     "importedBits": int(sum(ingested.values())),
                     "importBatch": ex.stats.histogram_summary(
                         "import_batch_seconds")},
+                # self-healing pipeline visibility (r18): governor
+                # state (healthy/degraded/probing), watchdog knob,
+                # quarantine counts — the serving-through-a-sick-device
+                # pane (bench/config28)
+                "deviceHealth": ex.device_health(),
                 **({"clusterHealth": cluster_health}
                    if cluster_health is not None else {}),
                 **({"writeHealth": write_health}

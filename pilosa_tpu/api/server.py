@@ -446,6 +446,11 @@ class Handler(BaseHTTPRequestHandler):
         if ex.batcher is not None:
             stats.gauge("count_batcher_window_seconds",
                         ex.batcher.current_window)
+        # self-healing pipeline (r18): governor state at scrape time
+        # (0 healthy, 1 degraded, 2 probing) — transitions also set
+        # this gauge the moment they happen
+        stats.gauge("device_health_state",
+                    ex.device_health()["stateCode"])
         # admission / shedding visibility (VERDICT advice #6): how
         # full the executor is right now, next to the shed counter
         # and queue-wait histogram fire() maintains

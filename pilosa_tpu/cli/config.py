@@ -116,6 +116,23 @@ class Config:
     # output slots) — the attack on the one-RPC-per-query solo floor.
     # False restores the always-windowed pre-r17 path.
     solo_fastlane: bool = True
+    # Pipeline watchdog (r18): per-stage age bound (seconds) on every
+    # in-flight batcher window.  A window stalled past it — hung XLA
+    # compile, stalled dispatch, wedged device→host read — is
+    # QUARANTINED: its items fail with a structured error naming the
+    # stage, its pipeline slot is reclaimed, and the wedged stage
+    # worker is superseded so unrelated queries keep serving.  Keep it
+    # well above worst-case legitimate compiles (seconds at full
+    # scale).  0 disables the monitor entirely (the pre-r18 contract:
+    # no watchdog thread, unbounded dispatch waits).
+    dispatch_watchdog_seconds: float = 30.0
+    # Device health governor (r18): after consecutive dispatch faults
+    # or a watchdog trip flip serving to DEGRADED (fast lane off,
+    # pipelining off, windows executed inline per item on the proven
+    # op-at-a-time fallback path), then — every this-many seconds —
+    # admit ONE window back onto the fused pipeline as a probe;
+    # success restores healthy serving.
+    device_health_probe_seconds: float = 5.0
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

@@ -96,6 +96,7 @@ def h_internal_query(self: Handler) -> None:
     from pilosa_tpu.exec import result_to_json
     from pilosa_tpu.exec.executor import (ExecutionError,
                                           ExecutorSaturatedError,
+                                          PipelineStalledError,
                                           QueryTimeoutError,
                                           WriteUnavailableError)
     from pilosa_tpu.pql.parser import ParseError
@@ -166,6 +167,12 @@ def h_internal_query(self: Handler) -> None:
         # it back to QueryTimeoutError, and an operator curling a node
         # directly sees elapsed-vs-budget
         raise ApiError.timeout(e, time.monotonic() - t0, budget)
+    except PipelineStalledError as e:
+        # same structured 500 as the public edge (r18): a quarantined
+        # dispatch-pipeline window on THIS node names the stalled
+        # stage — the coordinator's fan-out sees a server fault, not a
+        # bad query, and an operator curling the peer sees the stage
+        raise ApiError.pipeline_stall(e)
     except ExecutorSaturatedError as e:
         # a saturated PEER is overload, not a bad query: 503 so the
         # coordinator's fan-out classifies it like a busy node (and a

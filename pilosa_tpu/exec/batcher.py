@@ -52,6 +52,37 @@ r17 changes (the solo-floor/roofline work, ISSUE 12):
   (``fused.PingPong``), so consecutive dispatches re-use two standing
   output slots instead of allocating per window, and the selcounts
   union gathers in SORTED slot order (ascending memory stride).
+
+r18 changes (the self-healing pipeline, ISSUE 13):
+
+- **deadline propagation**: every ``submit_*``/``enqueue_*`` carries
+  the query's monotonic deadline; :meth:`wait` blocks with a BOUNDED
+  timeout and raises ``QueryTimeoutError`` (naming the item's stage)
+  on expiry, marking the item ABANDONED so the group's shared
+  readback skips finishing it without disturbing co-batched answers.
+  The solo fast lane checks the deadline before dispatching.
+- **pipeline watchdog + window quarantine**: a monitor thread bounds
+  each in-flight window's dispatch and readback age
+  (``dispatch_watchdog_seconds``).  A stuck window is QUARANTINED:
+  its unfinished items fail with a structured
+  ``PipelineStalledError`` naming the stalled stage, its pipeline
+  slot is reclaimed, and the wedged stage worker is superseded by a
+  fresh thread (the zombie exits when the hang resolves) so the
+  queue keeps draining.  In a multi-group window, each group's
+  dispatch is bounded individually — a hung group fails alone while
+  the window's other groups (other planes, other kinds) proceed.
+  ``pipeline_watchdog_trips_total{stage}`` /
+  ``pipeline_quarantined_windows_total`` on /metrics.
+- **device health governor** (``exec.health``): consecutive dispatch
+  faults or watchdog trips flip serving to DEGRADED — fast lane off,
+  pipelining off, every window executed inline per item on the
+  proven op-at-a-time fallback path — then probe back to HEALTHY.
+  ``device_health_state`` gauge + ``deviceHealth`` on /status.
+- Two wedge classes fixed: a readback failure OUTSIDE ``_readback``'s
+  per-item fallbacks now fails every unfinished item in the window
+  (no ``_Pending.event`` left unset forever), and a collector death
+  with items queued fails the backlog immediately instead of
+  orphaning it until the next enqueue.
 """
 
 from __future__ import annotations
@@ -62,14 +93,21 @@ import time
 
 import numpy as np
 
+from pilosa_tpu import fault
 from pilosa_tpu.engine import kernels
+
+
+def _stall_error(msg: str, stage: str, elapsed: float = 0.0):
+    # lazy: executor imports this module lazily and vice versa
+    from pilosa_tpu.exec.executor import PipelineStalledError
+    return PipelineStalledError(msg, stage=stage, elapsed=elapsed)
 
 
 class _Pending:
     __slots__ = ("kind", "nodes", "leaves", "delta", "event", "result",
-                 "error")
+                 "error", "deadline", "abandoned", "stage", "delivered")
 
-    def __init__(self, kind, nodes, leaves, delta=None):
+    def __init__(self, kind, nodes, leaves, delta=None, deadline=None):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
         #                       | "selcounts" | "tree" | "distinct"
         self.nodes = nodes    # count: tuple of plan trees;
@@ -82,6 +120,48 @@ class _Pending:
         self.event = threading.Event()
         self.result = None
         self.error: Exception | None = None
+        # deadline-aware waiting (r18): the query's time.monotonic()
+        # cutoff.  On expiry the caller marks the item ABANDONED and
+        # leaves — the group's shared finish skips it, co-batched
+        # items are untouched.  ``stage`` names where the item
+        # currently is (queued → dispatch → readback) so a timeout or
+        # quarantine error can say what stalled.
+        self.deadline = deadline
+        self.abandoned = False
+        self.stage = "queued"
+        # True once a result/error was actually STORED — the event
+        # alone cannot distinguish "answered" from "abandoned item
+        # acknowledged" at the deadline boundary (see wait())
+        self.delivered = False
+
+
+class _Window:
+    """One dispatched collection window's lifecycle record: what the
+    watchdog ages, what quarantine fails, what owns a pipeline slot."""
+
+    __slots__ = ("wid", "items", "stage", "t0", "pending",
+                 "distinct_futs", "win_bytes", "slot_held", "inflight",
+                 "done", "faulted", "bounded")
+
+    def __init__(self, wid: int, items: list, slot_held: bool):
+        self.wid = wid
+        self.items = items          # every _Pending popped into this window
+        self.stage = "dispatch"     # "dispatch" -> "readback"
+        self.t0 = time.monotonic()  # current STAGE's start (reset on
+        #                             progress so the watchdog bounds
+        #                             stall time, not total time)
+        self.pending: list = []     # dispatched (key, group, out, finish)
+        self.distinct_futs: list = []
+        self.win_bytes = 0
+        self.slot_held = slot_held  # owns a _pipe_slots token
+        self.inflight = False       # counted in _inflight_windows
+        self.done = False           # closed (finished or quarantined)
+        self.faulted = False        # any group fell back this window
+        # True while the collector bounds this window's group joins
+        # ITSELF (the multi-group fut.result(watchdog) path): the
+        # whole-window watchdog defers, so a single hung group can
+        # never take co-batched innocents down with it
+        self.bounded = False
 
 
 class CountBatcher:
@@ -99,8 +179,11 @@ class CountBatcher:
 
     def __init__(self, fused, window_s="adaptive", max_batch: int = 64,
                  stats=None, pipeline_depth: int = 2,
-                 solo_fastlane: bool = True):
+                 solo_fastlane: bool = True,
+                 watchdog_s: float = 5.0,
+                 probe_after_s: float = 5.0):
         from pilosa_tpu.exec.fused import PingPong
+        from pilosa_tpu.exec.health import DeviceHealthGovernor
         from pilosa_tpu.obs import NopStats
         from pilosa_tpu.obs.metrics import (BYTE_BUCKETS, COUNT_BUCKETS,
                                             RATIO_BUCKETS)
@@ -135,9 +218,8 @@ class CountBatcher:
             queue.Queue() if self.pipeline_depth > 1 else None)
         # the actual run-ahead bound: a slot is taken BEFORE a
         # window's groups dispatch and released when its readback
-        # finishes, so dispatched-but-unread windows can never exceed
-        # pipeline_depth (a queue-size bound alone would let the
-        # collector dispatch one extra window past the put)
+        # finishes (or when quarantine reclaims it — r18), so
+        # dispatched-but-unread windows can never exceed pipeline_depth
         self._pipe_slots = threading.Semaphore(self.pipeline_depth)
         self._read_thread: threading.Thread | None = None
         # dispatched-but-unread windows; collector increments, reader
@@ -145,6 +227,25 @@ class CountBatcher:
         # the depth gauge and the overlap observations
         self._inflight_windows = 0
         self._pipe_lock = threading.Lock()
+        # pipeline watchdog + window quarantine (r18 tentpole): every
+        # dispatched window registers here; the monitor thread bounds
+        # each window's per-STAGE age by ``watchdog_s`` and
+        # quarantines overage — items failed with a structured error
+        # naming the stage, pipeline slot reclaimed, the wedged stage
+        # worker superseded.  0 disables (the pre-r18 contract: no
+        # monitor thread, unbounded dispatch waits).
+        self.watchdog_s = max(0.0, float(watchdog_s))
+        self._windows: dict[int, _Window] = {}
+        self._win_seq = 0
+        self._watchdog: threading.Thread | None = None
+        self._busy = 0  # collector cycles mid-batch (watchdog idleness)
+        self._trips = 0        # watchdog trips (mirror of the counter)
+        self._quarantined = 0  # quarantined windows/groups
+        # device health governor (r18): healthy→degraded→probing
+        # breaker fed by dispatch faults + watchdog trips; degraded
+        # serving runs windows on the per-item fallback path
+        self.governor = DeviceHealthGovernor(stats=self.stats,
+                                             probe_after_s=probe_after_s)
         # solo fast lane (r17 tentpole): with no queue pressure, a
         # width-1 request skips window formation entirely and rides a
         # pre-bound dispatch chain on the CALLER's thread — no enqueue,
@@ -175,12 +276,66 @@ class CountBatcher:
     def current_window(self) -> float:
         return self._win
 
+    def health_payload(self) -> dict:
+        """The ``/status`` deviceHealth block: governor state plus the
+        watchdog's knobs and lifetime trip/quarantine counts."""
+        out = self.governor.payload()
+        out.update({
+            "watchdogSeconds": self.watchdog_s,
+            "quarantinedWindows": self._quarantined,
+            "inflightWindows": self._inflight_windows,
+        })
+        return out
+
+    # -- item delivery (r18) -------------------------------------------------
+    #
+    # Every result/error hand-off routes through these two, so an item
+    # can never be finished twice (quarantine racing a late readback)
+    # and an ABANDONED item (deadline expired, caller gone) is skipped
+    # without disturbing its co-batched neighbors.
+
+    @staticmethod
+    def _deliver(p: _Pending, value) -> None:
+        if not (p.abandoned or p.event.is_set()):
+            p.result = value
+            p.delivered = True
+        p.event.set()
+
+    @staticmethod
+    def _deliver_error(p: _Pending, err: Exception) -> None:
+        if not (p.abandoned or p.event.is_set()):
+            p.error = err
+            p.delivered = True
+        p.event.set()
+
+    @staticmethod
+    def _skip(p: _Pending) -> bool:
+        """True when a finish loop should not compute this item's
+        answer (abandoned by its caller, or already settled by
+        quarantine)."""
+        if p.abandoned or p.event.is_set():
+            p.event.set()
+            return True
+        return False
+
+    @staticmethod
+    def _check_deadline(deadline: float | None,
+                        stage: str = "dispatch") -> None:
+        """Refuse work whose deadline already passed — the solo fast
+        lane's pre-dispatch check, and the enqueue guard that keeps an
+        expired caller from occupying a window slot at all."""
+        if deadline is not None and time.monotonic() > deadline:
+            from pilosa_tpu.exec.executor import QueryTimeoutError
+            raise QueryTimeoutError(
+                f"query deadline expired before {stage}", stage=stage)
+
     def _ensure_worker(self) -> None:
         if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(target=self._loop,
+            self._thread = threading.Thread(target=self._run_collector,
                                             name="pilosa-count-batcher",
                                             daemon=True)
             self._thread.start()
+        self._ensure_watchdog()
 
     def _enqueue(self, p: _Pending) -> _Pending:
         with self._lock:
@@ -193,8 +348,30 @@ class CountBatcher:
         """Block on an enqueued item's result (pairs with the
         ``enqueue_*`` methods — a caller that needs several items can
         enqueue them ALL into one collection window before waiting on
-        any, instead of serializing one window per item)."""
-        p.event.wait()
+        any, instead of serializing one window per item).
+
+        Deadline-aware (r18): an item carrying a deadline waits with a
+        BOUNDED timeout; on expiry it is marked abandoned (the shared
+        readback skips it) and ``QueryTimeoutError`` names the stage
+        the item was in when the clock ran out."""
+        if p.deadline is None:
+            p.event.wait()
+        else:
+            remaining = p.deadline - time.monotonic()
+            if remaining <= 0 or not p.event.wait(remaining):
+                p.abandoned = True
+                # boundary race: a deliverer between our timeout and
+                # the abandon mark may have STORED the answer (then
+                # p.delivered is True — return it) or may observe the
+                # mark and skip (event set, nothing stored — the event
+                # alone cannot tell the two apart, so only `delivered`
+                # decides; a timeout here while a late store lands is
+                # an honest timeout either way)
+                if not p.delivered:
+                    from pilosa_tpu.exec.executor import QueryTimeoutError
+                    raise QueryTimeoutError(
+                        "query deadline expired in the dispatch "
+                        f"pipeline (stage={p.stage})", stage=p.stage)
         if p.error is not None:
             raise p.error
         return p.result
@@ -206,16 +383,18 @@ class CountBatcher:
 
     def _fl_try_enter(self) -> bool:
         """Atomically admit ONE fast-lane dispatch: fast lane enabled,
-        adaptive window currently snapped to 0 (traffic is solo —
-        under queue pressure the window grows and coalescing wins),
-        nothing already queued to join, and no other fast-lane
-        dispatch in flight — the admission check and the in-flight
-        increment happen under one lock, so two simultaneous callers
-        can never both take the lane (the loser lands in the window,
-        which is the adaptive pressure signal).  A True return must
-        be paired with :meth:`_fl_leave`."""
+        device HEALTHY (a degraded device must not dispatch inline on
+        caller threads, r18), adaptive window currently snapped to 0
+        (traffic is solo — under queue pressure the window grows and
+        coalescing wins), nothing already queued to join, and no other
+        fast-lane dispatch in flight — the admission check and the
+        in-flight increment happen under one lock, so two simultaneous
+        callers can never both take the lane (the loser lands in the
+        window, which is the adaptive pressure signal).  A True return
+        must be paired with :meth:`_fl_leave`."""
         if not (self.solo_fastlane and self.adaptive
-                and self._win == 0.0 and not self._queue):
+                and self._win == 0.0 and not self._queue
+                and self.governor.fastlane_ok()):
             return False
         with self._fl_lock:
             if self._fl_active:
@@ -256,6 +435,7 @@ class CountBatcher:
             host = np.asarray(out).astype(np.int64)
             self._pp.retire(out)
         except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
             return None
         self._fastlane_done("count",
                             sum(getattr(a, "nbytes", 0) for a in leaves))
@@ -277,6 +457,7 @@ class CountBatcher:
             host = np.asarray(out).astype(np.int64)
             self._pp.retire(out)
         except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
             return None
         nbytes = (len(order) * plane.shape[0] * plane.shape[-1] * 4
                   + (delta.nbytes if delta is not None else 0))
@@ -300,6 +481,7 @@ class CountBatcher:
                 host = np.asarray(out).astype(np.int64)[0]
                 self._pp.retire(out)
         except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
             return None
         self._fastlane_done(
             "rowcounts",
@@ -315,6 +497,7 @@ class CountBatcher:
                                              tuple(extras), delta=delta)
             val = int(np.asarray(out).astype(np.int64)[0])
         except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
             return None
         nbytes = (len(slots) * plane.shape[0] * plane.shape[-1] * 4
                   + sum(getattr(a, "nbytes", 0) for a in extras)
@@ -324,16 +507,18 @@ class CountBatcher:
 
     # -- blocking submits ----------------------------------------------------
 
-    def submit(self, node, leaves) -> int:
+    def submit(self, node, leaves, deadline: float | None = None) -> int:
         """Block until the coalesced batch containing this Count runs;
         returns the host-finished int64 total."""
-        return self.submit_many((node,), leaves)[0]
+        return self.submit_many((node,), leaves, deadline=deadline)[0]
 
-    def submit_many(self, nodes, leaves) -> list[int]:
+    def submit_many(self, nodes, leaves,
+                    deadline: float | None = None) -> list[int]:
         """A whole request's Count run as ONE batch item (the nodes
         share one leaf list); N concurrent requests coalesce into one
         program regardless of how many Counts each carries."""
         nodes, leaves = tuple(nodes), tuple(leaves)
+        self._check_deadline(deadline)
         if self._fl_try_enter():
             try:
                 out = self._fastlane_counts(nodes, leaves)
@@ -341,26 +526,35 @@ class CountBatcher:
                 self._fl_leave()
             if out is not None:
                 return out
-        return self._submit(_Pending("count", nodes, leaves))
+        return self._submit(_Pending("count", nodes, leaves,
+                                     deadline=deadline))
 
-    def submit_sum(self, plane, filter_words) -> tuple[int, int]:
+    def submit_sum(self, plane, filter_words,
+                   deadline: float | None = None) -> tuple[int, int]:
         """BSI Sum: (sum of offsets, non-null count), host-finished."""
+        self._check_deadline(deadline)
         leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._submit(_Pending("sum", None, leaves))
+        return self._submit(_Pending("sum", None, leaves,
+                                     deadline=deadline))
 
-    def submit_minmax(self, plane, filter_words):
+    def submit_minmax(self, plane, filter_words,
+                      deadline: float | None = None):
         """BSI Min/Max: per-shard (min, min_cnt, max, max_cnt) tuples."""
+        self._check_deadline(deadline)
         leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._submit(_Pending("minmax", None, leaves))
+        return self._submit(_Pending("minmax", None, leaves,
+                                     deadline=deadline))
 
     def submit_rowcounts(self, plane, filter_words=None,
-                         delta=None) -> np.ndarray:
+                         delta=None,
+                         deadline: float | None = None) -> np.ndarray:
         """Whole-plane per-row totals int64[R_pad] (cross-shard reduce
         on device — callers gate on the int32-exact shard bound).
         Identical concurrent items (same plane/filter objects) share
         one computation.  ``delta`` (the plane's DeltaOverlay) makes
         the answer base⊕delta — items over the same (plane, overlay)
         pair still dedupe to one scan."""
+        self._check_deadline(deadline)
         if self._fl_try_enter():
             try:
                 out = self._fastlane_rowcounts(plane, filter_words,
@@ -370,19 +564,22 @@ class CountBatcher:
             if out is not None:
                 return out
         return self.wait(self.enqueue_rowcounts(plane, filter_words,
-                                                delta))
+                                                delta, deadline=deadline))
 
     def enqueue_rowcounts(self, plane, filter_words=None,
-                          delta=None) -> _Pending:
+                          delta=None,
+                          deadline: float | None = None) -> _Pending:
         """Non-blocking variant: returns a handle for :meth:`wait`, so
         a request needing several row-count reads (filtered TopN with
         tanimoto) lands them all in ONE collection window."""
+        self._check_deadline(deadline, stage="queued")
         leaves = (plane,) if filter_words is None else (plane, filter_words)
         return self._enqueue(_Pending("rowcounts", None, leaves,
-                                      delta=delta))
+                                      delta=delta, deadline=deadline))
 
     def submit_selected(self, plane, slots: tuple,
-                        delta=None) -> np.ndarray:
+                        delta=None,
+                        deadline: float | None = None) -> np.ndarray:
         """Selected-row Counts (the multi-query fused popcount): the
         window's items over the SAME resident plane merge into one
         row-gather + popcount program — one pass over the UNION of
@@ -390,6 +587,7 @@ class CountBatcher:
         back int64[len(slots)] in the caller's slot order.  Duplicate
         slots across concurrent requests are computed once.  ``delta``
         merges the plane's pending write overlay at dispatch time."""
+        self._check_deadline(deadline)
         if self._fl_try_enter():
             try:
                 out = self._fastlane_selected(plane, tuple(slots),
@@ -399,16 +597,18 @@ class CountBatcher:
             if out is not None:
                 return out
         return self._submit(_Pending("selcounts", tuple(slots), (plane,),
-                                     delta=delta))
+                                     delta=delta, deadline=deadline))
 
     def submit_tree(self, plane, slots: tuple, prog: tuple,
-                    extras: tuple = (), delta=None) -> int:
+                    extras: tuple = (), delta=None,
+                    deadline: float | None = None) -> int:
         """One compound-tree Count (whole-tree compilation, r16): the
         window's tree items over the SAME (plane, overlay) pair union
         their gathered row slots into ONE in-program gather and fold
         every item's postfix program in one fused dispatch — N
         concurrent compound queries cost one memory pass and join the
         window's single packed readback."""
+        self._check_deadline(deadline)
         if self._fl_try_enter():
             try:
                 out = self._fastlane_tree(plane, slots, prog, extras,
@@ -418,168 +618,489 @@ class CountBatcher:
             if out is not None:
                 return out
         return self.wait(self.enqueue_tree(plane, slots, prog, extras,
-                                           delta))
+                                           delta, deadline=deadline))
 
     def enqueue_tree(self, plane, slots: tuple, prog: tuple,
-                     extras: tuple = (), delta=None) -> _Pending:
+                     extras: tuple = (), delta=None,
+                     deadline: float | None = None) -> _Pending:
         """Non-blocking :meth:`submit_tree`: a request carrying K
         compound Counts enqueues them ALL into one collection window
         before waiting on any."""
+        self._check_deadline(deadline, stage="queued")
         return self._enqueue(_Pending(
             "tree", (tuple(slots), tuple(prog), tuple(extras)),
-            (plane,), delta=delta))
+            (plane,), delta=delta, deadline=deadline))
 
-    def submit_distinct(self, plane, filter_words):
+    def submit_distinct(self, plane, filter_words,
+                        deadline: float | None = None):
         """BSI Distinct presence: host (pos bool[2^d], neg bool[2^d]).
         Coalescing here is DEDUPLICATION only — the presence scan is a
         multi-dispatch block loop, so stacking would multiply compute;
         identical concurrent requests share one scan."""
-        leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._submit(_Pending("distinct", None, leaves))
+        self._check_deadline(deadline)
+        return self._submit(_Pending("distinct", None,
+                                     (plane,) if filter_words is None
+                                     else (plane, filter_words),
+                                     deadline=deadline))
 
-    def _loop(self) -> None:
+    # -- collector -----------------------------------------------------------
+
+    def _superseded(self) -> bool:
+        """True when a fresh collector replaced this thread (the
+        quarantine restart, r18): the zombie must stop touching the
+        shared queue the moment it notices."""
+        return self._thread is not threading.current_thread()
+
+    def _run_collector(self) -> None:
+        """Collector main: one window cycle per loop, wrapped so a
+        cycle failure can never kill the worker silently — before r18
+        a collector death with items already queued orphaned them
+        until the NEXT enqueue happened to call ``_ensure_worker``;
+        now the queued backlog is failed with structured errors and
+        the same thread keeps serving."""
         while True:
-            self._kick.wait()
-            # collection window: let concurrent submitters pile in.
-            # Adaptive mode keeps it at 0 for solo traffic and grows it
-            # only while batches actually coalesce.
-            win = self._win if self.adaptive else self.window_s
-            if win > 0:
-                time.sleep(win)
+            if self._superseded():
+                return
+            try:
+                self._collect_once()
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                self._fail_backlog(e)
+
+    def _fail_backlog(self, exc: Exception) -> None:
+        """Collector-death path: every queued item is failed loudly
+        (structured error naming the stage) instead of wedging until a
+        future enqueue restarts the worker."""
+        with self._lock:
+            batch = self._queue[:]
+            self._queue.clear()
+            self._kick.clear()
+        err = _stall_error(
+            f"dispatch collector failed; {len(batch)} queued item(s) "
+            f"aborted: {exc!r}", stage="collect")
+        err.__cause__ = exc
+        for p in batch:
+            self._deliver_error(p, err)
+
+    def _collect_once(self) -> None:
+        self._kick.wait()
+        if self._superseded():
+            return
+        # collection window: let concurrent submitters pile in.
+        # Adaptive mode keeps it at 0 for solo traffic and grows it
+        # only while batches actually coalesce.
+        win = self._win if self.adaptive else self.window_s
+        if win > 0:
+            time.sleep(win)
+        with self._lock:
+            backlog = len(self._queue)
+            batch = self._queue[: self.max_batch]
+            del self._queue[: len(batch)]
+            if not self._queue:
+                self._kick.clear()
+        if not batch:
+            return
+        # busy marker: the idle-exiting watchdog must outlive every
+        # popped-but-not-yet-registered batch (see _watchdog_loop)
+        with self._lock:
+            self._busy += 1
+        try:
+            self._process_batch(batch, backlog)
+        finally:
             with self._lock:
-                backlog = len(self._queue)
-                batch = self._queue[: self.max_batch]
-                del self._queue[: len(batch)]
-                if not self._queue:
-                    self._kick.clear()
-            if not batch:
-                continue
-            if self.adaptive:
-                if len(batch) > 1 or backlog > len(batch):
-                    self._win = min(max(self._win * 2, self.ADAPT_MIN),
-                                    self.ADAPT_MAX)
-                elif self._win:
-                    nxt = self._win / 2
-                    self._win = 0.0 if nxt < self.ADAPT_MIN else nxt
-            self.stats.count("batcher_batches", 1)
-            self.stats.count("batcher_items", len(batch))
-            self.stats.gauge("batcher_window_seconds", self._win)
-            # window occupancy + fill ratio (r14 device telemetry):
-            # the coalescing histograms the config23 roofline reasons
-            # about — how many items a window actually collects and
-            # how close it runs to max_batch
-            self.stats.observe("batcher_window_items", float(len(batch)))
-            self.stats.observe("batcher_window_fill_ratio",
-                               len(batch) / self.max_batch)
-            # stacked outputs need uniform shapes: group by kind + the
-            # output-shaping leaf dimension (counts: n_shards — mixed
-            # row/plane leaf ranks fuse fine, only the int32[S] outputs
-            # must stack; aggregates/rowcounts: the full plane shape;
-            # selcounts: the plane IDENTITY — one gather per plane)
-            groups: dict[tuple, list[_Pending]] = {}
+                self._busy -= 1
+
+    def _process_batch(self, batch: list, backlog: int) -> None:
+        if self.adaptive:
+            if len(batch) > 1 or backlog > len(batch):
+                self._win = min(max(self._win * 2, self.ADAPT_MIN),
+                                self.ADAPT_MAX)
+            elif self._win:
+                nxt = self._win / 2
+                self._win = 0.0 if nxt < self.ADAPT_MIN else nxt
+        self.stats.count("batcher_batches", 1)
+        self.stats.count("batcher_items", len(batch))
+        self.stats.gauge("batcher_window_seconds", self._win)
+        # window occupancy + fill ratio (r14 device telemetry):
+        # the coalescing histograms the config23 roofline reasons
+        # about — how many items a window actually collects and
+        # how close it runs to max_batch
+        self.stats.observe("batcher_window_items", float(len(batch)))
+        self.stats.observe("batcher_window_fill_ratio",
+                           len(batch) / self.max_batch)
+        # stacked outputs need uniform shapes: group by kind + the
+        # output-shaping leaf dimension (counts: n_shards — mixed
+        # row/plane leaf ranks fuse fine, only the int32[S] outputs
+        # must stack; aggregates/rowcounts: the full plane shape;
+        # selcounts: the plane IDENTITY — one gather per plane)
+        groups: dict[tuple, list[_Pending]] = {}
+        for p in batch:
+            if p.kind == "count":
+                key = ("count", p.leaves[0].shape[0])
+            elif p.kind == "selcounts":
+                # delta identity joins the key: items over the
+                # same (plane, overlay) pair slot-union into one
+                # gather; a fresher overlay is a different answer
+                key = ("selcounts", id(p.leaves[0]),
+                       id(p.delta) if p.delta is not None else 0)
+            elif p.kind == "tree":
+                # same (plane, overlay) pair → one gather of the
+                # slot UNION serves every item's program
+                key = ("tree", id(p.leaves[0]),
+                       id(p.delta) if p.delta is not None else 0)
+            elif p.kind == "rowcounts" and p.delta is not None:
+                key = ("rowcounts-delta", id(p.leaves[0]),
+                       id(p.delta),
+                       id(p.leaves[1]) if len(p.leaves) == 2 else 0)
+            else:
+                key = (p.kind, p.leaves[0].shape)
+            groups.setdefault(key, []).append(p)
+        # DEGRADED serving (r18 governor): the device is suspect —
+        # every group runs inline per item on the proven op-at-a-time
+        # fallback path (answers stay exact; throughput, not
+        # correctness, is what degrades).  No pipeline, no fast lane,
+        # no shared readback to stall.
+        if not self.governor.admit():
             for p in batch:
-                if p.kind == "count":
-                    key = ("count", p.leaves[0].shape[0])
-                elif p.kind == "selcounts":
-                    # delta identity joins the key: items over the
-                    # same (plane, overlay) pair slot-union into one
-                    # gather; a fresher overlay is a different answer
-                    key = ("selcounts", id(p.leaves[0]),
-                           id(p.delta) if p.delta is not None else 0)
-                elif p.kind == "tree":
-                    # same (plane, overlay) pair → one gather of the
-                    # slot UNION serves every item's program
-                    key = ("tree", id(p.leaves[0]),
-                           id(p.delta) if p.delta is not None else 0)
-                elif p.kind == "rowcounts" and p.delta is not None:
-                    key = ("rowcounts-delta", id(p.leaves[0]),
-                           id(p.delta),
-                           id(p.leaves[1]) if len(p.leaves) == 2 else 0)
-                else:
-                    key = (p.kind, p.leaves[0].shape)
-                groups.setdefault(key, []).append(p)
-            # BATCHED READBACK (r12): every one-program kind dispatches
-            # asynchronously, then the whole window's outputs are
-            # packed into ONE device array and read with ONE
-            # device->host transfer — on transports with a fixed
-            # per-read RPC floor, the window now pays that floor once
-            # total, not once per kind/shape group.  Distinct stays on
-            # the pool: its presence scan is a multi-dispatch host
-            # loop that cannot join a single readback.
-            pending = []
-            distinct_futs = []
-            program_groups = []
+                p.stage = "dispatch"
             for key, group in groups.items():
                 if key[0] == "distinct":
-                    distinct_futs.append(self._group_pool().submit(
-                        self._run_distinct, group))
+                    self._run_distinct(group)
                 else:
-                    program_groups.append((key, group))
-            # run-ahead bound BEFORE dispatching: at pipeline_depth
-            # dispatched-but-unread windows the collector waits here,
-            # so device output held by in-flight windows never exceeds
-            # the documented knob
-            slot_held = False
-            if self._readq is not None and (program_groups
-                                            or distinct_futs):
-                self._pipe_slots.acquire()
-                slot_held = True
-            t_disp = time.perf_counter()
-            if len(program_groups) == 1:
-                # the common (and solo-path) case skips the pool
-                # round-trip: one group, dispatch inline
-                key, group = program_groups[0]
-                try:
-                    pending.append((key, group)
-                                   + self._dispatch_one(key, group))
-                except Exception:  # noqa: BLE001 — per-item fallback
                     self._run_fallback(key, group)
-            elif program_groups:
-                # dispatch groups CONCURRENTLY (a first-time compile
-                # in one group must not stall the others' warm
-                # dispatches), then join for the window's single
-                # packed readback
-                futs = [(key, group, self._group_pool().submit(
-                    self._dispatch_one, key, group))
-                    for key, group in program_groups]
-                for key, group, fut in futs:
-                    try:
-                        pending.append((key, group) + fut.result())
-                    except Exception:  # noqa: BLE001 — per-item fallback
-                        self._run_fallback(key, group)
-            # bytes the window's fused programs read from HBM (r14):
-            # per-kind scan-volume counters feed capacity math, and
-            # bytes / (readback-start -> readback-complete) is the
-            # LIVE bandwidth the config23 roofline bench measures
-            # offline — the gauge tracks how far serving sits from
-            # that roof (see _finish_window for why the clock starts
-            # at the read, not the dispatch)
-            win_bytes = 0
-            for key, group, _, _ in pending:
-                nbytes = self._group_bytes(key[0], group)
-                if nbytes:
-                    self.stats.count("kernel_bytes_scanned_total",
-                                     nbytes, kind=key[0])
-                    win_bytes += nbytes
-            item = (pending, distinct_futs, win_bytes)
-            if slot_held and (pending or distinct_futs):
-                # PIPELINED READBACK (r17): hand the dispatched window
-                # to the readback worker and immediately collect the
-                # next one — window N's device compute overlaps window
-                # N-1's packed device->host read.
-                with self._pipe_lock:
-                    overlapped = self._inflight_windows > 0
-                    self._inflight_windows += 1
-                    depth = self._inflight_windows
-                self.stats.observe("readback_overlap_ratio",
-                                   1.0 if overlapped else 0.0)
-                self.stats.gauge("dispatch_pipeline_depth", depth)
-                self._ensure_reader()
-                self._readq.put(item)
+            return
+        self._dispatch_window(batch, groups)
+
+    def _dispatch_window(self, batch: list, groups: dict) -> None:
+        """The fused pipeline: one dispatch per group, the window's
+        outputs packed into one readback (handed to the readback
+        worker when pipelining is on).  Registered with the watchdog
+        for the whole dispatch→readback lifetime."""
+        # BATCHED READBACK (r12): every one-program kind dispatches
+        # asynchronously, then the whole window's outputs are
+        # packed into ONE device array and read with ONE
+        # device->host transfer — on transports with a fixed
+        # per-read RPC floor, the window now pays that floor once
+        # total, not once per kind/shape group.  Distinct stays on
+        # the pool: its presence scan is a multi-dispatch host
+        # loop that cannot join a single readback.
+        pending = []
+        distinct_futs = []
+        program_groups = []
+        for key, group in groups.items():
+            if key[0] == "distinct":
+                distinct_futs.append(self._group_pool().submit(
+                    self._run_distinct, group))
             else:
-                if slot_held:  # every dispatch fell back: nothing to read
-                    self._pipe_slots.release()
-                self._finish_window(item)
+                program_groups.append((key, group))
+        # run-ahead bound BEFORE dispatching: at pipeline_depth
+        # dispatched-but-unread windows the collector waits here,
+        # so device output held by in-flight windows never exceeds
+        # the documented knob.  Quarantine reclaims a stuck window's
+        # slot, so this acquire cannot deadlock behind a wedge.
+        slot_held = False
+        use_pipe = (self._readq is not None
+                    and self.governor.pipelining_ok())
+        if use_pipe and (program_groups or distinct_futs):
+            self._pipe_slots.acquire()
+            slot_held = True
+        w = self._register_window(batch, slot_held)
+        for p in batch:
+            p.stage = "dispatch"
+        if len(program_groups) == 1:
+            # the common (and solo-path) case skips the pool
+            # round-trip: one group, dispatch inline — a hang here
+            # wedges the collector, which the watchdog resolves by
+            # quarantining the window and superseding this thread
+            key, group = program_groups[0]
+            try:
+                pending.append((key, group)
+                               + self._dispatch_one(key, group))
+            except Exception:  # noqa: BLE001 — per-item fallback
+                w.faulted = True
+                self.governor.record_fault()
+                if not w.done:
+                    # the fallback gets its OWN stage budget: aging it
+                    # against the failed dispatch's t0 would let the
+                    # watchdog quarantine a legitimately progressing
+                    # per-item recovery
+                    w.t0 = time.monotonic()
+                    self._run_fallback(key, group)
+        elif program_groups:
+            # dispatch groups CONCURRENTLY (a first-time compile
+            # in one group must not stall the others' warm
+            # dispatches), then join for the window's single
+            # packed readback.  Each group's join is bounded by the
+            # watchdog (r18): a hung group fails ALONE — the other
+            # groups' (other planes', other kinds') items proceed.
+            from concurrent.futures import TimeoutError as _FutTimeout
+            futs = [(key, group, self._group_pool().submit(
+                self._dispatch_one, key, group))
+                for key, group in program_groups]
+            bound = self.watchdog_s if self.watchdog_s > 0 else None
+            # the collector bounds each join ITSELF here, so the
+            # whole-window watchdog defers (w.bounded): a single hung
+            # group fails alone — co-batched groups of other kinds /
+            # planes proceed, and innocents are never quarantined
+            w.bounded = True
+            for key, group, fut in futs:
+                try:
+                    pending.append((key, group) + fut.result(bound))
+                except _FutTimeout:
+                    self._fail_stalled_group(key, group, bound)
+                    w.faulted = True
+                except Exception:  # noqa: BLE001 — per-item fallback
+                    w.faulted = True
+                    self.governor.record_fault()
+                    if not w.done:
+                        # hand the inline fallback BACK to the
+                        # watchdog with a fresh budget: under
+                        # w.bounded it would otherwise run unwatched —
+                        # a fallback that hangs on the same sick
+                        # device must still be quarantinable
+                        w.t0 = time.monotonic()
+                        w.bounded = False
+                        try:
+                            self._run_fallback(key, group)
+                        finally:
+                            w.bounded = True
+                # progress heartbeat: the watchdog bounds STALL time
+                # per stage, not the sum of a wide window's joins
+                w.t0 = time.monotonic()
+            w.bounded = False
+        if w.done:
+            # quarantined mid-dispatch: items already failed, slot
+            # already reclaimed, a fresh collector owns the queue —
+            # this (zombie) thread drops everything on the floor
+            return
+        # bytes the window's fused programs read from HBM (r14):
+        # per-kind scan-volume counters feed capacity math, and
+        # bytes / (readback-start -> readback-complete) is the
+        # LIVE bandwidth the config23 roofline bench measures
+        # offline — the gauge tracks how far serving sits from
+        # that roof (see _finish_window for why the clock starts
+        # at the read, not the dispatch)
+        win_bytes = 0
+        for key, group, _, _ in pending:
+            nbytes = self._group_bytes(key[0], group)
+            if nbytes:
+                self.stats.count("kernel_bytes_scanned_total",
+                                 nbytes, kind=key[0])
+                win_bytes += nbytes
+        w.pending = pending
+        w.distinct_futs = distinct_futs
+        w.win_bytes = win_bytes
+        if not (pending or distinct_futs):
+            # every dispatch fell back or was failed: nothing to read
+            self._window_done(w)
+            return
+        with self._pipe_lock:
+            w.stage = "readback"
+            w.t0 = time.monotonic()
+        for p in batch:
+            p.stage = "readback"
+        if slot_held:
+            # PIPELINED READBACK (r17): hand the dispatched window
+            # to the readback worker and immediately collect the
+            # next one — window N's device compute overlaps window
+            # N-1's packed device->host read.
+            with self._pipe_lock:
+                if w.done:
+                    return
+                overlapped = self._inflight_windows > 0
+                self._inflight_windows += 1
+                w.inflight = True
+                depth = self._inflight_windows
+            self.stats.observe("readback_overlap_ratio",
+                               1.0 if overlapped else 0.0)
+            self.stats.gauge("dispatch_pipeline_depth", depth)
+            self._ensure_reader()
+            self._readq.put(w)
+        else:
+            err = None
+            try:
+                self._finish_window(w)
+            except Exception as e:  # noqa: BLE001 — final guard (r18):
+                err = e            # fail, never wedge, the whole window
+            if err is not None:
+                self._fail_window_items(
+                    w, _wrap_readback_error(err))
+            if self._window_done(w) and err is None and not w.faulted:
+                self.governor.record_success()
+
+    def _fail_stalled_group(self, key, group, bound: float) -> None:
+        """One group's dispatch exceeded the watchdog bound while the
+        rest of the window proceeded: fail ONLY its items (structured,
+        naming the stage) and notify the governor — the wedged pool
+        worker parks until the hang resolves."""
+        self._trips += 1
+        self._quarantined += 1
+        self.stats.count("pipeline_watchdog_trips_total", 1,
+                         stage="dispatch")
+        self.stats.count("pipeline_quarantined_windows_total", 1)
+        self.governor.record_trip()
+        err = _stall_error(
+            f"{key[0]} dispatch stalled past the "
+            f"{bound:g}s watchdog bound and was quarantined "
+            f"(dispatch_watchdog_seconds)", stage="dispatch",
+            elapsed=bound)
+        for p in group:
+            self._deliver_error(p, err)
+
+    # -- window registry + watchdog (r18) ------------------------------------
+
+    def _register_window(self, batch: list, slot_held: bool) -> _Window:
+        with self._pipe_lock:
+            self._win_seq += 1
+            w = _Window(self._win_seq, batch, slot_held)
+            if self.watchdog_s > 0:
+                self._windows[w.wid] = w
+        return w
+
+    def _window_done(self, w: _Window) -> bool:
+        """Idempotently close a window: unregister it, release its
+        pipeline slot, settle the depth gauge.  Returns False when the
+        window was already closed (quarantined, or a zombie worker
+        finishing late) — the caller must not treat it as its own."""
+        with self._pipe_lock:
+            if w.done:
+                return False
+            w.done = True
+            self._windows.pop(w.wid, None)
+            depth = None
+            if w.inflight:
+                w.inflight = False
+                self._inflight_windows -= 1
+                depth = self._inflight_windows
+            slot = w.slot_held
+            w.slot_held = False
+        if depth is not None:
+            self.stats.gauge("dispatch_pipeline_depth", depth)
+        if slot:
+            self._pipe_slots.release()
+        return True
+
+    def _fail_window_items(self, w: _Window, err: Exception) -> None:
+        """Fail every UNFINISHED item in the window (finished and
+        abandoned ones are skipped by the delivery guard)."""
+        for p in w.items:
+            self._deliver_error(p, err)
+
+    def _ensure_watchdog(self) -> None:
+        if self.watchdog_s <= 0:
+            return  # knob off: the exact pre-r18 thread census
+        if self._watchdog is None or not self._watchdog.is_alive():
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="pilosa-pipeline-watchdog", daemon=True)
+            self._watchdog.start()
+
+    # consecutive idle ticks after which the monitor thread parks
+    # itself (restarted by the next enqueue): a short-lived executor
+    # must not leak a polling thread for the process lifetime
+    WATCHDOG_IDLE_TICKS = 8
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: bound every in-flight window's per-stage
+        age; quarantine overage.  Happy-path cost is one short sleep
+        and a scan of at most pipeline_depth+1 dict entries per tick —
+        nothing touches the dispatch hot path.  Windows whose group
+        joins the collector is bounding itself (``w.bounded``) are
+        skipped: their per-group timeout is the enforcer there, and a
+        whole-window quarantine would take co-batched innocents down.
+        Exits after WATCHDOG_IDLE_TICKS quiet ticks (the next enqueue
+        revives it) so an idle batcher costs no polling."""
+        idle = 0
+        while True:
+            # interval re-derived per tick so a runtime watchdog_s
+            # change (tests, live tuning) takes effect without a
+            # thread restart
+            time.sleep(max(0.02, min(self.watchdog_s / 4.0, 1.0))
+                       if self.watchdog_s > 0 else 0.25)
+            if self.watchdog_s <= 0:
+                with self._lock:
+                    if self._watchdog is threading.current_thread():
+                        self._watchdog = None
+                return
+            now = time.monotonic()
+            with self._pipe_lock:
+                stuck = [w for w in self._windows.values()
+                         if not w.done and not w.bounded
+                         and now - w.t0 > self.watchdog_s]
+            for w in stuck:
+                self._quarantine(w, now - w.t0)
+            # dead-worker sweep (belt over the _run_collector wrapper):
+            # a collector that died with items queued is restarted NOW,
+            # not at the next enqueue
+            with self._lock:
+                backlog = bool(self._queue)
+                t = self._thread
+                quiet = (not self._queue and not self._busy
+                         and not self._windows)
+                if quiet:
+                    idle += 1
+                    if (idle >= self.WATCHDOG_IDLE_TICKS
+                            and self._watchdog
+                            is threading.current_thread()):
+                        # park: _ensure_worker (under this same lock)
+                        # restarts the monitor before any new item can
+                        # enqueue, so no window ever runs unwatched
+                        self._watchdog = None
+                        return
+                else:
+                    idle = 0
+            if backlog and t is not None and not t.is_alive():
+                self._restart_collector()
+
+    def _quarantine(self, w: _Window, age: float) -> None:
+        """A window exceeded the watchdog bound in ``w.stage``: fail
+        its unfinished items with a structured error naming the stage,
+        reclaim its pipeline slot, and supersede the wedged stage
+        worker with a fresh thread so the queue keeps draining (the
+        zombie exits on its own when the hang resolves)."""
+        stage = w.stage
+        # read BEFORE _window_done clears it: was the window handed to
+        # the readback worker, or was it finishing INLINE on the
+        # collector (probe windows, depth<=1 fallbacks)?  The restart
+        # must supersede whichever thread is actually wedged.
+        handed = w.inflight
+        if not self._window_done(w):
+            return  # finished while we decided: no trip
+        self._trips += 1
+        self._quarantined += 1
+        self.stats.count("pipeline_watchdog_trips_total", 1, stage=stage)
+        self.stats.count("pipeline_quarantined_windows_total", 1)
+        self.governor.record_trip()
+        err = _stall_error(
+            f"dispatch-pipeline window stalled in {stage} for "
+            f"{age:.2f}s (dispatch_watchdog_seconds="
+            f"{self.watchdog_s:g}); the window was quarantined and "
+            f"its pipeline slot reclaimed", stage=stage, elapsed=age)
+        self._fail_window_items(w, err)
+        if stage == "readback" and handed and self._readq is not None:
+            self._restart_reader()
+        else:
+            self._restart_collector()
+
+    def _restart_collector(self) -> None:
+        self._thread = threading.Thread(target=self._run_collector,
+                                        name="pilosa-count-batcher",
+                                        daemon=True)
+        self._thread.start()
+        # wake a zombie parked on the kick (it exits on supersession)
+        # and hand any backlog straight to the fresh worker
+        self._kick.set()
+
+    def _restart_reader(self) -> None:
+        self._read_thread = threading.Thread(
+            target=self._read_loop, name="pilosa-batch-readback",
+            daemon=True)
+        self._read_thread.start()
+        # a parked zombie (defensive: restarts normally happen while
+        # the old reader is wedged mid-window) wakes on the sentinel
+        # and exits on supersession
+        self._readq.put(None)
+
+    # -- readback worker -----------------------------------------------------
 
     def _ensure_reader(self) -> None:
         if self._read_thread is None or not self._read_thread.is_alive():
@@ -590,40 +1111,49 @@ class CountBatcher:
 
     def _read_loop(self) -> None:
         while True:
-            item = self._readq.get()
+            if self._read_thread is not threading.current_thread():
+                return  # superseded by a quarantine restart (r18)
+            w = self._readq.get()
+            if w is None or w.done:
+                continue  # wake sentinel / already-quarantined window
+            err = None
             try:
-                self._finish_window(item)
-            except Exception:  # noqa: BLE001 — per-item state is set by
-                pass           # _readback's fallbacks; the worker lives on
-            finally:
-                with self._pipe_lock:
-                    self._inflight_windows -= 1
-                    depth = self._inflight_windows
-                self.stats.gauge("dispatch_pipeline_depth", depth)
-                self._pipe_slots.release()
+                self._finish_window(w)
+            except Exception as e:  # noqa: BLE001 — final guard (r18):
+                err = e
+            if err is not None:
+                # before r18 this swallow could leave a window's
+                # _Pending.event unset forever when _finish_window
+                # raised OUTSIDE _readback's per-item fallbacks; now
+                # every unfinished item is failed loudly
+                self._fail_window_items(w, _wrap_readback_error(err))
+            if self._window_done(w) and err is None and not w.faulted:
+                self.governor.record_success()
 
-    def _finish_window(self, item) -> None:
+    def _finish_window(self, w: _Window) -> None:
         """Read one dispatched window back and finish its items — the
         half of the old loop tail that runs on the readback worker
         when pipelining is on (inline when off)."""
-        pending, distinct_futs, win_bytes = item
+        if fault.ACTIVE:
+            # chaos seam (r18): a stalled device→host read
+            fault.fire("exec.readback_hang")
         # bandwidth wall clock starts HERE, not at dispatch: a
         # pipelined window's queue wait overlaps the previous window's
         # read (the feature working as intended) and must not deflate
         # the gauge — the read itself still blocks on any residual
         # compute, so bytes/wall remains the live achieved bandwidth
         t0 = time.perf_counter()
-        self._readback(pending)
-        if win_bytes:
+        self._readback(w)
+        if w.win_bytes:
             # per-window scan-volume distribution (byte-scale
             # buckets) + the live bandwidth the window achieved
             self.stats.observe("kernel_window_bytes",
-                               float(win_bytes))
+                               float(w.win_bytes))
             wall = time.perf_counter() - t0
             if wall > 0:
                 self.stats.gauge("kernel_bandwidth_gbps",
-                                 round(win_bytes / wall / 1e9, 4))
-        for f in distinct_futs:
+                                 round(w.win_bytes / wall / 1e9, 4))
+        for f in w.distinct_futs:
             try:
                 f.result()
             except Exception:  # noqa: BLE001 — _run_distinct sets its
@@ -639,6 +1169,13 @@ class CountBatcher:
         as the enqueue floor."""
         t0 = time.perf_counter()
         kind = key[0]
+        if fault.ACTIVE:
+            # chaos seams (r18): a hung XLA compile / stalled dispatch
+            # (delay action) and a faulting dispatch (error action) —
+            # the sites the watchdog, quarantine and governor are
+            # proven against
+            fault.fire("exec.dispatch_hang", kind=kind)
+            fault.fire("exec.dispatch_error", kind=kind)
         if kind == "count":
             ret = self._dispatch_counts(group)
         elif kind == "rowcounts":
@@ -708,13 +1245,14 @@ class CountBatcher:
         else:
             self._fallback_aggs(key[0], group)
 
-    def _readback(self, pending: list) -> None:
+    def _readback(self, w: _Window) -> None:
         """One device->host transfer for the whole collection window:
         pack every group's int32 output into a single flat array, read
         it once, slice per group.  A single-group window reads its
         output directly (the pack would only add a dispatch); any pack
         or finish failure degrades to per-group reads, then to the
         per-item fallbacks."""
+        pending = w.pending
         if not pending:
             return
         if len(pending) == 1:
@@ -722,6 +1260,9 @@ class CountBatcher:
             try:
                 finish(np.asarray(out))
             except Exception:  # noqa: BLE001 — per-item fallback
+                w.faulted = True
+                self.governor.record_fault()
+                w.t0 = time.monotonic()  # fresh budget for the fallback
                 self._run_fallback(key, group)
             else:
                 # only after a delivered finish (which copied): a
@@ -757,6 +1298,9 @@ class CountBatcher:
                     off += size
                 finish(host)
             except Exception:  # noqa: BLE001 — per-item fallback
+                w.faulted = True
+                self.governor.record_fault()
+                w.t0 = time.monotonic()  # fresh budget for the fallback
                 self._run_fallback(key, group)
         # every finish copied out of `packed` (astype/int/fancy-index),
         # so the packed device buffer can re-enter the donated chain
@@ -784,21 +1328,22 @@ class CountBatcher:
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
             for p, (a, b) in zip(group, spans):
-                p.result = [int(row.sum()) for row in host[a:b]]
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, [int(row.sum()) for row in host[a:b]])
         return per_shard, finish
 
     def _fallback_counts(self, group: list[_Pending]) -> None:
         for p in group:
+            if self._skip(p):
+                continue
             try:
-                p.result = [
+                self._deliver(p, [
                     int(kernels.shard_totals(
                         self.fused.run(node, p.leaves, "count")))
-                    for node in p.nodes]
+                    for node in p.nodes])
             except Exception as e2:  # noqa: BLE001
-                p.error = e2
-            finally:
-                p.event.set()
+                self._deliver_error(p, e2)
 
     def _dispatch_selcounts(self, group: list[_Pending]):
         """The window's selected-row Counts over one plane: gather the
@@ -823,8 +1368,9 @@ class CountBatcher:
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
             for p in group:
-                p.result = host[[pos[s] for s in p.nodes]]
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, host[[pos[s] for s in p.nodes]])
         return out, finish
 
     def _dispatch_tree(self, group: list[_Pending]):
@@ -842,21 +1388,22 @@ class CountBatcher:
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
             for k, p in enumerate(group):
-                p.result = int(host[k])
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, int(host[k]))
         return out, finish
 
     def _fallback_tree(self, group: list[_Pending]) -> None:
         for p in group:
+            if self._skip(p):
+                continue
             try:
                 slots, prog, extras = p.nodes
                 out = self.fused.run_tree_counts(
                     p.leaves[0], slots, (prog,), extras, delta=p.delta)
-                p.result = int(np.asarray(out).astype(np.int64)[0])
+                self._deliver(p, int(np.asarray(out).astype(np.int64)[0]))
             except Exception as e2:  # noqa: BLE001
-                p.error = e2
-            finally:
-                p.event.set()
+                self._deliver_error(p, e2)
 
     def _dispatch_rowcounts_delta(self, group: list[_Pending]):
         """Whole-plane row counts of base⊕delta: the group key is the
@@ -870,29 +1417,30 @@ class CountBatcher:
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
             for p in group:
-                p.result = host
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, host)
         return out, finish
 
     def _fallback_selcounts(self, group: list[_Pending]) -> None:
         import jax.numpy as jnp
         for p in group:
+            if self._skip(p):
+                continue
             try:
                 idx = jnp.asarray(p.nodes, dtype=jnp.int32)
                 if p.delta is not None:
                     from pilosa_tpu.ingest.delta import \
                         adjusted_selected_counts
                     d = p.delta
-                    p.result = np.asarray(adjusted_selected_counts(
+                    self._deliver(p, np.asarray(adjusted_selected_counts(
                         p.leaves[0], idx, d.rows, d.words,
-                        d.vals)).astype(np.int64)
+                        d.vals)).astype(np.int64))
                 else:
-                    p.result = kernels.shard_totals(
-                        kernels.selected_row_counts(p.leaves[0], idx))
+                    self._deliver(p, kernels.shard_totals(
+                        kernels.selected_row_counts(p.leaves[0], idx)))
             except Exception as e2:  # noqa: BLE001
-                p.error = e2
-            finally:
-                p.event.set()
+                self._deliver_error(p, e2)
 
     @staticmethod
     def _dedupe(group: list[_Pending]):
@@ -932,29 +1480,30 @@ class CountBatcher:
         def finish(host: np.ndarray) -> None:
             host = host.astype(np.int64)
             for p, slot in zip(group, assign):
-                p.result = host[slot]
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, host[slot])
         return out, finish
 
     def _fallback_rowcounts(self, group: list[_Pending]) -> None:
         for p in group:
+            if self._skip(p):
+                continue
             try:
                 flt = p.leaves[1] if len(p.leaves) == 2 else None
                 if p.delta is not None:
                     from pilosa_tpu.ingest.delta import \
                         adjusted_row_counts
                     d = p.delta
-                    p.result = np.asarray(adjusted_row_counts(
+                    self._deliver(p, np.asarray(adjusted_row_counts(
                         p.leaves[0], d.rows, d.words, d.vals, flt,
                         reduce_shards=False)).astype(np.int64).sum(
-                            axis=0)
+                            axis=0))
                 else:
-                    p.result = kernels.shard_totals(
-                        kernels.row_counts(p.leaves[0], flt))
+                    self._deliver(p, kernels.shard_totals(
+                        kernels.row_counts(p.leaves[0], flt)))
             except Exception as e2:  # noqa: BLE001
-                p.error = e2
-            finally:
-                p.event.set()
+                self._deliver_error(p, e2)
 
     def _run_distinct(self, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik
@@ -991,10 +1540,9 @@ class CountBatcher:
                 t.join()
         for p, slot in zip(group, assign):
             if errors[slot] is not None:
-                p.error = errors[slot]
+                self._deliver_error(p, errors[slot])
             else:
-                p.result = results[slot]
-            p.event.set()
+                self._deliver(p, results[slot])
         # distinct can't join the packed readback (multi-dispatch host
         # loop), so its dispatch observation covers the whole scan —
         # read included — and its bytes land on the same counter
@@ -1025,20 +1573,31 @@ class CountBatcher:
 
         def finish(host: np.ndarray) -> None:
             for k, p in enumerate(group):
-                p.result = decode(host[k])
-                p.event.set()
+                if self._skip(p):
+                    continue
+                self._deliver(p, decode(host[k]))
         return out, finish
 
     def _fallback_aggs(self, kind: str, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik
         for p in group:
+            if self._skip(p):
+                continue
             try:
                 flt = p.leaves[1] if len(p.leaves) == 2 else None
                 if kind == "sum":
-                    p.result = bsik.sum_count(p.leaves[0], flt)
+                    self._deliver(p, bsik.sum_count(p.leaves[0], flt))
                 else:
-                    p.result = bsik.min_max(p.leaves[0], flt)
+                    self._deliver(p, bsik.min_max(p.leaves[0], flt))
             except Exception as e2:  # noqa: BLE001
-                p.error = e2
-            finally:
-                p.event.set()
+                self._deliver_error(p, e2)
+
+
+def _wrap_readback_error(exc: Exception) -> Exception:
+    """A failure escaping ``_finish_window`` OUTSIDE the per-item
+    fallbacks: wrap as a structured stall error (stage=readback) so
+    the window's unfinished items fail loudly instead of wedging."""
+    err = _stall_error(f"window readback failed: {exc!r}",
+                       stage="readback")
+    err.__cause__ = exc
+    return err

@@ -238,7 +238,28 @@ class QueryTimeoutError(ExecutionError):
     """Query deadline exceeded (reference: upstream threads request
     context cancellation through the executor; deadlines are the
     equivalent for a compiled-dispatch engine — checked at block
-    boundaries, between calls, and before each streamed row block)."""
+    boundaries, between calls, before each streamed row block, and —
+    r18 — while blocked on the dispatch pipeline, where ``stage``
+    names what the query was waiting on when the clock ran out
+    (queued/dispatch/readback); it rides the structured 504 body)."""
+
+    def __init__(self, msg: str, stage: str | None = None):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class PipelineStalledError(ExecutionError):
+    """A dispatch-pipeline window exceeded the watchdog bound and was
+    quarantined (r18): the caller's work was failed loudly — naming
+    the stalled stage — instead of wedging a serving thread forever
+    behind a sick device.  Maps to a structured HTTP 500
+    (``pipelineStall`` body) at the public and internal edges."""
+
+    def __init__(self, msg: str, stage: str = "dispatch",
+                 elapsed: float = 0.0):
+        super().__init__(msg)
+        self.stage = stage
+        self.elapsed = elapsed
 
 
 @dataclass
@@ -268,7 +289,9 @@ class Executor:
                  delta_compact_fraction: float = 0.5,
                  tree_fusion: bool = True,
                  dispatch_pipeline_depth: int = 2,
-                 solo_fastlane: bool = True):
+                 solo_fastlane: bool = True,
+                 dispatch_watchdog_seconds: float = 30.0,
+                 device_health_probe_seconds: float = 5.0):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -284,7 +307,16 @@ class Executor:
         compute overlaps window N-1's readback); <=1 restores serial
         dispatch->read.  ``solo_fastlane`` (r17): width-1 requests
         with no queue pressure dispatch inline on the caller thread
-        over donated ping-pong chains instead of forming a window."""
+        over donated ping-pong chains instead of forming a window.
+        ``dispatch_watchdog_seconds`` (r18): per-stage age bound on
+        in-flight batcher windows — a window stalled past it is
+        quarantined (items failed with a structured error naming the
+        stage, pipeline slot reclaimed, wedged worker superseded);
+        0 disables the monitor entirely (pre-r18 contract).
+        ``device_health_probe_seconds`` (r18): how long degraded
+        serving (per-item fallback execution after consecutive
+        dispatch faults / watchdog trips) lasts before one window
+        probes the fused pipeline again."""
         self.holder = holder
         self.translate = translate or TranslateStore(holder.path)
         self.placement = placement
@@ -334,7 +366,9 @@ class Executor:
             self.batcher = CountBatcher(
                 self.fused, window_s=window, stats=self.stats,
                 pipeline_depth=dispatch_pipeline_depth,
-                solo_fastlane=solo_fastlane)
+                solo_fastlane=solo_fastlane,
+                watchdog_s=dispatch_watchdog_seconds,
+                probe_after_s=device_health_probe_seconds)
         # query-plan cache (r6 tentpole): (index, normalized PQL,
         # shards, translate flag) -> planned tree + leaf specs, so a
         # repeated serving shape skips parse AND plan entirely (PQL
@@ -365,6 +399,24 @@ class Executor:
         """Admitted top-level queries currently executing (the
         /metrics ``query_slots_in_use`` gauge)."""
         return self._inflight
+
+    def _query_deadline(self) -> float | None:
+        """The serving thread's current query deadline (set by the
+        outermost :meth:`execute`) — what every batcher submit
+        carries so pipeline waits stay bounded (r18)."""
+        return getattr(self._tls, "deadline", None)
+
+    def device_health(self) -> dict:
+        """The ``/status`` deviceHealth block: the batcher's governor
+        state, watchdog knob and quarantine counts (a batcher-less
+        executor is trivially healthy — there is no shared pipeline
+        to stall)."""
+        if self.batcher is None:
+            return {"state": "healthy", "stateCode": 0,
+                    "watchdogSeconds": 0.0, "quarantinedWindows": 0,
+                    "inflightWindows": 0, "consecutiveFaults": 0,
+                    "watchdogTrips": 0}
+        return self.batcher.health_payload()
 
     # -- in-flight accounting (OOM recovery) --------------------------------
 
@@ -467,6 +519,11 @@ class Executor:
                 raise
             timer.mark("admit")
             self._tls.stage_timer = timer
+            # deadline propagation (r18): remember this query's cutoff
+            # on the serving thread so every batcher submit down-stack
+            # carries it — wait() then blocks with a BOUNDED timeout
+            # instead of forever behind a sick device
+            self._tls.deadline = deadline
         self._tls.depth = depth + 1
         try:
             if depth == 0 and fault.ACTIVE:
@@ -496,6 +553,7 @@ class Executor:
             self._tls.depth = depth
             if depth == 0:
                 self._tls.stage_timer = None
+                self._tls.deadline = None
                 self.planes.end_query()
                 self._leave_inflight()
                 if self._exec_slots is not None:
@@ -583,7 +641,8 @@ class Executor:
         paths).  With the batcher, the whole request is ONE batch item:
         concurrent requests share a dispatch + read."""
         if self.batcher is not None:
-            out = self.batcher.submit_many(nodes, leaves)
+            out = self.batcher.submit_many(
+                nodes, leaves, deadline=self._query_deadline())
             if timer is not None:
                 timer.mark("read")
             return out
@@ -714,13 +773,15 @@ class Executor:
                 # single tree: the blocking submit rides the solo fast
                 # lane when traffic is solo (inline dispatch, no window)
                 ps, item = resolved[0]
-                out = [self.batcher.submit_tree(ps.plane, *item,
-                                                delta=ps.delta)]
+                out = [self.batcher.submit_tree(
+                    ps.plane, *item, delta=ps.delta,
+                    deadline=self._query_deadline())]
             else:
                 # enqueue ALL trees before waiting on any: the whole
                 # request lands in one collection window
-                handles = [self.batcher.enqueue_tree(ps.plane, *item,
-                                                     delta=ps.delta)
+                handles = [self.batcher.enqueue_tree(
+                    ps.plane, *item, delta=ps.delta,
+                    deadline=self._query_deadline())
                            for ps, item in resolved]
                 out = [self.batcher.wait(h) for h in handles]
             if timer is not None:
@@ -879,8 +940,9 @@ class Executor:
         (``ps.delta``, r15 ingest) answers base⊕delta in the same
         program — writes never force a rebuild here."""
         if self.batcher is not None:
-            vals = self.batcher.submit_selected(ps.plane, slots,
-                                                delta=ps.delta)
+            vals = self.batcher.submit_selected(
+                ps.plane, slots, delta=ps.delta,
+                deadline=self._query_deadline())
             if timer is not None:
                 timer.mark("read")  # coalesced wait: window+dispatch+read
         else:
@@ -907,7 +969,8 @@ class Executor:
         small = len(ps.shards) <= self._REDUCE_SHARD_MAX
         delta = ps.delta
         if self.batcher is not None and small:
-            totals = self.batcher.submit_rowcounts(ps.plane, delta=delta)
+            totals = self.batcher.submit_rowcounts(
+                ps.plane, delta=delta, deadline=self._query_deadline())
             if timer is not None:
                 timer.mark("read")  # coalesced wait: window+dispatch+read
             return totals
@@ -2027,7 +2090,8 @@ class Executor:
             try:
                 leaves: list = []
                 node = self._plan(ctx, call.children[0], leaves)
-                return self.batcher.submit(node, leaves)
+                return self.batcher.submit(
+                    node, leaves, deadline=self._query_deadline())
             except Unfusable:
                 pass
         # fused: bitwise tree + per-shard popcount in one XLA program;
@@ -2051,7 +2115,8 @@ class Executor:
             # concurrent identical Distincts share one presence scan
             # through the coalescing window (dedupe, not stacking —
             # the scan is a multi-dispatch block loop)
-            pos, neg = self.batcher.submit_distinct(ps.plane, filter_words)
+            pos, neg = self.batcher.submit_distinct(
+                ps.plane, filter_words, deadline=self._query_deadline())
         else:
             pos, neg = bsik.distinct_presence(ps.plane, filter_words)
         pos = np.nonzero(np.asarray(pos))[0]
@@ -2111,7 +2176,8 @@ class Executor:
         if self.batcher is not None:
             # concurrent BSI aggregates coalesce like Counts: one
             # program + one read per collection window
-            total, cnt = self.batcher.submit_sum(ps.plane, filter_words)
+            total, cnt = self.batcher.submit_sum(
+                ps.plane, filter_words, deadline=self._query_deadline())
         else:
             # same compiled one-read program, batch of one (eager
             # bit_counts would pay one dispatch per op + 3 reads)
@@ -2134,7 +2200,8 @@ class Executor:
         field, filter_words = self._agg_args(ctx, call)
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
         if self.batcher is not None:
-            per_shard = self.batcher.submit_minmax(ps.plane, filter_words)
+            per_shard = self.batcher.submit_minmax(
+                ps.plane, filter_words, deadline=self._query_deadline())
         else:
             flags = (filter_words is not None,)
             leaves = (ps.plane,) + ((filter_words,)
@@ -2244,11 +2311,12 @@ class Executor:
                 # BEFORE either wait, so a tanimoto request pays one
                 # collection window, not two in series.  A delta-dirty
                 # plane (r15 ingest) answers base⊕delta in-window.
-                h1 = self.batcher.enqueue_rowcounts(ps.plane,
-                                                    filter_words,
-                                                    delta=ps.delta)
-                h2 = (self.batcher.enqueue_rowcounts(ps.plane,
-                                                     delta=ps.delta)
+                h1 = self.batcher.enqueue_rowcounts(
+                    ps.plane, filter_words, delta=ps.delta,
+                    deadline=self._query_deadline())
+                h2 = (self.batcher.enqueue_rowcounts(
+                    ps.plane, delta=ps.delta,
+                    deadline=self._query_deadline())
                       if need_row_counts else None)
                 totals = self.batcher.wait(h1)[:ps.n_rows]
                 if h2 is not None:

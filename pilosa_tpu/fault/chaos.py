@@ -49,6 +49,17 @@ coordinator_crash_hint_log            kill -9 the write coordinator
                                       recovery truncates the torn op
                                       (it never applies anywhere) and
                                       replays the clean prefix
+hung_dispatch_serving                 a hung device dispatch on one
+                                      plane: unaffected queries keep
+                                      answering exact (availability
+                                      1.0), the wedged caller gets a
+                                      structured 504/500 naming the
+                                      stage, the governor probes back
+                                      to healthy, zero leaked threads
+flaky_device_governor                 consecutive dispatch faults:
+                                      answers stay exact (fallback),
+                                      the governor degrades then
+                                      probes back to healthy
 ====================================  ==================================
 
 Oracle semantics are at-least-once honest: a write the harness saw FAIL
@@ -1010,6 +1021,262 @@ def scenario_bulk_import_kill_handoff(cluster, seed: int) -> ChaosHarness:
     return h
 
 
+def scenario_hung_dispatch_serving(cluster, seed: int) -> ChaosHarness:
+    """A device dispatch HANGS mid-serve (r18): the ``exec.dispatch_hang``
+    failpoint stalls one plane's whole-plane row-count dispatch (the
+    kind a multi-Count request over index A rides) while concurrent
+    single-Count traffic against index B keeps flowing.  Invariants:
+
+    - every B query from before the hang to after recovery answers
+      oracle-exact — ZERO failures (availability 1.0 for unaffected
+      work: the watchdog bounds the stall per group/window, so B's
+      items are never wedged behind A's sick dispatch);
+    - the wedged A caller receives a STRUCTURED error naming the
+      stalled stage (504 timeout with ``stage`` or 500
+      ``pipelineStall``) within its deadline + one watchdog period +
+      grace — never a hung connection;
+    - the watchdog trip degrades the governor and, after the fault
+      clears, probing returns it to HEALTHY (visible on /status
+      deviceHealth);
+    - no leaked pipeline threads after recovery: exactly one collector
+      and at most one readback worker remain once the zombie unwedges
+      (the post-scenario thread census, via /debug/threads).
+
+    Requires a cluster booted with a sub-second watchdog + probe and
+    the solo fast lane off (see SCENARIOS extra_env) — the hang must
+    land in the windowed dispatch the watchdog governs."""
+    h = ChaosHarness(cluster, seed, index="chaos_hang_a")
+    c = h.client(0)
+    index_b = "chaos_hang_b"
+    h.setup()
+    c.create_index(index_b)
+    c.create_field(index_b, h.field)
+    # deterministic oracles: all writes happen BEFORE the fault
+    want_a = {}
+    for row in range(3):
+        cols = {h.rng.randrange(h.MAX_COL) for _ in range(6)}
+        for col in cols:
+            c.query(h.index, f"Set({col}, {h.field}={row})")
+        want_a[row] = len(cols)
+    want_b = {}
+    for row in range(3):
+        cols = {h.rng.randrange(h.MAX_COL) for _ in range(5)}
+        for col in cols:
+            c.query(index_b, f"Set({col}, {h.field}={row})")
+        want_b[row] = len(cols)
+    # warm both planes: the multi-Count A request must ride the
+    # resident whole-plane rowcounts path before the hang is armed.
+    # Retried: the scenario boots with a 0.4s watchdog, and a
+    # first-time XLA compile legitimately outliving it just gets a
+    # quarantine 500 — a retry hits the now-cached program.
+    pql_a = "".join(f"Count(Row({h.field}={r}))" for r in range(3))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if c.query(h.index, pql_a) == [want_a[r] for r in range(3)]:
+                break
+        except (ClientError, OSError):
+            pass
+        time.sleep(0.2)
+    else:
+        raise h._fail("index A plane never warmed")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if all(c.query(index_b, f"Count(Row({h.field}={row}))")
+                   == [want_b[row]] for row in range(3)):
+                break
+        except (ClientError, OSError):
+            pass
+        time.sleep(0.2)
+    else:
+        raise h._fail("index B never warmed oracle-exact")
+    # if a warm-up compile tripped the 0.4s watchdog, let the governor
+    # probe back before the measured episode starts (queries must keep
+    # flowing — probes ride collection windows)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            c.query(index_b, f"Count(Row({h.field}=0))")
+            if c._json("GET", "/status")["deviceHealth"]["state"] \
+                    == "healthy":
+                break
+        except (ClientError, OSError):
+            pass
+        time.sleep(0.1)
+    else:
+        raise h._fail("governor not healthy before the hang")
+
+    # unaffected traffic: hammer B single-Counts THROUGH the stall
+    import threading
+    b_errors: list = []
+    b_served = [0]
+    stop_at = [time.monotonic() + 12.0]
+
+    def b_reader(i: int) -> None:
+        bc = cluster.client(0)
+        row = i % 3
+        while time.monotonic() < stop_at[0]:
+            try:
+                got = bc.query(index_b,
+                               f"Count(Row({h.field}={row}))")
+            except (ClientError, OSError) as e:
+                b_errors.append(f"B query failed: {e!r}")
+                return
+            if got != [want_b[row]]:
+                b_errors.append(f"B answer diverged: {got} != "
+                                f"[{want_b[row]}]")
+                return
+            b_served[0] += 1
+    readers = [threading.Thread(target=b_reader, args=(i,))
+               for i in range(4)]
+    for t in readers:
+        t.start()
+    time.sleep(0.5)  # readers established through the healthy path
+    # the hang: one plane's (index A's) rowcounts dispatch stalls for
+    # 2s — well past the 0.4s watchdog — exactly once
+    h.set_fault(0, "exec.dispatch_hang", "delay", times=1,
+                match={"kind": "rowcounts"}, args={"seconds": 2.0})
+    t0 = time.monotonic()
+    try:
+        c._do("POST", f"/index/{h.index}/query?timeout=1.0",
+              pql_a.encode())
+    except ClientError as e:
+        elapsed = time.monotonic() - t0
+        if e.status not in (500, 504):
+            raise h._fail(
+                f"wedged caller got status {e.status}, not a "
+                f"structured 500/504: {e}")
+        msg = str(e)
+        if "dispatch" not in msg and "pipeline" not in msg \
+                and "stage" not in msg:
+            raise h._fail(f"error does not name the stalled stage: "
+                          f"{msg!r}")
+        # deadline (1.0) + one watchdog period (0.4) + grace
+        if elapsed > 1.0 + 0.4 + 1.0:
+            raise h._fail(f"wedged caller held {elapsed:.2f}s — past "
+                          f"deadline + watchdog + grace")
+    else:
+        raise h._fail("query through a hung dispatch succeeded "
+                      "inside its 1s deadline against a 2s stall")
+    finally:
+        h.clear_faults()
+    # the governor tripped (watchdog) and must probe back to healthy
+    deadline = time.monotonic() + 20
+    saw_degraded = False
+    while time.monotonic() < deadline:
+        dh = c._json("GET", "/status").get("deviceHealth", {})
+        if dh.get("state") in ("degraded", "probing"):
+            saw_degraded = True
+        if saw_degraded and dh.get("state") == "healthy":
+            break
+        time.sleep(0.1)
+    else:
+        raise h._fail(
+            f"governor never walked degraded→healthy after the hang "
+            f"(last state {dh.get('state')!r}, saw_degraded="
+            f"{saw_degraded})")
+    if h.counter_total(0, "pipeline_watchdog_trips_total") < 1:
+        raise h._fail("pipeline_watchdog_trips_total never incremented")
+    if h.counter_total(0, "pipeline_quarantined_windows_total") < 1:
+        raise h._fail("no window was ever quarantined")
+    # recovered: A serves exact again (fresh collector, healthy state)
+    if c.query(h.index, pql_a) != [want_a[r] for r in range(3)]:
+        raise h._fail("index A diverged after recovery")
+    stop_at[0] = 0.0
+    for t in readers:
+        t.join(timeout=30)
+    if b_errors:
+        raise h._fail(f"unaffected traffic failed through the stall: "
+                      f"{b_errors[:3]}")
+    if b_served[0] < 8:
+        raise h._fail(f"B readers served only {b_served[0]} queries — "
+                      f"not meaningful coverage of the stall window")
+    # thread census: after the 2s delay resolves, the superseded
+    # zombie collector exits — exactly one live collector, at most
+    # one readback worker, at most one watchdog remain
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        dump = h.client(0)._do("GET", "/debug/threads").decode()
+        census = {
+            name: dump.count(f"Thread {name} (")
+            for name in ("pilosa-count-batcher",
+                         "pilosa-batch-readback",
+                         "pilosa-pipeline-watchdog")}
+        if (census["pilosa-count-batcher"] == 1
+                and census["pilosa-batch-readback"] <= 1
+                and census["pilosa-pipeline-watchdog"] <= 1):
+            break
+        time.sleep(0.3)
+    else:
+        raise h._fail(f"pipeline threads leaked after recovery: "
+                      f"{census}")
+    return h
+
+
+def scenario_flaky_device_governor(cluster, seed: int) -> ChaosHarness:
+    """A FLAKY-then-healthy device (r18): ``exec.dispatch_error``
+    fails consecutive fused dispatches (each falls back per item —
+    answers stay oracle-exact) until the governor's breaker degrades
+    serving; once the fault schedule exhausts, a probe window flips it
+    back to healthy.  Invariants: every query answers exactly through
+    the whole episode, the governor walks
+    healthy→degraded→(probing)→healthy on /status, and
+    ``device_health_state`` is exported on /metrics.  Requires a
+    cluster booted with a sub-second probe interval and the solo fast
+    lane off (see SCENARIOS extra_env)."""
+    h = ChaosHarness(cluster, seed, index="chaos_flaky")
+    c = h.client(0)
+    h.setup()
+    for row in range(3):
+        for _ in range(5):
+            if not h.write(row, h.rng.randrange(h.MAX_COL)):
+                raise h._fail("setup write did not ack")
+    want = {row: len(h.acked.get(row, ())) for row in range(3)}
+    for row in range(3):  # warm the fused path
+        if c.query(h.index, f"Count(Row({h.field}={row}))") \
+                != [want[row]]:
+            raise h._fail("warmup count diverged")
+    if c._json("GET", "/status")["deviceHealth"]["state"] != "healthy":
+        raise h._fail("governor not healthy before the fault")
+    # enough consecutive faults to cross the breaker threshold (3),
+    # plus one to fail the first probe — then the device 'heals'
+    h.set_fault(0, "exec.dispatch_error", "error", times=4)
+    saw = {"degraded": False, "healthy_again": False}
+    deadline = time.monotonic() + 30
+    i = 0
+    try:
+        while time.monotonic() < deadline:
+            row = i % 3
+            i += 1
+            got = c.query(h.index, f"Count(Row({h.field}={row}))")
+            if got != [want[row]]:
+                raise h._fail(
+                    f"answer diverged under dispatch faults: {got} != "
+                    f"[{want[row]}] (degraded serving must stay exact)")
+            state = c._json("GET", "/status")["deviceHealth"]["state"]
+            if state in ("degraded", "probing"):
+                saw["degraded"] = True
+            elif state == "healthy" and saw["degraded"]:
+                saw["healthy_again"] = True
+                break
+            time.sleep(0.05)
+    finally:
+        h.clear_faults()
+    if not saw["degraded"]:
+        raise h._fail("governor never degraded under consecutive "
+                      "dispatch faults")
+    if not saw["healthy_again"]:
+        raise h._fail("governor never probed back to healthy after "
+                      "the fault schedule exhausted")
+    if h.counter_total(0, "fault_triggered_total") < 3:
+        raise h._fail("dispatch faults never actually fired")
+    if "device_health_state" not in c.metrics_text():
+        raise h._fail("device_health_state missing from /metrics")
+    h.check_oracle()
+    return h
+
+
 SCENARIOS = {
     "partition_during_resize": (scenario_partition_during_resize, 3),
     "crash_mid_oplog_append": (scenario_crash_mid_oplog_append, 1),
@@ -1031,6 +1298,21 @@ SCENARIOS = {
                                    3),
     # r15 — ingest (bulk imports through failure, op-id dedup)
     "bulk_import_kill_handoff": (scenario_bulk_import_kill_handoff, 3),
+    # r18 — self-healing dispatch pipeline (watchdog, quarantine,
+    # device health governor): sub-second watchdog/probe so the
+    # scenarios finish under tier-1, fast lane off so the injected
+    # hang lands in the windowed dispatch the watchdog governs
+    "hung_dispatch_serving": (scenario_hung_dispatch_serving, 1,
+                              {"PILOSA_DISPATCH_WATCHDOG_SECONDS": "0.4",
+                               "PILOSA_DEVICE_HEALTH_PROBE_SECONDS":
+                                   "0.4",
+                               "PILOSA_SOLO_FASTLANE": "0",
+                               "PILOSA_COUNT_BATCH_WINDOW": "0.002"}),
+    "flaky_device_governor": (scenario_flaky_device_governor, 1,
+                              {"PILOSA_DEVICE_HEALTH_PROBE_SECONDS":
+                                   "0.3",
+                               "PILOSA_SOLO_FASTLANE": "0",
+                               "PILOSA_COUNT_BATCH_WINDOW": "0.002"}),
 }
 
 

@@ -109,7 +109,10 @@ class PilosaTPUServer:
             delta_compact_fraction=self.cfg.delta_compact_fraction,
             tree_fusion=self.cfg.tree_fusion,
             dispatch_pipeline_depth=self.cfg.dispatch_pipeline_depth,
-            solo_fastlane=self.cfg.solo_fastlane)
+            solo_fastlane=self.cfg.solo_fastlane,
+            dispatch_watchdog_seconds=self.cfg.dispatch_watchdog_seconds,
+            device_health_probe_seconds=(
+                self.cfg.device_health_probe_seconds))
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

@@ -486,3 +486,40 @@ def test_config27_compound_smoke():
         assert d["modes"][mode]["single_stream"]["ok"] > 0
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config28_pipeline_resilience_smoke():
+    """bench/config28 (serving through a sick device, r18) in --smoke
+    mode: an injected dispatch hang on one plane while unaffected
+    traffic keeps flowing.  Pinned on every run — the bench itself
+    asserts them while measuring: availability == 1.0 for the
+    unaffected work, the wedged caller's structured 504/500 names the
+    stalled stage within deadline + one watchdog period + grace, the
+    governor walks degraded→healthy, and zero pipeline threads leak
+    after recovery."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config28_pipeline_resilience.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("pipeline_resilience_qps")
+    assert out["unit"] == "qps" and out["value"] > 0
+    d = out["detail"]
+    # the acceptance bar: a stall on one plane costs unaffected work
+    # NOTHING — asserted in-bench too, re-checked here on the artifact
+    assert d["stall"]["availability"] == 1.0
+    assert d["stall"]["caller_status"] in (500, 504)
+    assert d["stall"]["caller_stage"] in ("dispatch", "queued",
+                                          "readback")
+    assert d["stall"]["caller_seconds"] is not None
+    assert d["healthy"]["qps"] > 0 and d["degraded"]["qps"] > 0
+    assert d["degraded"]["qps_ratio"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -27,6 +27,16 @@ distributed invariant after faults clear:
                                  acking (hinted as import records),
                                  the drain replays, op-id dedup no-ops
                                  redelivery, AAE resurrects nothing
+- hung dispatch serving        → a hung device dispatch: unaffected
+                                 queries keep answering oracle-exact,
+                                 the wedged caller gets a structured
+                                 504/500 naming the stage, the
+                                 governor probes back to healthy, no
+                                 leaked pipeline threads
+- flaky device governor        → consecutive dispatch faults: answers
+                                 stay exact on the fallback path while
+                                 the governor degrades, then probes
+                                 back to healthy
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -122,3 +132,23 @@ def test_straggler_hedged_read(tmp_path):
     with run_process_cluster(3, str(tmp_path), replicas=2,
                              extra_env=env) as cluster:
         chaos.scenario_straggler_hedged_read(cluster, SEED)
+
+
+def test_hung_dispatch_serving(tmp_path):
+    # own single-node cluster: sub-second watchdog/probe knobs (r18) —
+    # a hung dispatch on one plane must cost its caller a structured
+    # error and nobody else anything
+    env = dict(chaos.SCENARIOS["hung_dispatch_serving"][2])
+    with run_process_cluster(1, str(tmp_path),
+                             extra_env=env) as cluster:
+        chaos.scenario_hung_dispatch_serving(cluster, SEED)
+
+
+def test_flaky_device_governor(tmp_path):
+    # own single-node cluster: sub-second probe interval (r18) — the
+    # governor must degrade under consecutive dispatch faults and
+    # probe back once the device heals, answers exact throughout
+    env = dict(chaos.SCENARIOS["flaky_device_governor"][2])
+    with run_process_cluster(1, str(tmp_path),
+                             extra_env=env) as cluster:
+        chaos.scenario_flaky_device_governor(cluster, SEED)
