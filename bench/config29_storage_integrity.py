@@ -1,0 +1,331 @@
+"""Config #29: storage-integrity overhead + corruption MTTR (r19).
+
+Two phases:
+
+**A — scrub overhead.**  The config18 concurrent product workload
+(oracle-verified every call) measured twice over one on-disk index:
+scrub OFF (the pre-r19 contract) vs a LIVE scrubber re-verifying the
+same files in a continuous loop at the default 32 MB/s byte budget.
+The acceptance bar: scrub-on within 3% of scrub-off at the widest
+concurrency level (asserted in full runs; ``--smoke`` runs toy planes
+on CPU where noise swamps 3%, so smoke only bounds catastrophe).
+
+**B — corruption drill, measured.**  An in-process 2-node replicas=2
+cluster; one snapshot byte-flipped on disk while reader threads hammer
+BOTH nodes.  Asserted while measuring: read availability == 1.0 (zero
+failed reads, every answer oracle-exact — quarantined legs 503 and
+ride the replica-failover path), the scrubber detects + repairs from
+the replica, and a forced AAE round moves zero blocks afterwards.
+Reported: detection-to-repaired MTTR seconds.
+
+``--smoke`` (or PILOSA_BENCH_SMOKE=1): 2 shards × 4 rows, sweep 1/2/4
+— tier-1 runs it (tests/test_bench_smoke.py) so this bench can never
+bitrot.
+
+Prints ONE JSON line: scrub-on qps at the widest level; ``regressions``
+carries the shared headline guard plus the r19 detail guard rows
+(``repair_availability``, ``qps_scrub_on``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+from bench._util import log
+
+SMOKE = ("--smoke" in sys.argv
+         or os.environ.get("PILOSA_BENCH_SMOKE") == "1")
+N_SHARDS = 2 if SMOKE else int(os.environ.get("PILOSA_BENCH_SHARDS", "954"))
+N_ROWS = 4 if SMOKE else int(os.environ.get("PILOSA_BENCH_ROWS", "32"))
+SWEEP = ((1, 2, 4) if SMOKE else (1, 2, 4, 8, 16, 32, 64))
+ITERS = 3 if SMOKE else 6
+WORDS = 32768  # words per shard (2^20 bits / 32)
+INDEX, FIELD = "i", "f"
+MAX_OVERHEAD = 0.03  # the r19 acceptance bar (full runs)
+DRILL_SECONDS = 4.0 if SMOKE else 15.0
+
+
+def write_index(plane: np.ndarray, data_dir: str) -> None:
+    """A REAL on-disk index from the packed plane (the config18
+    recipe), then re-snapshotted through the fragments so every file
+    carries the r19 frame checksum the scrubber verifies."""
+    from pilosa_tpu.store import Holder, roaring
+
+    h = Holder(data_dir).open()
+    idx = h.create_index(INDEX, track_existence=False)
+    idx.create_field(FIELD)
+    h.close()
+    frag_dir = os.path.join(data_dir, INDEX, FIELD, "views", "standard",
+                            "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(plane.shape[0]):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+    # frame every snapshot (legacy unframed files scrub by full parse,
+    # which is NOT the steady-state cost this config measures)
+    h = Holder(data_dir).open()
+    for v in h.index(INDEX).field(FIELD).views.values():
+        for frag in v.fragments.values():
+            frag.snapshot()
+    h.close()
+
+
+def burst(fn, n_threads: int, iters: int, queries_per_call: int):
+    barrier = threading.Barrier(n_threads + 1)
+    errors: list = []
+
+    def worker():
+        barrier.wait()
+        for _ in range(iters):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — surface after join
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        raise AssertionError(f"burst errors: {errors[:3]}")
+    return queries_per_call * iters * n_threads / dt
+
+
+def measure(api, want, label: str) -> dict:
+    pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+    assert api.query(INDEX, pql)["results"] == want, \
+        f"{label}: counts diverge from oracle"
+
+    def call():
+        if api.query(INDEX, pql)["results"] != want:
+            raise AssertionError(f"{label}: count mismatch")
+
+    qps = {}
+    for c in SWEEP:
+        qps[c] = burst(call, c, ITERS, N_ROWS)
+        log(f"{label:>9} {c:>2} clients: {qps[c]:,.1f} qps")
+    return qps
+
+
+def phase_a_overhead(platform: str) -> tuple[dict, dict, float]:
+    from pilosa_tpu.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs import Stats
+    from pilosa_tpu.store import Holder
+    from pilosa_tpu.store.scrub import Scrubber
+
+    rng = np.random.default_rng(42)
+    plane = rng.integers(0, 1 << 32, size=(N_SHARDS, N_ROWS, WORDS),
+                         dtype=np.uint32)
+    plane &= rng.integers(0, 1 << 32, size=plane.shape, dtype=np.uint32)
+    oracle = (np.bitwise_count(plane).sum(axis=(0, 2), dtype=np.int64)
+              if hasattr(np, "bitwise_count") else
+              np.array([int(np.unpackbits(
+                  plane[:, r].reshape(-1).view(np.uint8)).sum())
+                  for r in range(N_ROWS)], dtype=np.int64))
+    want = [int(c) for c in oracle]
+
+    data_dir = tempfile.mkdtemp(prefix="pilosa_c29_")
+    try:
+        write_index(plane, data_dir)
+        holder = Holder(data_dir).open()
+        stats = Stats()
+        ex = Executor(holder, stats=stats)
+        api = API(holder, ex, trace_sample_rate=0.0,
+                  slow_query_threshold=0.0)
+        pql = "".join(f"Count(Row({FIELD}={r}))" for r in range(N_ROWS))
+        t0 = time.perf_counter()
+        assert api.query(INDEX, pql)["results"] == want
+        log(f"first product query (plane build + compile): "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        # OFF: the pre-r19 contract — no scrubber thread at all
+        qps_off = measure(api, want, "scrub-off")
+        # ON: a live scrubber looping continuously at the default
+        # byte budget while the identical workload serves
+        scrubber = Scrubber(holder, interval=0.05,
+                            bytes_per_second=32 << 20,
+                            stats=stats).start()
+        assert [t for t in threading.enumerate()
+                if t.name == "pilosa-scrub"], "scrub thread missing"
+        qps_on = measure(api, want, "scrub-on")
+        # the overhead figure covers the semantics: passes really ran
+        # and really verified bytes, zero corruption on healthy files
+        deadline = time.monotonic() + 30
+        while scrubber.payload()["passes"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        sp = scrubber.payload()
+        assert sp["passes"] >= 1 and sp["bytesScanned"] > 0, sp
+        assert sp["corruptionsFound"] == 0, sp
+        scrubber.close()
+        holder.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    top = SWEEP[-1]
+    overhead = 1.0 - qps_on[top] / qps_off[top]
+    log(f"scrub-on overhead at {top} clients: {overhead * 100:.2f}% "
+        f"(off {qps_off[top]:,.1f} / on {qps_on[top]:,.1f} qps; "
+        f"{sp['passes']} passes, {sp['bytesScanned']} bytes verified)")
+    if SMOKE:
+        assert overhead < 0.5, \
+            f"smoke scrub overhead {overhead:.2%} is pathological"
+    else:
+        assert overhead < MAX_OVERHEAD, \
+            (f"scrubbing costs {overhead:.2%} at {top} clients; the "
+             f"r19 bar is {MAX_OVERHEAD:.0%}")
+    return qps_off, qps_on, overhead
+
+
+def phase_b_drill(base_dir: str) -> dict:
+    """Byte-flip a replica's snapshot under live readers: availability
+    must be 1.0 (zero failures, every answer exact) while the scrubber
+    detects, quarantines and repairs; MTTR = flip → repaired."""
+    from pilosa_tpu.engine.words import SHARD_WIDTH
+    from pilosa_tpu.testing import run_cluster
+
+    with run_cluster(2, base_dir, replicas=2,
+                     scrub_interval_seconds=0.2) as cluster:
+        c = cluster.client(0)
+        c.create_index("drill")
+        c.create_field("drill", "f")
+        cols = sorted(s * SHARD_WIDTH + k
+                      for s in range(2) for k in (1, 5, 900))
+        for col in cols:
+            c.query("drill", f"Set({col}, f=0)")
+        for cl in cluster.clients:
+            assert cl.query("drill", "Row(f=0)")[0]["columns"] == cols
+
+        victim = cluster.servers[1]
+        frag = victim.holder.index("drill").field("f") \
+            .standard_view().fragment(0)
+        frag.snapshot()
+
+        stop = threading.Event()
+        served = [0]
+        failures: list = []
+
+        def reader(i: int) -> None:
+            cl = cluster.clients[i % 2]
+            while not stop.is_set():
+                try:
+                    got = cl.query("drill", "Row(f=0)Count(Row(f=0))")
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"read failed: {e!r}")
+                    return
+                if got[0]["columns"] != cols or got[1] != len(cols):
+                    failures.append(f"read diverged: {got}")
+                    return
+                served[0] += 1
+
+        readers = [threading.Thread(target=reader, args=(i,))
+                   for i in range(4)]
+        for t in readers:
+            t.start()
+        time.sleep(0.3)  # readers established through the healthy path
+
+        size = os.path.getsize(frag.path)
+        with open(frag.path, "r+b") as f:
+            f.seek(size - 2)
+            b = f.read(1)
+            f.seek(size - 2)
+            f.write(bytes([b[0] ^ 0x55]))
+        t_flip = time.monotonic()
+        sh = victim.holder.storage_health
+        mttr = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pay = sh.payload()
+            if (not pay["quarantined"] and pay["lastRepair"]
+                    and not failures):
+                mttr = time.monotonic() - t_flip
+                break
+            if failures:
+                break
+            time.sleep(0.02)
+        # keep hammering a little past the repair, then stop
+        t_end = time.monotonic() + min(1.0, DRILL_SECONDS)
+        while time.monotonic() < t_end and not failures:
+            time.sleep(0.05)
+        stop.set()
+        for t in readers:
+            t.join(timeout=30)
+        assert not failures, f"availability broke: {failures[:3]}"
+        assert mttr is not None, "corruption was never repaired"
+        assert served[0] >= 8, f"only {served[0]} reads — no coverage"
+        scr = victim.scrubber.payload()
+        assert scr["corruptionsFound"] >= 1, scr
+        # post-repair: exact everywhere, forced AAE moves ZERO blocks
+        for cl in cluster.clients:
+            assert cl.query("drill", "Row(f=0)")[0]["columns"] == cols
+            got = cl._json("POST", "/internal/aae/run", {})
+            assert got["repaired"] == 0, got
+        availability = 1.0  # asserted: zero failures among served[0]
+        log(f"corruption drill: MTTR {mttr:.2f}s, {served[0]} reads "
+            f"served, availability {availability}")
+        return {"mttr_seconds": round(mttr, 3),
+                "availability": availability,
+                "reads_served": served[0]}
+
+
+def main() -> None:
+    import jax
+    platform = jax.devices()[0].platform
+
+    qps_off, qps_on, overhead = phase_a_overhead(platform)
+    drill_dir = tempfile.mkdtemp(prefix="pilosa_c29_drill_")
+    try:
+        drill = phase_b_drill(drill_dir)
+    finally:
+        shutil.rmtree(drill_dir, ignore_errors=True)
+
+    top = SWEEP[-1]
+    metric = f"storage_integrity_qps_{platform}"
+    detail = {
+        "overhead_pct": round(overhead * 100, 2),
+        "qps_off": {str(k): round(v, 1) for k, v in qps_off.items()},
+        "qps_on": {str(k): round(v, 1) for k, v in qps_on.items()},
+        "drill": drill,
+    }
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # headline + r19 detail guard: availability through corruption and
+    # the scrub-on throughput are tracked round over round — a future
+    # PR that lets quarantine leak read failures or makes scrubbing
+    # expensive fails the guard even while scrub-off qps hides it
+    regressions = (
+        mod.regression_guard(metric, qps_on[top])
+        + mod.detail_regression_guard(metric, detail, {
+            "repair_availability": ("drill", "availability"),
+            "qps_scrub_on": ("qps_on", str(top)),
+        }))
+    print(json.dumps({
+        "metric": metric,
+        "value": round(qps_on[top], 1), "unit": "qps",
+        "vs_baseline": round(qps_on[top] / qps_off[top], 4),
+        "regressions": regressions,
+        "detail": detail}))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from pilosa_tpu.exec.executor import (ExecutionError,
 from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.store import FieldOptions, Holder
 from pilosa_tpu.store.field import BSI_TYPES
+from pilosa_tpu.store.health import StorageFaultError
 from pilosa_tpu.store.view import VIEW_STANDARD
 
 
@@ -83,12 +84,38 @@ class ApiError(Exception):
         handoff disabled, ``hint_overflow`` — backlog older than
         hint_max_age, ``no_live_replica``, ``replica_busy`` — an
         alive replica shed the op).  Mirrors the 504 timeout
-        block: unavailability is never a generic 400/500."""
+        block: unavailability is never a generic 400/500.  (The r19
+        disk-full refusal has its own 507 shape — see
+        :meth:`storage_fault`.)"""
         return cls(str(exc), 503,
                    retry_after=getattr(exc, "retry_after", 1.0),
                    extra={"writeUnavailable": {
                        "op": exc.op, "replica": exc.replica,
                        "reason": exc.reason}})
+
+    @classmethod
+    def storage_fault(cls, exc) -> "ApiError":
+        """The storage-integrity contract (r19), applied by the
+        request dispatcher to ANY surface a
+        :class:`~pilosa_tpu.store.health.StorageFaultError` escapes
+        from: ``disk_full`` answers a 507-style structured
+        ``writeUnavailable{reason: "disk_full"}`` (the node is
+        READ-ONLY; reads keep serving; peers hint the missed copies),
+        anything else (quarantined corrupt/io_error fragment) answers
+        503 with a structured ``storageFault{path, kind}`` naming the
+        sick fragment — storage unavailability is never a generic
+        500."""
+        kind = getattr(exc, "kind", "unknown")
+        retry = getattr(exc, "retry_after", 1.0)
+        if kind == "disk_full":
+            return cls(str(exc), 507, retry_after=retry,
+                       extra={"writeUnavailable": {
+                           "op": None, "replica": None,
+                           "reason": "disk_full"}})
+        return cls(str(exc), 503, retry_after=retry,
+                   extra={"storageFault": {
+                       "path": getattr(exc, "path", None),
+                       "kind": kind}})
 
 
 def field_options_from_json(o: dict) -> FieldOptions:
@@ -349,6 +376,11 @@ class API:
             # 503 + Retry-After with the structured writeUnavailable
             # body naming the down replica (r13)
             return {}, ApiError.write_unavailable(e)
+        except StorageFaultError as e:
+            # the storage layer refused (node read-only on disk-full,
+            # or the target fragment quarantined): structured 507/503,
+            # never a generic 500 (r19)
+            return {}, ApiError.storage_fault(e)
         except (ParseError, ExecutionError) as e:
             return {}, ApiError(str(e), 400)
 
@@ -723,7 +755,18 @@ class API:
         pc = ex.planes.stats()
         delta = pc.get("delta", {})
         ingested = snap_counters.get("ingest_bits_total", {})
+        # storage-integrity pane (r19): disk governor state, the
+        # quarantine registry, scrub progress, last replica repair
+        storage_health = None
+        sh = getattr(self.holder, "storage_health", None)
+        if sh is not None:
+            storage_health = sh.payload()
+            scrubber = getattr(self, "scrubber", None)
+            if scrubber is not None:
+                storage_health["scrub"] = scrubber.payload()
         return {"state": state, "nodes": nodes,
+                **({"storageHealth": storage_health}
+                   if storage_health is not None else {}),
                 # ingest visibility (r15): device delta overlays
                 # (fill %, compaction backlog + last duration) and
                 # bulk-import volume — the mixed read/write serving

@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from pilosa_tpu import __version__, fault
 from pilosa_tpu.api.api import API, ApiError
+from pilosa_tpu.store.health import StorageFaultError as _StorageFaultError
 
 
 def parse_timeout_param(raw: str) -> float:
@@ -180,7 +181,14 @@ class Handler(BaseHTTPRequestHandler):
                 self._reply({"error": f"no route {method} {parsed.path}"}, 404)
                 return
             fn(self, **params)
-        except ApiError as e:
+        except (ApiError, _StorageFaultError) as e:
+            if isinstance(e, _StorageFaultError):
+                # storage-integrity refusal (r19) escaping ANY handler
+                # — import endpoints, hint replay, fragment merge,
+                # internal query: map it once to the structured
+                # 507/503 shape instead of a generic 500, then share
+                # the ApiError reply path
+                e = ApiError.storage_fault(e)
             code = e.status
             hdrs = None
             if e.retry_after is not None:
@@ -464,6 +472,15 @@ class Handler(BaseHTTPRequestHandler):
         stats.gauge("oplog_bytes", st["oplogBytes"])
         stats.gauge("fragment_count", st["fragmentCount"])
         stats.gauge("snapshot_bytes", st["snapshotBytes"])
+        # storage integrity (r19): governor state + quarantine depth
+        # at scrape time (transitions also set both the moment they
+        # happen — this keeps a restarted scraper consistent)
+        sh = getattr(self.server.api.holder, "storage_health", None)
+        if sh is not None:
+            pay = sh.payload()
+            stats.gauge("disk_health_state", pay["stateCode"])
+            stats.gauge("storage_fragment_quarantined",
+                        len(pay["quarantined"]))
 
     # scrapers negotiating this media type get OpenMetrics output —
     # the only exposition format in which exemplars are legal (a

@@ -113,6 +113,14 @@ def h_backup_fragment(self: Handler, index: str, field: str, view: str,
                       shard: str) -> None:
     t0 = time.perf_counter()
     frag = _find_fragment(self, index, field, view, shard)
+    # storage quarantine gate (r19): an archive must never capture a
+    # corrupt copy — 503 here routes the driver onto its replica
+    # fallback, exactly like a dead node mid-backup
+    sh = getattr(self.server.api.holder, "storage_health", None)
+    if sh is not None and sh.is_quarantined(frag.path):
+        raise ApiError(
+            f"fragment quarantined (storage corruption): {frag.path} "
+            "— back up from a replica", 503, retry_after=2.0)
     blob, gen, checksum = capture_fragment(frag)
     digest = hashlib.sha256(blob).hexdigest()
     stats = getattr(self.server, "stats", None)

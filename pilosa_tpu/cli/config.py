@@ -133,6 +133,25 @@ class Config:
     # admit ONE window back onto the fused pipeline as a probe;
     # success restores healthy serving.
     device_health_probe_seconds: float = 5.0
+    # Storage integrity (r19).  Background scrubber: re-verify every
+    # on-disk checksum (snapshot frames, op-log records, dense
+    # sidecars, hint logs) each scrub_interval_seconds, reading at
+    # most scrub_bytes_per_second (a strictly-lower-priority I/O
+    # budget).  scrub_bytes_per_second=0 disables the scrubber
+    # entirely (the pre-r19 contract: no thread, no re-verification).
+    # A corrupt fragment is quarantined — reads serve from replicas,
+    # local strict writes refuse with a structured 503 storageFault —
+    # and auto-repaired from a healthy replica in cluster mode.
+    scrub_interval_seconds: float = 600.0
+    scrub_bytes_per_second: int = 32 << 20
+    # Disk-health governor: write-path ENOSPC flips the node to
+    # READ-ONLY degraded serving (strict writes refuse with a
+    # structured writeUnavailable{disk_full}; peers hint the missed
+    # copies); every disk_probe_seconds a probe (statvfs headroom >=
+    # disk_min_free_bytes + a real probe write) checks whether space
+    # freed and restores healthy serving.
+    disk_min_free_bytes: int = 64 << 20
+    disk_probe_seconds: float = 5.0
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

@@ -261,6 +261,13 @@ def cmd_check(args) -> int:
                     except Exception as e:  # noqa: BLE001
                         problems += 1
                         print(f"BAD {iname}/{fname}/{vname}/{shard}: {e}")
+    # r19: a corrupt snapshot no longer raises at open — it
+    # quarantines (the node serves the fragment from replicas) — so
+    # the offline check must read the registry too
+    for entry in h.storage_health.quarantined_entries():
+        problems += 1
+        print(f"BAD {entry['path']}: quarantined "
+              f"({entry['kind']}) {entry['detail']}")
     h.close()
     print(f"{problems} problems" if problems else "all fragments ok")
     return 1 if problems else 0

@@ -736,6 +736,7 @@ class Cluster:
         nodes that already failed the leg."""
         alive = set(self.alive_ids()) - set(exclude)
         healthy = alive - self.breakers.unhealthy_peers()
+        sh = getattr(self.api.holder, "storage_health", None)
         groups: dict[str, list[int]] = {}
         for s in shards:
             owners = self.shard_owners(index, s)
@@ -746,6 +747,22 @@ class Cluster:
                 raise RuntimeError(
                     f"no alive replica for shard {s} of {index!r} "
                     f"(owners {owners})")
+            if (target == self.node_id and sh is not None
+                    and sh.shard_quarantined(index, s)):
+                # a LOCAL fragment of this shard is quarantined
+                # (corrupt, r19): serve the shard from a replica
+                # exactly as if it were remote.  Self remains the last
+                # resort — with no live replica a loud quarantined
+                # answer still beats a refused read.
+                alt = next((o for o in owners
+                            if o in healthy and o != self.node_id),
+                           None)
+                if alt is None:
+                    alt = next((o for o in owners
+                                if o in alive and o != self.node_id),
+                               None)
+                if alt is not None:
+                    target = alt
             groups.setdefault(target, []).append(s)
         return {k: tuple(v) for k, v in groups.items()}
 
@@ -1089,10 +1106,20 @@ class Cluster:
             self.stats.count("aae_hint_deferred_total", 1)
             return 0
         holder = self.api.holder
+        storage_health = getattr(holder, "storage_health", None)
         for iname, idx in list(holder.indexes.items()):
             for fname, f in list(idx.fields.items()):
                 for vname, v in list(f.views.items()):
                     for shard, frag in list(v.fragments.items()):
+                        if storage_health is not None \
+                                and storage_health.is_quarantined(
+                                    frag.path):
+                            # quarantined (r19): this copy is
+                            # untrustworthy — pushing its blocks would
+                            # spread the corruption; replica repair
+                            # owns it, AAE resumes after un-quarantine
+                            deferred += 1
+                            continue
                         owners = self.shard_owners(iname, shard)
                         if self.node_id not in owners:
                             # ORPHAN: we hold a fragment the active
@@ -1441,11 +1468,101 @@ class Cluster:
         from pilosa_tpu.store import roaring
         idx = self.api.holder.index(index)
         frag = idx.field(field).view(view).fragment(shard)
+        sh = getattr(self.api.holder, "storage_health", None)
+        if sh is not None and sh.is_quarantined(frag.path):
+            # a resize/orphan push from a corrupt copy would spread
+            # the corruption to the new owner — refuse loudly (the
+            # resize job logs and retries after repair)
+            raise RuntimeError(
+                f"fragment {frag.path} is quarantined (storage "
+                "corruption); not pushing until repaired")
         blob = roaring.serialize(frag.positions())
         qs = f"index={index}&field={field}&view={view}&shard={shard}"
         self._client(dest)._do(
             "POST", f"/internal/fragment/merge?{qs}", blob,
             content_type="application/octet-stream")
+
+    # -- quarantine repair (r19 storage integrity) ---------------------------
+
+    def repair_quarantined(self, entry: dict) -> bool:
+        """Replica repair for one quarantined fragment (the scrubber's
+        ``on_corrupt`` hook): pull a healthy replica's FULL position
+        set over the AAE data path, rebuild the local fragment
+        wholesale (fresh framed snapshot, truncated op-log), re-verify
+        the new bytes, un-quarantine.  While this runs, reads keep
+        serving from the replica (``group_shards_by_node`` routes
+        around us) and local writes keep refusing — the replica's copy
+        therefore includes every write accepted during quarantine, so
+        the rebuild loses nothing.  Returns True when repaired; a
+        False (no live replica, pull failed, disk still refusing)
+        leaves the quarantine in place for the next scrub pass."""
+        from pilosa_tpu.store import roaring as _roaring
+        from pilosa_tpu.store import scrub as _scrub
+        sh = getattr(self.api.holder, "storage_health", None)
+        key = entry.get("key")
+        if sh is None or key is None:
+            return False  # not a fragment of this tree
+        index, field, view, shard = key
+        idx = self.api.holder.index(index)
+        fld = idx.field(field) if idx is not None else None
+        vw = fld.view(view) if fld is not None else None
+        frag = vw.fragment(shard) if vw is not None else None
+        if frag is None:
+            # the fragment no longer exists (index/field deleted):
+            # nothing to repair, drop the stale quarantine entry
+            sh.unquarantine(entry["path"])
+            return True
+        alive = set(self.alive_ids())
+        sources = [o for o in self.shard_owners(index, shard)
+                   if o != self.node_id and o in alive]
+        # breaker-closed replicas first; open peers stay a last resort
+        sources.sort(key=lambda o: self.breakers.state(o) != "closed")
+        qs = (f"index={index}&field={field}&view={view}"
+              f"&shard={shard}")
+        for src in sources:
+            try:
+                blob = self._client(src)._do(
+                    "GET", f"/internal/fragment/data?{qs}")
+                positions = _roaring.deserialize(blob)
+            except Exception as e:  # noqa: BLE001 — try the next replica
+                self.logger.warning(
+                    "storage repair: pull %s/%s/%s/%d from %s failed: "
+                    "%s", index, field, view, shard, src, e)
+                continue
+            try:
+                frag.rebuild_from_positions(positions)
+            except OSError as e:
+                self.logger.error(
+                    "storage repair: rebuild of %s failed on disk: %s "
+                    "(quarantine stays; next scrub pass retries)",
+                    frag.path, e)
+                return False
+            problems, _ = _scrub.verify_fragment(frag)
+            if problems is None or problems:
+                # None = no verdict (the scan raced a file change) —
+                # un-quarantining on anything short of a VERIFIED
+                # clean read would put unconfirmed bytes back into
+                # service; the quarantine stays and the next scrub
+                # pass retries the repair
+                self.logger.error(
+                    "storage repair: REBUILT fragment %s did not "
+                    "verify clean (%s) — quarantine stays; next scrub "
+                    "pass retries", frag.path,
+                    "no verdict" if problems is None else problems)
+                return False
+            sh.unquarantine(frag.path)
+            sh.note_repair(frag.path, source=src)
+            self.logger.info(
+                "storage repair: fragment %s/%s/%s/%d rebuilt from "
+                "replica %s (%d positions) and re-verified",
+                index, field, view, shard, src, len(positions))
+            return True
+        self.logger.warning(
+            "storage repair: no live replica for quarantined "
+            "%s/%s/%s/%d (owners %s); retrying next scrub pass",
+            index, field, view, shard,
+            self.shard_owners(index, shard))
+        return False
 
     # -- observability fan-in (r14: the single-pane cluster view) ------------
 

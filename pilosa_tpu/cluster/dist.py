@@ -830,8 +830,27 @@ class DistributedExecutor:
         apply — a hinted replay could reorder behind a newer direct
         write), and any other 5xx from an alive peer is a real failed
         write, not AAE-repairable noise."""
+        if e.status == 503 and "quarantined" in str(e):
+            # a QUARANTINED-fragment refusal (r19; both shapes — the
+            # internal-query shard gate and the fragment write gate's
+            # storageFault — carry the word).  The busy-never-hints
+            # rationale does not apply: a quarantined fragment serves
+            # NO reads (routing skips it, peer legs 503 onto the
+            # failover path), so a hinted strict op can never be
+            # contradicted by a read on that replica — and repair +
+            # ordered drain deliver it once the fragment is healthy.
+            # Without this, one quarantined replica would refuse
+            # strict writes for its shard cluster-wide for the whole
+            # detect→repair window.
+            return "down"
         if e.status == 503:
             return "busy"
+        if e.status == 507:
+            # the replica's DISK is out (r19 read-only degraded
+            # serving): the node is alive, answered before mutating,
+            # and will drain an ordered hint replay once its probe
+            # restores healthy — exactly what handoff is for
+            return "down"
         if e.status == 0 and e.kind != "timeout":
             return "down"
         return None

@@ -118,6 +118,24 @@ def h_internal_query(self: Handler) -> None:
         budget = parse_timeout_param(self.query["timeout"][0])
         deadline = time.monotonic() + budget
     t0 = time.monotonic()
+    # storage quarantine gate (r19): a leg covering a shard whose
+    # local fragment is quarantined must not serve (possibly corrupt)
+    # bits — 503 here is transport-class to the coordinator's fan-out,
+    # which re-groups the shards onto the next live replica (the PR 6
+    # failover path, exactly as if the shard were remote)
+    sh = getattr(api.holder, "storage_health", None)
+    if sh is not None and sh.gate_active:
+        bad = [s for s in (shards or ())
+               if sh.shard_quarantined(index, s)]
+        if not shards and any(
+                e.get("key") and e["key"][0] == index
+                for e in sh.quarantined_entries()):
+            bad = ["*"]
+        if bad:
+            raise ApiError(
+                f"shard(s) {bad} of {index!r} quarantined on this "
+                "node (storage corruption): retry a replica", 503,
+                retry_after=2.0)
     pql = self._body().decode()
     from contextlib import nullcontext
 
@@ -302,6 +320,7 @@ def h_hints_replay(self: Handler) -> None:
     from pilosa_tpu.exec.executor import (ExecutionError,
                                           ExecutorSaturatedError)
     from pilosa_tpu.pql.parser import ParseError
+    from pilosa_tpu.store.health import StorageFaultError
 
     cluster = _cluster(self)
     api = self.server.api
@@ -351,6 +370,12 @@ def h_hints_replay(self: Handler) -> None:
                     translate_output=False)
         except ExecutorSaturatedError as e:
             raise ApiError(str(e), 503, retry_after=e.retry_after)
+        except StorageFaultError as e:
+            # this node's storage is sick (read-only on disk-full, or
+            # the target fragment quarantined, r19): the op is
+            # RETRYABLE, never droppable — defer the whole batch (the
+            # applied prefix dedups on the retry)
+            raise ApiError.storage_fault(e)
         except (ParseError, ExecutionError) as e:
             cluster.logger.warning(
                 "hint replay dropped %s on %s: %s",
@@ -365,6 +390,20 @@ def h_hints_replay(self: Handler) -> None:
                  "dropped": dropped})
 
 
+def _check_fragment_health(handler: Handler, frag) -> None:
+    """AAE exchange gate (r19): a quarantined fragment's bytes are
+    untrustworthy — serving its blocks/data would spread the
+    corruption to replicas.  503 defers the peer's sync until repair
+    un-quarantines (the repair itself pulls FROM the healthy peer, so
+    this gate never deadlocks a repair)."""
+    sh = getattr(handler.server.api.holder, "storage_health", None)
+    if sh is not None and sh.is_quarantined(frag.path):
+        raise ApiError(
+            f"fragment quarantined (storage corruption): {frag.path} "
+            "— sync deferred until replica repair completes", 503,
+            retry_after=2.0)
+
+
 def h_fragment_blocks(self: Handler) -> None:
     cluster = self.server.api.cluster
     if cluster is not None and cluster.node_id in cluster.hinted_peers():
@@ -375,11 +414,13 @@ def h_fragment_blocks(self: Handler) -> None:
         raise ApiError("fragment blocks deferred: hinted writes "
                        "pending for this node (replay first)", 409)
     frag = _fragment(self)
+    _check_fragment_health(self, frag)
     self._reply({"blocks": {str(k): v for k, v in frag.blocks().items()}})
 
 
 def h_fragment_data(self: Handler) -> None:
     frag = _fragment(self)
+    _check_fragment_health(self, frag)
     if "block" in self.query:
         positions = frag.block_positions(int(_qs(self, "block")))
     else:

@@ -318,7 +318,8 @@ class Executor:
         dispatch faults / watchdog trips) lasts before one window
         probes the fused pipeline again."""
         self.holder = holder
-        self.translate = translate or TranslateStore(holder.path)
+        self.translate = translate or TranslateStore(
+            holder.path, health=getattr(holder, "storage_health", None))
         self.placement = placement
         if placement is not None and place is None:
             place = placement.place

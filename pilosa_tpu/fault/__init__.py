@@ -19,7 +19,9 @@ wall-clock or global randomness in the trigger path.
 Actions:
 
 - ``error``       — raise :class:`FaultError` (an ``OSError``: looks
-                    like the disk/socket fault it stands in for)
+                    like the disk/socket fault it stands in for; an
+                    ``errno`` arg — ``"ENOSPC"``/``"EIO"``/int — types
+                    it for the disk-health governor's classification)
 - ``delay``       — sleep ``seconds`` then continue
 - ``oom``         — raise ``ValueError("RESOURCE_EXHAUSTED …")``, the
                     exact shape the executor's device-OOM recovery
@@ -68,7 +70,26 @@ _stats = None  # optional metrics sink (obs.Stats duck type)
 
 class FaultError(OSError):
     """An injected fault (subclasses OSError: at the store seams it
-    stands in for a disk error, at process seams for a crash)."""
+    stands in for a disk error, at process seams for a crash).  An
+    ``errno`` fault arg (``"ENOSPC"``/``"EIO"``/an int) types the
+    error so the disk-health governor's errno classification runs on
+    injected faults exactly as on real ones."""
+
+
+def resolve_errno(value) -> int:
+    """An errno fault arg → its numeric value: int passthrough, or a
+    symbolic name looked up in the stdlib ``errno`` module."""
+    import errno as _errno_mod
+    if isinstance(value, bool):
+        raise ValueError(f"bad errno fault arg {value!r}")
+    if isinstance(value, int):
+        return value
+    no = getattr(_errno_mod, str(value), None)
+    if not isinstance(no, int):
+        raise ValueError(
+            f"unknown errno name {value!r} in fault args "
+            "(use e.g. \"ENOSPC\", \"EIO\", or a number)")
+    return no
 
 
 class Failpoint:
@@ -92,6 +113,11 @@ class Failpoint:
         self.times = int(times) if times is not None else None
         self.match = dict(match or {})
         self.args = dict(args or {})
+        if "errno" in self.args:
+            # typed disk faults (r19): validate at arm time — a typo'd
+            # errno name must fail the arming, not silently inject an
+            # un-typed fault the governor then misclassifies
+            self.args["errno"] = resolve_errno(self.args["errno"])
         self._rng = random.Random(seed if seed is not None else 0)
         self._hits = 0
         self._fired = 0
@@ -199,7 +225,11 @@ def fire(site: str, **ctx) -> dict | None:
             time.sleep(float(fp.args.get("seconds", 0.05)))
             return fp.to_json()
         if fp.action == "error":
-            raise FaultError(f"injected fault at {site}")
+            err = FaultError(f"injected fault at {site}")
+            if "errno" in fp.args:
+                err.errno = fp.args["errno"]
+                err.strerror = f"injected fault at {site}"
+            raise err
         if fp.action == "oom":
             # the exact status-text + exception-type shape the
             # executor's _is_device_oom recovery classifier accepts
@@ -214,11 +244,18 @@ def torn_write(f, data: bytes, spec: dict) -> None:
     :class:`FaultError` (the crash).  The single tear implementation
     every write seam shares (``sys.write`` and the record-relative
     ``oplog.append``), so tear semantics can never diverge by site."""
-    off = min(int(spec.get("args", {}).get("offset", 0)), len(data))
+    args = spec.get("args", {})
+    off = min(int(args.get("offset", 0)), len(data))
     f.write(data[:off])
     f.flush()
-    raise FaultError(
+    err = FaultError(
         f"injected torn write: {off}/{len(data)} bytes persisted")
+    if "errno" in args:
+        # a typed tear: ENOSPC's short-write-then-error shape — the
+        # process survives, the governor classifies, and recovery must
+        # still find a clean record prefix
+        err.errno = resolve_errno(args["errno"])
+    raise err
 
 
 def configure(spec: str | list | None, logger=None) -> int:

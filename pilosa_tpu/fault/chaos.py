@@ -1021,6 +1021,241 @@ def scenario_bulk_import_kill_handoff(cluster, seed: int) -> ChaosHarness:
     return h
 
 
+def scenario_corrupt_fragment_scrub_repair(cluster,
+                                           seed: int) -> ChaosHarness:
+    """Byte-flip a fragment snapshot on disk (replicas=2, r19): the
+    background scrubber must DETECT the corruption (frame CRC),
+    QUARANTINE the fragment — reads of the affected shard keep
+    answering oracle-exact throughout, zero failures, because the
+    victim's own routing skips the quarantined fragment and a peer's
+    fan-out leg gets a 503 that rides the PR 6 replica-failover path —
+    then AUTO-REPAIR it from the healthy replica (full position pull,
+    wholesale rebuild, fresh framed snapshot, re-verify), after which
+    a forced anti-entropy round on every node finds ZERO divergence
+    (resurrects nothing).  Requires a cluster booted with a sub-second
+    scrub interval and periodic AAE off (see SCENARIOS) — pre-
+    detection, an AAE round could diff the corrupt copy outward; the
+    scrub interval is exactly the knob that bounds that window."""
+    import os as _os
+
+    h = ChaosHarness(cluster, seed, index="chaos_scrub")
+    h.setup()
+    for s in range(3):
+        if not h.write(0, s * SHARD_WIDTH + 1):
+            raise h._fail("setup write did not ack")
+    h.random_writes(24)
+    h.check_oracle()
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    # force snapshots to disk on the victim (the tar-backup endpoint
+    # compacts every dirty fragment), then flip one byte of shard 0's
+    # snapshot blob IN PLACE (r+b: truncating would SIGBUS the mmap)
+    h.client(victim)._do("GET", "/internal/backup")
+    frag_path = _os.path.join(cluster.nodes[victim].data_dir,
+                              h.index, h.field, "views", "standard",
+                              "fragments", "0")
+    with open(frag_path, "rb") as f:
+        head = f.read(4)
+    if head != b"PSF1":
+        raise h._fail(f"snapshot at {frag_path} is not framed: {head!r}")
+    size = _os.path.getsize(frag_path)
+    with open(frag_path, "r+b") as f:
+        f.seek(size - 2)
+        byte = f.read(1)
+        f.seek(size - 2)
+        f.write(bytes([byte[0] ^ 0x55]))
+    # the scrubber (sub-second interval) must detect the flip.  The
+    # repair hook runs in the SAME pass, so the quarantine window can
+    # be too short to observe on /status — the detection counter is
+    # the reliable witness
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if h.counter_total(victim,
+                           "storage_corruption_detected_total") >= 1:
+            break
+        time.sleep(0.1)
+    else:
+        raise h._fail("scrubber never detected the flipped byte")
+    # from detection on: EVERY read on EVERY node answers oracle-exact
+    # — zero failures — while repair converges in the background
+    # (quarantined legs 503 and ride the replica-failover path)
+    repaired = False
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        for i in range(h.n):
+            try:
+                h.check_oracle(via=i)
+            except InvariantViolation:
+                raise
+            except (ClientError, OSError) as e:
+                raise h._fail(f"read failed during quarantine: {e!r}")
+        sh = h.client(victim)._json(
+            "GET", "/status").get("storageHealth", {})
+        if not sh.get("quarantined") \
+                and h.counter_total(victim, "storage_repair_total") >= 1:
+            repaired = True
+            break
+    if not repaired:
+        raise h._fail("quarantined fragment was never repaired")
+    if h.counter_total(victim, "storage_corruption_detected_total") < 1:
+        raise h._fail("storage_corruption_detected_total never counted")
+    if h.counter_total(victim, "storage_repair_total") < 1:
+        raise h._fail("storage_repair_total never counted")
+    last = h.client(victim)._json(
+        "GET", "/status")["storageHealth"].get("lastRepair")
+    if not last:
+        raise h._fail("storageHealth.lastRepair missing after repair")
+    # the repaired bytes must re-verify as a healthy framed snapshot
+    with open(frag_path, "rb") as f:
+        if f.read(4) != b"PSF1":
+            raise h._fail("repair did not rewrite a framed snapshot")
+    # forced AAE everywhere: ZERO divergence (the repair pulled the
+    # replica's full set — union-merge must find nothing to move)
+    for i in range(h.n):
+        got = h.client(i)._json("POST", "/internal/aae/run", {})
+        if got.get("repaired"):
+            raise h._fail(
+                f"forced AAE on node {i} repaired "
+                f"{got['repaired']} blocks after replica repair "
+                "(divergence survived)")
+    h.check_oracle()
+    h.await_replica_convergence(expected_holders=2)
+    return h
+
+
+def scenario_disk_full_during_ingest(cluster, seed: int) -> ChaosHarness:
+    """ENOSPC mid-bulk-import (replicas=2, r19): the victim's first
+    failing op-log append flips it READ-ONLY — bulk-import batches via
+    the healthy entry node keep ACKING (the victim's 507 legs are
+    classified hint-worthy and durably hinted, the PR 8 machinery),
+    direct writes at the victim refuse with the structured 507
+    ``writeUnavailable{reason: "disk_full"}`` (never a crash, never a
+    torn ack), reads keep answering on BOTH nodes — then 'freeing
+    space' (clearing the fault) lets the probe restore HEALTHY, the
+    heartbeat drain replays the hinted batches in order, and every
+    node ends bit-exact (forced AAE resurrects nothing).  Requires a
+    sub-second disk probe (see SCENARIOS)."""
+    h = ChaosHarness(cluster, seed, index="chaos_enospc")
+    h.setup()
+    seed_pairs = [(r, s * SHARD_WIDTH + h.rng.randrange(1, 1000))
+                  for s in range(3) for r in range(h.N_ROWS)]
+    if not h.bulk_import(seed_pairs):
+        raise h._fail("seed bulk import did not ack")
+    h.check_oracle()
+    # the mid-outage oracle: bits acked BEFORE the disk fills must
+    # stay readable on every node throughout (the read-only replica
+    # is merely STALE for the writes hinted PAST it — the standard
+    # replica-consistency caveat — so the full oracle only applies
+    # again after the drain)
+    pre_acked = {r: set(c) for r, c in h.acked.items()}
+    coord = h.coordinator_index()
+    victim = next(i for i in range(h.n) if i != coord)
+    entry = coord
+    # ENOSPC on every durable write under the victim's data dir —
+    # op-logs, snapshots AND the governor's probe file, so the node
+    # stays read-only until the 'disk' recovers (fault cleared)
+    h.set_fault(victim, "sys.write", "error",
+                args={"errno": "ENOSPC"},
+                match={"path": cluster.nodes[victim].data_dir})
+    # bulk-import THROUGH the full disk: every batch must keep acking
+    # (the victim's legs refuse 507 and hand off as hints)
+    flipped = False
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        batch = [(h.rng.randrange(h.N_ROWS),
+                  h.rng.randrange(h.MAX_COL)) for _ in range(8)]
+        if not h.bulk_import(batch, via=entry):
+            raise h._fail("bulk import refused while one replica's "
+                          "disk is full")
+        st = h.client(victim)._json(
+            "GET", "/status").get("storageHealth", {})
+        if st.get("state") == "read_only":
+            flipped = True
+            break
+    if not flipped:
+        raise h._fail("victim never flipped read-only under ENOSPC")
+    # structured refusal at the read-only node: a direct strict write
+    # must answer 507 with the writeUnavailable{disk_full} body (raw
+    # request — the client helper strips the structured fields)
+    import http.client as _httpc
+    import json as _json
+    # at-least-once honest: the healthy replica's leg may apply before
+    # the read-only node's local leg refuses — an attempted, un-acked
+    # write (exactly the torn-ack class the oracle absorbs)
+    h.attempted.setdefault(0, set()).add(1)
+    h.cleared.setdefault(0, set()).discard(1)
+    conn = _httpc.HTTPConnection("127.0.0.1",
+                                 cluster.nodes[victim].port, timeout=15)
+    try:
+        body = f"Set(1, {h.field}=0)".encode()
+        conn.request("POST", f"/index/{h.index}/query", body,
+                     headers={"Content-Length": str(len(body))})
+        resp = conn.getresponse()
+        payload = _json.loads(resp.read().decode())
+    finally:
+        conn.close()
+    if resp.status != 507:
+        raise h._fail(f"read-only write answered {resp.status}, want "
+                      f"the structured 507: {payload}")
+    wu = payload.get("writeUnavailable") or {}
+    if wu.get("reason") != "disk_full":
+        raise h._fail(f"507 body lacks writeUnavailable.disk_full: "
+                      f"{payload}")
+    if not resp.getheader("Retry-After"):
+        raise h._fail("507 refusal carries no Retry-After header")
+    # the hinted backlog for the victim is durably queued on the entry
+    wh = h.client(entry).write_health()
+    if not wh.get("hintBacklogOps"):
+        raise h._fail(f"no hints queued for the disk-full replica: {wh}")
+    # reads: full availability on BOTH nodes — every query answers,
+    # pre-outage acked bits all present, nothing phantom, Count
+    # consistent (writes hinted DURING the outage may lag on legs the
+    # stale replica serves; the full oracle re-applies after drain)
+    for i in range(h.n):
+        for row in range(h.N_ROWS):
+            try:
+                res = h.client(i).query(
+                    h.index,
+                    f"Row({h.field}={row})"
+                    f"Count(Row({h.field}={row}))")
+            except (ClientError, OSError) as e:
+                raise h._fail(
+                    f"read failed on node {i} during disk-full: {e!r}")
+            got = set(res[0]["columns"])
+            if not pre_acked.get(row, set()) <= got:
+                raise h._fail(
+                    f"node {i} row {row}: pre-outage acked bits lost "
+                    f"during disk-full degradation")
+            if not got <= h.attempted.get(row, set()):
+                raise h._fail(f"node {i} row {row}: phantom bits "
+                              "during disk-full degradation")
+            if res[1] != len(got):
+                raise h._fail(f"node {i} row {row}: Count/Row mismatch "
+                              "during disk-full degradation")
+    if h.counter_total(victim, "fault_triggered_total") < 1:
+        raise h._fail("the ENOSPC fault never actually fired")
+    # 'free space': clear the fault — the probe restores HEALTHY
+    h.clear_faults()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        st = h.client(victim)._json(
+            "GET", "/status").get("storageHealth", {})
+        if st.get("state") == "healthy":
+            break
+        time.sleep(0.2)
+    else:
+        raise h._fail("victim never recovered after space freed")
+    # the drain replays the hinted batches; every node ends bit-exact
+    h.await_hints_drained(entry)
+    h.await_oracle()
+    if h.counter_total(entry, "hint_replay_total") < 1:
+        raise h._fail("hint_replay_total never incremented")
+    for i in range(h.n):
+        h.client(i)._json("POST", "/internal/aae/run", {})
+    h.check_oracle()
+    return h
+
+
 def scenario_hung_dispatch_serving(cluster, seed: int) -> ChaosHarness:
     """A device dispatch HANGS mid-serve (r18): the ``exec.dispatch_hang``
     failpoint stalls one plane's whole-plane row-count dispatch (the
@@ -1298,6 +1533,18 @@ SCENARIOS = {
                                    3),
     # r15 — ingest (bulk imports through failure, op-id dedup)
     "bulk_import_kill_handoff": (scenario_bulk_import_kill_handoff, 3),
+    # r19 — storage integrity (scrub + quarantine + replica repair,
+    # disk-full governor): sub-second scrub/probe so the drills finish
+    # under tier-1; periodic AAE off for the corruption drill (pre-
+    # detection, an AAE round could diff the corrupt copy outward —
+    # the scrub interval is the knob bounding that window)
+    "corrupt_fragment_scrub_repair":
+        (scenario_corrupt_fragment_scrub_repair, 2,
+         {"PILOSA_SCRUB_INTERVAL_SECONDS": "0.4",
+          "PILOSA_ANTI_ENTROPY_INTERVAL": "0"}),
+    "disk_full_during_ingest":
+        (scenario_disk_full_during_ingest, 2,
+         {"PILOSA_DISK_PROBE_SECONDS": "0.3"}),
     # r18 — self-healing dispatch pipeline (watchdog, quarantine,
     # device health governor): sub-second watchdog/probe so the
     # scenarios finish under tier-1, fast lane off so the injected

@@ -43,6 +43,7 @@ class PilosaTPUServer:
         self.grpc = None
         self.cluster = None
         self.diagnostics = None
+        self.scrubber = None
 
     def open(self) -> "PilosaTPUServer":
         if self.cfg.faults:
@@ -91,6 +92,13 @@ class PilosaTPUServer:
             self.logger.info("compilation cache: %s", cache_dir)
         from pilosa_tpu.store import syswrap
         syswrap.GLOBAL.set_max(self.cfg.max_map_count)
+        # disk-health governor (r19): wire stats + knobs BEFORE the
+        # holder opens, so boot-time snapshot verification already
+        # quarantines (and counts) through the configured registry
+        self.holder.storage_health.configure(
+            base=self.cfg.data_dir, stats=self.stats, logger=self.logger,
+            min_free_bytes=self.cfg.disk_min_free_bytes,
+            probe_seconds=self.cfg.disk_probe_seconds)
         self.holder.open()
         placement = None
         if self.cfg.mesh:
@@ -149,6 +157,19 @@ class PilosaTPUServer:
                              ghost or "127.0.0.1", self.grpc.port)
         if self.cluster is not None:
             self.cluster.open()
+        # background scrubber (r19): re-verifies every on-disk
+        # checksum at the configured byte budget; corrupt fragments
+        # quarantine and — in cluster mode — repair from a healthy
+        # replica through the AAE data path.  scrub_bytes_per_second=0
+        # restores the pre-r19 contract (no thread at all).
+        from pilosa_tpu.store.scrub import Scrubber
+        self.scrubber = Scrubber(
+            self.holder, interval=self.cfg.scrub_interval_seconds,
+            bytes_per_second=self.cfg.scrub_bytes_per_second,
+            stats=self.stats, logger=self.logger,
+            on_corrupt=(self.cluster.repair_quarantined
+                        if self.cluster is not None else None)).start()
+        self.api.scrubber = self.scrubber
         from pilosa_tpu.obs.diagnostics import Diagnostics
         self.diagnostics = Diagnostics(
             self.holder, self.cluster,
@@ -160,6 +181,8 @@ class PilosaTPUServer:
     def close(self) -> None:
         if self.diagnostics is not None:
             self.diagnostics.close()
+        if self.scrubber is not None:
+            self.scrubber.close()
         if self.cluster is not None:
             self.cluster.close()
         if self.grpc is not None:

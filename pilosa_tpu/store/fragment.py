@@ -27,6 +27,7 @@ import zlib
 import numpy as np
 
 from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.store import health as _storage_health
 from pilosa_tpu.store import roaring
 from pilosa_tpu.store.oplog import (OP_CLEAR_BITS, OP_CLEAR_ROW, OP_SET_BITS,
                                     OP_SET_ROW, OpLog)
@@ -45,10 +46,14 @@ class Fragment:
     """Bits of one (field, view, shard)."""
 
     def __init__(self, path: str, shard: int, *, max_op_n: int = MAX_OP_N,
-                 fsync: bool = False, snapshot_submit=None):
+                 fsync: bool = False, snapshot_submit=None, health=None):
         self.path = path                      # snapshot file
         self.shard = shard
         self.max_op_n = max_op_n
+        # disk-health governor + quarantine registry (r19), threaded
+        # down from the holder like snapshot_submit; None for bare
+        # fragments (unit tests) — every check is guarded
+        self._health = health
         # when set, op-log compaction is handed to a background queue
         # (reference: the fragment snapshot queue in holder.go) instead
         # of running inline on the write path
@@ -66,6 +71,9 @@ class Fragment:
         self._snap_mm = None
         self._snap_dir: roaring.Directory | None = None
         self._snap_pending: set[int] = set()
+        # the framed snapshot's declared crc32 (None = legacy unframed
+        # file): re-checked when the mmap demotes to a heap copy
+        self._snap_crc: int | None = None
         # recent-mutation journal for incremental device-plane updates
         # (exec.planes): (generation_after, {row: word_idx set | None}),
         # None = whole row changed.  Bounded; a gap means "rebuild".
@@ -109,29 +117,82 @@ class Fragment:
             if self._open:
                 return self
             if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
-                self._open_snapshot()
+                try:
+                    self._open_snapshot()
+                except Exception as e:  # noqa: BLE001 — a corrupt
+                    # snapshot must quarantine the FRAGMENT, never
+                    # fail the whole holder open (the node still
+                    # serves every healthy fragment; this one reads
+                    # from replicas until repaired)
+                    self._mark_corrupt("snapshot", f"open failed: {e}")
             for op, aux, positions in self._oplog.replay():
                 self._apply(op, aux, positions)
                 self.op_n += 1
             self._open = True
         return self
 
+    # r19 snapshot frame: versioned header + crc32 of the roaring blob
+    # (the end-to-end checksum the `.dense` sidecar already had).
+    # Legacy unframed snapshots (raw roaring, first two bytes ==
+    # roaring.MAGIC) still load — they just carry no checksum.
+    SNAP_MAGIC = b"PSF1"
+    SNAP_VERSION = 1
+    _SNAP_HDR = struct.Struct("<4sHHQI")  # magic, ver, rsvd, len, crc
+
     def _open_snapshot(self) -> None:
         """mmap the snapshot and parse only its container directory —
         zero-copy cold start (the reference's ``roaring.FromBuffer`` over
         ``syswrap.Mmap``): no bit is expanded until a row is touched.
         Map count is bounded by ``syswrap.GLOBAL`` (LRU demotion to a
-        heap copy — the reference's mmap→heap fallback)."""
+        heap copy — the reference's mmap→heap fallback).  Framed (r19)
+        snapshots verify their crc BEFORE any bit can be served —
+        corruption that would still parse (a flipped container key
+        silently misroutes bits) quarantines instead."""
         import mmap as _mmaplib
 
         from pilosa_tpu.store import syswrap
         with open(self.path, "rb") as f:
-            head = f.read(2)
-            if len(head) == 2 and struct.unpack("<H", head)[0] == \
+            head = f.read(self._SNAP_HDR.size)
+            if head[:4] == self.SNAP_MAGIC:
+                if len(head) < self._SNAP_HDR.size:
+                    self._mark_corrupt("snapshot",
+                                       "truncated frame header")
+                    return
+                _m, ver, _r, blen, crc = self._SNAP_HDR.unpack(head)
+                if ver != self.SNAP_VERSION:
+                    self._mark_corrupt(
+                        "snapshot", f"unknown frame version {ver}")
+                    return
+                mm = _mmaplib.mmap(f.fileno(), 0,
+                                   access=_mmaplib.ACCESS_READ)
+                blob = memoryview(mm)[self._SNAP_HDR.size:]
+                # integrity before use; zlib releases the GIL so
+                # concurrent fragment opens overlap the passes
+                bad = len(blob) != blen or zlib.crc32(blob) != crc
+                if bad:
+                    del blob
+                    try:
+                        mm.close()
+                    except BufferError:
+                        pass
+                    self._mark_corrupt(
+                        "snapshot",
+                        "frame length/crc mismatch (disk corruption)")
+                    return
+                self._snap_mm = mm
+                self._snap_crc = crc
+                self._snap_dir = roaring.Directory(blob)
+                self._snap_pending = set(
+                    int(r) for r in self._snap_dir.row_ids())
+                syswrap.GLOBAL.register(self)
+                return
+            if len(head) >= 2 and struct.unpack("<H", head[:2])[0] == \
                     roaring.MAGIC:
+                # legacy unframed (pre-r19) snapshot: no checksum
                 mm = _mmaplib.mmap(f.fileno(), 0,
                                    access=_mmaplib.ACCESS_READ)
                 self._snap_mm = mm
+                self._snap_crc = None
                 self._snap_dir = roaring.Directory(memoryview(mm))
                 self._snap_pending = set(
                     int(r) for r in self._snap_dir.row_ids())
@@ -140,6 +201,37 @@ class Fragment:
             # non-pilosa (e.g. standard32) snapshot: legacy eager load
             f.seek(0)
             self._load_positions(roaring.deserialize(f.read()))
+
+    def poison_snapshot(self) -> None:
+        """Scrub-detected snapshot corruption on a LIVE fragment: drop
+        the in-memory mapping so lazily-pending rows can no longer
+        expand from the corrupt blob (reads then serve the overlay
+        rows only — loud and quarantined, never silently wrong; the
+        generation bump invalidates device planes built over the bad
+        bytes).  The registry entry is the caller's job."""
+        with self.lock:
+            self._drop_snapshot()
+            self._snap_crc = None
+            self.generation += 1
+            self._recent.clear()
+            self._recent.append((self.generation, None))
+
+    def _mark_corrupt(self, kind: str, detail: str) -> None:
+        """Quarantine this fragment after an end-to-end checksum (or
+        parse) failure: drop the snapshot refs and serve EMPTY locally
+        — in cluster mode reads route to a replica and the scrubber's
+        repair pulls a fresh copy; single-node, a loud quarantined
+        empty beats silently-wrong bits."""
+        self._drop_snapshot()
+        self._snap_crc = None
+        h = self._health
+        if h is not None:
+            h.quarantine(self.path, kind, detail)
+        else:
+            import logging
+            logging.getLogger("pilosa_tpu.store").error(
+                "fragment snapshot corrupt (%s) at %s: %s",
+                kind, self.path, detail)
 
     def _demote_map(self) -> bool:
         """Swap the mmap'd snapshot for a heap copy (syswrap LRU
@@ -153,6 +245,14 @@ class Fragment:
             if self._snap_mm is None or self._snap_dir is None:
                 return True  # nothing to demote — already heap/absent
             heap = bytes(self._snap_dir.buf)
+            if self._snap_crc is not None \
+                    and zlib.crc32(heap) != self._snap_crc:
+                # the mapped bytes changed under us (disk/page-cache
+                # corruption): the heap copy is poisoned — quarantine
+                # at the demotion re-parse instead of serving it
+                self._mark_corrupt(
+                    "snapshot", "crc mismatch at mmap demotion")
+                return True
             self._snap_dir = roaring.Directory(memoryview(heap))
             self._snap_mm = None  # closed when the last view dies
             return True
@@ -449,7 +549,14 @@ class Fragment:
         try:
             st = os.stat(self.path)
             snap = (st.st_size, st.st_mtime_ns)
-        except OSError:
+        except OSError as e:
+            # an ABSENT snapshot (ENOENT) is the deliberate fallback —
+            # the fragment has never compacted, stamp (0, 0).  Any
+            # other errno is a disk fault: log once + feed the
+            # governor, then keep the conservative fallback (a zero
+            # stamp can only make the next build go cold, never wrong)
+            _storage_health.note_os_error("fragment.stamp", self.path,
+                                          e, health=self._health)
             snap = (0, 0)
         return (snap[0], snap[1], self._oplog.size())
 
@@ -583,24 +690,32 @@ class Fragment:
         if submit is not None:
             submit(self.dense_path, hdr, blob)
         else:
-            self.write_sidecar_file(self.dense_path, hdr, blob)
+            self.write_sidecar_file(self.dense_path, hdr, blob,
+                                    health=self._health)
 
     @staticmethod
-    def write_sidecar_file(path: str, hdr: bytes, blob: bytes) -> None:
+    def write_sidecar_file(path: str, hdr: bytes, blob: bytes,
+                           health=None) -> None:
         """Atomic best-effort sidecar write (also the deferred-writer
         entry point — the blob is immutable bytes, so writing after
-        the build moved on is safe)."""
+        the build moved on is safe).  Failure is DELIBERATELY
+        swallowed (a sidecar is a cache; a plane build must never fail
+        on it) but no longer silently: log once + feed the disk-health
+        governor — an ENOSPC here is the same full disk the oplog seam
+        would hit next."""
         tmp = path + ".tmp"
         try:
             with open(tmp, "wb") as f:
                 f.write(hdr)
                 f.write(blob)
             os.replace(tmp, path)
-        except OSError:
+        except OSError as e:
+            _storage_health.note_os_error("sidecar.write", path, e,
+                                          health=health)
             try:
                 os.unlink(tmp)
             except OSError:
-                pass
+                pass  # tmp may never have been created (ENOENT)
 
     # Cap on the generation-cached inverted index (sparse bits copied
     # into one flat array): 64M bits = 256MB.  Beyond it a second flat
@@ -690,6 +805,16 @@ class Fragment:
 
     # -- mutation -----------------------------------------------------------
 
+    def _write_gate(self) -> None:
+        """Refuse mutations BEFORE any in-memory change when the
+        storage layer is sick (node read-only on disk-full, or this
+        fragment quarantined) — a refusal can never half-apply.  The
+        healthy path costs one attribute load and a falsy branch
+        (``StorageHealth.gate_active``)."""
+        h = self._health
+        if h is not None and h.gate_active:
+            h.check_write(self.path)
+
     def set_bit(self, row_id: int, col: int) -> bool:
         return self.set_bits(np.array([row_id], np.uint64),
                              np.array([col], np.uint64)) > 0
@@ -705,6 +830,7 @@ class Fragment:
         :class:`~pilosa_tpu.store.oplog.SyncBatch`) defers the op-log
         fsync to the import batch boundary — one fsync per batch per
         touched fragment, not one per record."""
+        self._write_gate()
         positions = (np.asarray(row_ids, np.uint64) * _SW
                      + np.asarray(cols, np.uint64))
         with self.lock:
@@ -716,6 +842,7 @@ class Fragment:
 
     def clear_bits(self, row_ids: np.ndarray, cols: np.ndarray,
                    sync_batch=None) -> int:
+        self._write_gate()
         positions = (np.asarray(row_ids, np.uint64) * _SW
                      + np.asarray(cols, np.uint64))
         with self.lock:
@@ -735,6 +862,7 @@ class Fragment:
         return self._apply_grouped(groups, clear=True)
 
     def _apply_grouped(self, groups, clear: bool) -> int:
+        self._write_gate()
         op = OP_CLEAR_BITS if clear else OP_SET_BITS
         with self.lock:
             self._probe_cache = None  # mutates merged truth directly
@@ -771,6 +899,7 @@ class Fragment:
 
     def clear_row(self, row_id: int) -> int:
         """Clear every bit of a row (reference: ``fragment.clearRow``)."""
+        self._write_gate()
         with self.lock:
             changed = self._apply(OP_CLEAR_ROW, row_id, None)
             if changed:
@@ -782,6 +911,7 @@ class Fragment:
         ``fragment.setRow``).  Logged as ONE op-log record carrying the
         row's complete new contents, so a crash mid-call can never replay
         a cleared row without its replacement bits."""
+        self._write_gate()
         with self.lock:
             self._flush_pending()     # equality check needs merged truth
             self._ensure_row(row_id)  # no-op check needs snapshot truth
@@ -799,6 +929,7 @@ class Fragment:
                        sync_batch=None) -> int:
         """Union (or clear) an already-roaring-encoded bit set — the bulk
         loader fast path (reference: ``API.ImportRoaring``, SURVEY.md §4.5)."""
+        self._write_gate()
         positions = roaring.deserialize(blob)
         op = OP_CLEAR_BITS if clear else OP_SET_BITS
         with self.lock:
@@ -816,18 +947,54 @@ class Fragment:
         overlay — compaction is also the host-memory release point
         (positions() composes from the old blob + overlay without
         materializing, so rows must not be left half-resident)."""
+        h = self._health
+        if (h is not None and not getattr(self, "_rebuilding", False)
+                and h.is_quarantined(self.path)):
+            # compacting a QUARANTINED fragment would overwrite the
+            # corrupt-but-detectable file with a validly-framed
+            # snapshot of whatever partial state memory holds —
+            # masking the corruption forever (the registry is
+            # in-memory; a restart would open 'healthy').  Keep the
+            # evidence; replica repair owns the way out.
+            import logging
+            logging.getLogger("pilosa_tpu.store").warning(
+                "refusing to compact quarantined fragment %s "
+                "(would mask corruption as valid data)", self.path)
+            return
+        from pilosa_tpu.store import syswrap
         with self.lock:
             pre_stamp = self._dense_stamp()  # state the sidecar may match
-            blob = roaring.serialize(self.positions())  # includes pending
-            self._pend_pos = np.empty(0, np.uint64)
-            self._probe_cache = None
+            # merge the pending tier into rows FIRST: a failed file
+            # write below (disk full) must leave merged in-memory
+            # truth intact, not drop the pending bits with the blob
+            self._flush_pending()
+            blob = roaring.serialize(self.positions())
             tmp = self.path + ".tmp"
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+            # r19 frame: versioned header + crc32 of the blob, written
+            # through the sys.write/sys.fsync failpoints so chaos
+            # schedules cover snapshots exactly like op-logs
+            hdr = self._SNAP_HDR.pack(self.SNAP_MAGIC, self.SNAP_VERSION,
+                                      0, len(blob), zlib.crc32(blob))
+            try:
+                with open(tmp, "wb") as f:
+                    syswrap.checked_write(f, hdr)
+                    syswrap.checked_write(f, blob)
+                    f.flush()
+                    syswrap.checked_fsync(f)
+                os.replace(tmp, self.path)
+            except OSError as e:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                h = self._health
+                if h is not None \
+                        and not isinstance(
+                            e, _storage_health.StorageFaultError):
+                    raise h.write_failed(self.path, e,
+                                         site="fragment.snapshot") from e
+                raise
             self._drop_snapshot()
             self.rows = {}
             try:
@@ -869,12 +1036,21 @@ class Fragment:
                     f.write(hdr_s.pack(magic, ver, 0,
                                        *self._dense_stamp(), blen, crc))
                     return
-        except OSError:
-            return  # no sidecar (or unreadable): nothing to do
+        except OSError as e:
+            # ENOENT (no sidecar) is the deliberate no-op; any other
+            # errno (unreadable, disk fault) logs once + feeds the
+            # governor — the stale-stamp fallback stays safe either
+            # way (the next build just goes cold)
+            _storage_health.note_os_error("sidecar.restamp",
+                                          self.dense_path, e,
+                                          health=self._health)
+            return
         try:
             os.unlink(self.dense_path)
-        except OSError:
-            pass
+        except OSError as e:
+            _storage_health.note_os_error("sidecar.unlink",
+                                          self.dense_path, e,
+                                          health=self._health)
 
     # -- anti-entropy -------------------------------------------------------
 
@@ -922,6 +1098,7 @@ class Fragment:
 
     def merge_positions(self, positions: np.ndarray) -> int:
         """Union positions in (AAE repair receive path)."""
+        self._write_gate()
         with self.lock:
             changed = self._apply(OP_SET_BITS, 0, positions)
             if changed:
@@ -1084,13 +1261,63 @@ class Fragment:
 
     def _log(self, op: int, aux: int, positions: np.ndarray | None,
              sync_batch=None) -> None:
-        self._oplog.append(op, aux, positions, sync_batch=sync_batch)
+        try:
+            self._oplog.append(op, aux, positions, sync_batch=sync_batch)
+        except OSError as e:
+            # disk-fault governor seam (r19): classify by errno —
+            # ENOSPC flips the node read-only (this op is NOT acked;
+            # memory ran ahead of disk, which the at-least-once
+            # contract absorbs exactly like a torn write), repeated
+            # EIO quarantines just this fragment
+            h = self._health
+            if h is not None \
+                    and not isinstance(e, _storage_health.StorageFaultError):
+                raise h.write_failed(self._oplog.path, e,
+                                     site="oplog.append") from e
+            raise
+        h = self._health
+        if h is not None:
+            h.note_write_success(self.path)
         self.op_n += 1
         if self.op_n > self.max_op_n:
             if self._snapshot_submit is not None:
                 self._snapshot_submit(self)  # background compaction
             else:
                 self.snapshot()
+
+    def rebuild_from_positions(self, positions: np.ndarray) -> None:
+        """Replace this fragment's ENTIRE state with ``positions`` —
+        the quarantine-repair receive path (r19): the local copy is
+        untrustworthy (corrupt snapshot/op-log), so a healthy replica's
+        full position set becomes the new truth.  Discards the old
+        snapshot, op-log and overlay wholesale, loads the new bits,
+        and compacts them into a fresh framed snapshot (verified by
+        the caller before un-quarantine).  Deliberately bypasses the
+        write gate — this IS the path out of quarantine."""
+        with self.lock:
+            self._drop_snapshot()
+            self.rows = {}
+            self._pend_pos = np.empty(0, np.uint64)
+            self._probe_cache = None
+            self._oplog.truncate()
+            self.op_n = 0
+            self._load_positions(positions)
+            self.generation += 1
+            # device-plane journals cannot describe a wholesale
+            # replacement: force the rebuild path
+            self._recent.clear()
+            self._recent.append((self.generation, None))
+            try:
+                os.unlink(self.dense_path)  # sidecar captured old bytes
+            except OSError:
+                pass
+            # the one compaction allowed while still quarantined:
+            # this snapshot IS the replacement of the corrupt bytes
+            self._rebuilding = True
+            try:
+                self.snapshot()
+            finally:
+                self._rebuilding = False
 
     def maybe_snapshot(self) -> None:
         """Background-queue entry point: compact only if still OVER the

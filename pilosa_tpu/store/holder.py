@@ -97,11 +97,17 @@ class SnapshotQueue:
 class Holder:
     def __init__(self, path: str, *, fsync: bool = False,
                  async_snapshots: bool = True):
+        from pilosa_tpu.store.health import StorageHealth
         self.path = path
         self.fsync = fsync
         self.indexes: dict[str, Index] = {}
         self._lock = threading.RLock()
         self._snap_queue = SnapshotQueue() if async_snapshots else None
+        # disk-health governor + corruption quarantine (r19): one per
+        # holder tree, threaded down to every fragment (the same chain
+        # snapshot_submit rides); the server wires stats/knobs via
+        # configure() after boot
+        self.storage_health = StorageHealth(base=path)
 
     @property
     def _submit(self):
@@ -119,20 +125,23 @@ class Holder:
             for entry in entries:
                 self.indexes[entry] = Index(
                     os.path.join(self.path, entry), entry,
-                    fsync=self.fsync, snapshot_submit=self._submit).open()
+                    fsync=self.fsync, snapshot_submit=self._submit,
+                    health=self.storage_health).open()
             return self
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=min(8, len(entries))) as pool:
             opened = pool.map(
                 lambda e: (e, Index(os.path.join(self.path, e), e,
                                     fsync=self.fsync,
-                                    snapshot_submit=self._submit).open()),
+                                    snapshot_submit=self._submit,
+                                    health=self.storage_health).open()),
                 entries)
             for entry, idx in opened:
                 self.indexes[entry] = idx
         return self
 
     def close(self) -> None:
+        self.storage_health.close()
         if self._snap_queue is not None:
             self._snap_queue.close()
         with self._lock:
@@ -153,7 +162,8 @@ class Holder:
             idx = Index(os.path.join(self.path, name), name, keys=keys,
                         track_existence=track_existence, fsync=self.fsync,
                         created_at=created_at or time.time(),
-                        snapshot_submit=self._submit)
+                        snapshot_submit=self._submit,
+                        health=self.storage_health)
             os.makedirs(idx.path, exist_ok=True)
             idx.save_meta()
             idx.open()

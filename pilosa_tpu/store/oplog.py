@@ -52,6 +52,25 @@ RAW_FLAG = 0x80           # op-byte flag: payload is raw <u8 positions
 RAW_MAX_POSITIONS = 4096  # beyond this, roaring wins on size
 
 
+def clean_prefix_end(buf: bytes, header: struct.Struct) -> int:
+    """Byte offset where the whole-record prefix of a CRC-framed log
+    ends (== ``len(buf)`` for a clean file).  Works for every frame
+    this repo uses — op-log, hint log — because they share one shape:
+    a u32 crc32 of everything after it comes FIRST, the payload byte
+    length comes LAST in the header.  The single copy of the scan the
+    failed-append truncation and the scrubber's verifiers share (the
+    decode-as-you-go replay loops keep their own walk — they need the
+    payloads)."""
+    pos = 0
+    while pos + header.size <= len(buf):
+        fields = header.unpack_from(buf, pos)
+        end = pos + header.size + fields[-1]
+        if end > len(buf) or zlib.crc32(buf[pos + 4:end]) != fields[0]:
+            break
+        pos = end
+    return pos
+
+
 class SyncBatch:
     """Fsync coalescer for one import batch (r15 ingest): every op-log
     append inside the batch notes its log here instead of fsyncing
@@ -107,20 +126,36 @@ class OpLog:
         body = struct.pack("<BQI", op, aux, len(payload)) + payload
         record = struct.pack("<I", zlib.crc32(body)) + body
         f = self._file()
-        if fault.ACTIVE:
-            # record-relative torn tail: persist only args.offset bytes
-            # of THIS record then "crash" — replay must recover the
-            # clean prefix (CRC framing) whatever the offset
-            spec = fault.fire("oplog.append", path=self.path, op=op)
-            if spec is not None and spec["action"] == "torn_write":
-                fault.torn_write(f, record, spec)
-        syswrap.checked_write(f, record)
-        f.flush()
-        if self.fsync:
-            if sync_batch is not None:
-                sync_batch.note(self)
-            else:
-                syswrap.checked_fsync(f)
+        try:
+            if fault.ACTIVE:
+                # record-relative torn tail: persist only args.offset
+                # bytes of THIS record then "crash" — replay must
+                # recover the clean prefix (CRC framing) whatever the
+                # offset
+                spec = fault.fire("oplog.append", path=self.path, op=op)
+                if spec is not None and spec["action"] == "torn_write":
+                    fault.torn_write(f, record, spec)
+            syswrap.checked_write(f, record)
+            # flush INSIDE the tear handler: small records are
+            # buffered by checked_write without a syscall, so a real
+            # ENOSPC usually surfaces HERE — a flush failure is the
+            # same partial-bytes-on-disk state as a failed write
+            f.flush()
+            if self.fsync:
+                if sync_batch is not None:
+                    sync_batch.note(self)
+                else:
+                    syswrap.checked_fsync(f)
+        except OSError:
+            # a SHORT write without a crash (ENOSPC, quota): partial
+            # record bytes may be on disk.  Truncate back to the clean
+            # record prefix NOW — once the disk recovers, the next
+            # append must land on a record boundary, or replay would
+            # stop at this tear and silently discard every later
+            # acked record (the same poisoned-tail rule HintLog
+            # enforces, r13)
+            self.truncate_torn_tail()
+            raise
 
     def sync(self) -> None:
         """Fsync the log file if durability is on (the deferred half of
@@ -157,6 +192,26 @@ class OpLog:
         if good_end < len(buf):
             with open(self.path, "r+b") as f:
                 f.truncate(good_end)
+
+    def truncate_torn_tail(self) -> None:
+        """Physically truncate any torn/corrupt tail back to the
+        whole-record prefix (frame scan, no payload decode — this is
+        the failed-append recovery path).  Best-effort: a disk that
+        cannot even truncate leaves the tear for boot replay's own
+        clean-prefix recovery."""
+        self.close()
+        try:
+            with open(self.path, "rb") as f:
+                buf = f.read()
+        except OSError:
+            return
+        pos = clean_prefix_end(buf, _HEADER)
+        if pos < len(buf):
+            try:
+                with open(self.path, "r+b") as f:
+                    f.truncate(pos)
+            except OSError:
+                pass
 
     def truncate(self) -> None:
         """Discard the log (after a snapshot compaction)."""

@@ -1,0 +1,417 @@
+"""Background storage scrubber (r19): low-priority re-verification of
+every on-disk checksum, feeding the quarantine + repair pipeline.
+
+The anti-entropy loop (cluster/cluster.py ``sync_once``) keeps
+*replicas* honest with per-block checksums; this module keeps a single
+node's *disk* honest.  A single-flight walker re-reads, at a
+configurable byte-rate budget, every durable artifact that carries a
+checksum:
+
+- fragment **snapshots** (the r19 ``PSF1`` frame CRC; legacy unframed
+  snapshots are parse-verified instead — they predate the checksum),
+- fragment **op-logs** (CRC-framed records; a bad record mid-file is
+  corruption — a live node's log is always a clean record sequence,
+  because boot replay truncates torn tails and failed appends truncate
+  their own tear),
+- dense **sidecars** (header CRC; corrupt = cache, so it is unlinked
+  and counted, never quarantined — the next build goes cold),
+- **hint logs** (CRC-framed; corruption is counted and logged loudly —
+  recovery-by-clean-prefix happens at the HintLog layer).
+
+A corrupt snapshot or op-log QUARANTINES the fragment via
+:class:`~pilosa_tpu.store.health.StorageHealth` and hands the entry to
+``on_corrupt`` (in cluster mode: replica repair through the AAE data
+path, re-verified here before un-quarantine).
+
+Knobs: ``scrub_interval_seconds`` (pause between passes) and
+``scrub_bytes_per_second`` (the I/O budget; ``0`` disables the
+scrubber entirely — the pre-r19 contract, no thread).  Progress rides
+the ``storageHealth.scrub`` block on ``/status`` and
+``storage_scrub_bytes_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+
+from pilosa_tpu.store import roaring
+from pilosa_tpu.store.oplog import _HEADER as _OPLOG_HEADER
+from pilosa_tpu.store.oplog import clean_prefix_end
+
+# paced-read chunk: the byte budget is enforced BETWEEN chunks, so one
+# huge file cannot blow scrub_bytes_per_second in a single burst
+_READ_CHUNK = 4 << 20
+
+
+def _read_paced(path: str, pace=None) -> bytes:
+    """Read a whole file in budget-paced chunks (``pace(nbytes)`` is
+    the scrubber's token bucket; None = unpaced, the repair re-verify
+    path)."""
+    parts = []
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_READ_CHUNK)
+            if not chunk:
+                break
+            parts.append(chunk)
+            if pace is not None:
+                pace(len(chunk))
+    return b"".join(parts)
+
+
+# -- per-file verifiers (shared by the scrub pass and repair re-verify) -------
+
+
+def verify_snapshot_file(path: str, pace=None) -> tuple[str | None, int]:
+    """(problem or None, bytes read).  Framed snapshots verify length
+    + CRC; legacy unframed ones parse-verify (they carry no checksum —
+    a parse error is the only corruption signal they can give)."""
+    from pilosa_tpu.store.fragment import Fragment
+    try:
+        buf = _read_paced(path, pace)
+    except FileNotFoundError:
+        return None, 0
+    except OSError as e:
+        return f"unreadable: {e}", 0
+    if not buf:
+        return None, 0
+    hdr_s = Fragment._SNAP_HDR
+    if buf[:4] == Fragment.SNAP_MAGIC:
+        if len(buf) < hdr_s.size:
+            return "truncated frame header", len(buf)
+        _m, ver, _r, blen, crc = hdr_s.unpack_from(buf)
+        blob = memoryview(buf)[hdr_s.size:]
+        if ver != Fragment.SNAP_VERSION:
+            return f"unknown frame version {ver}", len(buf)
+        if len(blob) != blen:
+            return (f"length mismatch: header says {blen}, "
+                    f"file has {len(blob)}", len(buf))
+        if zlib.crc32(blob) != crc:
+            return "crc mismatch", len(buf)
+        return None, len(buf)
+    # legacy (pre-r19) snapshot: no checksum — full parse is the check
+    try:
+        roaring.deserialize(buf)
+    except Exception as e:  # noqa: BLE001 — any parse failure = corrupt
+        return f"legacy snapshot unparsable: {e}", len(buf)
+    return None, len(buf)
+
+
+def verify_oplog_file(path: str, pace=None) -> tuple[str | None, int]:
+    """A clean op-log is a whole-record prefix covering the entire
+    file: boot replay truncates crash tears and a failed append
+    truncates its own, so on a settled file any mid-file CRC/frame
+    mismatch is byte corruption, not an in-flight write.
+    (:func:`verify_fragment` detects a concurrent append via the
+    before/after stamp and withholds the verdict.)"""
+    try:
+        buf = _read_paced(path, pace)
+    except FileNotFoundError:
+        return None, 0
+    except OSError as e:
+        return f"unreadable: {e}", 0
+    pos = clean_prefix_end(buf, _OPLOG_HEADER)
+    if pos < len(buf):
+        return (f"corrupt record at byte {pos} "
+                f"({len(buf) - pos} trailing bytes)", len(buf))
+    return None, len(buf)
+
+
+def verify_sidecar_file(path: str, pace=None) -> tuple[str | None, int]:
+    """Dense-sidecar image CRC (header-declared).  A stamp mismatch is
+    NOT corruption (any write stales the stamp by design); only a
+    byte-level CRC/length failure reports."""
+    from pilosa_tpu.store.fragment import Fragment
+    hdr_s = Fragment._DENSE_HDR
+    try:
+        buf = _read_paced(path, pace)
+    except FileNotFoundError:
+        return None, 0
+    except OSError as e:
+        return f"unreadable: {e}", 0
+    if len(buf) < hdr_s.size:
+        return "truncated header", len(buf)
+    magic, ver, _, _s0, _s1, _s2, blen, crc = hdr_s.unpack_from(buf)
+    if magic != Fragment.DENSE_MAGIC or ver != Fragment.DENSE_VERSION:
+        return "bad magic/version", len(buf)
+    blob = memoryview(buf)[hdr_s.size:]
+    if len(blob) != blen:
+        return f"length mismatch ({len(blob)} != {blen})", len(buf)
+    if zlib.crc32(blob) != crc:
+        return "crc mismatch", len(buf)
+    return None, len(buf)
+
+
+def verify_hintlog_file(path: str, pace=None) -> tuple[str | None, int]:
+    """Hint-log frame scan (same rule as the op-log: a live log is a
+    whole-record file — HintLog truncates tears at recovery AND at
+    failed appends)."""
+    # the authoritative frame layout lives with the hint log itself
+    # (deferred import: store must not import cluster at module load)
+    from pilosa_tpu.cluster.hints import _FRAME
+    try:
+        buf = _read_paced(path, pace)
+    except FileNotFoundError:
+        return None, 0
+    except OSError as e:
+        return f"unreadable: {e}", 0
+    pos = clean_prefix_end(buf, _FRAME)
+    if pos < len(buf):
+        return (f"corrupt record at byte {pos} "
+                f"({len(buf) - pos} trailing bytes)", len(buf))
+    return None, len(buf)
+
+
+def _frag_stamp(frag) -> tuple:
+    """(snapshot size, snapshot mtime_ns, op-log size): changes with
+    every compaction and every append — the settledness witness."""
+    try:
+        st = os.stat(frag.path)
+        snap = (st.st_size, st.st_mtime_ns)
+    except OSError:
+        snap = (0, 0)
+    try:
+        osz = os.path.getsize(frag._oplog.path)
+    except OSError:
+        osz = 0
+    return (snap[0], snap[1], osz)
+
+
+def verify_fragment(frag, pace=None) -> tuple[dict[str, str] | None, int]:
+    """Verify one fragment's snapshot + op-log WITHOUT its lock (the
+    scrub must never stall serving behind a multi-second file read):
+    the on-disk stamp is captured before and after, and a mismatch —
+    a compaction or append raced the scan, so a mid-file 'tear' may
+    just be an in-flight write — withholds the verdict entirely
+    (returns ``(None, bytes)``; the next pass, or the repair retry,
+    re-scans a settled image).  ``({}, bytes)`` = verified clean."""
+    before = _frag_stamp(frag)
+    try:
+        snap_p, snap_b = verify_snapshot_file(frag.path, pace)
+        op_p, op_b = verify_oplog_file(frag._oplog.path, pace)
+    except Exception:  # noqa: BLE001 — unreadable mid-swap: no verdict
+        return None, 0
+    if _frag_stamp(frag) != before:
+        return None, snap_b + op_b
+    problems: dict[str, str] = {}
+    if snap_p:
+        problems["snapshot"] = snap_p
+    if op_p:
+        problems["oplog"] = op_p
+    return problems, snap_b + op_b
+
+
+class Scrubber:
+    """Single-flight background walker re-verifying every on-disk
+    checksum at ``bytes_per_second``; corrupt fragments quarantine and
+    flow to ``on_corrupt`` (the cluster's replica-repair hook)."""
+
+    def __init__(self, holder, *, interval: float = 600.0,
+                 bytes_per_second: int = 32 << 20, stats=None,
+                 logger=None, on_corrupt=None):
+        from pilosa_tpu.obs import NopStats, get_logger
+        self.holder = holder
+        self.health = holder.storage_health
+        self.interval = float(interval)
+        self.bytes_per_second = int(bytes_per_second)
+        self.stats = stats or NopStats()
+        self.logger = logger or get_logger("pilosa_tpu.store")
+        # on_corrupt(entry) — called once per quarantined entry per
+        # pass (fresh detections AND still-pending older ones, so a
+        # failed repair retries every pass)
+        self.on_corrupt = on_corrupt
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._flight = threading.Lock()
+        # progress (read by the /status storageHealth.scrub block)
+        self._passes = 0
+        self._bytes_total = 0
+        self._corruptions = 0
+        self._last_pass_seconds = 0.0
+        self._last_pass_at = 0.0
+        self._pace_t0 = 0.0
+        self._pace_bytes = 0
+
+    @property
+    def enabled(self) -> bool:
+        """False restores the pre-r19 contract byte-for-byte: no
+        scrubber thread, no re-verification, no repair hook."""
+        return self.bytes_per_second > 0 and self.interval > 0
+
+    def start(self) -> "Scrubber":
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="pilosa-scrub", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception as e:  # noqa: BLE001 — scrub must not die
+                self.logger.warning("scrub pass failed: %s", e)
+
+    # -- one pass -------------------------------------------------------------
+
+    def _pace(self, nbytes: int) -> None:
+        """Token-bucket byte budget: sleep so the pass's cumulative
+        read rate stays at/under ``bytes_per_second`` — scrubbing is
+        strictly lower priority than serving I/O."""
+        if self.bytes_per_second <= 0 or nbytes <= 0:
+            return
+        self._pace_bytes += nbytes
+        ahead = (self._pace_bytes / self.bytes_per_second
+                 - (time.monotonic() - self._pace_t0))
+        if ahead > 0:
+            self._stop.wait(min(ahead, 1.0))
+
+    def run_once(self) -> dict:
+        """One full verification pass (single-flight; concurrent calls
+        return without scanning).  Returns the pass summary."""
+        if not self._flight.acquire(blocking=False):
+            return {"skipped": "pass already running"}
+        try:
+            return self._run_once_locked()
+        finally:
+            self._flight.release()
+
+    def _run_once_locked(self) -> dict:
+        t0 = time.monotonic()
+        self._pace_t0 = t0
+        self._pace_bytes = 0
+        scanned = corrupt = files = 0
+        health = self.health
+        for frag in self._fragments():
+            if self._stop.is_set():
+                break
+            if health.is_quarantined(frag.path):
+                continue  # repair owns it; re-verify happens there
+            problems, nbytes = verify_fragment(frag, pace=self._pace)
+            scanned += nbytes
+            files += 2
+            if problems is None:
+                continue  # raced a write/compaction: next pass retries
+            for artifact, problem in problems.items():
+                corrupt += 1
+                health.quarantine(frag.path, artifact, problem)
+                if artifact == "snapshot":
+                    # drop the live mmap/heap refs too: without this a
+                    # SINGLE-NODE deployment (no replica routing, no
+                    # internal-query gate) would keep lazily expanding
+                    # rows from the corrupt blob — a loud quarantined
+                    # empty beats silently-wrong bits (the same
+                    # contract _mark_corrupt applies at open/demote)
+                    frag.poison_snapshot()
+            # sidecar: a cache, never quarantined — corrupt unlinks so
+            # the next plane build goes cold instead of wrong (the
+            # loader's own CRC would catch it too; scrubbing surfaces
+            # it before a restart does)
+            side_p, side_b = verify_sidecar_file(frag.dense_path,
+                                                 pace=self._pace)
+            scanned += side_b
+            if side_b:
+                files += 1
+            if side_p:
+                corrupt += 1
+                self.stats.count("storage_corruption_detected_total", 1,
+                                 kind="sidecar")
+                self.logger.warning(
+                    "scrub: corrupt dense sidecar %s (%s) — unlinked, "
+                    "next plane build goes cold",
+                    frag.dense_path, side_p)
+                try:
+                    os.remove(frag.dense_path)
+                except OSError:
+                    pass
+        hints_dir = os.path.join(self.holder.path, "_hints")
+        if os.path.isdir(hints_dir):
+            for name in sorted(os.listdir(hints_dir)):
+                if not name.endswith(".hints"):
+                    continue
+                p = os.path.join(hints_dir, name)
+                try:
+                    before = os.path.getsize(p)
+                except OSError:
+                    continue
+                problem, nbytes = verify_hintlog_file(
+                    p, pace=self._pace)
+                scanned += nbytes
+                files += 1
+                try:
+                    settled = os.path.getsize(p) == before
+                except OSError:
+                    settled = False
+                if problem and not settled:
+                    # raced a live append or an ack-compaction rename:
+                    # a half-flushed tail is not corruption — withhold
+                    # the verdict, the next pass re-scans settled bytes
+                    # (the same stamp rule verify_fragment applies)
+                    continue
+                if problem:
+                    corrupt += 1
+                    self.stats.count(
+                        "storage_corruption_detected_total", 1,
+                        kind="hintlog")
+                    self.logger.error(
+                        "scrub: corrupt hint log %s (%s) — acked "
+                        "hinted writes past the tear are LOST; "
+                        "anti-entropy repairs the divergence after "
+                        "hint gating expires", p, problem)
+        # hand every pending quarantined entry (fresh + older failed
+        # repairs) to the repair hook
+        repaired = 0
+        if self.on_corrupt is not None:
+            for entry in health.quarantined_entries():
+                if self._stop.is_set():
+                    break
+                try:
+                    if self.on_corrupt(entry):
+                        repaired += 1
+                except Exception as e:  # noqa: BLE001 — retried next pass
+                    self.logger.warning(
+                        "scrub: repair hook failed for %s: %s",
+                        entry["path"], e)
+        self._passes += 1
+        self._bytes_total += scanned
+        self._corruptions += corrupt
+        self._last_pass_seconds = time.monotonic() - t0
+        self._last_pass_at = time.time()
+        if scanned:
+            self.stats.count("storage_scrub_bytes_total", scanned)
+        if corrupt:
+            self.logger.warning(
+                "scrub pass: %d corrupt artifact(s) in %d files "
+                "(%d bytes, %.2fs)", corrupt, files, scanned,
+                self._last_pass_seconds)
+        return {"files": files, "bytes": scanned, "corrupt": corrupt,
+                "repaired": repaired,
+                "seconds": round(self._last_pass_seconds, 3)}
+
+    def _fragments(self):
+        for idx in list(self.holder.indexes.values()):
+            for f in list(idx.fields.values()):
+                for v in list(f.views.values()):
+                    yield from list(v.fragments.values())
+
+    def payload(self) -> dict:
+        """The ``scrub`` sub-block of ``storageHealth`` on /status."""
+        return {
+            "enabled": self.enabled,
+            "intervalSeconds": self.interval,
+            "bytesPerSecond": self.bytes_per_second,
+            "passes": self._passes,
+            "bytesScanned": self._bytes_total,
+            "corruptionsFound": self._corruptions,
+            "lastPassSeconds": round(self._last_pass_seconds, 3),
+            "lastPassAt": self._last_pass_at,
+        }

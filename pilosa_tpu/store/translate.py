@@ -340,9 +340,13 @@ class TranslateStore:
     ``<data>/<index>/_keys/_columns.sqlite`` for column keys,
     ``<data>/<index>/_keys/<field>.sqlite`` per field."""
 
-    def __init__(self, holder_path: str, cache_size: int = DEFAULT_CACHE_SIZE):
+    def __init__(self, holder_path: str, cache_size: int = DEFAULT_CACHE_SIZE,
+                 health=None):
         self.holder_path = holder_path
         self.cache_size = cache_size
+        # disk-health governor (r19): previously-silent OSError sites
+        # below feed its fault counter (note_os_error)
+        self._health = health
         self._logs: dict[tuple[str, str | None], KeyStore] = {}
         self._lock = threading.Lock()
 
@@ -381,8 +385,16 @@ class TranslateStore:
                         name = fn[:-len(".sqlite")]
                         seen.add((index,
                                   None if name == "_columns" else name))
-        except OSError:
-            pass
+        except OSError as e:
+            # an ABSENT holder dir (ENOENT, fresh node) is the
+            # deliberate fallback: in-process stores alone are the
+            # answer.  Any other errno means persisted stores may be
+            # hidden from cluster joiners — log once + feed the
+            # governor, still answer with what we have (degraded,
+            # never an error)
+            from pilosa_tpu.store.health import note_os_error
+            note_os_error("translate.list", self.holder_path, e,
+                          health=self._health)
         return sorted(seen, key=lambda t: (t[0], t[1] or ""))
 
     def _paths(self, index: str, name: str) -> list[str]:
@@ -405,8 +417,17 @@ class TranslateStore:
                     for path in self._paths(index, field):
                         try:
                             os.remove(path)
-                        except OSError:
-                            pass
+                        except OSError as e:
+                            # most of these files are OPTIONAL (wal/
+                            # shm/legacy logs): ENOENT is the
+                            # deliberate no-op.  A remove that fails
+                            # for any other reason leaves a dead
+                            # field's key state to haunt a recreated
+                            # field — log once + feed the governor
+                            from pilosa_tpu.store.health import \
+                                note_os_error
+                            note_os_error("translate.drop", path, e,
+                                          health=self._health)
                 return
             for key in [k for k in self._logs if k[0] == index]:
                 self._logs.pop(key).close()

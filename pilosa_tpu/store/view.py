@@ -19,11 +19,12 @@ VIEW_BSI_PREFIX = "bsi_"
 
 class View:
     def __init__(self, path: str, name: str, *, fsync: bool = False,
-                 snapshot_submit=None):
+                 snapshot_submit=None, health=None):
         self.path = path  # <field>/views/<name>
         self.name = name
         self.fsync = fsync
         self.snapshot_submit = snapshot_submit
+        self.health = health  # disk-health governor (r19), holder's
         self.fragments: dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -42,7 +43,8 @@ class View:
             for shard in shards:
                 frag = Fragment(os.path.join(frag_dir, str(shard)), shard,
                                 fsync=self.fsync,
-                                snapshot_submit=self.snapshot_submit)
+                                snapshot_submit=self.snapshot_submit,
+                                health=self.health)
                 self.fragments[shard] = frag.open()
         return self
 
@@ -53,7 +55,8 @@ class View:
                 path = os.path.join(self.path, "fragments", str(shard))
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 frag = Fragment(path, shard, fsync=self.fsync,
-                                snapshot_submit=self.snapshot_submit).open()
+                                snapshot_submit=self.snapshot_submit,
+                                health=self.health).open()
                 self.fragments[shard] = frag
             return frag
 

@@ -37,6 +37,17 @@ distributed invariant after faults clear:
                                  stay exact on the fallback path while
                                  the governor degrades, then probes
                                  back to healthy
+- corrupt fragment scrub repair→ a byte-flipped snapshot: the scrubber
+                                 detects (frame CRC), reads stay
+                                 oracle-exact via replica failover,
+                                 the fragment repairs from its
+                                 replica, forced AAE finds zero
+                                 divergence
+- disk full during ingest      → ENOSPC mid-bulk-import: the node
+                                 flips read-only with structured 507
+                                 refusals, batches keep acking via
+                                 peer hints, freeing space restores
+                                 healthy and the drain lands bit-exact
 
 Every schedule reproduces from the printed seed (override with
 PILOSA_CHAOS_SEED).  The multi-node scenarios share one module-scoped
@@ -142,6 +153,30 @@ def test_hung_dispatch_serving(tmp_path):
     with run_process_cluster(1, str(tmp_path),
                              extra_env=env) as cluster:
         chaos.scenario_hung_dispatch_serving(cluster, SEED)
+
+
+def test_corrupt_fragment_scrub_repair(tmp_path):
+    # own 2-node replicas=2 cluster: sub-second scrub interval,
+    # periodic AAE off (r19) — a byte-flipped snapshot must be
+    # detected by the scrubber, served through via replica failover
+    # with zero read failures, repaired from the replica, and leave
+    # zero divergence for a forced AAE round
+    env = dict(chaos.SCENARIOS["corrupt_fragment_scrub_repair"][2])
+    with run_process_cluster(2, str(tmp_path), replicas=2,
+                             extra_env=env) as cluster:
+        chaos.scenario_corrupt_fragment_scrub_repair(cluster, SEED)
+
+
+def test_disk_full_during_ingest(tmp_path):
+    # own 2-node replicas=2 cluster: sub-second disk probe (r19) —
+    # injected ENOSPC must flip the victim read-only with structured
+    # 507 refusals while bulk imports keep acking (peer hints), and
+    # freeing space must restore healthy serving with the drain
+    # landing bit-exact everywhere
+    env = dict(chaos.SCENARIOS["disk_full_during_ingest"][2])
+    with run_process_cluster(2, str(tmp_path), replicas=2,
+                             extra_env=env) as cluster:
+        chaos.scenario_disk_full_during_ingest(cluster, SEED)
 
 
 def test_flaky_device_governor(tmp_path):

@@ -201,6 +201,69 @@ class TestOplogSeam:
         assert len(list(log2.replay())) == 3
 
 
+class TestTypedErrno:
+    """r19 satellite: the ``errno`` fault arg types a disk fault
+    (ENOSPC vs EIO) so chaos schedules drive the disk-health
+    governor's REAL errno classification, deterministically."""
+
+    def test_error_action_carries_symbolic_errno(self):
+        import errno
+        fault.set_fault("s", "error", args={"errno": "ENOSPC"})
+        with pytest.raises(fault.FaultError) as ei:
+            fault.fire("s")
+        assert ei.value.errno == errno.ENOSPC
+
+    def test_error_action_carries_numeric_errno(self):
+        import errno
+        fault.set_fault("s", "error", args={"errno": errno.EIO})
+        with pytest.raises(fault.FaultError) as ei:
+            fault.fire("s")
+        assert ei.value.errno == errno.EIO
+
+    def test_untyped_error_has_no_errno(self):
+        fault.set_fault("s", "error")
+        with pytest.raises(fault.FaultError) as ei:
+            fault.fire("s")
+        assert ei.value.errno is None
+
+    def test_unknown_errno_name_rejected_at_arm_time(self):
+        # a typo'd errno must fail the ARMING loudly, not silently
+        # inject an un-typed fault the governor then misclassifies
+        with pytest.raises(ValueError):
+            fault.set_fault("s", "error", args={"errno": "ENOSPACE"})
+        assert fault.ACTIVE is False
+
+    def test_torn_write_carries_errno(self, tmp_path):
+        # the ENOSPC shape: a SHORT write then a typed error — the
+        # process survives and classification still runs
+        import errno
+
+        import numpy as np
+
+        from pilosa_tpu.store.oplog import OP_SET_BITS, OpLog
+        log = OpLog(str(tmp_path / "t.oplog"))
+        fault.set_fault("oplog.append", "torn_write", nth=1,
+                        args={"offset": 5, "errno": "ENOSPC"})
+        with pytest.raises(fault.FaultError) as ei:
+            log.append(OP_SET_BITS, 0, np.array([1], np.uint64))
+        assert ei.value.errno == errno.ENOSPC
+        log.close()
+
+    def test_classifier_sees_injected_errno(self):
+        import errno
+
+        from pilosa_tpu.store.health import classify_oserror
+        fault.set_fault("s", "error", args={"errno": "ENOSPC"})
+        with pytest.raises(fault.FaultError) as ei:
+            fault.fire("s")
+        assert classify_oserror(ei.value) == "disk_full"
+        fault.clear()
+        fault.set_fault("s", "error", args={"errno": errno.EIO})
+        with pytest.raises(fault.FaultError) as ei:
+            fault.fire("s")
+        assert classify_oserror(ei.value) == "io_error"
+
+
 class TestExecutorSeams:
     def test_injected_oom_drives_real_recovery(self, tmp_path):
         from pilosa_tpu.exec import Executor

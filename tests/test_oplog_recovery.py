@@ -105,6 +105,56 @@ def test_torn_at_mid_record_offsets(tmp_path):
             _assert_clean_prefix(path, n_full=i)
 
 
+def test_enospc_truncated_tail_recovers_like_torn_write(tmp_path):
+    """r19 satellite: an ENOSPC SHORT WRITE — the disk takes only a
+    prefix of the record and errors, but the process SURVIVES (no
+    crash) — must recover to a clean record prefix on reopen exactly
+    like the torn-write-crash case.  Sharper still: because a failed
+    append truncates its own tear, appends continuing in the SAME
+    process once space frees land on a record boundary — replay must
+    never silently discard them behind a stale tear."""
+    import errno
+    import os
+
+    for i in range(len(RECORDS)):
+        size = _record_size(str(tmp_path / "probe.oplog"), i)
+        (tmp_path / "probe.oplog").unlink()
+        for off in sorted({0, 1, 8, size // 2, size - 1}):
+            if off >= size:
+                continue
+            path = str(tmp_path / f"enospc{i}_{off}.oplog")
+            log = OpLog(path)
+            for op, aux, pos in RECORDS[:i]:
+                log.append(op, aux, pos)
+            # the typed disk fault: short write + ENOSPC, via the same
+            # sys.write seam a real full disk errors through
+            fault.set_fault("sys.write", "torn_write", nth=1,
+                            args={"offset": off, "errno": "ENOSPC"})
+            op, aux, pos = RECORDS[i]
+            with pytest.raises(OSError) as ei:
+                log.append(op, aux, pos)
+            assert ei.value.errno == errno.ENOSPC
+            fault.clear()
+            # the tear was truncated away immediately: the file is a
+            # whole-record prefix again
+            replayed = list(OpLog(path).replay())
+            assert len(replayed) == i, (off, len(replayed))
+            # no crash: the SAME (still-open) log appends once space
+            # frees, and replay sees prefix + the new record — the
+            # torn bytes never swallow a later acked append
+            log.append(OP_SET_BITS, 0, np.array([42], np.uint64))
+            log.close()
+            replayed = list(OpLog(path).replay())
+            assert len(replayed) == i + 1
+            for (w_op, w_aux, w_pos), (g_op, g_aux, g_pos) in zip(
+                    RECORDS[:i], replayed):
+                assert (g_op, g_aux) == (w_op, w_aux)
+                np.testing.assert_array_equal(g_pos, w_pos)
+            np.testing.assert_array_equal(
+                replayed[-1][2], np.array([42], np.uint64))
+            os.remove(path)
+
+
 def test_torn_set_row_never_half_applies(tmp_path):
     """SET_ROW (the Store() record) replaces a row as ONE record —
     clear + new contents together.  A tear anywhere in that record must

@@ -1,0 +1,582 @@
+"""r19 storage-integrity subsystem: snapshot frame checksums, the
+background scrubber, corruption quarantine + replica repair, and the
+disk-fault governor.
+
+The process-cluster drills live in tests/test_chaos.py
+(``corrupt_fragment_scrub_repair``, ``disk_full_during_ingest``); this
+file pins the layer contracts in-process: frame round-trip + legacy
+load, verify-on-open/demote, every scrub verdict kind, errno
+classification (ENOSPC → read-only + probe recovery, EIO → per-
+fragment quarantine), the structured 507/503 refusals at the public
+edge, the knob-off pre-r19 contract (no scrubber thread), and the
+2-node quarantine → replica-repair → zero-divergence cycle."""
+
+import errno
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import fault
+from pilosa_tpu.store import Holder, roaring
+from pilosa_tpu.store.fragment import Fragment
+from pilosa_tpu.store.health import (StorageFaultError, StorageHealth,
+                                     classify_oserror)
+from pilosa_tpu.store.scrub import (Scrubber, verify_fragment,
+                                    verify_oplog_file,
+                                    verify_sidecar_file,
+                                    verify_snapshot_file)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _flip_byte(path: str, offset_from_end: int = 2) -> None:
+    """Flip one byte IN PLACE (r+b: truncating would SIGBUS a live
+    mmap of the file)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - offset_from_end)
+        b = f.read(1)
+        f.seek(size - offset_from_end)
+        f.write(bytes([b[0] ^ 0x55]))
+
+
+class TestSnapshotFrame:
+    def test_framed_round_trip(self, tmp_path):
+        p = str(tmp_path / "frag")
+        f = Fragment(p, 0).open()
+        f.set_bits(np.array([0, 0, 7], np.uint64),
+                   np.array([1, 5, 9], np.uint64))
+        f.snapshot()
+        raw = open(p, "rb").read()
+        assert raw[:4] == b"PSF1"
+        f.close()
+        g = Fragment(p, 0).open()
+        assert list(g.row(0).columns()) == [1, 5]
+        assert list(g.row(7).columns()) == [9]
+        assert verify_snapshot_file(p)[0] is None
+        g.close()
+
+    def test_legacy_unframed_snapshot_still_loads(self, tmp_path):
+        p = str(tmp_path / "legacy")
+        pos = np.array([3, 1 << 21, 5 << 20], np.uint64)
+        with open(p, "wb") as f:
+            f.write(roaring.serialize(pos))
+        g = Fragment(p, 0).open()
+        np.testing.assert_array_equal(g.positions(), pos)
+        assert verify_snapshot_file(p)[0] is None
+        g.close()
+
+    def test_corrupt_frame_quarantines_at_open(self, tmp_path):
+        h = StorageHealth(base=str(tmp_path))
+        p = str(tmp_path / "bad")
+        f = Fragment(p, 0, health=h).open()
+        f.set_bits(np.array([0], np.uint64), np.array([7], np.uint64))
+        f.snapshot()
+        f.close()
+        _flip_byte(p)
+        g = Fragment(p, 0, health=h).open()
+        assert h.is_quarantined(p)
+        entry = h.quarantined_entries()[0]
+        assert entry["kind"] == "snapshot"
+        # serves EMPTY (loud), never possibly-wrong bits
+        assert not g.row(0).any()
+        # local writes refuse BEFORE mutating, with the structured kind
+        with pytest.raises(StorageFaultError) as ei:
+            g.set_bits(np.array([0], np.uint64),
+                       np.array([9], np.uint64))
+        assert ei.value.kind == "snapshot"
+        assert not g.row(0).any()
+
+    def test_demote_reverifies_crc(self, tmp_path):
+        h = StorageHealth(base=str(tmp_path))
+        p = str(tmp_path / "dem")
+        f = Fragment(p, 0, health=h).open()
+        f.set_bits(np.array([0], np.uint64),
+                   np.arange(100, dtype=np.uint64))
+        f.snapshot()
+        assert f._snap_mm is not None and f._snap_crc is not None
+        _flip_byte(p)  # the mapped pages see the new bytes
+        assert f._demote_map() is True
+        assert h.is_quarantined(p)
+        f._oplog.close()
+
+    def test_close_never_masks_quarantined_corruption(self, tmp_path):
+        """A quarantined fragment must NOT be compacted by close()/
+        maybe_snapshot(): writing a fresh validly-framed snapshot over
+        the corrupt file would mask the corruption forever (the
+        registry is in-memory — a restart would open 'healthy' with
+        the snapshot bits silently gone)."""
+        h = StorageHealth(base=str(tmp_path))
+        p = str(tmp_path / "mask")
+        f = Fragment(p, 0, health=h).open()
+        f.set_bits(np.array([0], np.uint64),
+                   np.arange(50, dtype=np.uint64))
+        f.snapshot()
+        f.close()
+        _flip_byte(p)
+        corrupt_bytes = open(p, "rb").read()
+        g = Fragment(p, 0, health=h).open()
+        assert h.is_quarantined(p)
+        # an oplog tail from BEFORE the corruption landed (models a
+        # boot where replay applied ops on top of the bad snapshot)
+        g.op_n = 3
+        g.close()
+        # the corrupt evidence is untouched: close refused to compact
+        assert open(p, "rb").read() == corrupt_bytes
+        # a fresh open re-detects (idempotent quarantine)
+        g2 = Fragment(p, 0, health=h).open()
+        assert h.is_quarantined(p)
+        g2._oplog.close()
+
+    def test_rebuild_from_positions_round_trip(self, tmp_path):
+        p = str(tmp_path / "reb")
+        f = Fragment(p, 0).open()
+        f.set_bits(np.array([0, 1], np.uint64),
+                   np.array([1, 2], np.uint64))
+        want = np.array([5, (1 << 20) + 3, 9 << 20], np.uint64)
+        f.rebuild_from_positions(want)
+        np.testing.assert_array_equal(f.positions(), np.sort(want))
+        assert f.op_n == 0  # op-log truncated; snapshot is the truth
+        assert open(p, "rb").read(4) == b"PSF1"
+        assert not verify_fragment(f)[0]
+        f.close()
+        g = Fragment(p, 0).open()
+        np.testing.assert_array_equal(g.positions(), np.sort(want))
+        g.close()
+
+
+class TestScrubber:
+    def _holder_with_fragment(self, tmp_path):
+        h = Holder(str(tmp_path))
+        h.open()
+        idx = h.create_index("i")
+        fld = idx.create_field("f")
+        fld.set_bit(0, 1)
+        fld.set_bit(0, 5)
+        fld.set_bit(2, 9)
+        frag = fld.standard_view().fragment(0)
+        frag.snapshot()
+        return h, frag
+
+    def test_clean_pass_counts_bytes(self, tmp_path):
+        h, frag = self._holder_with_fragment(tmp_path)
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30)
+        out = s.run_once()
+        assert out["corrupt"] == 0 and out["bytes"] > 0
+        assert s.payload()["passes"] == 1
+        h.close()
+
+    def test_flipped_snapshot_quarantines(self, tmp_path):
+        h, frag = self._holder_with_fragment(tmp_path)
+        _flip_byte(frag.path)
+        repairs = []
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30,
+                     on_corrupt=lambda e: repairs.append(e) or False)
+        out = s.run_once()
+        assert out["corrupt"] == 1
+        assert h.storage_health.is_quarantined(frag.path)
+        assert h.storage_health.shard_quarantined("i", 0)
+        assert repairs and repairs[0]["key"] == ("i", "f", "standard", 0)
+        # a failed repair retries NEXT pass (entry handed over again)
+        s.run_once()
+        assert len(repairs) == 2
+        h.close()
+
+    def test_midfile_oplog_corruption_quarantines(self, tmp_path):
+        h, frag = self._holder_with_fragment(tmp_path)
+        # two more records, then corrupt the FIRST one's payload —
+        # mid-file damage, not an in-flight tail
+        frag.set_bits(np.array([1], np.uint64),
+                      np.array([3], np.uint64))
+        frag.set_bits(np.array([1], np.uint64),
+                      np.array([4], np.uint64))
+        oplog_path = frag._oplog.path
+        frag._oplog.close()
+        with open(oplog_path, "r+b") as f:
+            f.seek(8)
+            b = f.read(1)
+            f.seek(8)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert verify_oplog_file(oplog_path)[0] is not None
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30)
+        out = s.run_once()
+        assert out["corrupt"] == 1
+        entry = h.storage_health.quarantined_entries()[0]
+        assert entry["kind"] == "oplog"
+        h.close()
+
+    def test_corrupt_sidecar_is_unlinked_not_quarantined(self, tmp_path):
+        h, frag = self._holder_with_fragment(tmp_path)
+        # a syntactically-valid sidecar with a wrong crc
+        hdr = frag._DENSE_HDR.pack(frag.DENSE_MAGIC, frag.DENSE_VERSION,
+                                   0, 1, 2, 3, 4, 12345)
+        with open(frag.dense_path, "wb") as f:
+            f.write(hdr + b"zzzz")
+        assert verify_sidecar_file(frag.dense_path)[0] is not None
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30)
+        out = s.run_once()
+        assert out["corrupt"] == 1
+        assert not os.path.exists(frag.dense_path)  # unlinked: cache
+        assert not h.storage_health.quarantined_entries()
+        h.close()
+
+    def test_corrupt_hint_log_counted_not_quarantined(self, tmp_path):
+        h, frag = self._holder_with_fragment(tmp_path)
+        hints_dir = os.path.join(h.path, "_hints")
+        os.makedirs(hints_dir)
+        with open(os.path.join(hints_dir, "ff.hints"), "wb") as f:
+            f.write(b"\x01\x02garbage-that-is-not-a-frame\x03")
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30)
+        out = s.run_once()
+        assert out["corrupt"] == 1
+        assert not h.storage_health.quarantined_entries()
+        h.close()
+
+    def test_knob_off_means_no_thread(self, tmp_path):
+        # scrub_bytes_per_second=0 restores the pre-r19 contract:
+        # no scrubber thread at all
+        h, _frag = self._holder_with_fragment(tmp_path)
+        s = Scrubber(h, interval=600, bytes_per_second=0)
+        assert s.enabled is False
+        s.start()
+        assert s._thread is None
+        assert not [t for t in threading.enumerate()
+                    if t.name == "pilosa-scrub"]
+        s.close()
+        h.close()
+
+
+class TestDiskGovernor:
+    def test_errno_classification(self):
+        assert classify_oserror(OSError(errno.ENOSPC, "x")) == "disk_full"
+        assert classify_oserror(OSError(errno.EDQUOT, "x")) == "disk_full"
+        assert classify_oserror(OSError(errno.EIO, "x")) == "io_error"
+        assert classify_oserror(OSError(errno.EACCES, "x")) == "other"
+        assert classify_oserror(ValueError("no errno")) == "other"
+
+    def test_enospc_flips_read_only_and_probe_restores(self, tmp_path):
+        h = StorageHealth(base=str(tmp_path), probe_seconds=0.05)
+        p = str(tmp_path / "frag")
+        f = Fragment(p, 0, health=h).open()
+        fault.set_fault("sys.write", "error", args={"errno": "ENOSPC"},
+                        match={"path": str(tmp_path)})
+        with pytest.raises(StorageFaultError) as ei:
+            f.set_bits(np.array([0], np.uint64),
+                       np.array([1], np.uint64))
+        assert ei.value.kind == "disk_full"
+        assert h.state == "read_only"
+        # the gate now refuses BEFORE touching memory or disk
+        with pytest.raises(StorageFaultError):
+            f.set_bits(np.array([0], np.uint64),
+                       np.array([2], np.uint64))
+        # the probe also rides the sys.write seam: while the fault is
+        # armed over the whole data dir, 'space' is still out
+        time.sleep(0.3)
+        assert h.state == "read_only"
+        fault.clear()  # 'free space'
+        deadline = time.monotonic() + 5
+        while h.state != "healthy" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert h.state == "healthy"
+        assert f.set_bits(np.array([0], np.uint64),
+                          np.array([2], np.uint64)) == 1
+        h.close()
+        f._oplog.close()
+
+    def test_repeated_eio_quarantines_one_fragment(self, tmp_path):
+        h = StorageHealth(base=str(tmp_path))
+        sick = Fragment(str(tmp_path / "sick"), 0, health=h).open()
+        fine = Fragment(str(tmp_path / "fine"), 0, health=h).open()
+        fault.set_fault("sys.write", "error", args={"errno": "EIO"},
+                        match={"path": "sick.oplog"}, nth=1, prob=1.0,
+                        times=3)
+        for i in range(3):
+            with pytest.raises(StorageFaultError) as ei:
+                sick.set_bits(np.array([0], np.uint64),
+                              np.array([10 + i], np.uint64))
+            assert ei.value.kind == "io_error"
+        fault.clear()
+        assert h.is_quarantined(sick.path)
+        assert h.state == "healthy"  # EIO is per-fragment, not nodal
+        # the healthy sibling keeps writing
+        assert fine.set_bits(np.array([0], np.uint64),
+                             np.array([1], np.uint64)) == 1
+        sick._oplog.close()
+        fine.close()
+        h.close()
+
+    def test_write_success_resets_eio_streak(self, tmp_path):
+        # the quarantine trigger is CONSECUTIVE failures: a success in
+        # between restarts the count
+        h = StorageHealth(base=str(tmp_path))
+        f = Fragment(str(tmp_path / "blip"), 0, health=h).open()
+        for round_ in range(3):
+            fault.set_fault("sys.write", "error",
+                            args={"errno": "EIO"},
+                            match={"path": "blip.oplog"}, nth=1,
+                            prob=1.0, times=2)
+            for i in range(2):
+                with pytest.raises(StorageFaultError):
+                    f.set_bits(np.array([0], np.uint64),
+                               np.array([100 * round_ + i], np.uint64))
+            fault.clear()
+            assert f.set_bits(
+                np.array([0], np.uint64),
+                np.array([100 * round_ + 50], np.uint64)) == 1
+        assert not h.is_quarantined(f.path)
+        f.close()
+        h.close()
+
+
+class TestServerSurfaces:
+    @pytest.fixture
+    def node(self, tmp_path):
+        from pilosa_tpu.cli.config import Config
+        from pilosa_tpu.server import PilosaTPUServer
+        cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                     mesh=False, scrub_interval_seconds=600.0,
+                     disk_probe_seconds=0.1)
+        srv = PilosaTPUServer(cfg).open()
+        yield srv
+        srv.close()
+
+    def _req(self, srv, method, path, body=b""):
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=15)
+        try:
+            conn.request(method, path, body,
+                         headers={"Content-Length": str(len(body))})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, json.loads(data.decode()), resp
+        finally:
+            conn.close()
+
+    def test_status_carries_storage_health_and_scrub(self, node):
+        status, payload, _ = self._req(node, "GET", "/status")
+        assert status == 200
+        sh = payload["storageHealth"]
+        assert sh["state"] == "healthy"
+        assert sh["quarantined"] == []
+        assert sh["scrub"]["enabled"] is True
+        assert sh["scrub"]["bytesPerSecond"] == 32 << 20
+
+    def test_default_boot_starts_scrub_thread(self, node):
+        assert [t for t in threading.enumerate()
+                if t.name == "pilosa-scrub"]
+
+    def test_read_only_answers_structured_507(self, node):
+        self._req(node, "POST", "/index/t7")
+        self._req(node, "POST", "/index/t7/field/f")
+        st, _, _ = self._req(node, "POST", "/index/t7/query",
+                             b"Set(1, f=0)")
+        assert st == 200
+        node.holder.storage_health.note_fault(
+            str(node.holder.path), OSError(errno.ENOSPC, "full"))
+        try:
+            st, payload, resp = self._req(node, "POST",
+                                          "/index/t7/query",
+                                          b"Set(2, f=0)")
+            assert st == 507, payload
+            assert payload["writeUnavailable"]["reason"] == "disk_full"
+            assert resp.getheader("Retry-After")
+            # imports refuse with the same structured shape
+            body = json.dumps({"rowIDs": [0], "columnIDs": [3]}).encode()
+            st, payload, _ = self._req(
+                node, "POST", "/index/t7/field/f/import", body)
+            assert st == 507, payload
+            assert payload["writeUnavailable"]["reason"] == "disk_full"
+            # reads keep serving at full availability
+            st, payload, _ = self._req(node, "POST", "/index/t7/query",
+                                       b"Count(Row(f=0))")
+            assert st == 200 and payload["results"] == [1]
+        finally:
+            # restore for teardown (the probe would do it too)
+            deadline = time.monotonic() + 5
+            while (node.holder.storage_health.state != "healthy"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+        assert node.holder.storage_health.state == "healthy"
+        st, _, _ = self._req(node, "POST", "/index/t7/query",
+                             b"Set(2, f=0)")
+        assert st == 200
+
+    def test_quarantined_fragment_answers_structured_503(self, node):
+        self._req(node, "POST", "/index/t8")
+        self._req(node, "POST", "/index/t8/field/f")
+        st, _, _ = self._req(node, "POST", "/index/t8/query",
+                             b"Set(1, f=0)")
+        assert st == 200
+        frag = node.holder.index("t8").field("f") \
+            .standard_view().fragment(0)
+        node.holder.storage_health.quarantine(frag.path, "snapshot",
+                                              "test corruption")
+        st, payload, resp = self._req(node, "POST", "/index/t8/query",
+                                      b"Set(2, f=0)")
+        assert st == 503, payload
+        assert payload["storageFault"]["kind"] == "snapshot"
+        assert payload["storageFault"]["path"] == frag.path
+        assert resp.getheader("Retry-After")
+        node.holder.storage_health.unquarantine(frag.path)
+        st, _, _ = self._req(node, "POST", "/index/t8/query",
+                             b"Set(2, f=0)")
+        assert st == 200
+
+    def test_knob_off_boots_without_scrub_thread(self, tmp_path):
+        # scrub_bytes_per_second=0 = the pre-r19 contract, pinned like
+        # the r18 watchdog knob: no scrubber thread exists at all
+        from pilosa_tpu.cli.config import Config
+        from pilosa_tpu.server import PilosaTPUServer
+        cfg = Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "k"),
+                     mesh=False, scrub_bytes_per_second=0)
+        srv = PilosaTPUServer(cfg).open()
+        try:
+            assert not [t for t in threading.enumerate()
+                        if t.name == "pilosa-scrub"]
+            st = srv.api.status()
+            assert st["storageHealth"]["scrub"]["enabled"] is False
+        finally:
+            srv.close()
+
+
+class TestClusterRepair:
+    def test_quarantine_repair_zero_divergence(self, tmp_path):
+        """The in-process twin of the chaos drill: 2 nodes replicas=2,
+        byte-flip the victim's snapshot, scrub detects + repairs from
+        the replica, reads stay exact on both nodes throughout, and a
+        forced AAE round moves ZERO blocks afterwards."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        from pilosa_tpu.testing import run_cluster
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         scrub_interval_seconds=600.0) as cluster:
+            c = cluster.client(0)
+            c.create_index("qi")
+            c.create_field("qi", "f")
+            want = {}
+            for s in range(2):
+                cols = [s * SHARD_WIDTH + k for k in (1, 5, 77)]
+                for col in cols:
+                    c.query("qi", f"Set({col}, f=0)")
+                want[s] = cols
+            all_cols = sorted(c for cols in want.values() for c in cols)
+            for cl in cluster.clients:
+                assert cl.query("qi", "Row(f=0)")[0]["columns"] \
+                    == all_cols
+            victim = cluster.servers[1]
+            frag = victim.holder.index("qi").field("f") \
+                .standard_view().fragment(0)
+            frag.snapshot()
+            _flip_byte(frag.path)
+            sh = victim.holder.storage_health
+            out = victim.scrubber.run_once()
+            assert out["corrupt"] == 1
+            assert out["repaired"] == 1, out
+            assert not sh.quarantined_entries()
+            assert sh.payload()["lastRepair"]["source"] \
+                == cluster.servers[0].cluster.node_id
+            # the repaired file re-verifies and replays exactly
+            assert verify_snapshot_file(frag.path)[0] is None
+            for cl in cluster.clients:
+                assert cl.query("qi", "Row(f=0)")[0]["columns"] \
+                    == all_cols
+            # forced AAE finds ZERO divergence after the repair
+            for cl in cluster.clients:
+                got = cl._json("POST", "/internal/aae/run", {})
+                assert got["repaired"] == 0, got
+
+    def test_quarantined_leg_rides_replica_failover(self, tmp_path):
+        """A peer-coordinated read whose leg lands on the quarantined
+        node gets a 503 and fails over to the healthy replica — zero
+        read failures, exact answers, the PR 6 path."""
+        from pilosa_tpu.engine.words import SHARD_WIDTH
+        from pilosa_tpu.testing import run_cluster
+        with run_cluster(2, str(tmp_path), replicas=2,
+                         scrub_interval_seconds=600.0) as cluster:
+            c = cluster.client(0)
+            c.create_index("qf")
+            c.create_field("qf", "f")
+            cols = [1, SHARD_WIDTH + 2]
+            for col in cols:
+                c.query("qf", f"Set({col}, f=0)")
+            for cl in cluster.clients:
+                assert cl.query("qf", "Row(f=0)")[0]["columns"] == cols
+            # quarantine shard 0 on node 1 WITHOUT repairing (registry
+            # only — models the window while repair is pending)
+            victim = cluster.servers[1]
+            frag = victim.holder.index("qf").field("f") \
+                .standard_view().fragment(0)
+            victim.holder.storage_health.quarantine(
+                frag.path, "snapshot", "pinned window")
+            try:
+                # every read on BOTH nodes stays exact: the victim's
+                # own routing skips the quarantined shard, a peer leg
+                # that lands there 503s and fails over
+                for _ in range(5):
+                    for cl in cluster.clients:
+                        assert cl.query("qf", "Row(f=0)")[0]["columns"] \
+                            == cols
+                        assert cl.query("qf", "Count(Row(f=0))") \
+                            == [len(cols)]
+                # STRICT writes keep serving too: the quarantined
+                # replica's refusal is classified hint-worthy (it
+                # serves no reads, so a hinted op can't be
+                # contradicted) — never a cluster-wide replica_busy
+                # refusal for the whole detect→repair window
+                healthy = cluster.clients[0]
+                assert healthy.query("qf", "Clear(1, f=0)") == [True]
+                wh = healthy.write_health()
+                assert wh.get("hintBacklogOps"), wh
+            finally:
+                victim.holder.storage_health.unquarantine(frag.path)
+            # after un-quarantine the drain replays; every node
+            # converges on the cleared state (nothing resurrected)
+            deadline = time.monotonic() + 30
+            want = [c for c in cols if c != 1]
+            while time.monotonic() < deadline:
+                try:
+                    if all(cl.query("qf", "Row(f=0)")[0]["columns"]
+                           == want for cl in cluster.clients):
+                        break
+                except Exception:  # noqa: BLE001 — drain mid-flight
+                    pass
+                time.sleep(0.2)
+            else:
+                raise AssertionError("hinted Clear never drained to "
+                                     "the repaired replica")
+
+    def test_scrub_detection_poisons_single_node_serving(self, tmp_path):
+        """Single node, no replica: once the scrubber detects snapshot
+        corruption, the fragment must STOP serving from the corrupt
+        blob (loud quarantined empty — overlay rows only), never
+        silently-wrong bits."""
+        h = Holder(str(tmp_path))
+        h.open()
+        idx = h.create_index("sp")
+        fld = idx.create_field("f")
+        for col in (1, 5, 9):
+            fld.set_bit(0, col)
+        frag = fld.standard_view().fragment(0)
+        frag.snapshot()
+        assert list(frag.row(0).columns()) == [1, 5, 9]
+        # drop the materialized row so reads go back through the blob
+        frag.rows.clear()
+        frag._snap_pending = set(
+            int(r) for r in frag._snap_dir.row_ids())
+        _flip_byte(frag.path)
+        s = Scrubber(h, interval=600, bytes_per_second=1 << 30)
+        out = s.run_once()
+        assert out["corrupt"] == 1
+        # the corrupt mapping is gone: reads serve empty, not garbage
+        assert not frag.row(0).any()
+        assert frag._snap_dir is None and frag._snap_mm is None
+        h.close()
