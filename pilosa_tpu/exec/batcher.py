@@ -110,13 +110,18 @@ class _Pending:
     def __init__(self, kind, nodes, leaves, delta=None, deadline=None):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
         #                       | "selcounts" | "tree" | "distinct"
+        #                       | "bsirange" | "groupby" (r20)
         self.nodes = nodes    # count: tuple of plan trees;
         #                       selcounts: tuple of plane row slots;
         #                       tree: (slots, postfix prog, extras);
+        #                       bsirange: (spec, operands, sig);
+        #                       groupby: (args, static, sig);
         #                       others: None
         self.leaves = leaves  # count: plan leaves; others: plane[, filter]
         self.delta = delta    # rowcounts/selcounts: the plane's
-        #                       DeltaOverlay (base⊕delta merge, r15)
+        #                       DeltaOverlay (base⊕delta merge, r15);
+        #                       sum/minmax/bsirange: the BSI plane's
+        #                       BsiOverlay (r20)
         self.event = threading.Event()
         self.result = None
         self.error: Exception | None = None
@@ -200,6 +205,14 @@ class CountBatcher:
         self.stats.set_buckets("batcher_window_fill_ratio", RATIO_BUCKETS)
         self.stats.set_buckets("kernel_window_bytes", BYTE_BUCKETS)
         self.stats.set_buckets("readback_overlap_ratio", RATIO_BUCKETS)
+        # per-SHAPE window fill (r20): how many items each kind's
+        # group actually coalesced per window — the attribution the
+        # PQL-surface bench reasons about (a kind stuck at 1 under
+        # concurrency is not co-batching)
+        self.stats.set_buckets("pipeline_window_fill", COUNT_BUCKETS)
+        # lifetime co-batched BSI aggregate items (mirror of the
+        # bsi_batch_hits_total counter) for /status
+        self._bsi_batch_hits = 0
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._kick = threading.Event()
@@ -284,6 +297,9 @@ class CountBatcher:
             "watchdogSeconds": self.watchdog_s,
             "quarantinedWindows": self._quarantined,
             "inflightWindows": self._inflight_windows,
+            # r20: lifetime BSI-aggregate items that co-batched into
+            # an existing same-plane group (the window-fill proof)
+            "bsiBatchHits": self._bsi_batch_hits,
         })
         return out
 
@@ -505,6 +521,73 @@ class CountBatcher:
         self._fastlane_done("tree", nbytes)
         return val
 
+    @staticmethod
+    def _agg_bytes(plane, extra, delta) -> int:
+        return (plane.nbytes + extra
+                + (delta.nbytes if delta is not None else 0))
+
+    def _fastlane_agg(self, kind: str, plane, filter_words, delta):
+        """One BSI Sum/Min/Max dispatched inline on the caller thread
+        (batch of one through the per-plane family — same program
+        bucketing as the windowed path).  None = fall back."""
+        from pilosa_tpu.engine import bsi as bsik
+        flags = (filter_words is not None,)
+        filters = (filter_words,) if filter_words is not None else ()
+        try:
+            if kind == "sum":
+                out = self.fused.run_sum_plane_batch(
+                    plane, flags, filters, delta=delta)
+                val = bsik.decode_sum_packed(np.asarray(out)[0])
+            else:
+                out = self.fused.run_minmax_plane_batch(
+                    plane, flags, filters, delta=delta)
+                val = bsik.decode_minmax_packed(np.asarray(out)[0])
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
+            return None
+        self._fastlane_done(kind, self._agg_bytes(
+            plane, sum(getattr(f, "nbytes", 0) for f in filters),
+            delta))
+        return val
+
+    def _fastlane_bsirange(self, plane, spec: tuple, operands: tuple,
+                           delta):
+        """One BSI Range-count inline: batch of one through
+        ``run_range_batch``.  None = fall back to the window."""
+        try:
+            out = self.fused.run_range_batch(plane, (spec,),
+                                             tuple(operands),
+                                             delta=delta)
+            val = int(np.asarray(out).astype(np.int64)[0])
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
+            return None
+        self._fastlane_done("bsirange", self._agg_bytes(plane, 0, delta))
+        return val
+
+    def _fastlane_groupby(self, args: tuple, agg_kind, meta: tuple):
+        """One GroupBy block inline on the caller thread.  None =
+        fall back to the window."""
+        from pilosa_tpu.exec import groupby as gb
+        planes, ci, lp, fw, ap, dl = args
+        try:
+            out = self.fused.run_groupby_batch(planes, ci, lp, fw, ap,
+                                               agg_kind, delta=dl)
+            host = np.asarray(out)
+        except Exception:  # noqa: BLE001 — windowed path is the fallback
+            self.governor.record_fault()
+            return None
+        self._fastlane_done("groupby", self._groupby_bytes(args))
+        return gb.unflatten_block(host, *meta, agg_kind)
+
+    @staticmethod
+    def _groupby_bytes(args: tuple) -> int:
+        planes, _ci, lp, fw, ap, dl = args
+        return (sum(getattr(p, "nbytes", 0) for p in planes)
+                + lp.nbytes + (getattr(fw, "nbytes", 0) or 0)
+                + (ap.nbytes if ap is not None else 0)
+                + (dl.nbytes if dl is not None else 0))
+
     # -- blocking submits ----------------------------------------------------
 
     def submit(self, node, leaves, deadline: float | None = None) -> int:
@@ -529,21 +612,112 @@ class CountBatcher:
         return self._submit(_Pending("count", nodes, leaves,
                                      deadline=deadline))
 
-    def submit_sum(self, plane, filter_words,
+    def submit_sum(self, plane, filter_words, delta=None,
                    deadline: float | None = None) -> tuple[int, int]:
-        """BSI Sum: (sum of offsets, non-null count), host-finished."""
+        """BSI Sum: (sum of offsets, non-null count), host-finished.
+        Concurrent items over the SAME plane co-batch into one
+        program (identical filters dedupe to one scan); ``delta`` (a
+        ``BsiOverlay``, r20) merges the plane's pending write columns
+        at dispatch — base⊕delta exact, no fold on the query path."""
         self._check_deadline(deadline)
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_agg("sum", plane, filter_words,
+                                         delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
         leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._submit(_Pending("sum", None, leaves,
+        return self._submit(_Pending("sum", None, leaves, delta=delta,
                                      deadline=deadline))
 
-    def submit_minmax(self, plane, filter_words,
+    def submit_minmax(self, plane, filter_words, delta=None,
                       deadline: float | None = None):
-        """BSI Min/Max: per-shard (min, min_cnt, max, max_cnt) tuples."""
+        """BSI Min/Max: (min, min_cnt, max, max_cnt) tuples — one per
+        shard, plus one per overlay-touched word column when the
+        plane carries a delta (zero-count entries; the host combine
+        drops them).  Same co-batch/dedupe/overlay contract as
+        :meth:`submit_sum`."""
         self._check_deadline(deadline)
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_agg("minmax", plane, filter_words,
+                                         delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
         leaves = (plane,) if filter_words is None else (plane, filter_words)
-        return self._submit(_Pending("minmax", None, leaves,
+        return self._submit(_Pending("minmax", None, leaves, delta=delta,
                                      deadline=deadline))
+
+    def submit_bsirange(self, plane, spec: tuple, operands: tuple,
+                        sig: tuple, delta=None,
+                        deadline: float | None = None) -> int:
+        """One BSI Range-count (``Count(Row(field op p))`` and the
+        between forms) as a first-class batch item: the window's
+        range counts over the SAME (plane, overlay) pair fuse into
+        one program referencing the plane once, and identical
+        predicates (same ``sig``: op keys, offsets, filter identity)
+        dedupe to a single comparison chain.  ``spec`` is the item's
+        static shape, ``operands`` its traced masks/sign/filter
+        arrays (see ``fused.run_range_batch``)."""
+        self._check_deadline(deadline)
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_bsirange(plane, spec, operands,
+                                              delta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
+        return self.wait(self.enqueue_bsirange(plane, spec, operands,
+                                               sig, delta,
+                                               deadline=deadline))
+
+    def enqueue_bsirange(self, plane, spec: tuple, operands: tuple,
+                         sig: tuple, delta=None,
+                         deadline: float | None = None) -> _Pending:
+        """Non-blocking :meth:`submit_bsirange`: a request carrying K
+        range Counts enqueues them ALL into one collection window
+        before waiting on any."""
+        self._check_deadline(deadline, stage="queued")
+        return self._enqueue(_Pending(
+            "bsirange", (spec, tuple(operands), sig), (plane,),
+            delta=delta, deadline=deadline))
+
+    def submit_groupby(self, planes: tuple, combo_idx, last_plane,
+                       filter_words, agg_plane, agg_kind,
+                       meta: tuple, digest, delta=None,
+                       deadline: float | None = None) -> dict:
+        """One GroupBy combination block through the window machinery
+        (r20): identical concurrent blocks (same planes, same
+        combinations — ``digest`` hashes the combo slots) dedupe to
+        ONE program, and any block shares its collection window's
+        dispatch pool + packed readback with concurrent Counts and
+        aggregates.  ``delta``: the agg plane's ``BsiOverlay`` —
+        aggregate GroupBys answer base⊕delta in-program.  ``meta`` =
+        (n_combos, n_last, depth) for the unflatten; returns the
+        block's output dict of host arrays."""
+        self._check_deadline(deadline)
+        args = (planes, combo_idx, last_plane, filter_words, agg_plane,
+                delta)
+        if self._fl_try_enter():
+            try:
+                out = self._fastlane_groupby(args, agg_kind, meta)
+            finally:
+                self._fl_leave()
+            if out is not None:
+                return out
+        sig = (tuple(id(p) for p in planes), id(last_plane),
+               id(filter_words) if filter_words is not None else 0,
+               id(agg_plane) if agg_plane is not None else 0,
+               id(delta) if delta is not None else 0,
+               agg_kind, digest)
+        return self._submit(_Pending(
+            "groupby", (args, (agg_kind, meta), sig), (last_plane,),
+            deadline=deadline))
 
     def submit_rowcounts(self, plane, filter_words=None,
                          delta=None,
@@ -751,9 +925,36 @@ class CountBatcher:
                 key = ("rowcounts-delta", id(p.leaves[0]),
                        id(p.delta),
                        id(p.leaves[1]) if len(p.leaves) == 2 else 0)
+            elif p.kind in ("sum", "minmax", "bsirange"):
+                # BSI aggregates group by plane IDENTITY (r20): the
+                # window's same-plane aggregates co-batch into one
+                # program referencing the plane once, and identical
+                # items (same filter / predicate signature) dedupe to
+                # one scan inside the dispatch.  The overlay identity
+                # joins the key like selcounts — a fresher overlay is
+                # a different answer.
+                key = (p.kind, id(p.leaves[0]),
+                       id(p.delta) if p.delta is not None else 0)
+            elif p.kind == "groupby":
+                # identical concurrent GroupBy blocks (same planes,
+                # same combination block — the sig carries a digest)
+                # dedupe to ONE program; distinct blocks still share
+                # the window's dispatch pool and packed readback
+                key = ("groupby",) + p.nodes[2]
             else:
                 key = (p.kind, p.leaves[0].shape)
             groups.setdefault(key, []).append(p)
+        # per-shape coalescing attribution (r20): window fill by kind,
+        # plus the lifetime count of BSI-aggregate items that joined
+        # an existing same-plane group (the co-batch proof counter)
+        for key, group in groups.items():
+            self.stats.observe("pipeline_window_fill",
+                               float(len(group)), kind=key[0])
+            if key[0] in ("sum", "minmax", "bsirange") \
+                    and len(group) > 1:
+                self.stats.count("bsi_batch_hits_total",
+                                 len(group) - 1, kind=key[0])
+                self._bsi_batch_hits += len(group) - 1
         # DEGRADED serving (r18 governor): the device is suspect —
         # every group runs inline per item on the proven op-at-a-time
         # fallback path (answers stay exact; throughput, not
@@ -1186,6 +1387,10 @@ class CountBatcher:
             ret = self._dispatch_selcounts(group)
         elif kind == "tree":
             ret = self._dispatch_tree(group)
+        elif kind == "bsirange":
+            ret = self._dispatch_bsirange(group)
+        elif kind == "groupby":
+            ret = self._dispatch_groupby(group)
         else:
             ret = self._dispatch_aggs(kind, group)
         self.stats.observe("kernel_dispatch_seconds",
@@ -1223,6 +1428,15 @@ class CountBatcher:
         if kind == "count":
             return sum(getattr(a, "nbytes", 0)
                        for p in group for a in p.leaves)
+        if kind == "bsirange":
+            # one plane pass per unique predicate signature + the
+            # overlay gather once
+            plane = group[0].leaves[0]
+            d = group[0].delta
+            return (len({p.nodes[2] for p in group}) * plane.nbytes
+                    + (d.nbytes if d is not None else 0))
+        if kind == "groupby":
+            return CountBatcher._groupby_bytes(group[0].nodes[0])
         seen: set = set()
         total = 0
         for p in group:
@@ -1231,6 +1445,9 @@ class CountBatcher:
                 continue
             seen.add(k)
             total += sum(getattr(a, "nbytes", 0) for a in p.leaves)
+        d = group[0].delta
+        if kind in ("sum", "minmax") and d is not None:
+            total += d.nbytes
         return total
 
     def _run_fallback(self, key, group):
@@ -1242,6 +1459,10 @@ class CountBatcher:
             self._fallback_selcounts(group)
         elif key[0] == "tree":
             self._fallback_tree(group)
+        elif key[0] == "bsirange":
+            self._fallback_bsirange(group)
+        elif key[0] == "groupby":
+            self._fallback_groupby(group)
         else:
             self._fallback_aggs(key[0], group)
 
@@ -1553,30 +1774,162 @@ class CountBatcher:
             self.stats.count("kernel_bytes_scanned_total", nbytes,
                              kind="distinct")
 
-    def _dispatch_aggs(self, kind: str, group: list[_Pending]):
-        from pilosa_tpu.engine import bsi as bsik
+    @staticmethod
+    def _dedupe_pad(items: list[_Pending], assign: list[int],
+                    key_rank) -> tuple[list[_Pending], list[int]]:
+        """Canonical-order + pow2-pad a deduped item list (shared by
+        the per-plane aggregate dispatches): sort unique items by
+        ``key_rank`` so the static program shape is order-independent,
+        remap the caller assignment, pad by repeating item 0."""
         from pilosa_tpu.exec.fused import pow2_bucket
-        # pad the batch to a pow2 bucket (repeating item 0; see
-        # fused.pow2_bucket) so the program set stays bounded per
-        # (kind, shape)
-        group.sort(key=lambda p: len(p.leaves))  # canonical flag order:
-        # program variants per bucket stay O(bucket), not O(2^bucket)
-        pad = [group[0]] * (pow2_bucket(len(group)) - len(group))
-        flags = tuple(len(p.leaves) == 2 for p in group + pad)
-        all_leaves = tuple(a for p in group + pad for a in p.leaves)
+        order = sorted(range(len(items)), key=lambda i: key_rank(items[i]))
+        items = [items[i] for i in order]
+        back = {old: new for new, old in enumerate(order)}
+        assign = [back[a] for a in assign]
+        padded = items + [items[0]] * (pow2_bucket(len(items))
+                                       - len(items))
+        return padded, assign
+
+    def _dispatch_aggs(self, kind: str, group: list[_Pending]):
+        """The window's BSI Sum/Min/Max items over ONE (plane,
+        overlay) pair (the group key carries both identities, r20):
+        identical items (same filter) dedupe to one scan, distinct
+        filters fuse into one program referencing the plane ONCE, and
+        a pending overlay merges in-program (base side excludes the
+        touched word columns; the mini side answers them) — aggregates
+        stay rebuild- and fold-free under sustained BSI ingest."""
+        from pilosa_tpu.engine import bsi as bsik
+        plane = group[0].leaves[0]
+        delta = group[0].delta
+        uniq: dict[int, int] = {}
+        items: list[_Pending] = []
+        assign: list[int] = []
+        for p in group:
+            k = id(p.leaves[1]) if len(p.leaves) == 2 else 0
+            slot = uniq.get(k)
+            if slot is None:
+                slot = uniq[k] = len(items)
+                items.append(p)
+            assign.append(slot)
+        padded, assign = self._dedupe_pad(items, assign,
+                                          lambda p: len(p.leaves))
+        flags = tuple(len(p.leaves) == 2 for p in padded)
+        filters = tuple(p.leaves[1] for p in padded
+                        if len(p.leaves) == 2)
         if kind == "sum":
-            out = self.fused.run_sum_batch(flags, all_leaves)
+            out = self.fused.run_sum_plane_batch(plane, flags, filters,
+                                                 delta=delta)
             decode = bsik.decode_sum_packed
         else:
-            out = self.fused.run_minmax_batch(flags, all_leaves)
+            out = self.fused.run_minmax_plane_batch(plane, flags,
+                                                    filters,
+                                                    delta=delta)
             decode = bsik.decode_minmax_packed
 
         def finish(host: np.ndarray) -> None:
-            for k, p in enumerate(group):
+            for p, slot in zip(group, assign):
                 if self._skip(p):
                     continue
-                self._deliver(p, decode(host[k]))
+                self._deliver(p, decode(host[slot]))
         return out, finish
+
+    def _dispatch_bsirange(self, group: list[_Pending]):
+        """The window's BSI Range-counts over one (plane, overlay)
+        pair: dedupe by predicate signature, one fused program with
+        the plane as a single operand, int32[K] totals into the
+        window's packed readback."""
+        plane = group[0].leaves[0]
+        delta = group[0].delta
+        uniq: dict[tuple, int] = {}
+        items: list[_Pending] = []
+        assign: list[int] = []
+        for p in group:
+            sig = p.nodes[2]
+            slot = uniq.get(sig)
+            if slot is None:
+                slot = uniq[sig] = len(items)
+                items.append(p)
+            assign.append(slot)
+        padded, assign = self._dedupe_pad(items, assign,
+                                          lambda p: p.nodes[2])
+        specs = tuple(p.nodes[0] for p in padded)
+        operands = tuple(a for p in padded for a in p.nodes[1])
+        out = self.fused.run_range_batch(plane, specs, operands,
+                                         delta=delta)
+
+        def finish(host: np.ndarray) -> None:
+            host = host.astype(np.int64)
+            for p, slot in zip(group, assign):
+                if self._skip(p):
+                    continue
+                self._deliver(p, int(host[slot]))
+        return out, finish
+
+    def _dispatch_groupby(self, group: list[_Pending]):
+        """One GroupBy block per group (the key's sig dedupes
+        identical concurrent blocks to a single program); the flat
+        int32 output joins the window's packed readback and every
+        item unflattens the same host arrays."""
+        from pilosa_tpu.exec import groupby as gb
+        p0 = group[0]
+        args, (agg_kind, meta), _sig = p0.nodes
+        planes, ci, lp, fw, ap, dl = args
+        out = self.fused.run_groupby_batch(planes, ci, lp, fw, ap,
+                                           agg_kind, delta=dl)
+
+        def finish(host: np.ndarray) -> None:
+            d = gb.unflatten_block(host, *meta, agg_kind)
+            for p in group:
+                if self._skip(p):
+                    continue
+                self._deliver(p, d)
+        return out, finish
+
+    def _fallback_groupby(self, group: list[_Pending]) -> None:
+        from pilosa_tpu.exec import groupby as gb
+        for p in group:
+            if self._skip(p):
+                continue
+            try:
+                args, (agg_kind, _meta), _sig = p.nodes
+                planes, ci, lp, fw, ap, dl = args
+                ad = ((dl.col_shard, dl.col_word, dl.col_vals,
+                       dl.col_mask) if dl is not None else None)
+                out = gb._groupby_program(planes, ci, lp, fw, ap,
+                                          agg_kind, agg_delta=ad)
+                self._deliver(p, {k: np.asarray(v)
+                                  for k, v in out.items()})
+            except Exception as e2:  # noqa: BLE001
+                self._deliver_error(p, e2)
+
+    def _fallback_bsirange(self, group: list[_Pending]) -> None:
+        """Per-item eager range count (base/mini split applied with
+        eager jnp ops — no fused program involved)."""
+        import jax.numpy as jnp
+
+        from pilosa_tpu.engine import bsi as bsik
+        for p in group:
+            if self._skip(p):
+                continue
+            try:
+                (op_keys, has_filter), operands, _sig = p.nodes
+                preds = [(operands[2 * i], operands[2 * i + 1], k)
+                         for i, k in enumerate(op_keys)]
+                flt = operands[-1] if has_filter else None
+                from pilosa_tpu.ingest.delta import bsi_sides
+                sides = bsi_sides(p.leaves[0], flt, p.delta)
+                total = 0
+                for pl, fw in sides:
+                    words = None
+                    for masks, neg, okey in preds:
+                        cmp = bsik.range_cmp(pl, masks, neg, fw)[okey]
+                        words = cmp if words is None \
+                            else jnp.bitwise_and(words, cmp)
+                    total += int(kernels.shard_totals(
+                        kernels.count(words)))
+                self._deliver(p, total)
+            except Exception as e2:  # noqa: BLE001
+                self._deliver_error(p, e2)
 
     def _fallback_aggs(self, kind: str, group: list[_Pending]) -> None:
         from pilosa_tpu.engine import bsi as bsik
@@ -1585,10 +1938,20 @@ class CountBatcher:
                 continue
             try:
                 flt = p.leaves[1] if len(p.leaves) == 2 else None
+                from pilosa_tpu.ingest.delta import bsi_sides
+                sides = bsi_sides(p.leaves[0], flt, p.delta)
                 if kind == "sum":
-                    self._deliver(p, bsik.sum_count(p.leaves[0], flt))
+                    total = cnt = 0
+                    for pl, fw in sides:
+                        t, c = bsik.sum_count(pl, fw)
+                        total += t
+                        cnt += c
+                    self._deliver(p, (total, cnt))
                 else:
-                    self._deliver(p, bsik.min_max(p.leaves[0], flt))
+                    tuples = []
+                    for pl, fw in sides:
+                        tuples.extend(bsik.min_max(pl, fw))
+                    self._deliver(p, tuples)
             except Exception as e2:  # noqa: BLE001
                 self._deliver_error(p, e2)
 

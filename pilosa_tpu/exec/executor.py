@@ -33,7 +33,8 @@ from pilosa_tpu.exec.result import (ExtractResult, GroupCountsResult,
                                     Pair, PairsResult, RowIdsResult,
                                     RowResult, ValCount)
 from pilosa_tpu.pql import parse_cached
-from pilosa_tpu.pql.ast import BETWEEN_OPS, Call, Condition, Query
+from pilosa_tpu.pql.ast import (BETWEEN_OPS, Call, Condition, Query,
+                                between_cmp_ops)
 from pilosa_tpu.store.field import BSI_TYPES, Field
 from pilosa_tpu.store.holder import Holder
 from pilosa_tpu.store.index import Index
@@ -232,6 +233,12 @@ class _PlanEntry:
     unkeyed_fields: tuple = ()
     # "tree" entries: canonical specs, one per Count call (r16)
     tree_specs: tuple = ()
+    # "bsirange" entries (r20): per call (field_name, op_keys,
+    # offsets) — BSI range Counts served through the batcher's
+    # bsirange family (plane fetched delta-aware per hit, so the
+    # entry survives sustained ingest like the unkeyed-plane kinds;
+    # ``bsi_sigs`` pins depth/base so the baked offsets stay valid)
+    range_items: tuple = ()
 
 
 class QueryTimeoutError(ExecutionError):
@@ -616,6 +623,9 @@ class Executor:
         fast = self._count_batch_plane(ctx, calls)
         if fast is not None:
             return fast
+        fast = self._count_batch_bsi(ctx, calls)
+        if fast is not None:
+            return fast
         fast = self._count_batch_tree(ctx, calls)
         if fast is not None:
             return fast
@@ -717,6 +727,119 @@ class Executor:
             return None
         return self._plane_count_rows(
             ps, row_ids, getattr(self._tls, "stage_timer", None))
+
+    # -------------------------------------------------- BSI range (r20)
+
+    def _bsirange_item(self, ctx: _Ctx, child: Call):
+        """Lower ``Count(Row(field op p))`` / the between forms to a
+        batcher ``bsirange`` item: ``(field, op_keys, offsets)``.
+        None = not a simple BSI range count (compound children, time
+        args, non-BSI field, or a saturated predicate whose trivial
+        answer the generic path lowers without a kernel)."""
+        if child.name not in ("Row", "Range") or child.children:
+            return None
+        hit = _field_arg(child)
+        if hit is None:
+            return None
+        fname, value = hit
+        field = ctx.index.field(str(fname))
+        if field is None or field.options.type not in BSI_TYPES:
+            return None
+        if ("from" in child.args or "to" in child.args
+                or "_timestamp" in child.args):
+            return None
+        cond = (value if isinstance(value, Condition)
+                else Condition("==", value))
+        if isinstance(cond.value, Call) or (
+                cond.op not in _SCALAR_TO_KEY
+                and cond.op not in BETWEEN_OPS):
+            return None
+        opts = field.options
+        depth = opts.bit_depth
+        bound = (1 << depth) - 1
+        if cond.op in BETWEEN_OPS:
+            lo_op, hi_op = between_cmp_ops(cond.op)
+            pairs = [(lo_op, cond.value[0]), (hi_op, cond.value[1])]
+        else:
+            pairs = [(_SCALAR_TO_KEY[cond.op], cond.value)]
+        op_keys, offsets = [], []
+        for op_key, v in pairs:
+            offset = field.to_stored(v) - opts.base
+            if offset > bound or offset < -bound:
+                return None  # saturated: trivial, no kernel needed
+            op_keys.append(op_key)
+            offsets.append(int(offset))
+        return field, tuple(op_keys), tuple(offsets)
+
+    def _bsirange_operands(self, field: Field, offsets: tuple) -> tuple:
+        depth = field.options.bit_depth
+        ops = []
+        for offset in offsets:
+            ops.append(jnp.asarray(bsik.predicate_masks(abs(offset),
+                                                        depth)))
+            ops.append(jnp.asarray(offset < 0))
+        return tuple(ops)
+
+    def _count_batch_bsi(self, ctx: _Ctx,
+                         calls: list[Call]) -> list[int] | None:
+        """A request of simple BSI range Counts through the batcher's
+        ``bsirange`` family (r20): every call enqueues into ONE
+        collection window, same-plane items across concurrent requests
+        co-batch into one fused program (identical predicates dedupe),
+        and the plane arrives DELTA-AWARE (``bsi_plane_delta``) — no
+        fold, no rebuild under sustained ingest.  None = some call
+        isn't this shape (fall through to tree/generic)."""
+        if self.batcher is None or not ctx.shards:
+            return None
+        if len(ctx.shards) > self._REDUCE_SHARD_MAX:
+            return None  # device int32 shard reduce must stay exact
+        items = []
+        for call in calls:
+            it = self._bsirange_item(ctx, call.children[0])
+            if it is None:
+                return None
+            field, op_keys, offsets = it
+            items.append((field, op_keys, offsets,
+                          self._bsirange_operands(field, offsets)))
+        return self._run_bsirange_items(
+            ctx, items, getattr(self._tls, "stage_timer", None))
+
+    def _run_bsirange_items(self, ctx: _Ctx, items: list,
+                            timer) -> list[int]:
+        """Dispatch resolved bsirange items — ``(field, op_keys,
+        offsets, operands)`` per Count — through the batcher: the one
+        place that builds the batcher's spec/sig tuples and decides
+        solo (blocking submit → fast lane) vs windowed (enqueue ALL
+        before waiting on any).  Planes resolve up front, so a
+        failing resolution can never abandon already-enqueued
+        neighbors in the window."""
+        deadline = self._query_deadline()
+        planes: dict[str, object] = {}
+        for field, _ops, _offs, _operands in items:
+            if field.name not in planes:
+                planes[field.name] = self.planes.bsi_plane_delta(
+                    ctx.index.name, field, ctx.shards)
+        if timer is not None:
+            timer.mark("plan")
+        if len(items) == 1:
+            field, op_keys, offsets, operands = items[0]
+            ps = planes[field.name]
+            out = [self.batcher.submit_bsirange(
+                ps.plane, (op_keys, False), operands,
+                (op_keys, offsets, 0), delta=ps.delta,
+                deadline=deadline)]
+        else:
+            handles = []
+            for field, op_keys, offsets, operands in items:
+                ps = planes[field.name]
+                handles.append(self.batcher.enqueue_bsirange(
+                    ps.plane, (op_keys, False), operands,
+                    (op_keys, offsets, 0), delta=ps.delta,
+                    deadline=deadline))
+            out = [self.batcher.wait(h) for h in handles]
+        if timer is not None:
+            timer.mark("read")  # coalesced wait: window+dispatch+read
+        return out
 
     # -------------------------------------------------- whole-tree (r16)
 
@@ -1117,6 +1240,9 @@ class Executor:
             entry = self._plan_plane_entry(ctx, calls)
             if entry is not None:
                 return entry
+            entry = self._plan_bsirange_entry(ctx, calls)
+            if entry is not None:
+                return entry
             entry = self._plan_tree_entry(ctx, calls)
             if entry is not None:
                 return entry
@@ -1180,6 +1306,40 @@ class Executor:
                           row_ids=row_ids,
                           unkeyed_plane=not field.options.keys,
                           unkeyed_fields=(field.name,))
+
+    def _plan_bsirange_entry(self, ctx: _Ctx,
+                             calls) -> "_PlanEntry | None":
+        """Match an all-BSI-range-count request (r20): the entry bakes
+        only (field, op keys, offsets) — the plane arrives delta-aware
+        per hit and the predicate masks re-derive from the pinned
+        depth, so the entry SURVIVES sustained ingest (no per-hit
+        generation compare; ``bsi_sigs`` re-verifies depth/base)."""
+        if self.batcher is None or not ctx.shards:
+            return None
+        if len(ctx.shards) > self._REDUCE_SHARD_MAX:
+            return None
+        items = []
+        sigs: dict[str, tuple] = {}
+        deps: dict[tuple, None] = {}
+        for call in calls:
+            it = self._bsirange_item(ctx, call.children[0])
+            if it is None:
+                return None
+            field, op_keys, offsets = it
+            # operands baked DEVICE-resident (like the generic plan's
+            # const leaves): masks depend only on offset and the
+            # depth the bsi_sigs check pins, so a cache hit re-binds
+            # zero operands
+            items.append((field.name, op_keys, offsets,
+                          self._bsirange_operands(field, offsets)))
+            sigs[field.name] = _bsi_signature(field.options)
+            deps[(field.name, field.bsi_view_name)] = None
+        deps = tuple(deps)
+        return _PlanEntry("bsirange", ctx.shards, deps,
+                          self._dep_gens(ctx.index, deps, ctx.shards),
+                          len(calls), range_items=tuple(items),
+                          bsi_sigs=tuple(sigs.items()),
+                          unkeyed_plane=True)
 
     def _plan_tree_entry(self, ctx: _Ctx, calls) -> "_PlanEntry | None":
         """Tree-shaped plans (r16): every Count child lowers to a
@@ -1324,8 +1484,7 @@ class Executor:
         deps[(field.name, field.bsi_view_name)] = None
         depths[field.name] = _bsi_signature(field.options)
         if cond.op in BETWEEN_OPS:
-            lo_op = "gt" if cond.op.startswith("<>") else "ge"
-            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo_op, hi_op = between_cmp_ops(cond.op)
             lo = self._plan_spec_bsi_cmp(field, lo_op, cond.value[0],
                                          specs, leaf)
             hi = self._plan_spec_bsi_cmp(field, hi_op, cond.value[1],
@@ -1423,6 +1582,19 @@ class Executor:
             out = self._run_tree_specs(ctx, list(entry.tree_specs),
                                        timer)
             if out is not None and timer is not None:
+                timer.mark("assemble")
+            return out
+        if entry.kind == "bsirange":
+            if self.batcher is None:  # knob flipped after caching
+                return None
+            items = []
+            for fname, op_keys, offsets, operands in entry.range_items:
+                field = ctx.index.field(fname)
+                if field is None:
+                    return None
+                items.append((field, op_keys, offsets, operands))
+            out = self._run_bsirange_items(ctx, items, timer)
+            if timer is not None:
                 timer.mark("assemble")
             return out
         if entry.kind == "plane":
@@ -1819,8 +1991,7 @@ class Executor:
                 f"field {field.name!r}: condition on non-BSI field")
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
         if cond.op in BETWEEN_OPS:
-            lo_op = "gt" if cond.op.startswith("<>") else "ge"
-            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo_op, hi_op = between_cmp_ops(cond.op)
             lo = self._plan_bsi_cmp(ctx, field, ps, lo_op, cond.value[0],
                                     leaves, leaf)
             hi = self._plan_bsi_cmp(ctx, field, ps, hi_op, cond.value[1],
@@ -1948,8 +2119,7 @@ class Executor:
                 f"field {field.name!r}: condition on non-BSI field")
         ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
         if cond.op in BETWEEN_OPS:
-            lo_op = "gt" if cond.op.startswith("<>") else "ge"
-            hi_op = "lt" if cond.op.endswith("><") else "le"
+            lo_op, hi_op = between_cmp_ops(cond.op)
             lo = self._bsi_cmp(field, ps, lo_op, cond.value[0])
             hi = self._bsi_cmp(field, ps, hi_op, cond.value[1])
             return kernels.intersect(lo, hi)
@@ -2079,6 +2249,11 @@ class Executor:
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
+        # simple BSI range counts ride the bsirange family (r20):
+        # delta-aware plane, same-plane co-batching, solo fast lane
+        fast = self._count_batch_bsi(ctx, [call])
+        if fast is not None:
+            return fast[0]
         # compound boolean trees compile whole (r16): one in-program
         # row gather + postfix fold, windowed with concurrent requests
         fused_tree = self._count_batch_tree(ctx, [call])
@@ -2173,19 +2348,26 @@ class Executor:
 
     def _execute_sum(self, ctx: _Ctx, call: Call) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
-        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        # delta-aware plane (r20): sustained ingest absorbs into the
+        # plane's BsiOverlay and the aggregate kernels answer
+        # base⊕delta — no fold, no rebuild on the query path
+        ps = self.planes.bsi_plane_delta(ctx.index.name, field,
+                                         ctx.shards)
         if self.batcher is not None:
-            # concurrent BSI aggregates coalesce like Counts: one
-            # program + one read per collection window
+            # concurrent same-plane BSI aggregates co-batch into one
+            # program + one read per collection window (solo requests
+            # ride the fast lane)
             total, cnt = self.batcher.submit_sum(
-                ps.plane, filter_words, deadline=self._query_deadline())
+                ps.plane, filter_words, delta=ps.delta,
+                deadline=self._query_deadline())
         else:
             # same compiled one-read program, batch of one (eager
             # bit_counts would pay one dispatch per op + 3 reads)
             flags = (filter_words is not None,)
-            leaves = (ps.plane,) + ((filter_words,)
-                                    if filter_words is not None else ())
-            out = np.asarray(self.fused.run_sum_batch(flags, leaves))[0]
+            filters = ((filter_words,)
+                       if filter_words is not None else ())
+            out = np.asarray(self.fused.run_sum_plane_batch(
+                ps.plane, flags, filters, delta=ps.delta))[0]
             total, cnt = bsik.decode_sum_packed(out)
         value = total + field.options.base * cnt
         return ValCount(value=field.from_stored(value) if cnt else 0,
@@ -2199,17 +2381,22 @@ class Executor:
 
     def _min_max(self, ctx: _Ctx, call: Call, want_min: bool) -> ValCount:
         field, filter_words = self._agg_args(ctx, call)
-        ps = self.planes.bsi_plane(ctx.index.name, field, ctx.shards)
+        ps = self.planes.bsi_plane_delta(ctx.index.name, field,
+                                         ctx.shards)
         if self.batcher is not None:
             per_shard = self.batcher.submit_minmax(
-                ps.plane, filter_words, deadline=self._query_deadline())
+                ps.plane, filter_words, delta=ps.delta,
+                deadline=self._query_deadline())
         else:
             flags = (filter_words is not None,)
-            leaves = (ps.plane,) + ((filter_words,)
-                                    if filter_words is not None else ())
-            out = np.asarray(self.fused.run_minmax_batch(flags, leaves))[0]
+            filters = ((filter_words,)
+                       if filter_words is not None else ())
+            out = np.asarray(self.fused.run_minmax_plane_batch(
+                ps.plane, flags, filters, delta=ps.delta))[0]
             per_shard = bsik.decode_minmax_packed(out)
-        # reduce across the shard axis on host (one tuple per shard)
+        # reduce across the shard axis on host (one tuple per shard;
+        # a delta-dirty plane appends one zero-or-live tuple per
+        # overlay-touched word column — same combine)
         live = [(mn, mn_c, mx, mx_c)
                 for mn, mn_c, mx, mx_c in per_shard
                 if (mn_c if want_min else mx_c) > 0]
@@ -2312,16 +2499,25 @@ class Executor:
                 # BEFORE either wait, so a tanimoto request pays one
                 # collection window, not two in series.  A delta-dirty
                 # plane (r15 ingest) answers base⊕delta in-window.
-                h1 = self.batcher.enqueue_rowcounts(
-                    ps.plane, filter_words, delta=ps.delta,
-                    deadline=self._query_deadline())
-                h2 = (self.batcher.enqueue_rowcounts(
-                    ps.plane, delta=ps.delta,
-                    deadline=self._query_deadline())
-                      if need_row_counts else None)
-                totals = self.batcher.wait(h1)[:ps.n_rows]
-                if h2 is not None:
+                if need_row_counts:
+                    h1 = self.batcher.enqueue_rowcounts(
+                        ps.plane, filter_words, delta=ps.delta,
+                        deadline=self._query_deadline())
+                    h2 = self.batcher.enqueue_rowcounts(
+                        ps.plane, delta=ps.delta,
+                        deadline=self._query_deadline())
+                    totals = self.batcher.wait(h1)[:ps.n_rows]
                     row_totals = self.batcher.wait(h2)[:ps.n_rows]
+                else:
+                    # single-read TopN (the common shape) goes through
+                    # the blocking submit so a solo request rides the
+                    # width-1 fast lane (r20 satellite: inline
+                    # dispatch, no window formation) — under
+                    # concurrency it lands in the window and dedupes
+                    # exactly like the enqueue form
+                    totals = self.batcher.submit_rowcounts(
+                        ps.plane, filter_words, delta=ps.delta,
+                        deadline=self._query_deadline())[:ps.n_rows]
             elif ps.delta is not None:
                 counts = self.fused.run_rowcounts_delta(
                     ps.plane, ps.delta, filter_words=filter_words,
@@ -2768,6 +2964,7 @@ class Executor:
         agg = call.args.get("aggregate")
         agg_field = None
         agg_name = None
+        minmax_host = False
         if isinstance(agg, Call):
             if agg.name not in self._GROUPBY_AGGS:
                 raise ExecutionError(
@@ -2781,9 +2978,13 @@ class Executor:
                         f"GroupBy: aggregate field {aname!r} is not BSI")
                 if (agg_name in ("Min", "Max")
                         and agg_field.options.bit_depth > gb.MINMAX_MAX_DEPTH):
-                    raise ExecutionError(
-                        "GroupBy: Min/Max aggregate supports bit depth "
-                        f"<= {gb.MINMAX_MAX_DEPTH}")
+                    # graceful fallback (r20 satellite): the in-program
+                    # signed int32 reconstruction caps at 30 bits, so
+                    # deeper fields run the combination counts on
+                    # device and finish Min/Max per surviving group on
+                    # the exact host path (bit descent + python-int
+                    # reconstruction) instead of refusing the query
+                    minmax_host = True
         if len(ctx.shards) > gb.MAX_SHARDS:
             raise ExecutionError(
                 f"GroupBy: more than {gb.MAX_SHARDS} shards per node "
@@ -2801,9 +3002,18 @@ class Executor:
             ps = self.planes.rows_plane(ctx.index.name, f, VIEW_STANDARD,
                                         rows, ctx.shards)
             specs.append((f, rows, ps))
-        agg_plane = (self.planes.bsi_plane(ctx.index.name, agg_field,
-                                           ctx.shards)
-                     if agg_field is not None else None)
+        # delta-aware agg plane (r20): sustained BSI ingest absorbs
+        # into the overlay and the GroupBy program merges base⊕delta
+        # in-program — no fold on the query path.  The depth>30 host
+        # fallback needs a CLEAN plane (its bit descent reads the
+        # plane directly), so it folds instead.
+        agg_plane = None
+        if agg_field is not None:
+            agg_plane = (self.planes.bsi_plane(ctx.index.name,
+                                               agg_field, ctx.shards)
+                         if minmax_host else
+                         self.planes.bsi_plane_delta(
+                             ctx.index.name, agg_field, ctx.shards))
 
         having = call.args.get("having")
         having_metric = having_cond = None
@@ -2837,10 +3047,37 @@ class Executor:
         acc_mask: list[np.ndarray] = []
         n_levels = len(specs)
         total = 0
+        agg_kind = (None if minmax_host
+                    else self._GROUPBY_AGGS.get(agg_name))
+        run = None
+        if (self.batcher is not None
+                and len(ctx.shards) <= self._REDUCE_SHARD_MAX):
+            # GroupBy blocks ride the window machinery (r20): the
+            # flattened block program joins the collection window's
+            # dispatch pool + packed readback alongside concurrent
+            # Counts/aggregates, and identical concurrent GroupBys
+            # (same planes, same combination block) dedupe to ONE
+            # program via the digest
+            import hashlib
+            deadline = self._query_deadline()
+
+            def run(pl, ci, lp, fw, ap, agg, ad):
+                # ci arrives as the HOST combo array (see iter_blocks)
+                # — the digest costs no device round trip
+                meta = (int(ci.shape[0]) if pl else 1,
+                        int(lp.shape[1]),
+                        int(ap.shape[1]) - 2 if ap is not None else 0)
+                digest = hashlib.blake2b(
+                    ci.tobytes(), digest_size=8).digest()
+                return self.batcher.submit_groupby(
+                    pl, ci, lp, fw, ap, agg, meta, digest, delta=ad,
+                    deadline=deadline)
         for combo_rows, out in gb.iter_blocks(
-                specs, filter_words, agg_plane,
-                self._GROUPBY_AGGS.get(agg_name),
-                limited=limit is not None):
+                specs, filter_words,
+                None if minmax_host else agg_plane, agg_kind,
+                limited=limit is not None, run=run,
+                agg_delta=(None if minmax_host or agg_plane is None
+                           else agg_plane.delta)):
             ctx.check_deadline()  # large combination trees stream
             counts = np.asarray(out["counts"])  # (C, slots)
             slots = np.asarray(last_slots, np.int64)
@@ -2878,7 +3115,7 @@ class Executor:
                                 (int(pos[c, li, b]) - int(neg[c, li, b]))
                                 << b for b in range(depth)) \
                                 + base * int(acnt[c, li])
-            elif agg_name in ("Min", "Max"):
+            elif agg_name in ("Min", "Max") and not minmax_host:
                 key = "min" if agg_name == "Min" else "max"
                 aggs = (np.asarray(out[key])[:, slots].astype(np.int64)
                         + base)
@@ -2911,7 +3148,13 @@ class Executor:
                         continue
             acc_rows.append(rows_mat)
             acc_counts.append(sub[c_idx, l_idx])
-            if aggs is not None:
+            if minmax_host:
+                host_vals, host_ok = self._host_group_minmax(
+                    ctx, specs, filter_words, agg_plane, rows_mat,
+                    want_min=agg_name == "Min")
+                acc_aggs.append(host_vals + base)
+                acc_mask.append(host_ok)
+            elif aggs is not None:
                 acc_aggs.append(aggs[c_idx, l_idx])
                 acc_mask.append(agg_ok[c_idx, l_idx]
                                 if agg_ok is not None
@@ -2948,6 +3191,34 @@ class Executor:
             row_keys=row_keys if any(k is not None for k in row_keys)
             else None,
             counts=counts, aggs=agg_col, agg_mask=mask_col)
+
+    def _host_group_minmax(self, ctx: _Ctx, specs, filter_words,
+                           agg_plane, rows_mat: np.ndarray,
+                           want_min: bool):
+        """Exact host Min/Max per surviving group for BSI depths past
+        ``groupby.MINMAX_MAX_DEPTH`` (r20 satellite): the group's
+        column bitmap intersects on device, then the full-depth bit
+        descent + python-int reconstruction answers exactly — one
+        dispatch per group, the correctness path for depth > 30
+        fields, not the serving spine."""
+        vals: list = []
+        oks = np.zeros(len(rows_mat), bool)
+        for g in range(len(rows_mat)):
+            words = filter_words
+            for lvl, (_f, _rows, ps) in enumerate(specs):
+                row = ps.plane[:, ps.slot_of[int(rows_mat[g, lvl])], :]
+                words = row if words is None \
+                    else kernels.intersect(words, row)
+            tuples = bsik.min_max(agg_plane.plane, words)
+            live = [(mn, mc, mx, xc) for mn, mc, mx, xc in tuples
+                    if (mc if want_min else xc) > 0]
+            if not live:
+                vals.append(0)
+                continue
+            vals.append(min(mn for mn, *_ in live) if want_min
+                        else max(mx for _, _, mx, _ in live))
+            oks[g] = True
+        return np.array(vals), oks
 
     # -- writes -------------------------------------------------------------
 

@@ -724,27 +724,218 @@ class FusedCache:
         out = self._cached(key_s, search_build)(*args, jnp.int32(target))
         return out, total
 
-    def run_minmax_batch(self, flags: tuple, leaves):
-        """K BSI Min/Max items (same bit depth) in ONE program; same
-        leaf layout as :meth:`run_sum_batch`.  Returns int32
-        [K, n_shards, 2*depth+4]: min bits, max bits, min_neg, min_cnt,
-        max_neg, max_cnt (``bsi.min_max_bits`` packed for one read)."""
+    # ------------------------------------------------- BSI plane batches
+    #
+    # r20 (the PQL-surface work): the per-PLANE aggregate families.
+    # Unlike the legacy run_sum_batch layout (whose K items each carry
+    # their own plane leaf — K copies of a multi-GB operand in the
+    # program signature), these take ONE resident plane plus the
+    # items' filter leaves, so concurrent aggregates over the same
+    # plane co-batch into one program that references the plane once,
+    # and a pending BSI write overlay (``ingest.delta.BsiOverlay``)
+    # merges in-program: the base side scans the untouched columns
+    # (touched word columns masked out of the filter), the mini side
+    # runs the SAME kernel over the merged touched columns as a tiny
+    # standalone plane — base⊕delta exact with zero plane rewrites.
+
+    @staticmethod
+    def _bsi_split(plane, flt, delta_ops):
+        """(base filter, mini plane, mini filter) for one item: clean
+        pass-through when the plane has no overlay."""
+        from pilosa_tpu.ingest.delta import (bsi_excl_filter,
+                                             bsi_mini_filter,
+                                             bsi_mini_plane)
+        if delta_ops is None:
+            return flt, None, None
+        cs, cw, cv, cm = delta_ops
+        return (bsi_excl_filter(plane, cs, cw, flt),
+                bsi_mini_plane(plane, cs, cw, cv, cm),
+                bsi_mini_filter(plane, cs, cw, flt))
+
+    def _delta_args(self, delta):
+        if delta is None:
+            return None, ()
+        return (delta.col_shard.shape[0],
+                (delta.col_shard, delta.col_word, delta.col_vals,
+                 delta.col_mask))
+
+    def run_sum_plane_batch(self, plane, flags: tuple, filters: tuple,
+                            delta=None):
+        """K BSI Sum items over ONE resident plane in one program —
+        int32[K, n_shards, 2*depth+1], decoded by
+        ``bsi.decode_sum_packed`` exactly like :meth:`run_sum_batch`.
+        ``flags[k]`` = item k has a filter; ``filters`` holds the
+        flagged items' uint32[S, W] bitmaps in order.  With ``delta``
+        (a ``BsiOverlay``) the mini side's per-bit counts fold into
+        shard 0's row (Sum is linear over columns), so the output
+        shape and decode stay identical."""
+        n_filters = len(filters)
+        bucket, delta_ops = self._delta_args(delta)
+        key = (("sum-plane", plane.shape, flags, bucket), "agg")
+
         def build():
-            def program(*ls):
+            def program(p, *rest):
+                filts = rest[:n_filters]
+                dops = rest[n_filters:] or None
                 rows = []
-                i = 0
+                fi = 0
                 for has_filter in flags:
-                    plane = ls[i]
-                    flt = ls[i + 1] if has_filter else None
-                    i += 2 if has_filter else 1
-                    mm = bsik.min_max_bits(plane, flt)
-                    rows.append(jnp.concatenate(
-                        [mm["min_bits"].astype(jnp.int32),
-                         mm["max_bits"].astype(jnp.int32),
-                         mm["min_neg"].astype(jnp.int32)[..., None],
-                         mm["min_cnt"][..., None],
-                         mm["max_neg"].astype(jnp.int32)[..., None],
-                         mm["max_cnt"][..., None]], axis=-1))
+                    flt = filts[fi] if has_filter else None
+                    fi += 1 if has_filter else 0
+                    excl, mini, mflt = self._bsi_split(p, flt, dops)
+                    pos, neg, cnt = bsik.bit_counts(p, excl)
+                    row = jnp.concatenate(
+                        [pos, neg, cnt[..., None]], axis=-1)
+                    if mini is not None:
+                        mp, mn, mc = bsik.bit_counts(mini, mflt)
+                        adj = jnp.concatenate(
+                            [jnp.sum(mp, axis=0, dtype=jnp.int32),
+                             jnp.sum(mn, axis=0, dtype=jnp.int32),
+                             jnp.sum(mc, dtype=jnp.int32)[None]])
+                        row = row.at[0].add(adj)
+                    rows.append(row)
                 return jnp.stack(rows)
             return program
-        return self._cached((flags, "minmax-batch"), build)(*leaves)
+        return self._cached(key, build)(plane, *filters, *delta_ops) \
+            if delta_ops else self._cached(key, build)(plane, *filters)
+
+    def run_minmax_plane_batch(self, plane, flags: tuple,
+                               filters: tuple, delta=None):
+        """K BSI Min/Max items over ONE resident plane — int32
+        [K, n_shards (+ overlay columns), 2*depth+4], decoded by
+        ``bsi.decode_minmax_packed`` (the host combine reduces over
+        the whole leading axis and drops zero-count entries, so the
+        mini side's touched columns just append as extra pseudo-shard
+        rows)."""
+        n_filters = len(filters)
+        bucket, delta_ops = self._delta_args(delta)
+        key = (("minmax-plane", plane.shape, flags, bucket), "agg")
+
+        def build():
+            def pack(mm):
+                return jnp.concatenate(
+                    [mm["min_bits"].astype(jnp.int32),
+                     mm["max_bits"].astype(jnp.int32),
+                     mm["min_neg"].astype(jnp.int32)[..., None],
+                     mm["min_cnt"][..., None],
+                     mm["max_neg"].astype(jnp.int32)[..., None],
+                     mm["max_cnt"][..., None]], axis=-1)
+
+            def program(p, *rest):
+                filts = rest[:n_filters]
+                dops = rest[n_filters:] or None
+                rows = []
+                fi = 0
+                for has_filter in flags:
+                    flt = filts[fi] if has_filter else None
+                    fi += 1 if has_filter else 0
+                    excl, mini, mflt = self._bsi_split(p, flt, dops)
+                    row = pack(bsik.min_max_bits(p, excl))
+                    if mini is not None:
+                        # mini plane [K, R, 1] → per-column tuples
+                        # [K, 2d+4] appended as pseudo-shard rows;
+                        # pad columns carry cnt 0 (mini filter zero)
+                        # and drop in the host combine
+                        mrow = pack(bsik.min_max_bits(mini, mflt))
+                        row = jnp.concatenate([row, mrow], axis=0)
+                    rows.append(row)
+                return jnp.stack(rows)
+            return program
+        return self._cached(key, build)(plane, *filters, *delta_ops) \
+            if delta_ops else self._cached(key, build)(plane, *filters)
+
+    def run_range_batch(self, plane, specs: tuple, operands: tuple,
+                        delta=None):
+        """K BSI Range-counts over ONE resident plane in one program —
+        int32[K] totals (shard axis reduced on device; callers gate on
+        the int32-exact shard bound).  ``specs[k]`` is the item's
+        STATIC shape ``(op_keys tuple of 1–2, has_filter)``; the
+        predicate masks/signs and filter bitmaps are traced operands
+        in ``operands`` (flattened per item: masks, neg per op, then
+        the filter when flagged) — any predicate VALUE of the same
+        comparison shape reuses one executable.  A two-op item ANDs
+        its comparisons (between).  Delta-aware like the other
+        plane-batch families."""
+        bucket, delta_ops = self._delta_args(delta)
+        n_ops = len(operands)
+        key = (("range-plane", plane.shape, specs, bucket), "count")
+
+        def build():
+            def program(p, *rest):
+                ops = rest[:n_ops]
+                dops = rest[n_ops:] or None
+                totals = []
+                i = 0
+                for op_keys, has_filter in specs:
+                    preds = []
+                    for okey in op_keys:
+                        preds.append((ops[i], ops[i + 1], okey))
+                        i += 2
+                    flt = ops[i] if has_filter else None
+                    i += 1 if has_filter else 0
+                    excl, mini, mflt = self._bsi_split(p, flt, dops)
+
+                    def side(pl, fw):
+                        words = None
+                        for masks, neg, okey in preds:
+                            cmp = bsik.range_cmp(pl, masks, neg,
+                                                 fw)[okey]
+                            words = cmp if words is None \
+                                else jnp.bitwise_and(words, cmp)
+                        return jnp.sum(kernels.count(words),
+                                       dtype=jnp.int32)
+
+                    total = side(p, excl)
+                    if mini is not None:
+                        total = total + side(mini, mflt)
+                    totals.append(total)
+                return jnp.stack(totals)
+            return program
+        return self._cached(key, build)(plane, *operands, *delta_ops) \
+            if delta_ops else self._cached(key, build)(plane, *operands)
+
+    def run_groupby_batch(self, planes: tuple, combo_idx, last_plane,
+                          filter_words, agg_plane, agg: str | None,
+                          delta=None):
+        """One GroupBy combination block as a batcher-windowable
+        program: the whole ``exec.groupby`` body with its output dict
+        FLATTENED into one int32 array, so a GroupBy block joins the
+        collection window's packed readback alongside counts and BSI
+        aggregates instead of dispatching solo.  ``delta`` (the agg
+        plane's ``BsiOverlay``) keeps aggregate GroupBys fold-free
+        under sustained BSI ingest.  Unflatten with
+        ``exec.groupby.unflatten_block``."""
+        from pilosa_tpu.exec import groupby as gb
+        has_filter = filter_words is not None
+        has_agg = agg_plane is not None
+        bucket, delta_ops = self._delta_args(
+            delta if has_agg else None)
+        key = (("groupby", tuple(p.shape for p in planes),
+                combo_idx.shape, last_plane.shape, has_filter,
+                agg_plane.shape if has_agg else None, agg, bucket),
+               "agg")
+
+        def build():
+            def program(*ls):
+                n = len(planes)
+                pl = ls[:n]
+                ci, lp = ls[n], ls[n + 1]
+                j = n + 2
+                fw = ls[j] if has_filter else None
+                j += 1 if has_filter else 0
+                ap = ls[j] if has_agg else None
+                j += 1 if has_agg else 0
+                ad = ls[j:] or None
+                out = gb.groupby_out(pl, ci, lp, fw, ap, agg,
+                                     agg_delta=ad)
+                return jnp.concatenate(
+                    [out[name].astype(jnp.int32).reshape(-1)
+                     for name in gb.block_part_names(agg)])
+            return program
+        args = planes + (combo_idx, last_plane)
+        if has_filter:
+            args += (filter_words,)
+        if has_agg:
+            args += (agg_plane,)
+        args += delta_ops
+        return self._cached(key, build)(*args)

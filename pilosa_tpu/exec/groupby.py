@@ -35,9 +35,8 @@ MAX_SHARDS = kernels.SAFE_SHARD_SUM
 MINMAX_MAX_DEPTH = 30
 
 
-@partial(jax.jit, static_argnames=("agg",))
-def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
-                     agg_plane, agg):
+def groupby_out(prefix_planes, combo_idx, last_plane, filter_words,
+                agg_plane, agg, agg_delta=None):
     """All GroupBy combination counts (+ optional aggregate) in one program.
 
     prefix_planes: tuple of uint32[S, n_l, W], one per non-innermost
@@ -46,10 +45,26 @@ def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
     last_plane: uint32[S, n_last, W] — innermost level, vectorized.
     filter_words: uint32[S, W] | None.
     agg_plane: BSI uint32[S, D+2, W] | None; agg: None | "sum" | "minmax".
+    agg_delta (r20): the agg plane's pending write overlay as
+        ``(col_shard, col_word, col_vals, col_mask)`` — aggregates
+        answer base⊕delta with the same split the flat families use
+        (touched word columns excluded from the base pass, answered
+        by a merged mini plane), so GroupBy stays fold-free under
+        sustained BSI ingest.
 
     Returns per-combination stacked outputs: counts int32[C, n_last] and
     aggregate arrays (see body).
     """
+    mini = excl = None
+    if agg is not None and agg_delta is not None:
+        from pilosa_tpu.ingest.delta import (bsi_excl_filter,
+                                             bsi_mini_plane)
+        cs, cw, cv, cm = agg_delta
+        excl = bsi_excl_filter(agg_plane, cs, cw, None)   # [S, W]
+        mini = bsi_mini_plane(agg_plane, cs, cw, cv, cm)  # [K, R, 1]
+        s = agg_plane.shape[0]
+        cs_ok = cs < s
+        cs_c = jnp.clip(cs, 0, s - 1)
 
     def body(ix):
         prefix = filter_words
@@ -64,13 +79,37 @@ def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
         words = (last_plane if prefix is None
                  else jnp.bitwise_and(last_plane, prefix[:, None, :]))
         aplane = agg_plane[:, None]  # (S, 1, D+2, W) broadcast over rows
+        if mini is not None:
+            # mini side first: the combination's filter words GATHERED
+            # at the touched columns (from the PRE-exclusion words —
+            # the exclusion below zeroes exactly these), zero on pad
+            # lanes; base side: touched word columns masked out
+            wmini = jnp.where(cs_ok[:, None],
+                              words[cs_c, :, cw], 0)     # [K, n_last]
+            words = jnp.bitwise_and(words, excl[:, None, :])
+            mini_b = mini[:, None]       # [K, 1, R, 1] over n_last
+            wmini_b = wmini[..., None]   # [K, n_last, 1]
         if agg == "sum":
             pos_c, neg_c, cnt = bsik.bit_counts(aplane, words)
-            out["pos"] = jnp.sum(pos_c, axis=0, dtype=jnp.int32)
-            out["neg"] = jnp.sum(neg_c, axis=0, dtype=jnp.int32)
-            out["cnt"] = jnp.sum(cnt, axis=0, dtype=jnp.int32)
+            pos = jnp.sum(pos_c, axis=0, dtype=jnp.int32)
+            neg = jnp.sum(neg_c, axis=0, dtype=jnp.int32)
+            cn = jnp.sum(cnt, axis=0, dtype=jnp.int32)
+            if mini is not None:
+                mp, mn, mc = bsik.bit_counts(mini_b, wmini_b)
+                pos = pos + jnp.sum(mp, axis=0, dtype=jnp.int32)
+                neg = neg + jnp.sum(mn, axis=0, dtype=jnp.int32)
+                cn = cn + jnp.sum(mc, axis=0, dtype=jnp.int32)
+            out["pos"], out["neg"], out["cnt"] = pos, neg, cn
         else:  # minmax: signed int32 offsets, sentinel-reduced over shards
             mm = bsik.min_max_bits(aplane, words)
+            if mini is not None:
+                # touched columns append as pseudo-shard entries (the
+                # per-key shapes match: [S, n_last, ...] ⧺ [K, n_last,
+                # ...]); the sentinel reduce over axis 0 below then
+                # combines base and mini exactly
+                mmm = bsik.min_max_bits(mini_b, wmini_b)
+                mm = {k: jnp.concatenate([mm[k], mmm[k]], axis=0)
+                      for k in mm}
             depth = mm["min_bits"].shape[-1]
             weights = (jnp.int32(1) << jnp.arange(depth, dtype=jnp.int32))
 
@@ -104,6 +143,50 @@ def _groupby_program(prefix_planes, combo_idx, last_plane, filter_words,
     return jax.lax.map(body, combo_idx, batch_size=32)
 
 
+_groupby_program = partial(jax.jit, static_argnames=("agg",))(groupby_out)
+
+
+def block_part_names(agg: str | None) -> tuple[str, ...]:
+    """The canonical part order of one flattened GroupBy block (the
+    ``fused.run_groupby_batch`` layout)."""
+    if agg == "sum":
+        return ("counts", "pos", "neg", "cnt")
+    if agg == "minmax":
+        return ("counts", "min", "min_cnt", "max", "max_cnt")
+    return ("counts",)
+
+
+def block_shapes(n_combos: int, n_last: int, depth: int,
+                 agg: str | None) -> dict[str, tuple]:
+    """Per-part shapes of one block's outputs (leading dim C = the
+    padded combination count; prefix-less GroupBys run C = 1)."""
+    c = n_combos
+    shapes = {"counts": (c, n_last)}
+    if agg == "sum":
+        shapes.update(pos=(c, n_last, depth), neg=(c, n_last, depth),
+                      cnt=(c, n_last))
+    elif agg == "minmax":
+        shapes.update({"min": (c, n_last), "min_cnt": (c, n_last),
+                       "max": (c, n_last), "max_cnt": (c, n_last)})
+    return shapes
+
+
+def unflatten_block(flat: np.ndarray, n_combos: int, n_last: int,
+                    depth: int, agg: str | None) -> dict[str, np.ndarray]:
+    """Invert ``fused.run_groupby_batch``'s flatten: one packed int32
+    read back into the per-part arrays ``iter_blocks`` consumers
+    slice."""
+    shapes = block_shapes(n_combos, n_last, depth, agg)
+    out = {}
+    off = 0
+    for name in block_part_names(agg):
+        shape = shapes[name]
+        size = int(np.prod(shape, dtype=np.int64))
+        out[name] = flat[off:off + size].reshape(shape)
+        off += size
+    return out
+
+
 def combo_grid(levels: list[np.ndarray]) -> np.ndarray:
     """Cartesian product of per-level arrays in lexicographic order,
     [C, L] in the input dtype (row-slot int32 or row-id uint64 — row
@@ -125,7 +208,7 @@ LIMIT_BLOCK = 1024
 
 
 def iter_blocks(specs, filter_words, agg_plane, agg_kind,
-                limited: bool = False):
+                limited: bool = False, run=None, agg_delta=None):
     """Execute the program over lexicographic combination blocks.
 
     specs: list of (field, rows np.ndarray, PlaneSet); the last spec is
@@ -133,6 +216,13 @@ def iter_blocks(specs, filter_words, agg_plane, agg_kind,
     outputs dict of np arrays) in combination order; callers stop
     consuming once a ``limit=`` is satisfied.  Blocks are padded to one
     static shape (single compile), the pad tail is sliced off here.
+
+    ``run`` (r20): an alternative block dispatcher with the
+    ``_groupby_program`` signature returning a dict of HOST arrays —
+    the executor routes blocks through the batcher's collection
+    window here, so a GroupBy block shares its dispatch window and
+    packed readback with concurrent Counts/aggregates instead of
+    interleaving solo device round trips.
     """
     *prefix_specs, (last_f, last_rows, last_ps) = specs
     slot_levels = [np.array([ps.slot_of[int(r)] for r in rows], np.int32)
@@ -154,12 +244,22 @@ def iter_blocks(specs, filter_words, agg_plane, agg_kind,
 
     planes = tuple(ps.plane for _, _, ps in prefix_specs)
     aplane = agg_plane.plane if agg_plane is not None else None
+    if run is None:
+        def run(pl, ci, lp, fw, ap, agg, ad):
+            at = ((ad.col_shard, ad.col_word, ad.col_vals,
+                   ad.col_mask) if ad is not None else None)
+            return _groupby_program(pl, ci, lp, fw, ap, agg,
+                                    agg_delta=at)
     for start in range(0, n_combos, block):
         sl = combo_slots[start:start + block]
         n = sl.shape[0]
         if n < block:  # pad to the compiled shape; tail dropped below
             sl = np.concatenate([sl, np.repeat(sl[-1:], block - n, axis=0)])
-        out = _groupby_program(planes, jnp.asarray(sl), last_ps.plane,
-                               filter_words, aplane, agg_kind)
+        # the combo block stays a HOST array here: the batcher route
+        # hashes it for dedupe (a device array would force a blocking
+        # D2H read per block just to digest bytes that originated
+        # host-side), and jit converts it on dispatch either way
+        out = run(planes, sl, last_ps.plane,
+                  filter_words, aplane, agg_kind, agg_delta)
         yield (combo_rows[start:start + n],
                {k: np.asarray(v)[:n] for k, v in out.items()})

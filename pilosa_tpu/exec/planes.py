@@ -275,10 +275,52 @@ class PlaneCache:
 
     def bsi_plane(self, index: str, field: Field,
                   shards: tuple[int, ...]) -> PlaneSet:
-        """BSI bit-plane: rows are the fixed exists/sign/bit layout."""
+        """BSI bit-plane: rows are the fixed exists/sign/bit layout.
+        Always CLEAN (a pending overlay folds first) — consumers that
+        can answer base⊕delta go through :meth:`bsi_plane_delta`."""
         view_name = field.bsi_view_name
         key = ("bsi", index, field.name, view_name, shards,
                field.options.bit_depth)
+        return self._get(key, field, view_name, shards, self._build_bsi)
+
+    def bsi_plane_delta(self, index: str, field: Field,
+                        shards: tuple[int, ...]) -> PlaneSet:
+        """BSI bit-plane for the delta-aware aggregate consumers
+        (r20): a STALE resident plane absorbs its write gap into a
+        bounded device overlay (``ingest.delta.BsiOverlay``) and the
+        returned PlaneSet carries it as ``.delta`` — Sum/Min/Max/
+        Range-count kernels answer base⊕delta at dispatch, so
+        sustained ingest on an int field stops forcing folds or
+        rebuilds on the aggregate path."""
+        view_name = field.bsi_view_name
+        key = ("bsi", index, field.name, view_name, shards,
+               field.options.bit_depth)
+        # lock-free fast path: fresh entry serves as-is, overlay and
+        # all (the aggregate kernels merge it in-program)
+        hit = self._entries.get(key)
+        if hit is not None and hit[0] == self._gens_fast(field, view_name,
+                                                         shards):
+            self._touch(key)
+            self._lease_fast(key)
+            self.hits += 1
+            return hit[1]
+        if hit is not None and self.delta_cells > 0:
+            gens = self._gens(field, view_name, shards)
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is not None and cur[0] == gens:
+                    self._touch(key)
+                    self._lease(key)
+                    self.hits += 1
+                    return cur[1]
+            if cur is not None:
+                ps = self._delta_update(key, field, view_name, shards,
+                                        cur)
+                if ps is not None:
+                    with self._lock:
+                        self._lease(key)
+                    self.hits += 1
+                    return ps
         return self._get(key, field, view_name, shards, self._build_bsi)
 
     # Planes at or under this build inline (the latency of spawning a
@@ -1030,7 +1072,17 @@ class PlaneCache:
                 self.hits += 1
                 return ps
         elif hit is not None and key[0] in ("bsi", "rows", "row"):
-            ps = self._incremental(key, field, view_name, shards, hit)
+            ps = None
+            if getattr(hit[1], "delta", None) is None:
+                ps = self._incremental(key, field, view_name, shards, hit)
+            if ps is None and key[0] == "bsi":
+                # a pending BSI overlay (r20) or a gap past the
+                # incremental cap: fold overlay + journal gap into the
+                # base in one scatter (bounded by delta_cells +
+                # MAX_INCR_CELLS) — never silently drop overlay cells
+                # by scattering around them, never rebuild for a
+                # coverable gap
+                ps = self._fold(key, field, view_name, shards, hit)
             if ps is not None:
                 with self._lock:
                     self._lease(key)
@@ -1285,9 +1337,17 @@ class PlaneCache:
             if not mirror.would_fit(cells):
                 return None  # overlay full: fold/compact
             mirror.absorb(cells)
-            overlay = mirror.build_overlay(
-                jax.device_put,
-                ps.plane.shape[0] * ps.plane.shape[1])
+            if key[0] == "bsi":
+                # bit-sliced planes overlay by touched word COLUMN
+                # (the aggregate kernels read whole columns) — see
+                # ingest.delta.BsiOverlay
+                overlay = mirror.build_bsi_overlay(
+                    jax.device_put, ps.plane.shape[1],
+                    ps.plane.shape[0])
+            else:
+                overlay = mirror.build_overlay(
+                    jax.device_put,
+                    ps.plane.shape[0] * ps.plane.shape[1])
             new_ps = PlaneSet(ps.plane, ps.shards, ps.row_ids,
                               ps.slot_of, delta=overlay)
             self._entries[key] = (actual, new_ps, nbytes)

@@ -65,6 +65,45 @@ class DeltaOverlay:
         return int(self.rows.size) * 12
 
 
+@dataclass
+class BsiOverlay:
+    """Device form of a BIT-SLICED plane's pending write cells,
+    grouped by touched word-COLUMN (r20 BSI ingest).
+
+    A BSI write changes several rows of ONE word column at once (the
+    exists row, maybe the sign row, and the changed magnitude bits of
+    the same 32-column word), and the aggregate kernels
+    (``bit_counts``/``min_max_bits``/``range_cmp``) read whole columns
+    — so the overlay's unit is the (shard, word) column, not the
+    single cell.  The delta-aware aggregates split base⊕delta into
+
+      base side   the untouched columns: the clean kernel over the
+                  immutable base plane with touched word columns
+                  masked OUT of the filter (:func:`bsi_excl_filter`);
+      mini side   the touched columns as a tiny standalone plane
+                  ``uint32[K, rows, 1]`` holding the MERGED words
+                  (:func:`bsi_mini_plane`), run through the SAME
+                  kernel with a per-column filter word.
+
+    Exact by construction: every column is counted on exactly one
+    side.  Pad lanes carry ``col_shard == n_shards`` (dropped by the
+    exclusion scatter) and an all-zero mini filter (no contribution).
+    """
+
+    col_shard: jax.Array  # int32[K_pad] (pad lanes = n_shards)
+    col_word: jax.Array   # int32[K_pad]
+    col_vals: jax.Array   # uint32[K_pad, rows] new word values
+    col_mask: jax.Array   # uint32[K_pad, rows] 0xFFFFFFFF = row touched
+    n: int                # live touched columns (<= K_pad)
+    bits: int             # set bits carried by live cell values
+
+    @property
+    def nbytes(self) -> int:
+        # per lane: vals + mask words (col_vals.size covers both at
+        # 4 B each) plus the shard + word indices (4 B each)
+        return int(self.col_vals.size) * 8 + int(self.col_shard.size) * 8
+
+
 class DeltaMirror:
     """Host mirror of one resident plane's overlay cells.
 
@@ -80,6 +119,8 @@ class DeltaMirror:
     with the write batch, not the overlay's fill."""
 
     _GROW = 1024
+    # build_bsi_overlay's minimum pow2 column bucket (see there)
+    BSI_COL_PAD_MIN = 64
 
     def __init__(self, cap: int):
         self.cap = int(cap)
@@ -142,6 +183,45 @@ class DeltaMirror:
         vals[:n] = self._vals[:n]
         return DeltaOverlay(place(rows), place(words), place(vals),
                             n=n, bits=self.bits)
+
+    def build_bsi_overlay(self, place, n_rows: int,
+                          n_shards: int) -> BsiOverlay:
+        """Materialize the BSI (word-column-grouped) device overlay:
+        live cells regroup by (shard, word) so each touched column
+        carries its new row words + touched-row mask in one lane.
+        ``n_rows`` is the plane's row count (depth + 2); pad columns
+        carry ``col_shard == n_shards`` (dropped/masked).
+
+        Vectorized: this runs under the cache lock on every absorb
+        (once per write gap a read observes), so the work must stay
+        one ``np.unique`` + two fancy scatters — a python loop over
+        the mirror measured O(cells) per READ under sustained ingest
+        and collapsed the config30 mixed phase."""
+        n = len(self._index)
+        flat = self._rows[:n]
+        word = self._words[:n]
+        # one sortable key per (shard, word) column; words are < 2^32
+        key = (flat // n_rows).astype(np.int64) * (1 << 32) + word
+        uniq, inv = np.unique(key, return_inverse=True)
+        k = len(uniq)
+        # floor the pow2 column bucket: every bucket size is a fresh
+        # XLA compile of each delta-aware aggregate family, so the
+        # low rungs of the ladder (1, 2, 4, ... columns) are pure
+        # compile churn during ingest warm-up — pad lanes are masked,
+        # so a 64-column floor costs only trivial device scratch
+        k_pad = _pow2(max(self.BSI_COL_PAD_MIN, k))
+        col_shard = np.full(k_pad, n_shards, np.int32)
+        col_word = np.zeros(k_pad, np.int32)
+        col_vals = np.zeros((k_pad, n_rows), np.uint32)
+        col_mask = np.zeros((k_pad, n_rows), np.uint32)
+        col_shard[:k] = (uniq >> 32).astype(np.int32)
+        col_word[:k] = (uniq & 0xFFFFFFFF).astype(np.int32)
+        rows_in_col = (flat % n_rows).astype(np.int64)
+        col_vals[inv, rows_in_col] = self._vals[:n]
+        col_mask[inv, rows_in_col] = 0xFFFFFFFF
+        return BsiOverlay(place(col_shard), place(col_word),
+                          place(col_vals), place(col_mask),
+                          n=k, bits=self.bits)
 
 
 # ---------------------------------------------------------------------------
@@ -258,3 +338,69 @@ def adjusted_selected_counts(plane: jax.Array, row_idx: jax.Array,
     add = jnp.sum(jnp.where(match, diff[:, None], 0), axis=0,
                   dtype=jnp.int32)
     return sel + add
+
+
+# ---------------------------------------------------------------------------
+# BSI split kernels (r20): base-with-exclusion ⊕ merged mini plane.
+# Pure jnp — jitted through FusedCache's run_*_plane_batch family; the
+# overlay arrays are traced operands, so one program serves any
+# overlay of the same pow2 column bucket.
+# ---------------------------------------------------------------------------
+
+
+def bsi_sides(plane: jax.Array, filter_words, overlay):
+    """The base⊕delta split as ``[(plane, filter), ...]`` sides for
+    EAGER consumers (the batcher's per-item fallbacks): the clean
+    plane alone when there is no overlay, else the base with touched
+    columns excluded plus the merged mini plane.  Fused programs use
+    ``FusedCache._bsi_split`` (same math, traced operands)."""
+    if overlay is None:
+        return [(plane, filter_words)]
+    return [
+        (plane, bsi_excl_filter(plane, overlay.col_shard,
+                                overlay.col_word, filter_words)),
+        (bsi_mini_plane(plane, overlay.col_shard, overlay.col_word,
+                        overlay.col_vals, overlay.col_mask),
+         bsi_mini_filter(plane, overlay.col_shard, overlay.col_word,
+                         filter_words))]
+
+
+def bsi_excl_filter(plane: jax.Array, col_shard: jax.Array,
+                    col_word: jax.Array,
+                    filter_words: jax.Array | None) -> jax.Array:
+    """The base side's filter: the caller's ``filter_words`` (all-ones
+    when absent) with every overlay-touched word column zeroed — those
+    32-column words are answered by the mini plane instead.  Pad lanes
+    (``col_shard == S``) drop."""
+    s, _, w = plane.shape
+    base = (jnp.full((s, w), 0xFFFFFFFF, jnp.uint32)
+            if filter_words is None else filter_words)
+    return base.at[col_shard, col_word].set(0, mode="drop")
+
+
+def bsi_mini_plane(plane: jax.Array, col_shard: jax.Array,
+                   col_word: jax.Array, col_vals: jax.Array,
+                   col_mask: jax.Array) -> jax.Array:
+    """The mini side: each touched word column as one single-word
+    shard of a tiny standalone BSI plane ``uint32[K, rows, 1]`` —
+    overlay words where touched, base words elsewhere.  Pad lanes
+    gather shard 0 garbage; the mini FILTER zeroes them."""
+    s = plane.shape[0]
+    cs = jnp.clip(col_shard, 0, s - 1)
+    base_cols = plane[cs, :, col_word]            # [K, rows]
+    merged = jnp.where(col_mask.astype(bool), col_vals, base_cols)
+    return merged[..., None]                      # [K, rows, 1]
+
+
+def bsi_mini_filter(plane: jax.Array, col_shard: jax.Array,
+                    col_word: jax.Array,
+                    filter_words: jax.Array | None) -> jax.Array:
+    """The mini side's per-column filter word ``uint32[K, 1]``: the
+    caller's filter at each touched column (all-ones when absent),
+    zero on pad lanes so they contribute nothing anywhere."""
+    s = plane.shape[0]
+    valid = (col_shard < s).astype(jnp.uint32) * jnp.uint32(0xFFFFFFFF)
+    if filter_words is not None:
+        cs = jnp.clip(col_shard, 0, s - 1)
+        valid = valid & filter_words[cs, col_word]
+    return valid[:, None]                         # [K, 1]

@@ -556,3 +556,43 @@ def test_config29_storage_integrity_smoke():
     assert "overhead_pct" in d
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config30_pql_surface_smoke():
+    """bench/config30 (full PQL surface, r20) in --smoke mode:
+    per-shape qps + GB/s for Count/Range/Sum/Min/Max/GroupBy/TopN
+    through the product path, then mixed-shape serving under
+    sustained BSI ingest.  The ISSUE 15 acceptance bars are asserted
+    IN-BENCH while measuring — oracle-exact answers live and
+    quiesced, ZERO base-plane rebuilds (the BSI overlay absorbs every
+    write), and same-plane aggregates provably co-batching
+    (bsi_batch_hits_total > 0) — and re-checked here on the
+    artifact."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config30_pql_surface.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("pql_surface_qps")
+    assert out["unit"] == "qps" and out["value"] > 0
+    d = out["detail"]
+    # the whole surface measured: every shape has qps and scanned GB/s
+    assert set(d["shapes"]) == {"count", "range", "sum", "min", "max",
+                                "groupby", "topn"}
+    assert all(v["qps"] > 0 for v in d["shapes"].values())
+    assert all(v["gbps"] >= 0 for v in d["shapes"].values())
+    # the r20 contracts, re-checked on the artifact
+    assert d["plane_rebuilds_during_serving"] == 0
+    assert d["mixed_under_ingest"]["qps"] > 0
+    assert d["mixed_under_ingest"]["write_batches"] > 0
+    assert d["delta_absorbs"] >= 1
+    assert d["bsi_batch_hits"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

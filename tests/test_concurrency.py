@@ -365,7 +365,8 @@ def test_cross_request_bsi_aggregate_batching(tmp_path):
     assert results[6] == 2
     assert results[7] == 2
     agg_programs = [k for k in ex.fused._programs
-                    if k[1] in ("sum-batch", "minmax-batch")]
+                    if isinstance(k[0], tuple)
+                    and k[0][0] in ("sum-plane", "minmax-plane")]
     assert agg_programs, "aggregates must run through the batch programs"
 
 

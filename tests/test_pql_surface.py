@@ -1,0 +1,295 @@
+"""Full PQL surface at device speed (r20, ISSUE 15).
+
+Proof obligations:
+
+1. **Oracle parity under sustained ingest** — Sum/Min/Max/Range-count/
+   GroupBy answers are bit-exact vs a python truth map while BSI
+   writes stream in (delta overlays LIVE on the aggregate path:
+   absorbs observed, zero base-plane rebuilds), including negative
+   values, sign flips, and value overwrites.
+2. **Co-batching** — concurrent same-plane aggregates provably share
+   one collection-window group (``pipeline_window_fill{kind=sum}`` >
+   1 / ``bsi_batch_hits_total`` > 0 — the ISSUE 15 acceptance
+   criterion).
+3. **Graceful depth fallback** — GroupBy Min/Max on a BSI field
+   deeper than ``groupby.MINMAX_MAX_DEPTH`` answers exactly through
+   the host path instead of refusing (covered at depth 31).
+4. **Solo fast lane coverage** — width-1 Sum/Min/Max/Range-count/
+   TopN/GroupBy requests dispatch inline
+   (``solo_fastlane_hits_total{kind=...}``).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.store import FieldOptions, Holder
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("seg")
+    idx.create_field("amount",
+                     FieldOptions(type="int", min=-1000, max=1000))
+    ex = Executor(holder)
+    return holder, idx, ex
+
+
+class _Recorder:
+    """Minimal stats shim: counters + window-fill observations."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: dict = {}
+        self.fills: dict = {}
+
+    def count(self, name, value=1, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self.lock:
+            self.counters[key] = self.counters.get(key, 0) + value
+
+    def observe(self, name, value, **labels):
+        if name == "pipeline_window_fill":
+            key = tuple(sorted(labels.items()))
+            with self.lock:
+                self.fills.setdefault(key, []).append(value)
+
+    def counter(self, name, **labels):
+        return self.counters.get((name, tuple(sorted(labels.items()))), 0)
+
+    def gauge(self, *a, **k):
+        pass
+
+    def timing(self, *a, **k):
+        pass
+
+    def set_buckets(self, *a, **k):
+        pass
+
+
+def _truth_checks(ex, truth: dict, seg: dict):
+    """Assert every aggregate shape against the python oracle."""
+    vals = list(truth.values())
+    (s,) = ex.execute("i", "Sum(field=amount)")
+    assert (s.value, s.count) == (sum(vals), len(vals))
+    (mn,) = ex.execute("i", "Min(field=amount)")
+    (mx,) = ex.execute("i", "Max(field=amount)")
+    if vals:
+        lo, hi = min(vals), max(vals)
+        assert (mn.value, mn.count) == (lo, vals.count(lo))
+        assert (mx.value, mx.count) == (hi, vals.count(hi))
+    for pred in (0, -57, 123):
+        (c,) = ex.execute("i", f"Count(Row(amount > {pred}))")
+        assert c == sum(1 for v in vals if v > pred), pred
+        (c,) = ex.execute("i", f"Count(Row(amount <= {pred}))")
+        assert c == sum(1 for v in vals if v <= pred), pred
+    (c,) = ex.execute("i", "Count(Row(-50 < amount < 60))")
+    assert c == sum(1 for v in vals if -50 < v < 60)
+    # GroupBy Count + Sum over the seg rows
+    (g,) = ex.execute("i", "GroupBy(Rows(seg), aggregate=Sum(field=amount))")
+    got = {tuple(fr.row_id for fr in gc.group): (gc.count, gc.agg)
+           for gc in g.groups}
+    for row, cols in seg.items():
+        if not cols:
+            continue
+        in_group = [truth[c] for c in cols if c in truth]
+        assert got[(row,)] == (len(cols), sum(in_group)), (row, got)
+
+
+def test_aggregates_oracle_under_sustained_ingest(env):
+    """Interleaved value writes (negatives, sign flips, overwrites):
+    every shape stays exact, the BSI plane absorbs into its overlay
+    (delta live) and the base plane never rebuilds."""
+    import random
+    holder, idx, ex = env
+    rng = random.Random(20)
+    truth: dict[int, int] = {}
+    seg: dict[int, set] = {1: set(), 2: set()}
+    for c in range(40):
+        row = rng.choice((1, 2))
+        seg[row].add(c)
+        idx.field("seg").import_bits(
+            np.array([row], np.uint64), np.array([c], np.uint64))
+    idx.note_columns(np.arange(40, dtype=np.uint64))
+    # warm every shape on a first population
+    for c in range(0, 40, 2):
+        truth[c] = rng.randrange(-500, 500)
+    idx.field("amount").import_values(
+        np.array(list(truth), np.uint64), list(truth.values()))
+    _truth_checks(ex, truth, seg)
+    builds0 = ex.planes.builds
+    absorbs0 = ex.planes.delta_absorbs
+    for step in range(10):
+        cols = [rng.randrange(40) for _ in range(rng.randrange(1, 6))]
+        cv = {}
+        for c in cols:
+            # sign flips and overwrites exercise the sign row + the
+            # no-negative-zero invariant
+            cv[c] = rng.choice((-1, 1)) * rng.randrange(0, 500)
+        idx.field("amount").import_values(
+            np.array(list(cv), np.uint64), list(cv.values()))
+        truth.update(cv)
+        _truth_checks(ex, truth, seg)
+    assert ex.planes.builds == builds0, \
+        "BSI writes must not rebuild the base plane"
+    assert ex.planes.delta_absorbs > absorbs0, \
+        "the aggregate path must serve base⊕delta (overlay live)"
+
+
+def test_aggregates_exact_after_compaction(env):
+    """Overlay overflow drives a fold; aggregates stay exact through
+    the compaction swap."""
+    holder, idx, ex = env
+    ex.planes.delta_cells = 8
+    ex.planes.delta_compact_fraction = 0.25
+    truth = {}
+    import random
+    rng = random.Random(7)
+    for step in range(16):
+        # new column far apart → new overlay cells every batch
+        c = step * 64
+        truth[c] = rng.randrange(-300, 300)
+        idx.field("amount").import_values(
+            np.array([c], np.uint64), [truth[c]])
+        idx.note_columns(np.array([c], np.uint64))
+        (s,) = ex.execute("i", "Sum(field=amount)")
+        assert (s.value, s.count) == (sum(truth.values()), len(truth))
+    (mn,) = ex.execute("i", "Min(field=amount)")
+    assert mn.value == min(truth.values())
+
+
+def test_same_plane_aggregates_cobatch(tmp_path):
+    """ISSUE 15 acceptance: concurrent same-plane aggregates co-batch
+    — window fill > 1 for the sum kind, bsi_batch_hits_total > 0."""
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("v", FieldOptions(type="int", min=-100, max=100))
+    for c in range(30):
+        idx.field("v").set_value(c, c - 10)
+    idx.note_columns(np.arange(30, dtype=np.uint64))
+    rec = _Recorder()
+    # fixed window: the fast lane stays off, every submit joins a
+    # window — the co-batch proof must not depend on scheduler luck
+    ex = Executor(holder, stats=rec, count_batch_window=0.05,
+                  max_concurrent=16)
+    want = (sum(c - 10 for c in range(30)), 30)
+    (s,) = ex.execute("i", "Sum(field=v)")  # warm plane + program
+    assert (s.value, s.count) == want
+    start = threading.Barrier(6)
+    outs = []
+
+    def worker():
+        start.wait()
+        (s,) = ex.execute("i", "Sum(field=v)")
+        outs.append((s.value, s.count))
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert outs and all(o == want for o in outs), outs
+    assert rec.counter("bsi_batch_hits_total", kind="sum") > 0, \
+        (rec.counters, rec.fills)
+    fills = rec.fills.get((("kind", "sum"),), [])
+    assert fills and max(fills) > 1, fills
+
+
+def test_groupby_minmax_depth31_host_fallback(env):
+    """Depth 31 > MINMAX_MAX_DEPTH (30): GroupBy Min/Max answers
+    exactly through the host path instead of raising."""
+    from pilosa_tpu.exec import groupby as gb
+    holder, idx, ex = env
+    idx.create_field("deep", FieldOptions(type="int", min=0,
+                                          max=(1 << 31) - 1))
+    assert idx.field("deep").options.bit_depth > gb.MINMAX_MAX_DEPTH
+    idx.field("deep").set_value(1, 2_000_000_000)
+    idx.field("deep").set_value(2, 7)
+    idx.field("seg").import_bits(np.array([1, 1, 2], np.uint64),
+                                 np.array([1, 2, 9], np.uint64))
+    idx.note_columns(np.array([1, 2, 9], np.uint64))
+    (g,) = ex.execute("i", "GroupBy(Rows(seg), aggregate=Max(field=deep))")
+    got = {tuple(fr.row_id for fr in gc.group): (gc.count, gc.agg)
+           for gc in g.groups}
+    assert got[(1,)] == (2, 2_000_000_000), got
+    assert got[(2,)] == (1, None), got  # no deep value in the group
+    (g,) = ex.execute("i", "GroupBy(Rows(seg), aggregate=Min(field=deep))")
+    got = {tuple(fr.row_id for fr in gc.group): gc.agg for gc in g.groups}
+    assert got[(1,)] == 7, got
+
+
+def test_percentile_exact_with_negatives(env):
+    """The depth-bounded fori search answers the same rank the sorted
+    python oracle does, negatives included."""
+    holder, idx, ex = env
+    vals = {1: -400, 2: -3, 3: 0, 4: 17, 5: 17, 6: 999}
+    idx.field("amount").import_values(
+        np.array(list(vals), np.uint64), list(vals.values()))
+    idx.note_columns(np.array(list(vals), np.uint64))
+    import math
+    sv = sorted(vals.values())
+    for nth in (1, 25, 50, 90, 100):
+        (p,) = ex.execute("i", f"Percentile(field=amount, nth={nth})")
+        want = sv[min(len(sv) - 1,
+                      max(0, math.ceil(nth / 100 * len(sv)) - 1))]
+        assert p.value == want, (nth, p.value, want)
+
+
+def test_solo_fastlane_covers_new_kinds(tmp_path):
+    """Width-1 requests for every r20 shape dispatch inline —
+    solo_fastlane_hits_total moves for sum/minmax/bsirange/rowcounts/
+    groupby."""
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("seg")
+    idx.create_field("v", FieldOptions(type="int", min=-100, max=100))
+    for c in range(20):
+        idx.field("v").set_value(c, c - 5)
+    idx.field("seg").import_bits(
+        np.array([1] * 10 + [2] * 10, np.uint64),
+        np.arange(20, dtype=np.uint64))
+    idx.note_columns(np.arange(20, dtype=np.uint64))
+    rec = _Recorder()
+    ex = Executor(holder, stats=rec)
+    ex.execute("i", "Sum(field=v)")
+    ex.execute("i", "Min(field=v)")
+    ex.execute("i", "Count(Row(v > 3))")
+    ex.execute("i", "TopN(seg)")
+    ex.execute("i", "GroupBy(Rows(seg))")
+    for kind in ("sum", "minmax", "bsirange", "rowcounts", "groupby"):
+        assert rec.counter("solo_fastlane_hits_total", kind=kind) > 0, \
+            (kind, rec.counters)
+
+
+def test_groupby_batcher_parity_with_fallback(env):
+    """GroupBy through the window machinery answers byte-identically
+    to a batcher-less executor across aggregate kinds."""
+    holder, idx, ex = env
+    import random
+    rng = random.Random(5)
+    rows, cols = [], []
+    for c in range(60):
+        rows.append(rng.choice((1, 2, 3)))
+        cols.append(c)
+    idx.field("seg").import_bits(np.array(rows, np.uint64),
+                                 np.array(cols, np.uint64))
+    cv = {c: rng.randrange(-200, 200) for c in range(0, 60, 3)}
+    idx.field("amount").import_values(np.array(list(cv), np.uint64),
+                                      list(cv.values()))
+    idx.note_columns(np.arange(60, dtype=np.uint64))
+    plain = Executor(holder, count_batch_window=0)  # no batcher
+    for pql in ("GroupBy(Rows(seg))",
+                "GroupBy(Rows(seg), aggregate=Count())",
+                "GroupBy(Rows(seg), aggregate=Sum(field=amount))",
+                "GroupBy(Rows(seg), aggregate=Min(field=amount))",
+                "GroupBy(Rows(seg), aggregate=Max(field=amount))",
+                "GroupBy(Rows(seg), having=Condition(count > 15))"):
+        (a,) = ex.execute("i", pql)
+        (b,) = plain.execute("i", pql)
+        fmt = lambda g: [(tuple(fr.row_id for fr in gc.group),
+                          gc.count, gc.agg) for gc in g.groups]
+        assert fmt(a) == fmt(b), pql
