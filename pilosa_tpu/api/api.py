@@ -790,6 +790,12 @@ class API:
                 # quarantine counts — the serving-through-a-sick-device
                 # pane (bench/config28)
                 "deviceHealth": ex.device_health(),
+                # mesh serving (ISSUE 16): device count, shard axis,
+                # per-device resident plane bytes, padded shards —
+                # only present when a placement is wired
+                **({"mesh": mesh_block}
+                   if (mesh_block := ex.mesh_status()) is not None
+                   else {}),
                 **({"clusterHealth": cluster_health}
                    if cluster_health is not None else {}),
                 **({"writeHealth": write_health}
@@ -817,7 +823,7 @@ class API:
                         k: pc[k]
                         for k in ("builds", "buildSeconds", "buildBytes",
                                   "buildFailures", "warmHits",
-                                  "warmMisses")}},
+                                  "warmMisses", "meshed")}},
                 # slow-query visibility: ring totals + the configured
                 # threshold (full records behind GET /debug/slow)
                 "slowQueries": {

@@ -186,13 +186,19 @@ class CountBatcher:
                  stats=None, pipeline_depth: int = 2,
                  solo_fastlane: bool = True,
                  watchdog_s: float = 5.0,
-                 probe_after_s: float = 5.0):
+                 probe_after_s: float = 5.0,
+                 placement_key=None):
         from pilosa_tpu.exec.fused import PingPong
         from pilosa_tpu.exec.health import DeviceHealthGovernor
         from pilosa_tpu.obs import NopStats
         from pilosa_tpu.obs.metrics import (BYTE_BUCKETS, COUNT_BUCKETS,
                                             RATIO_BUCKETS)
         self.fused = fused
+        # placement identity (ISSUE 16 mesh serving): joins every batch
+        # group key, so co-batching / slot unions / plan-cache survival
+        # decisions can never mix items compiled against different
+        # placements.  None single-device — group keys unchanged.
+        self.placement_key = placement_key
         self.adaptive = window_s == "adaptive"
         self.window_s = 0.0 if self.adaptive else float(window_s)
         self._win = 0.0 if self.adaptive else self.window_s
@@ -943,7 +949,10 @@ class CountBatcher:
                 key = ("groupby",) + p.nodes[2]
             else:
                 key = (p.kind, p.leaves[0].shape)
-            groups.setdefault(key, []).append(p)
+            # placement identity rides every group key (kind stays at
+            # key[0] — fallback routing and fill attribution key on it)
+            groups.setdefault(key + (self.placement_key,),
+                              []).append(p)
         # per-shape coalescing attribution (r20): window fill by kind,
         # plus the lifetime count of BSI-aggregate items that joined
         # an existing same-plane group (the co-batch proof counter)
@@ -1345,12 +1354,18 @@ class CountBatcher:
         # compute, so bytes/wall remains the live achieved bandwidth
         t0 = time.perf_counter()
         self._readback(w)
+        wall = time.perf_counter() - t0
+        if self.placement_key is not None and w.pending:
+            # meshed window: the packed read blocks on the program's
+            # residual compute INCLUDING its cross-shard collectives,
+            # so the readback wall is the observable collective +
+            # transfer cost per window on the mesh
+            self.stats.observe("mesh_collective_seconds", wall)
         if w.win_bytes:
             # per-window scan-volume distribution (byte-scale
             # buckets) + the live bandwidth the window achieved
             self.stats.observe("kernel_window_bytes",
                                float(w.win_bytes))
-            wall = time.perf_counter() - t0
             if wall > 0:
                 self.stats.gauge("kernel_bandwidth_gbps",
                                  round(w.win_bytes / wall / 1e9, 4))

@@ -341,7 +341,8 @@ class Executor:
                                      delta_compact_fraction), **kw)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
-        self.fused = FusedCache(stats=self.stats)
+        self.fused = FusedCache(stats=self.stats,
+                                mesh_guard=placement is not None)
         # whole-tree compilation (r16): compound boolean Counts gather
         # rows from the resident plane and fold a postfix program in
         # one fused XLA dispatch.  Off (`tree_fusion=False`) restores
@@ -376,7 +377,16 @@ class Executor:
                 pipeline_depth=dispatch_pipeline_depth,
                 solo_fastlane=solo_fastlane,
                 watchdog_s=dispatch_watchdog_seconds,
-                probe_after_s=device_health_probe_seconds)
+                probe_after_s=device_health_probe_seconds,
+                placement_key=(getattr(placement, "key", None)
+                               if placement is not None else None))
+        # mesh serving telemetry (ISSUE 16): how many chips the plane
+        # axis spans (1 = single-device serving)
+        self.stats.gauge(
+            "mesh_devices",
+            int(getattr(placement, "n_devices", 1)
+                * getattr(placement, "words_size", 1))
+            if placement is not None else 1)
         # query-plan cache (r6 tentpole): (index, normalized PQL,
         # shards, translate flag) -> planned tree + leaf specs, so a
         # repeated serving shape skips parse AND plan entirely (PQL
@@ -425,6 +435,12 @@ class Executor:
                     "inflightWindows": 0, "consecutiveFaults": 0,
                     "watchdogTrips": 0}
         return self.batcher.health_payload()
+
+    def mesh_status(self) -> dict | None:
+        """The ``/status`` ``mesh`` block (ISSUE 16): device count,
+        shard axis, per-device resident plane bytes and padded-shard
+        count — None when serving single-device."""
+        return self.planes.mesh_stats()
 
     # -- in-flight accounting (OOM recovery) --------------------------------
 

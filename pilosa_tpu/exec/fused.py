@@ -15,6 +15,8 @@ sign scalar, see ``engine.bsi.predicate_masks``), so ``amount > 5`` and
 
 from __future__ import annotations
 
+import threading as _threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -36,6 +38,61 @@ from pilosa_tpu.engine import kernels
 
 class Unfusable(Exception):
     """Raised by planners for shapes the fused path doesn't cover."""
+
+
+def sharding_key(arr) -> object:
+    """Hashable sharding identity for program keys (mesh serving).
+
+    A jitted program specializes on its operands' shardings — GSPMD
+    compiles the cross-shard reductions (``sum`` over the shard axis,
+    shard-axis-sum-then-``top_k``) into ICI collectives — so the same
+    shape under two placements is two programs.  Keys carry this
+    alongside shape; single-device arrays map to None so the pre-mesh
+    key space is unchanged."""
+    sh = getattr(arr, "sharding", None)
+    if sh is None:
+        return None
+    try:
+        if len(sh.device_set) <= 1:
+            return None
+        mesh = getattr(sh, "mesh", None)
+        spec = getattr(sh, "spec", None)
+        if mesh is not None:
+            return (tuple(mesh.shape.items()), str(spec))
+        return str(sh)
+    except Exception:  # noqa: BLE001 — identity, best effort
+        return str(sh)
+
+
+#: One launch at a time for collective-bearing (meshed) programs,
+#: process-wide.  Multi-program collectives only compose when every
+#: device sees the programs in the SAME order, so launches must not
+#: interleave across threads; on the host-platform CPU backend the
+#: hazard is harder still — two in-flight 8-device programs split the
+#: per-device execution threads between two AllReduce rendezvous and
+#: deadlock outright (each waits forever for participants the other
+#: run is holding) — so there the launch also drains before the lock
+#: is released.  Module-level: a process may hold several meshed
+#: executors over the same devices.
+_MESH_LAUNCH_LOCK = _threading.Lock()
+
+
+def mesh_serialized(fn):
+    """Wrap a meshed jitted program so launches serialize (and, on the
+    CPU backend, complete) under ``_MESH_LAUNCH_LOCK``.  Applied at
+    cache-insert time by ``FusedCache`` instances serving a placement,
+    so every fused family — including the readback pack — flows
+    through the one choke point."""
+    drain = jax.default_backend() == "cpu"
+
+    def call(*args, **kw):
+        with _MESH_LAUNCH_LOCK:
+            out = fn(*args, **kw)
+            if drain:
+                jax.block_until_ready(out)
+            return out
+
+    return call
 
 
 def pow2_bucket(n: int) -> int:
@@ -202,10 +259,16 @@ class FusedCache:
 
     MAX_PROGRAMS = 256
 
-    def __init__(self, stats=None):
+    def __init__(self, stats=None, mesh_guard: bool = False):
         import threading
         from pilosa_tpu.exec._lru import Stamps
         from pilosa_tpu.obs import NopStats
+        # mesh_guard (r21): this cache compiles collective-bearing
+        # programs (its executor serves a placement), so every program
+        # is wrapped in ``mesh_serialized`` at insert time — launches
+        # stay cross-device-ordered and the CPU backend's rendezvous
+        # deadlock (see _MESH_LAUNCH_LOCK) cannot form.
+        self._mesh_guard = mesh_guard
         self._programs: dict = {}     # key -> jitted fn (GIL-atomic reads)
         self._idx_cache: dict = {}    # padded slot tuple -> device int32
         self._stamps = Stamps()       # approx-LRU recency (lock-free touch)
@@ -265,13 +328,15 @@ class FusedCache:
                 # two windows ago instead of allocating.  Donation is
                 # part of the program, hence part of the key.
                 fn = jax.jit(build(), donate_argnums=donate)
+                if self._mesh_guard:
+                    fn = mesh_serialized(fn)
                 self._insert(key, fn)
         return fn
 
     def run(self, node, leaves, want: str):
         """Execute a planned tree: ``want`` is "words" (bitmap) or
         "count" (fused popcount-reduce scalar)."""
-        key = (node, want)
+        key = (node, sharding_key(leaves[0]) if leaves else None, want)
 
         def build():
             if want == "count":
@@ -303,7 +368,8 @@ class FusedCache:
                 return jnp.stack([kernels.count(_build(n, ls))
                                   for n in nodes])
             return program
-        key = ((nodes, donate_ok), "count-batch")
+        key = ((nodes, donate_ok, sharding_key(leaves[0])),
+               "count-batch")
         if donate_ok:
             return self._cached(key, build,
                                 donate=(n_leaves,))(*leaves, scratch)
@@ -337,7 +403,8 @@ class FusedCache:
                                         axis=0, dtype=jnp.int32))
                 return jnp.stack(rows)
             return program
-        key = (flags, leaves[0].shape, donate_ok, "rowcounts-batch")
+        key = (flags, leaves[0].shape, sharding_key(leaves[0]),
+               donate_ok, "rowcounts-batch")
         # (donate flag inside the key, tag kept LAST — callers
         # introspect the program set by trailing tag)
         if donate_ok:
@@ -395,7 +462,8 @@ class FusedCache:
                      and tuple(scratch.shape) == (bucket,))
         if delta is not None:
             from pilosa_tpu.ingest.delta import adjusted_selected_counts
-            key = (("selcounts-delta", plane.shape, bucket,
+            key = (("selcounts-delta", plane.shape,
+                    sharding_key(plane), bucket,
                     delta.rows.shape[0], sorted_idx, donate_ok),
                    "count")
 
@@ -417,8 +485,8 @@ class FusedCache:
                                                 sorted_idx=sorted_idx),
                     axis=0, dtype=jnp.int32)
             return program
-        key = (("selcounts", plane.shape, bucket, sorted_idx,
-                donate_ok), "count")
+        key = (("selcounts", plane.shape, sharding_key(plane),
+                bucket, sorted_idx, donate_ok), "count")
         if donate_ok:
             return self._cached(key, build, donate=(2,))(plane, idx,
                                                          scratch)
@@ -435,8 +503,8 @@ class FusedCache:
         bucket, filtered, reduce)."""
         from pilosa_tpu.ingest.delta import adjusted_row_counts
         has_filter = filter_words is not None
-        key = (("rowcounts-delta", plane.shape, delta.rows.shape[0],
-                has_filter, reduce), "count")
+        key = (("rowcounts-delta", plane.shape, sharding_key(plane),
+                delta.rows.shape[0], has_filter, reduce), "count")
 
         def build():
             if has_filter:
@@ -480,7 +548,7 @@ class FusedCache:
         padded = (tuple(slots) or (0,)) + \
             ((slots[0] if slots else 0),) * (g_pad - max(1, g))
         has_delta = delta is not None
-        key = (("tree-gather", plane.shape, g_pad,
+        key = (("tree-gather", plane.shape, sharding_key(plane), g_pad,
                 delta.rows.shape[0] if has_delta else None), "words")
 
         def build():
@@ -514,7 +582,7 @@ class FusedCache:
         ex_args = [arg for op, arg in prog
                    if op == kernels.TREE_PUSHX]
         has_ex = ex_stack is not None
-        key = (("tree-item", rows.shape,
+        key = (("tree-item", rows.shape, sharding_key(rows),
                 ex_stack.shape if has_ex else None, skeleton), want)
 
         def build():
@@ -553,7 +621,8 @@ class FusedCache:
                     if op == kernels.TREE_PUSH]
         ex_args = [arg for op, arg in prog if op == kernels.TREE_PUSHX]
         has_delta = delta is not None
-        key = (("tree-solo", plane.shape, len(extras), skeleton,
+        key = (("tree-solo", plane.shape, sharding_key(plane),
+                len(extras), skeleton,
                 delta.rows.shape[0] if has_delta else None), want)
 
         def build():
@@ -665,7 +734,8 @@ class FusedCache:
                 return jnp.concatenate(
                     [x.reshape(-1) for x in xs[:len(shapes)]])
             return program
-        key = (shapes, donate_ok, "readback-pack")
+        key = (shapes, sharding_key(arrays[0]), donate_ok,
+               "readback-pack")
         if donate_ok:
             return self._cached(key, build,
                                 donate=(len(arrays),))(*arrays, scratch)
@@ -690,7 +760,8 @@ class FusedCache:
                         [pos, neg, cnt[..., None]], axis=-1))
                 return jnp.stack(rows)
             return program
-        return self._cached((flags, "sum-batch"), build)(*leaves)
+        return self._cached((flags, sharding_key(leaves[0]),
+                             "sum-batch"), build)(*leaves)
 
     def run_percentile(self, plane, filter_words, nth: float):
         """Percentile in two bounded programs (cached/evicted like every
@@ -715,12 +786,14 @@ class FusedCache:
                     ls[0], ls[1] if has_filter else None, ls[-1])
             return program
 
-        key_t = (("pct-total", plane.shape, has_filter), "pct")
+        key_t = (("pct-total", plane.shape, sharding_key(plane),
+                  has_filter), "pct")
         total = int(self._cached(key_t, total_build)(*args))
         if total == 0:
             return None, 0
         target = min(total, max(1, math.ceil(nth / 100.0 * total)))
-        key_s = (("pct-search", plane.shape, has_filter), "pct")
+        key_s = (("pct-search", plane.shape, sharding_key(plane),
+                  has_filter), "pct")
         out = self._cached(key_s, search_build)(*args, jnp.int32(target))
         return out, total
 
@@ -771,7 +844,8 @@ class FusedCache:
         shape and decode stay identical."""
         n_filters = len(filters)
         bucket, delta_ops = self._delta_args(delta)
-        key = (("sum-plane", plane.shape, flags, bucket), "agg")
+        key = (("sum-plane", plane.shape, sharding_key(plane), flags,
+                bucket), "agg")
 
         def build():
             def program(p, *rest):
@@ -809,7 +883,8 @@ class FusedCache:
         rows)."""
         n_filters = len(filters)
         bucket, delta_ops = self._delta_args(delta)
-        key = (("minmax-plane", plane.shape, flags, bucket), "agg")
+        key = (("minmax-plane", plane.shape, sharding_key(plane),
+                flags, bucket), "agg")
 
         def build():
             def pack(mm):
@@ -858,7 +933,8 @@ class FusedCache:
         plane-batch families."""
         bucket, delta_ops = self._delta_args(delta)
         n_ops = len(operands)
-        key = (("range-plane", plane.shape, specs, bucket), "count")
+        key = (("range-plane", plane.shape, sharding_key(plane),
+                specs, bucket), "count")
 
         def build():
             def program(p, *rest):
@@ -911,6 +987,7 @@ class FusedCache:
         bucket, delta_ops = self._delta_args(
             delta if has_agg else None)
         key = (("groupby", tuple(p.shape for p in planes),
+                sharding_key(last_plane),
                 combo_idx.shape, last_plane.shape, has_filter,
                 agg_plane.shape if has_agg else None, agg, bucket),
                "agg")

@@ -212,10 +212,13 @@ class PlaneCache:
         # resident plane's pending write cells, keyed like _entries;
         # the stored tuple is (base plane array, DeltaMirror) — a
         # rebuilt base invalidates its mirror by identity.  Meshed
-        # placements keep the pre-r15 incremental-scatter path (the
-        # overlay's flat-index math assumes one logical device array).
-        self.delta_cells = (int(delta_cells)
-                            if placement is None else 0)
+        # placements participate too (r21): the overlay's flat-index
+        # math is LOGICAL-array math, so with the overlay arrays
+        # replicated across the mesh (``MeshPlacement.replicate``)
+        # base⊕delta stays one GSPMD program over the sharded base —
+        # sustained ingest keeps the zero-rebuild guarantee on 1 chip
+        # or 8.
+        self.delta_cells = int(delta_cells)
         self.delta_compact_fraction = float(delta_compact_fraction)
         self._delta_mirrors: dict[tuple, tuple] = {}
         self._compacting: dict[tuple, threading.Thread] = {}
@@ -271,7 +274,9 @@ class PlaneCache:
                     shards: tuple[int, ...]) -> PlaneSet:
         """Whole-view plane (TopN / Rows / GroupBy path)."""
         key = ("plane", index, field.name, view_name, shards)
-        return self._get(key, field, view_name, shards, self._build_plane)
+        build = (self._build_plane_meshed if self.placement is not None
+                 else self._build_plane)
+        return self._get(key, field, view_name, shards, build)
 
     def bsi_plane(self, index: str, field: Field,
                   shards: tuple[int, ...]) -> PlaneSet:
@@ -383,8 +388,11 @@ class PlaneCache:
             self.misses += 1
             return None
         if est <= self.SYNC_BUILD_MAX or self.placement is not None:
-            # small plane, or meshed placement (sharded device zeros +
-            # donated updates aren't wired for mesh shardings): inline
+            # small plane, or meshed placement: inline — meshed builds
+            # go through _build_plane_meshed (parallel expansion, one
+            # sharded device_put, the pipeline's build metrics); the
+            # chunked donated-update pipeline isn't wired for mesh
+            # shardings
             return self.field_plane(index, field, view_name, shards)
         self.misses += 1
         with self._lock:
@@ -971,6 +979,9 @@ class PlaneCache:
                     "buildFailures": self.build_failures,
                     "warmHits": self.warm_hits,
                     "warmMisses": self.warm_misses,
+                    # meshed (ISSUE 16): builds land sharded across a
+                    # placement (the inline meshed build path)
+                    "meshed": self.placement is not None,
                     # r15 ingest: device delta overlays (writes served
                     # as base⊕delta without rebuild stalls)
                     "delta": self.delta_stats()}
@@ -1224,6 +1235,7 @@ class PlaneCache:
              else np.zeros((0, ps.plane.shape[-1]), np.uint32)))
         if kind == "row":
             new_plane = new_plane[:, 0, :]
+        new_plane = self._repin(new_plane, ps.plane)
         new_ps = PlaneSet(new_plane, ps.shards, ps.row_ids, ps.slot_of)
         with self._lock:
             cur = self._entries.get(key)
@@ -1301,6 +1313,28 @@ class PlaneCache:
             return None
         return self._fold(key, field, view_name, shards, hit)
 
+    def _overlay_put(self):
+        """Placement for overlay device arrays: replicated across the
+        mesh when one exists, plain ``device_put`` otherwise."""
+        p = self.placement
+        if p is not None and hasattr(p, "replicate"):
+            return p.replicate
+        return jax.device_put
+
+    def _repin(self, arr, like):
+        """Keep a refreshed plane on its predecessor's sharding: the
+        scatter's output layout is GSPMD's choice, and fused program
+        keys carry sharding identity (``exec.fused.sharding_key``) —
+        a drifted layout would recompile every family for the plane."""
+        if self.placement is None:
+            return arr
+        try:
+            if arr.sharding == like.sharding:
+                return arr
+            return jax.device_put(arr, like.sharding)
+        except Exception:  # noqa: BLE001 — best-effort pinning
+            return arr
+
     def _delta_absorb(self, key, field: Field, view_name: str,
                       shards: tuple[int, ...], hit):
         """Absorb journal cells into the plane's bounded device
@@ -1337,16 +1371,20 @@ class PlaneCache:
             if not mirror.would_fit(cells):
                 return None  # overlay full: fold/compact
             mirror.absorb(cells)
+            # overlay arrays are tiny — under a mesh they replicate
+            # (one copy per chip) so the merge with the shard-sharded
+            # base compiles without a host round trip
+            put = self._overlay_put()
             if key[0] == "bsi":
                 # bit-sliced planes overlay by touched word COLUMN
                 # (the aggregate kernels read whole columns) — see
                 # ingest.delta.BsiOverlay
                 overlay = mirror.build_bsi_overlay(
-                    jax.device_put, ps.plane.shape[1],
+                    put, ps.plane.shape[1],
                     ps.plane.shape[0])
             else:
                 overlay = mirror.build_overlay(
-                    jax.device_put,
+                    put,
                     ps.plane.shape[0] * ps.plane.shape[1])
             new_ps = PlaneSet(ps.plane, ps.shards, ps.row_ids,
                               ps.slot_of, delta=overlay)
@@ -1432,6 +1470,7 @@ class PlaneCache:
                 np.asarray(reset_rows, np.int32),
                 (np.stack([rv for _, rv in resets]) if resets
                  else np.zeros((0, ps.plane.shape[-1]), np.uint32)))
+            new_plane = self._repin(new_plane, ps.plane)
             new_ps = PlaneSet(new_plane, ps.shards, ps.row_ids,
                               ps.slot_of)
         with self._lock:
@@ -1526,6 +1565,89 @@ class PlaneCache:
         self._stats.observe("plane_build_seconds", dt)
         self._stats.count("plane_build_bytes_total", host.nbytes)
         return ps
+
+    def _build_plane_meshed(self, field: Field, view_name: str,
+                            shards: tuple[int, ...]) -> PlaneSet:
+        """Meshed inline build (ISSUE 16 satellite): fragments expand
+        CONCURRENTLY on the builder pool (native decode straight into
+        the host slab, dense sidecars honored) and the slab lands in
+        ONE sharded ``device_put`` — the chunked donated-update
+        pipeline assumes a single-device layout, so meshed builds get
+        their own path that still pays into the PR 5 build telemetry
+        (``plane_build_seconds``/``plane_build_bytes_total``) instead
+        of bypassing it silently."""
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+        from functools import partial
+        t0 = _time.perf_counter()
+        view = field.view(view_name)
+        row_ids = self._union_row_ids(field, view_name, shards)
+        r_pad = _pow2(max(1, len(row_ids)))
+        host = np.zeros((len(shards), r_pad, WORDS_PER_SHARD),
+                        dtype=np.uint32)
+        slot_of = {int(r): i for i, r in enumerate(row_ids)}
+        slots = np.arange(len(row_ids), dtype=np.uint64)
+        tasks = []
+        if view is not None and len(row_ids):
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue  # padding stays all-zero words
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                tasks.append(partial(
+                    frag.expand_rows_into, row_ids, host[si], slots,
+                    sidecar=self.sidecars))
+        if tasks:
+            with ThreadPoolExecutor(
+                    max_workers=self.BUILD_WORKERS) as pool:
+                self._expand_tasks(pool, tasks)
+        ps = PlaneSet(self.place(host), shards, row_ids, slot_of)
+        dt = _time.perf_counter() - t0
+        with self._lock:
+            self.builds += 1
+            self.build_seconds_total += dt
+            self.build_bytes_total += host.nbytes
+        self._stats.observe("plane_build_seconds", dt)
+        self._stats.count("plane_build_bytes_total", host.nbytes)
+        return ps
+
+    def mesh_stats(self) -> dict | None:
+        """/status ``mesh`` block (ISSUE 16): device count, shard
+        axis, per-device resident plane bytes, padded-shard count —
+        None when serving single-device.  Also refreshes the
+        ``plane_shard_bytes{device}`` gauges so /metrics shows the
+        HBM spread across the mesh."""
+        p = self.placement
+        if p is None:
+            return None
+        with self._lock:
+            entries = [e[1] for e in self._entries.values()]
+        per_dev: dict[str, int] = {}
+        padded = 0
+        seen = set()
+        for ps in entries:
+            for s in getattr(ps, "shards", ()):
+                if s == PAD_SHARD:
+                    padded += 1
+            plane = getattr(ps, "plane", None)
+            if plane is None or id(plane) in seen:
+                continue
+            seen.add(id(plane))
+            try:
+                for sh in plane.addressable_shards:
+                    d = str(sh.device)
+                    per_dev[d] = per_dev.get(d, 0) + int(sh.data.nbytes)
+            except Exception:  # noqa: BLE001 — telemetry best effort
+                continue
+        for d, b in per_dev.items():
+            self._stats.gauge("plane_shard_bytes", b, device=d)
+        n_dev = int(getattr(p, "n_devices", 1)
+                    * getattr(p, "words_size", 1))
+        axis = getattr(p, "axis", None) or getattr(p, "shard_axis",
+                                                   "shard")
+        return {"devices": n_dev, "axis": axis,
+                "perDeviceBytes": per_dev, "paddedShards": padded}
 
     def _build_bsi(self, field: Field, view_name: str,
                    shards: tuple[int, ...]) -> PlaneSet:

@@ -16,13 +16,18 @@ import time
 from pilosa_tpu import __version__
 
 
-def build_payload(holder, cluster=None, stats=None, slow_log=None) -> dict:
+def build_payload(holder, cluster=None, stats=None, slow_log=None,
+                  executor=None) -> dict:
     """Anonymized usage snapshot (counts only, no names/keys).  With
     ``stats``, includes the per-stage query-overhead summary
     (``query_stage_seconds``) so a payload doubles as the serving-path
     attribution dump; with ``slow_log`` (a
     :class:`pilosa_tpu.obs.SlowQueryLog`), the slow-query counters
-    (totals and slowest only — never PQL text, which may carry keys)."""
+    (totals and slowest only — never PQL text, which may carry keys);
+    with ``executor`` (a meshed :class:`pilosa_tpu.exec.Executor`),
+    the ``mesh`` serving block (device count, shard axis, per-device
+    resident plane bytes, padded-shard count — byte counts only,
+    never data)."""
     n_fields = 0
     n_shards = 0
     field_types: dict[str, int] = {}
@@ -84,6 +89,13 @@ def build_payload(holder, cluster=None, stats=None, slow_log=None) -> dict:
         payload["numDevices"] = jax.device_count()
     except Exception:  # noqa: BLE001 — diagnostics must never break serving
         pass
+    if executor is not None:
+        try:
+            mesh = executor.mesh_status()
+            if mesh is not None:
+                payload["mesh"] = mesh
+        except Exception:  # noqa: BLE001 — diagnostics never break serving
+            pass
     return payload
 
 
@@ -92,11 +104,13 @@ class Diagnostics:
     (upstream default-on behavior deliberately inverted)."""
 
     def __init__(self, holder, cluster=None, interval: float = 0.0,
-                 send=None, logger=None, stats=None, slow_log=None):
+                 send=None, logger=None, stats=None, slow_log=None,
+                 executor=None):
         self.holder = holder
         self.cluster = cluster
         self.stats = stats
         self.slow_log = slow_log
+        self.executor = executor
         self.interval = interval
         self.send = send or self._log_sink
         self.logger = logger
@@ -120,7 +134,8 @@ class Diagnostics:
             try:
                 self.send(build_payload(self.holder, self.cluster,
                                         stats=self.stats,
-                                        slow_log=self.slow_log))
+                                        slow_log=self.slow_log,
+                                        executor=self.executor))
             except Exception:  # noqa: BLE001
                 pass
 

@@ -50,6 +50,22 @@ class MeshPlacement:
     def place(self, host_array: np.ndarray) -> jax.Array:
         return jax.device_put(host_array, self.sharding(host_array.ndim))
 
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def replicate(self, host_array) -> jax.Array:
+        """device_put with a fully-replicated sharding — overlay arrays
+        (delta rows / BSI word-columns) stay one copy per chip so
+        base⊕delta compiles into a single GSPMD program with the
+        sharded base plane."""
+        return jax.device_put(host_array, self.replicated_sharding())
+
+    @property
+    def key(self) -> tuple:
+        """Hashable placement identity for program-cache / batch-group
+        keys: same mesh topology ⇒ same compiled programs."""
+        return ("mesh1d", self.axis, self.n_devices)
+
 
 WORDS_AXIS = "words"
 
@@ -98,6 +114,17 @@ class MeshPlacement2D:
 
     def place(self, host_array: np.ndarray) -> jax.Array:
         return jax.device_put(host_array, self.sharding(host_array.ndim))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def replicate(self, host_array) -> jax.Array:
+        return jax.device_put(host_array, self.replicated_sharding())
+
+    @property
+    def key(self) -> tuple:
+        return ("mesh2d", self.shard_axis, self.words_axis,
+                self.n_devices, self.words_size)
 
 
 def local_placement() -> MeshPlacement | None:

@@ -175,7 +175,8 @@ class PilosaTPUServer:
             self.holder, self.cluster,
             interval=self.cfg.diagnostics_interval,
             logger=self.logger, stats=self.stats,
-            slow_log=self.api.slow_log).start()
+            slow_log=self.api.slow_log,
+            executor=self.executor).start()
         return self
 
     def close(self) -> None:

@@ -596,3 +596,46 @@ def test_config30_pql_surface_smoke():
     assert d["bsi_batch_hits"] > 0
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config31_mesh_serving_smoke():
+    """bench/config31 (mesh-sharded fused serving, r16) in --smoke
+    mode: config30's mixed workload on a 1-device executor vs an
+    8-device virtual CPU mesh over the same holder.  The ISSUE 16
+    acceptance bars are asserted IN-BENCH — oracle-exact answers on
+    sharded planes live and quiesced, ZERO base-plane rebuilds under
+    sustained ingest (the replicated overlay absorbs every write),
+    co-batching + one packed readback per window on the meshed
+    pipeline — and re-checked here on the artifact."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config31_mesh_serving.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("mesh_serving_qps")
+    assert out["unit"] == "qps" and out["value"] > 0
+    d = out["detail"]
+    # both tables measured: every shape has qps on 1 chip AND 8 chips
+    for table in ("single", "mesh"):
+        assert set(d[table]) == {"count", "range", "sum", "min", "max",
+                                 "groupby", "topn"}
+        assert all(v["qps"] > 0 for v in d[table].values())
+        assert all(v["gbps"] >= 0 for v in d[table].values())
+    # the r16 contracts, re-checked on the artifact
+    assert d["mesh_devices"] == 8
+    assert d["padded_shards"] > 0  # shard count not divisible by 8
+    assert d["plane_rebuilds_during_serving"] == 0
+    assert d["mixed_under_ingest"]["qps"] > 0
+    assert d["mixed_under_ingest"]["write_batches"] > 0
+    assert d["delta_absorbs"] >= 1
+    assert d["bsi_batch_hits"] > 0
+    assert d["packed_readbacks"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -1,0 +1,382 @@
+"""Mesh-sharded fused serving (r16): the device-speed stack — fused
+count/tree/aggregate/TopN/GroupBy batches, delta overlays, the
+dispatch-window batcher — running over an 8-device virtual mesh with
+the single-device executor as bit-exact oracle.
+
+What is pinned here, per the r16 acceptance bar:
+
+* every fused family answers bit-exactly on sharded planes (the
+  cross-shard reduce is compiled INTO the jitted program, not a host
+  combine over per-device readbacks);
+* PAD_SHARD all-zero padding shards (12 data shards over 8 devices)
+  are provably inert through Count/Sum/Min/Max/TopN/GroupBy;
+* BOTH overlay kinds (set-field DeltaOverlay, BSI BsiOverlay) stay
+  enabled under placement — interleaved ingest absorbs into replicated
+  overlays with ZERO base-plane rebuilds;
+* concurrent same-plane aggregates still coalesce into shared dispatch
+  windows (``pipeline_window_fill`` > 1) on the meshed batcher;
+* the mesh telemetry surface (``Executor.mesh_status``, plane-cache
+  ``meshed`` flag, diagnostics payload) reports the placement.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.parallel import MeshPlacement
+from pilosa_tpu.store import FieldOptions, Holder
+
+N_SHARDS = 12   # not a multiple of 8 — every plane carries pad shards
+N_BITS = 6000
+N_VALUED = 1500
+INDEX = "i"
+
+
+@pytest.fixture(scope="module")
+def placement():
+    assert jax.device_count() == 8, "conftest must force 8 CPU devices"
+    return MeshPlacement(jax.devices())
+
+
+@pytest.fixture
+def served(tmp_path, rng):
+    """Holder spread over 12 shards: a segment field (8 rows), a
+    second set field for tree shapes, and a BSI int field.  Returns
+    (holder, index, truth) where truth carries the numpy oracle for
+    the pad-shard inertness checks."""
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index(INDEX)
+    idx.create_field("seg")
+    idx.create_field("g")
+    idx.create_field("amount", FieldOptions(type="int", min=-2000,
+                                            max=2000))
+    cols = rng.choice(N_SHARDS * SHARD_WIDTH, size=N_BITS,
+                      replace=False).astype(np.uint64)
+    rows = rng.integers(0, 8, size=N_BITS).astype(np.uint64)
+    idx.field("seg").import_bits(rows, cols)
+    half = cols[: N_BITS // 2]
+    idx.field("g").import_bits(np.ones(len(half), np.uint64), half)
+    vcols = cols[:N_VALUED]
+    vals = rng.integers(-500, 500, size=N_VALUED)
+    idx.field("amount").import_values(vcols, vals)
+    idx.note_columns(cols)
+    truth = {
+        "seg": {r: set(cols[rows == r].tolist()) for r in range(8)},
+        "vals": dict(zip(vcols.tolist(), (int(v) for v in vals))),
+    }
+    return h, idx, truth
+
+
+QUERIES = [
+    "Count(Row(seg=1))",
+    "Count(Intersect(Row(seg=1), Row(g=1)))",
+    "Count(Union(Row(seg=0), Row(seg=2), Row(g=1)))",
+    "Count(Xor(Row(seg=3), Row(g=1)))",
+    "Count(Difference(Row(seg=1), Row(g=1)))",
+    "Count(Row(amount > 0))",
+    "Count(Row(-250 <= amount <= 250))",
+    "Sum(field=amount)",
+    "Sum(Row(seg=1), field=amount)",
+    "Min(field=amount)",
+    "Max(field=amount)",
+    "Min(Row(g=1), field=amount)",
+    "Max(Row(g=1), field=amount)",
+]
+
+
+def canon_groups(res):
+    return sorted(
+        (tuple((fr.field, fr.row_id) for fr in gc.group), gc.count,
+         gc.agg)
+        for gc in res.groups)
+
+
+def canon_pairs(res):
+    return sorted(((p.count, p.id) for p in res.pairs),
+                  key=lambda t: (-t[0], t[1]))
+
+
+class TestMeshedFusedEquivalence:
+    """Every fused family, meshed vs single-device, bit-exact."""
+
+    def test_counts_trees_aggregates(self, served, placement):
+        h, _, _ = served
+        plain = Executor(h)
+        meshed = Executor(h, placement=placement)
+        for pql in QUERIES:
+            assert plain.execute(INDEX, pql) == \
+                meshed.execute(INDEX, pql), pql
+
+    def test_topn(self, served, placement):
+        h, _, _ = served
+        plain = Executor(h)
+        meshed = Executor(h, placement=placement)
+        for pql in ["TopN(seg)", "TopN(seg, n=3)",
+                    "TopN(seg, Row(g=1))"]:
+            (a,) = plain.execute(INDEX, pql)
+            (b,) = meshed.execute(INDEX, pql)
+            assert canon_pairs(a) == canon_pairs(b), pql
+
+    def test_groupby(self, served, placement):
+        h, _, _ = served
+        plain = Executor(h)
+        meshed = Executor(h, placement=placement)
+        for pql in ["GroupBy(Rows(seg))",
+                    "GroupBy(Rows(seg), aggregate=Sum(field=amount))",
+                    "GroupBy(Rows(seg), aggregate=Count())",
+                    "GroupBy(Rows(seg), having=Condition(count > 15))"]:
+            (a,) = plain.execute(INDEX, pql)
+            (b,) = meshed.execute(INDEX, pql)
+            assert canon_groups(a) == canon_groups(b), pql
+
+    def test_batched_concurrent_queries_match(self, served, placement):
+        """Same-plane queries issued concurrently go through the
+        dispatch-window batcher; every answer must still match the
+        single-device oracle."""
+        h, _, _ = served
+        plain = Executor(h)
+        meshed = Executor(h, placement=placement, max_concurrent=16)
+        want = {pql: plain.execute(INDEX, pql) for pql in QUERIES}
+        # compile every meshed program serially first: the storm below
+        # measures batched serving, not a concurrent compile pile-up
+        # tripping the dispatch watchdog
+        for pql in QUERIES:
+            assert meshed.execute(INDEX, pql) == want[pql], pql
+        errs: list[str] = []
+
+        def worker(i):
+            for k in range(len(QUERIES)):
+                pql = QUERIES[(i + k) % len(QUERIES)]
+                try:
+                    if meshed.execute(INDEX, pql) != want[pql]:
+                        errs.append(pql)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"{pql}: {e!r}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, f"meshed batched mismatches: {errs[:5]}"
+
+
+class TestPadShardInertness:
+    """Satellite 1: 12 shards over 8 devices pads to 16 with
+    PAD_SHARD all-zero planes — the padding must be provably inert
+    through every aggregate family, pinned against the numpy oracle
+    (not just the single-device executor)."""
+
+    def test_count_oracle(self, served, placement):
+        h, _, truth = served
+        ex = Executor(h, placement=placement)
+        for r in range(8):
+            assert ex.execute(INDEX, f"Count(Row(seg={r}))") == \
+                [len(truth["seg"][r])]
+
+    def test_sum_min_max_oracle(self, served, placement):
+        h, _, truth = served
+        ex = Executor(h, placement=placement)
+        vals = list(truth["vals"].values())
+        (s,) = ex.execute(INDEX, "Sum(field=amount)")
+        assert (s.value, s.count) == (sum(vals), len(vals))
+        (mn,) = ex.execute(INDEX, "Min(field=amount)")
+        (mx,) = ex.execute(INDEX, "Max(field=amount)")
+        assert (mn.value, mx.value) == (min(vals), max(vals))
+
+    def test_topn_groupby_oracle(self, served, placement):
+        h, _, truth = served
+        ex = Executor(h, placement=placement)
+        want = sorted(((len(truth["seg"][r]), r) for r in range(8)),
+                      key=lambda t: (-t[0], t[1]))
+        (tn,) = ex.execute(INDEX, "TopN(seg)")
+        assert canon_pairs(tn) == want
+        (gb,) = ex.execute(INDEX, "GroupBy(Rows(seg))")
+        got = {g[0][1]: c for g, c, _ in canon_groups(gb)}
+        assert got == {r: len(truth["seg"][r]) for r in range(8)
+                       if truth["seg"][r]}
+
+    def test_empty_filter_min_unshifted(self, served, placement):
+        """A Min/Max over an empty filter must report count == 0 — an
+        all-zero pad shard contributing a phantom zero value would
+        surface here as a nonzero count or a zero min."""
+        h, _, _ = served
+        ex = Executor(h, placement=placement)
+        plain = Executor(h)
+        for pql in ["Min(Row(seg=99), field=amount)",
+                    "Max(Row(seg=99), field=amount)",
+                    "Sum(Row(seg=99), field=amount)"]:
+            (a,) = ex.execute(INDEX, pql)
+            (b,) = plain.execute(INDEX, pql)
+            assert a.count == 0, pql
+            assert (a.value, a.count) == (b.value, b.count), pql
+
+
+class TestMeshOverlays:
+    """Tentpole: BOTH overlay kinds stay enabled under placement —
+    interleaved ingest absorbs into replicated device overlays and
+    base planes are never rebuilt."""
+
+    def test_bsi_overlay_zero_rebuild(self, served, placement):
+        h, idx, truth = served
+        ex = Executor(h, placement=placement)
+        # warm the BSI aggregate plane, then ingest into live columns
+        (s0,) = ex.execute(INDEX, "Sum(field=amount)")
+        builds0 = ex.planes.builds
+        absorbs0 = ex.planes.delta_absorbs
+        wcols = list(truth["vals"])[:64]
+        wvals = [int(v) for v in range(1, 65)]
+        idx.field("amount").import_values(np.array(wcols, np.uint64),
+                                          wvals)
+        truth["vals"].update(zip(wcols, wvals))
+        vals = list(truth["vals"].values())
+        (s1,) = ex.execute(INDEX, "Sum(field=amount)")
+        assert (s1.value, s1.count) == (sum(vals), len(vals))
+        (mn,) = ex.execute(INDEX, "Min(field=amount)")
+        assert mn.value == min(vals)
+        (rc,) = ex.execute(INDEX, "Count(Row(amount > 0))")
+        assert rc == sum(1 for v in vals if v > 0)
+        assert ex.planes.builds == builds0, \
+            "BSI ingest forced a base-plane rebuild on the mesh"
+        assert ex.planes.delta_absorbs > absorbs0, \
+            "BSI overlay never absorbed under placement"
+
+    def test_set_overlay_zero_rebuild(self, served, placement):
+        """The set-field DeltaOverlay rides the whole-view "plane"
+        entries (TopN/GroupBy path): warm TopN, Set new bits, and the
+        stale plane must absorb into its replicated overlay instead of
+        rebuilding."""
+        h, _, truth = served
+        ex = Executor(h, placement=placement)
+        (t0,) = ex.execute(INDEX, "TopN(seg)")  # warms the "plane" entry
+        (c0,) = ex.execute(INDEX, "Count(Row(seg=1))")
+        assert c0 == len(truth["seg"][1])
+        builds0 = ex.planes.builds
+        absorbs0 = ex.planes.delta_absorbs
+        # new bits in already-resident shards only (fresh shards would
+        # legitimately change the plane shape and force a rebuild)
+        all_set = set().union(*truth["seg"].values())
+        existing = sorted(truth["seg"][1])
+        newcols = [c + 1 for c in existing[:48]
+                   if (c + 1) not in all_set
+                   and (c + 1) % SHARD_WIDTH != 0][:32]
+        for c in newcols:
+            assert ex.execute(INDEX, f"Set({c}, seg=1)") == [True]
+        truth["seg"][1].update(newcols)
+        (t1,) = ex.execute(INDEX, "TopN(seg)")
+        want = sorted(((len(truth["seg"][r]), r) for r in range(8)),
+                      key=lambda t: (-t[0], t[1]))
+        assert canon_pairs(t1) == want
+        (c1,) = ex.execute(INDEX, "Count(Row(seg=1))")
+        assert c1 == len(truth["seg"][1])
+        assert ex.planes.builds == builds0, \
+            "Set ingest forced a base-plane rebuild on the mesh"
+        assert ex.planes.delta_absorbs > absorbs0, \
+            "set-field overlay never absorbed under placement"
+
+
+class TestMeshWindowFill:
+    """Satellite 3: concurrent same-plane aggregates must still
+    coalesce into shared dispatch windows on the meshed batcher —
+    one compiled program (with its in-program cross-shard reduce)
+    dispatched per window, not one per query."""
+
+    def test_window_fill_above_one(self, served, placement):
+        h, _, _ = served
+        stats = Stats()
+        ex = Executor(h, placement=placement, stats=stats,
+                      max_concurrent=32)
+        for pql in ("Sum(field=amount)", "Count(Row(seg=1))"):
+            ex.execute(INDEX, pql)  # warm programs first
+
+        def storm():
+            barrier = threading.Barrier(8)
+
+            def worker():
+                barrier.wait()
+                for _ in range(4):
+                    ex.execute(INDEX, "Sum(field=amount)")
+                    ex.execute(INDEX, "Count(Row(seg=1))")
+
+            ts = [threading.Thread(target=worker) for _ in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        filled = False
+        for _ in range(5):
+            storm()
+            summ = stats.histogram_summary("pipeline_window_fill")
+            if any(v["sum"] > v["count"] for v in summ.values()):
+                filled = True
+                break
+        assert filled, \
+            "no dispatch window ever coalesced >1 item on the mesh"
+        # the collective wall-clock metric must flow on meshed windows
+        assert stats.histogram_summary("mesh_collective_seconds"), \
+            "mesh_collective_seconds never observed"
+        snap = stats.snapshot()
+        assert snap["gauges"].get("mesh_devices", {}).get((), 0) == 8
+
+
+class TestMeshTelemetry:
+    """Satellites 2 + 6: the placement is visible — mesh_status()
+    payload, per-device resident bytes, pad-shard count, the
+    plane-build metrics from the meshed inline builder, and the
+    diagnostics payload plumbing."""
+
+    def test_mesh_status_payload(self, served, placement):
+        h, _, _ = served
+        stats = Stats()
+        ex = Executor(h, placement=placement, stats=stats)
+        ex.execute(INDEX, "Count(Row(seg=1))")
+        ex.execute(INDEX, "Sum(field=amount)")
+        ms = ex.mesh_status()
+        assert ms is not None
+        assert ms["devices"] == 8
+        assert ms["axis"]
+        assert ms["paddedShards"] > 0  # 12 shards pad to 16
+        per = ms["perDeviceBytes"]
+        assert len(per) == 8 and all(b > 0 for b in per.values())
+        # the per-device gauge mirrors the payload
+        shard_bytes = {k: v for k, v in
+                       stats.snapshot()["gauges"].get(
+                           "plane_shard_bytes", {}).items()}
+        assert len(shard_bytes) == 8
+        assert ex.planes.stats()["meshed"] is True
+
+    def test_unmeshed_has_no_mesh_block(self, served):
+        h, _, _ = served
+        ex = Executor(h)
+        assert ex.mesh_status() is None
+        assert ex.planes.stats()["meshed"] is False
+
+    def test_meshed_build_metrics(self, served, placement):
+        h, _, _ = served
+        stats = Stats()
+        ex = Executor(h, placement=placement, stats=stats)
+        # TopN builds the whole-view plane through the meshed inline
+        # builder (parallel fragment expansion + one sharded put)
+        ex.execute(INDEX, "TopN(seg)")
+        snap = stats.snapshot()
+        built = snap["counters"].get("plane_build_bytes_total", {})
+        assert sum(built.values()) > 0, \
+            "meshed inline build bypassed plane_build_bytes_total"
+        assert stats.histogram_summary("plane_build_seconds"), \
+            "meshed inline build bypassed plane_build_seconds"
+
+    def test_diagnostics_payload_mesh_block(self, served, placement):
+        from pilosa_tpu.obs.diagnostics import build_payload
+        h, _, _ = served
+        ex = Executor(h, placement=placement)
+        ex.execute(INDEX, "Count(Row(seg=1))")
+        payload = build_payload(h, executor=ex)
+        assert payload["mesh"]["devices"] == 8
+        assert payload["mesh"]["paddedShards"] > 0
