@@ -31,6 +31,7 @@ from pilosa_tpu.store import FieldOptions, Holder
 from pilosa_tpu.store.field import BSI_TYPES
 from pilosa_tpu.store.health import StorageFaultError
 from pilosa_tpu.store.view import VIEW_STANDARD
+from pilosa_tpu.tenancy import TenantThrottledError
 
 
 class ApiError(Exception):
@@ -92,6 +93,19 @@ class ApiError(Exception):
                    extra={"writeUnavailable": {
                        "op": exc.op, "replica": exc.replica,
                        "reason": exc.reason}})
+
+    @classmethod
+    def tenant_throttled(cls, exc) -> "ApiError":
+        """A per-tenant QoS shed (r17 tenancy): the tenant exceeded
+        ITS qps/slot quota — same 503 + Retry-After contract as
+        executor saturation, but with a structured
+        ``tenantThrottled{tenant, quota, kind}`` body so the client
+        can tell its own quota from server overload."""
+        return cls(str(exc), 503,
+                   retry_after=getattr(exc, "retry_after", 1.0),
+                   extra={"tenantThrottled": {
+                       "tenant": exc.tenant, "quota": exc.quota,
+                       "kind": exc.kind}})
 
     @classmethod
     def storage_fault(cls, exc) -> "ApiError":
@@ -381,6 +395,12 @@ class API:
             # or the target fragment quarantined): structured 507/503,
             # never a generic 500 (r19)
             return {}, ApiError.storage_fault(e)
+        except TenantThrottledError as e:
+            # the tenant's OWN quota shed this query (r17): 503 +
+            # Retry-After with the structured tenantThrottled body —
+            # never the generic 400 below (it is not a client mistake)
+            # and never confusable with whole-server saturation
+            return {}, ApiError.tenant_throttled(e)
         except (ParseError, ExecutionError) as e:
             return {}, ApiError(str(e), 400)
 
@@ -832,6 +852,10 @@ class API:
                 # HBM working set (reference: /status occupancy; the
                 # device plane cache is the resident working set here)
                 "planeCache": pc,
+                # multi-tenant economy (r17): paging state, per-tenant
+                # residency/hit-ratio/page-ins/sheds, QoS quotas,
+                # eviction reasons
+                "tenancy": ex.tenancy_status(),
                 # per-stage overhead attribution (parse/plan/admit/
                 # dispatch/read/assemble) — the diagnostics dump behind
                 # bench/config18's concurrency-gap breakdown

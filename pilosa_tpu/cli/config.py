@@ -152,6 +152,24 @@ class Config:
     # freed and restores healthy serving.
     disk_min_free_bytes: int = 64 << 20
     disk_probe_seconds: float = 5.0
+    # Multi-tenant HBM economy (r17 — tenant = index name).
+    # plane_paging: a plane past the HBM budget (or its tenant's byte
+    # quota) serves PAGED — fixed-byte shard pages resident on device,
+    # the host oracle covering the rest, bit-exact; single-device only
+    # (a mesh placement disables it).  plane_page_bytes sizes one page
+    # (smaller = finer residency control, more page-ins).
+    plane_paging: bool = True
+    plane_page_bytes: int = 64 << 20
+    # Per-tenant quotas, all 0 = off.  tenant_byte_quota caps one
+    # tenant's resident plane/page bytes (page-ins evict the tenant's
+    # OWN coldest entries first, then fall back to the oracle).
+    # tenant_qps_quota / tenant_slot_quota shed an over-quota tenant's
+    # queries with a structured tenantThrottled 503 + Retry-After
+    # BEFORE they take an executor slot — other tenants keep their
+    # admission floors.
+    tenant_byte_quota: int = 0
+    tenant_qps_quota: float = 0.0
+    tenant_slot_quota: int = 0
     # Warm dense-plane cache: cold plane builds persist generation-
     # keyed dense sidecar images (<fragment>.dense) so a restarted
     # node re-expands at near raw-copy speed instead of re-decoding

@@ -298,7 +298,12 @@ class Executor:
                  dispatch_pipeline_depth: int = 2,
                  solo_fastlane: bool = True,
                  dispatch_watchdog_seconds: float = 30.0,
-                 device_health_probe_seconds: float = 5.0):
+                 device_health_probe_seconds: float = 5.0,
+                 plane_paging: bool = True,
+                 plane_page_bytes: int = 64 << 20,
+                 tenant_byte_quota: int = 0,
+                 tenant_qps_quota: float = 0.0,
+                 tenant_slot_quota: int = 0):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -323,7 +328,17 @@ class Executor:
         ``device_health_probe_seconds`` (r18): how long degraded
         serving (per-item fallback execution after consecutive
         dispatch faults / watchdog trips) lasts before one window
-        probes the fused pipeline again."""
+        probes the fused pipeline again.
+
+        Tenancy (r17 — tenant = index name): ``plane_paging`` turns
+        over-budget plain-Row Count planes into PAGED residency
+        (``tenancy.PlanePager`` — only hot shard pages device-resident,
+        the host oracle covers the rest, bit-exact); single-device
+        only, a mesh placement disables it.  ``plane_page_bytes``
+        sizes one page.  ``tenant_byte_quota`` caps one tenant's
+        resident plane/page bytes (0 = off); ``tenant_qps_quota`` /
+        ``tenant_slot_quota`` shed an over-quota tenant's queries with
+        a structured 503 BEFORE they take an executor slot (0 = off)."""
         self.holder = holder
         self.translate = translate or TranslateStore(
             holder.path, health=getattr(holder, "storage_health", None))
@@ -332,13 +347,28 @@ class Executor:
             place = placement.place
         kw = {"budget_bytes": plane_budget} if plane_budget else {}
         from pilosa_tpu.obs import GLOBAL_TRACER, NopStats
+        from pilosa_tpu.tenancy import (PlanePager, ResidencyGovernor,
+                                        TenantQos)
         self.stats = stats or NopStats()
+        # tenancy (r17): the governor is always attached — with no
+        # quotas and no telemetry its eviction ordering degrades to
+        # the stamped LRU exactly, so the single-tenant default pays
+        # nothing.  The pager is single-device only: a partial page
+        # plane has no meaning under a mesh-sharded placement.
+        self.governor = ResidencyGovernor(byte_quota=tenant_byte_quota)
         self.planes = PlaneCache(place, placement=placement,
                                  stats=self.stats,
                                  sidecars=plane_sidecars,
                                  delta_cells=delta_cells,
                                  delta_compact_fraction=(
-                                     delta_compact_fraction), **kw)
+                                     delta_compact_fraction),
+                                 governor=self.governor, **kw)
+        self.pager = (PlanePager(self.planes, self.governor,
+                                 page_bytes=plane_page_bytes,
+                                 stats=self.stats)
+                      if plane_paging and placement is None else None)
+        self.qos = TenantQos(tenant_qps_quota, tenant_slot_quota,
+                             stats=self.stats)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache(stats=self.stats,
@@ -442,6 +472,40 @@ class Executor:
         count — None when serving single-device."""
         return self.planes.mesh_stats()
 
+    def tenancy_status(self) -> dict:
+        """The ``/status`` ``tenancy`` block (r17): knobs, per-tenant
+        residency/hit-ratio/page-in/shed counts, QoS state, eviction
+        reasons.  Refreshes the ``plane_resident_pages`` gauge at
+        scrape time (pager payload)."""
+        planes = self.planes
+        out = {"paging": self.pager is not None,
+               "tenantByteQuota": self.governor.byte_quota,
+               "evictions": planes.evictions,
+               "evictionsByReason": dict(planes._evictions_by_reason),
+               "qos": self.qos.payload()}
+        if self.pager is not None:
+            pg = self.pager.payload()
+            tenants = pg.pop("tenants")
+            out.update(pg)
+        else:
+            tenants = {}
+            with planes._lock:
+                for k, v in planes._entries.items():
+                    d = tenants.setdefault(
+                        k[1], {"residentBytes": 0, "residentPages": 0,
+                               "residentEntries": 0})
+                    d["residentBytes"] += v[2]
+                    d["residentEntries"] += 1
+        sheds = out["qos"]["sheds"]
+        for t, n in sheds.items():
+            tenants.setdefault(
+                t, {"residentBytes": 0, "residentPages": 0,
+                    "residentEntries": 0})
+        for t, d in tenants.items():
+            d["sheds"] = sheds.get(t, 0)
+        out["tenants"] = tenants
+        return out
+
     # -- in-flight accounting (OOM recovery) --------------------------------
 
     def _enter_inflight(self) -> None:
@@ -491,11 +555,19 @@ class Executor:
         # slot): register for OOM-recovery coordination
         depth = getattr(self._tls, "depth", 0)
         timer = None
+        qos_held = False
         if depth == 0:
             from pilosa_tpu.obs import StageTimer
             # stage marks double as `stage.*` child spans on the traced
             # query (per-request tracer when given, else the shared one)
             timer = StageTimer(self.stats, tracer=tracer or self.tracer)
+            # per-tenant QoS FIRST (r17 tenancy): an over-quota tenant
+            # sheds with a structured 503 BEFORE taking an executor
+            # slot, so its retries queue at the client — never in
+            # front of in-quota tenants' admissions
+            if self.qos.enabled:
+                self.qos.admit(index_name)  # raises TenantThrottledError
+                qos_held = True
             # bounded concurrency FIRST: each executing query holds
             # live device scratch (program temps, per-query outputs);
             # with residency near budget, unbounded client threads
@@ -513,6 +585,8 @@ class Executor:
                                    time.perf_counter() - t_wait)
                 if not acquired:
                     self.stats.count("query_shed_total", 1)
+                    if qos_held:
+                        self.qos.release(index_name)
                     raise ExecutorSaturatedError(
                         f"executor at max concurrent queries "
                         f"({self.max_concurrent}) for "
@@ -540,6 +614,8 @@ class Executor:
             except BaseException:
                 if self._exec_slots is not None:
                     self._exec_slots.release()
+                if qos_held:
+                    self.qos.release(index_name)
                 raise
             timer.mark("admit")
             self._tls.stage_timer = timer
@@ -582,6 +658,8 @@ class Executor:
                 self._leave_inflight()
                 if self._exec_slots is not None:
                     self._exec_slots.release()
+                if qos_held:
+                    self.qos.release(index_name)
 
     def _execute_calls(self, index, index_name: str, query: Query,
                        shards, translate_output: bool, tracer,
@@ -691,7 +769,47 @@ class Executor:
         the 1B-col serving condition (BASELINE.md r3).  Returns None
         when the batch doesn't match (mixed fields, conditions, time
         ranges, over-budget plane, or a tiny slice of a huge row set —
-        whole-plane counting would waste bandwidth there)."""
+        whole-plane counting would waste bandwidth there).  A plane
+        past the HBM budget (or its tenant's byte quota) no longer
+        dead-ends: it reroutes to the PAGED residency path (r17) —
+        resident shard pages answer on device, the host oracle covers
+        the rest, bit-exact."""
+        hit = self._plain_row_parse(ctx, calls)
+        if hit is None:
+            return None
+        field, values = hit
+        if not self.planes.has_plane(ctx.index.name, field, VIEW_STANDARD,
+                                     ctx.shards):
+            # admission decision only when the plane isn't resident yet:
+            # plane_bytes walks every fragment's row set — O(shards)
+            # host work that must stay OFF the per-request path (it
+            # capped serving at ~1.1k qps on the 954-shard bench)
+            est = self.planes.plane_bytes(field, VIEW_STANDARD,
+                                          ctx.shards)
+            if self._paging_engaged(est):
+                return self._paged_count(ctx, field, values)
+            if est > self.planes.budget:
+                return None
+            r_est = max(1, est // (len(ctx.shards) * WORDS_PER_SHARD * 4))
+            if len(calls) * 4 < r_est:
+                return None
+        row_ids = [self._row_id(ctx, field, v, create=False)
+                   for v in values]
+        # nowait: while the whole-field plane builds in the background
+        # the generic per-row path serves (bounded per-row transfers)
+        # instead of this batch stalling on full residency
+        ps = self.planes.field_plane_nowait(ctx.index.name, field,
+                                            VIEW_STANDARD, ctx.shards)
+        if ps is None:
+            return None
+        return self._plane_count_rows(
+            ps, row_ids, getattr(self._tls, "stage_timer", None))
+
+    def _plain_row_parse(self, ctx: _Ctx, calls: list[Call]):
+        """``(field, values)`` when every call is ``Count(Row(f=v))``
+        over ONE non-BSI field with plain scalar rows (no conditions,
+        no time ranges) — the shape both the whole-plane batch and the
+        paged path serve.  None otherwise."""
         fname = None
         values = []
         for call in calls:
@@ -719,30 +837,76 @@ class Executor:
             return None
         if not ctx.shards:  # shards=[]: generic path answers zeros
             return None
-        if not self.planes.has_plane(ctx.index.name, field, VIEW_STANDARD,
-                                     ctx.shards):
-            # admission decision only when the plane isn't resident yet:
-            # plane_bytes walks every fragment's row set — O(shards)
-            # host work that must stay OFF the per-request path (it
-            # capped serving at ~1.1k qps on the 954-shard bench)
-            est = self.planes.plane_bytes(field, VIEW_STANDARD,
-                                          ctx.shards)
-            if est > self.planes.budget:
-                return None
-            r_est = max(1, est // (len(ctx.shards) * WORDS_PER_SHARD * 4))
-            if len(calls) * 4 < r_est:
-                return None
+        return field, values
+
+    # ------------------------------------------------ paged residency (r17)
+
+    def _paging_engaged(self, est: int) -> bool:
+        """Whether a plane of ``est`` bytes serves PAGED: a pager
+        exists (single-device serving) and the plane exceeds the HBM
+        budget or its tenant's byte quota.  Under both limits the
+        whole-plane path keeps its exact pre-r17 behavior."""
+        if self.pager is None:
+            return False
+        limit = self.planes.budget
+        if self.governor.byte_quota > 0:
+            limit = min(limit, self.governor.byte_quota)
+        return est > limit
+
+    def _count_batch_paged(self, ctx: _Ctx,
+                           calls: list[Call]) -> list[int] | None:
+        """Solo-path entry to paged counting: engages only for the
+        plain-Row shape on a plane past the budget/quota limit —
+        everything else falls through to the existing paths."""
+        if self.pager is None or not ctx.shards:
+            return None
+        hit = self._plain_row_parse(ctx, calls)
+        if hit is None:
+            return None
+        field, values = hit
+        if self.planes.has_plane(ctx.index.name, field, VIEW_STANDARD,
+                                 ctx.shards):
+            return None  # whole plane resident: the normal path serves
+        est = self.planes.plane_bytes(field, VIEW_STANDARD, ctx.shards)
+        if not self._paging_engaged(est):
+            return None
+        return self._paged_count(ctx, field, values)
+
+    def _paged_count(self, ctx: _Ctx, field: Field,
+                     values: list) -> list[int] | None:
+        """Per-call totals for an over-limit plane via paged residency:
+        each shard page is either RESIDENT (answered on device — the
+        same selected-gather/whole-plane kernels, delta overlays and
+        all), PAGED IN on demand (sidecar-warm partial expansion,
+        admitted against the tenant's byte quota), or covered by the
+        host ORACLE (``row_cardinalities`` directory sums).  Totals sum
+        per row across pages — bit-exact regardless of the residency
+        mix.  None = the shard axis doesn't split (single page)."""
+        pages = self.pager.partition(field, VIEW_STANDARD, ctx.shards)
+        if pages is None:
+            return None
         row_ids = [self._row_id(ctx, field, v, create=False)
                    for v in values]
-        # nowait: while the whole-field plane builds in the background
-        # the generic per-row path serves (bounded per-row transfers)
-        # instead of this batch stalling on full residency
-        ps = self.planes.field_plane_nowait(ctx.index.name, field,
-                                            VIEW_STANDARD, ctx.shards)
-        if ps is None:
-            return None
-        return self._plane_count_rows(
-            ps, row_ids, getattr(self._tls, "stage_timer", None))
+        timer = getattr(self._tls, "stage_timer", None)
+        totals = [0] * len(row_ids)
+        for page_shards in pages:
+            ps = self.pager.resident_page(ctx.index.name, field,
+                                          VIEW_STANDARD, page_shards)
+            if ps is None:
+                ps = self.pager.page_in(ctx.index.name, field,
+                                        VIEW_STANDARD, page_shards)
+            if ps is not None:
+                part = self._plane_count_rows(ps, row_ids, timer)
+            else:
+                # quota denied the page-in: host truth answers this
+                # page exactly (directory sums, no bit expansion)
+                part = self.pager.oracle_counts(
+                    field, VIEW_STANDARD, page_shards, row_ids)
+            for i, v in enumerate(part):
+                totals[i] += int(v)
+        if timer is not None:
+            timer.mark("read")
+        return totals
 
     # -------------------------------------------------- BSI range (r20)
 
@@ -2265,6 +2429,12 @@ class Executor:
     def _execute_count(self, ctx: _Ctx, call: Call) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count: exactly one child required")
+        # over-budget/over-quota plain-Row planes serve PAGED (r17):
+        # resident shard pages on device, host oracle for the rest —
+        # without this, a too-big field never reached device speed
+        paged = self._count_batch_paged(ctx, [call])
+        if paged is not None:
+            return paged[0]
         # simple BSI range counts ride the bsirange family (r20):
         # delta-aware plane, same-plane co-batching, solo fast lane
         fast = self._count_batch_bsi(ctx, [call])

@@ -152,7 +152,8 @@ class PlaneCache:
     def __init__(self, place=None, budget_bytes: int = DEFAULT_BUDGET,
                  placement=None, stats=None, sidecars: bool = True,
                  delta_cells: int = 65536,
-                 delta_compact_fraction: float = 0.5):
+                 delta_compact_fraction: float = 0.5,
+                 governor=None):
         """``place(np_array) -> jax.Array`` controls device placement /
         mesh sharding; default is plain ``jax.device_put``.
         ``placement`` (the MeshPlacement the executor runs under, if
@@ -169,7 +170,14 @@ class PlaneCache:
         0 disables (pre-r15 incremental-scatter behavior).
         ``delta_compact_fraction``: overlay fill ratio past which the
         background compactor folds the overlay into the base plane and
-        swaps generations atomically."""
+        swaps generations atomically.
+
+        ``governor`` (r17 tenancy): an optional
+        :class:`pilosa_tpu.tenancy.ResidencyGovernor` — when present,
+        serving hits feed its telemetry and every eviction pass orders
+        by its keep-score (recent hits × bytes × rebuild cost) before
+        the LRU stamp; without it (or before any telemetry) ordering
+        is the stamped LRU exactly."""
         from pilosa_tpu.exec._lru import Stamps
         from pilosa_tpu.obs import NopStats
         self.place = place or (placement.place if placement is not None
@@ -178,6 +186,11 @@ class PlaneCache:
         self.budget = budget_bytes
         self._stats = stats or NopStats()
         self.sidecars = sidecars
+        self.governor = governor
+        # eviction accounting (r17 tenancy): every entry drop through
+        # _evict_entry tallies here and on plane_evictions_total{reason}
+        self.evictions = 0
+        self._evictions_by_reason: dict[str, int] = {}
         # plane-build accounting (also on /status via stats()):
         # warm = fragment expansions served from a dense sidecar
         self.warm_hits = 0
@@ -253,20 +266,80 @@ class PlaneCache:
             return set()
         return set().union(*self._leases.values())
 
-    def evict_unpinned(self) -> None:
-        """Free every entry NOT leased by an in-flight query — the
-        memory that eviction can actually reclaim.  OOM recovery uses
-        this instead of `invalidate`: dropping leased entries under
-        concurrent load evicts planes whose HBM cannot be freed and
-        makes every other in-flight query rebuild from scratch."""
+    def _eviction_order(self, pinned: set, keys=None) -> list:
+        """Unpinned cache keys in EXPLICIT eviction order (evict the
+        head first).  Primary key: the governor's keep-score ascending
+        (cheap-to-rebuild, cold, small entries go first); tie-break —
+        and the whole order when no governor is attached or an entry
+        has no telemetry yet — is the recency stamp, i.e. the original
+        approximate LRU.  Caller holds ``self._lock``."""
+        g = self.governor
+        ks = [k for k in (self._entries if keys is None else keys)
+              if k not in pinned and k in self._entries]
+        if g is None:
+            return sorted(ks, key=lambda k: self._stamps.get(k))
+        return sorted(ks, key=lambda k: (g.keep_score(
+            k, self._entries[k][2]), self._stamps.get(k)))
+
+    def _evict_entry(self, key, reason: str) -> int:
+        """Drop one entry (caller holds ``self._lock`` and has checked
+        pins); returns the bytes freed.  The single exit point every
+        eviction path shares, so ``plane_evictions_total{reason}`` and
+        the governor's recency reset can't be missed by a new path."""
+        _, _, nbytes = self._entries.pop(key)
+        self._stamps.pop(key)
+        self._delta_mirrors.pop(key, None)
+        self._bytes -= nbytes
+        self.evictions += 1
+        self._evictions_by_reason[reason] = \
+            self._evictions_by_reason.get(reason, 0) + 1
+        self._stats.count("plane_evictions_total", 1, reason=reason)
+        if self.governor is not None:
+            self.governor.note_evict(key)
+        return nbytes
+
+    def evict_unpinned(self, target_bytes: int | None = None,
+                       reason: str = "oom") -> int:
+        """Free entries NOT leased by an in-flight query — the memory
+        that eviction can actually reclaim — in explicit eviction
+        order, stopping once ``target_bytes`` are freed (None = free
+        everything unpinned, the OOM-recovery contract).  OOM recovery
+        uses this instead of `invalidate`: dropping leased entries
+        under concurrent load evicts planes whose HBM cannot be freed
+        and makes every other in-flight query rebuild from scratch.
+        Returns the bytes freed."""
         with self._lock:
             self._bytes_cache.clear()
             pinned = self._pinned()
-            for key in [k for k in self._entries if k not in pinned]:
-                _, _, nbytes = self._entries.pop(key)
-                self._stamps.pop(key)
-                self._delta_mirrors.pop(key, None)
-                self._bytes -= nbytes
+            freed = 0
+            for key in self._eviction_order(pinned):
+                if target_bytes is not None and freed >= target_bytes:
+                    break
+                freed += self._evict_entry(key, reason)
+            return freed
+
+    def evict_tenant(self, index: str, need_bytes: int,
+                     reason: str = "quota") -> int:
+        """Free up to ``need_bytes`` of ONE tenant's unpinned entries
+        in eviction order — the page-in admission path makes room
+        within a tenant's own byte quota without touching neighbors'
+        residency.  Returns the bytes freed."""
+        with self._lock:
+            pinned = self._pinned()
+            keys = [k for k in self._entries if k[1] == index]
+            freed = 0
+            for key in self._eviction_order(pinned, keys):
+                if freed >= need_bytes:
+                    break
+                freed += self._evict_entry(key, reason)
+            return freed
+
+    def tenant_bytes(self, index: str) -> int:
+        """Resident cache bytes attributed to one tenant (all key
+        kinds carry the index at position 1)."""
+        with self._lock:
+            return sum(v[2] for k, v in self._entries.items()
+                       if k[1] == index)
 
     # -- public -------------------------------------------------------------
 
@@ -971,6 +1044,12 @@ class PlaneCache:
                     "hitRatio": (round(hits / (hits + misses), 4)
                                  if hits + misses else 0.0),
                     "incrementalRefreshes": self.incremental_applied,
+                    # r17 tenancy: explicit-order eviction accounting
+                    # (budget pass, OOM recovery, quota make-room,
+                    # stale page drops)
+                    "evictions": self.evictions,
+                    "evictionsByReason": dict(
+                        self._evictions_by_reason),
                     # plane-build pipeline (r10): cold-build volume and
                     # the dense-sidecar warm cache's hit ratio
                     "builds": self.builds,
@@ -1028,9 +1107,12 @@ class PlaneCache:
         return view.generations_fast(shards)
 
     def _touch(self, key) -> None:
-        # lock-free recency (eviction order degrades to approximate
-        # LRU, which is all the byte-budget pass ever needed)
+        # lock-free recency (the eviction tie-break) + governor value
+        # telemetry (plain dict increment — a lost count under racing
+        # threads never matters to a relative ordering)
         self._stamps.touch(key)
+        if self.governor is not None:
+            self.governor.note_hit(key)
 
     def _lease(self, key) -> None:
         # caller holds self._lock
@@ -1128,24 +1210,22 @@ class PlaneCache:
             self._bytes += nbytes
             if lease:
                 self._lease(key)
-            # LRU eviction skips leased entries: their device refs
+            # budget eviction skips leased entries: their device refs
             # are alive in query frames, so popping them frees no
             # HBM and forces the other query to rebuild mid-flight.
             # (_pinned() unions every lease set — only pay for it
-            # when an eviction pass actually runs)
+            # when an eviction pass actually runs).  Order is the
+            # explicit _eviction_order: governor keep-score when one
+            # is attached, recency stamp otherwise.
             if self._bytes > self.budget and len(self._entries) > 1:
                 pinned = self._pinned()
-                for k in sorted(self._entries,
-                                key=lambda k: self._stamps.get(k)):
+                for k in self._eviction_order(pinned):
                     if (self._bytes <= self.budget
                             or len(self._entries) <= 1):
                         break
-                    if k == key or k in pinned:
+                    if k == key:
                         continue
-                    _, _, old_bytes = self._entries.pop(k)
-                    self._stamps.pop(k)
-                    self._delta_mirrors.pop(k, None)
-                    self._bytes -= old_bytes
+                    self._evict_entry(k, "budget")
             self._stamps.cleanup(self._entries)
 
     # Incremental cap: beyond this many changed (row, word) cells a

@@ -96,6 +96,22 @@ def build_payload(holder, cluster=None, stats=None, slow_log=None,
                 payload["mesh"] = mesh
         except Exception:  # noqa: BLE001 — diagnostics never break serving
             pass
+        try:
+            ten = executor.tenancy_status()
+            tenants = ten.get("tenants", {})
+            # counts only — tenant (index) names never leave the node
+            payload["tenancy"] = {
+                "paging": bool(ten.get("paging")),
+                "tenants": len(tenants),
+                "residentPages": sum(
+                    int(t.get("residentPages", 0))
+                    for t in tenants.values()),
+                "pageIns": int(ten.get("pageIns", 0)),
+                "evictions": int(ten.get("evictions", 0)),
+                "sheds": int(ten.get("qos", {}).get("shedTotal", 0)),
+            }
+        except Exception:  # noqa: BLE001 — diagnostics never break serving
+            pass
     return payload
 
 

@@ -120,7 +120,12 @@ class PilosaTPUServer:
             solo_fastlane=self.cfg.solo_fastlane,
             dispatch_watchdog_seconds=self.cfg.dispatch_watchdog_seconds,
             device_health_probe_seconds=(
-                self.cfg.device_health_probe_seconds))
+                self.cfg.device_health_probe_seconds),
+            plane_paging=self.cfg.plane_paging,
+            plane_page_bytes=self.cfg.plane_page_bytes,
+            tenant_byte_quota=self.cfg.tenant_byte_quota,
+            tenant_qps_quota=self.cfg.tenant_qps_quota,
+            tenant_slot_quota=self.cfg.tenant_slot_quota)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

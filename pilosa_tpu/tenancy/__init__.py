@@ -1,0 +1,27 @@
+"""Multi-tenant HBM economy (ISSUE 17).
+
+Three cooperating layers turn the device plane cache from a static
+placement into a managed economy across tenants (tenant = index):
+
+- :mod:`pilosa_tpu.tenancy.paging` — paged plane residency: a plane
+  too big for the HBM budget (or constrained by a per-tenant byte
+  quota) never materializes whole.  Instead its shard axis splits into
+  fixed-byte *pages*, each a partial ``PlaneSet`` cached/leased/evicted
+  like any other entry; fused kernels answer the resident pages on
+  device while the op-at-a-time host oracle covers the rest, bit-exact.
+- :mod:`pilosa_tpu.tenancy.governor` — the eviction/admission policy:
+  per-entry hit/cost telemetry turns the byte-budget LRU into a
+  cost/value ordering (value = recent hits × bytes scanned, cost =
+  rebuild seconds), and per-tenant byte quotas gate page-ins.
+- :mod:`pilosa_tpu.tenancy.qos` — per-tenant admission quotas (qps
+  token bucket + in-flight slot cap) shedding over-quota tenants with
+  a structured ``tenantThrottled`` 503 while other tenants keep their
+  floors.
+"""
+
+from pilosa_tpu.tenancy.governor import ResidencyGovernor
+from pilosa_tpu.tenancy.paging import PlanePager
+from pilosa_tpu.tenancy.qos import TenantQos, TenantThrottledError
+
+__all__ = ["ResidencyGovernor", "PlanePager", "TenantQos",
+           "TenantThrottledError"]

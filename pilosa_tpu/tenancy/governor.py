@@ -1,0 +1,113 @@
+"""Residency governor: cost/value eviction ordering + tenant quotas.
+
+The PlaneCache's byte-budget pass was pure approximate-LRU; under a
+many-tenant zipfian mix that evicts a hot tenant's expensive-to-rebuild
+plane as readily as a cold tenant's cheap page.  The governor keeps
+per-entry telemetry — decayed recent hits, bytes, build/page-in seconds
+— and orders eviction by *keep-score*:
+
+    keep = recent_hits × nbytes (bytes the entry served)
+                       × max(build_seconds, floor)
+
+Entries with no telemetry score 0.0, so ordering degrades to the
+existing stamped LRU exactly (the cold-start and governor-less cases
+are identical by construction — pinned by ``tests/test_tenancy.py``).
+
+It also owns the per-tenant byte quota (tenant = index name): page-ins
+and whole-plane admissions check ``admit_bytes`` before spending HBM on
+a tenant already at its cap.
+"""
+
+from __future__ import annotations
+
+import time
+
+# below this many seconds, a build is considered free (sidecar-warm
+# page-ins land here): the cost factor stops discriminating and the
+# ordering is driven by recency-of-use value alone
+_COST_FLOOR = 1e-3
+
+# telemetry decay half-life: hit counts halve this often, so "recent
+# hits" tracks the serving mix of the last few minutes, not all time
+DECAY_SECONDS = 120.0
+
+# telemetry map bound (keys are cache keys — user-controlled count):
+# on overflow the coldest half is dropped; affected entries simply
+# score 0.0 again (LRU fallback), never an error
+_MAX_KEYS = 4096
+
+
+class ResidencyGovernor:
+    """Per-entry cost/value telemetry + per-tenant byte quotas.
+
+    Thread contract mirrors the PlaneCache counters it feeds:
+    :meth:`note_hit` runs on the lock-free serving path (plain dict
+    increments — racing threads may lose the odd count, which a
+    relative ordering never notices); everything that *reads* the
+    telemetry for an eviction pass runs under the owning cache's
+    lock."""
+
+    def __init__(self, byte_quota: int = 0,
+                 decay_seconds: float = DECAY_SECONDS):
+        # tenant byte quota (bytes of resident plane/page entries one
+        # tenant may hold; 0 = unlimited)
+        self.byte_quota = int(byte_quota)
+        self.decay_seconds = float(decay_seconds)
+        self._hits: dict = {}            # key -> decayed hit count
+        self._build_s: dict = {}         # key -> last build/page-in s
+        self._last_decay = time.monotonic()
+
+    # -- telemetry feed (lock-free callers) ---------------------------------
+
+    def note_hit(self, key) -> None:
+        self._hits[key] = self._hits.get(key, 0.0) + 1.0
+
+    def note_build(self, key, seconds: float) -> None:
+        self._build_s[key] = float(seconds)
+        if len(self._build_s) > _MAX_KEYS:
+            self._prune()
+
+    def note_evict(self, key) -> None:
+        # keep the build cost (re-admission of the same key should
+        # remember what it costs) but reset its recency value
+        self._hits.pop(key, None)
+
+    # -- ordering (caller holds the owning cache's lock) --------------------
+
+    def keep_score(self, key, nbytes: int) -> float:
+        """Higher = more worth keeping.  0.0 when the entry has no
+        recorded hits — the eviction sort then falls through to its
+        LRU-stamp tie-break, i.e. exactly the pre-governor order."""
+        self._maybe_decay()
+        hits = self._hits.get(key)
+        if not hits:
+            return 0.0
+        cost = max(self._build_s.get(key, 0.0), _COST_FLOOR)
+        return hits * float(nbytes) * cost
+
+    def _maybe_decay(self) -> None:
+        now = time.monotonic()
+        if now - self._last_decay < self.decay_seconds:
+            return
+        self._last_decay = now
+        for k in list(self._hits):
+            v = self._hits.get(k, 0.0) * 0.5
+            if v < 0.25:
+                self._hits.pop(k, None)
+            else:
+                self._hits[k] = v
+
+    def _prune(self) -> None:
+        # drop the cheapest half of the build-cost map; their entries
+        # degrade to LRU ordering, never an error
+        keep = sorted(self._build_s.items(), key=lambda kv: -kv[1])
+        self._build_s = dict(keep[:_MAX_KEYS // 2])
+
+    # -- admission ----------------------------------------------------------
+
+    def admit_bytes(self, resident_bytes: int, want_bytes: int) -> bool:
+        """Whether a tenant already holding ``resident_bytes`` may
+        spend ``want_bytes`` more of HBM (True with quotas off)."""
+        if self.byte_quota <= 0:
+            return True
+        return resident_bytes + want_bytes <= self.byte_quota

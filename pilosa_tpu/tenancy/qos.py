@@ -1,0 +1,112 @@
+"""Per-tenant QoS: admission quotas + priority shedding.
+
+Tenant = index name.  Two independent quotas, both off by default:
+
+- **qps quota** — a token bucket per tenant (capacity = one second of
+  quota, refilled continuously).  An over-rate tenant sheds *before*
+  taking an executor slot, so a runaway tenant's requests never queue
+  in front of in-quota tenants.
+- **slot quota** — a per-tenant cap on concurrently EXECUTING queries,
+  strictly below the executor-wide ``max_concurrent``: one tenant can
+  never occupy every admission slot.
+
+A shed raises :class:`TenantThrottledError`, which the API layer maps
+to the same 503 + Retry-After contract the saturated executor already
+speaks — with a structured ``tenantThrottled{tenant, quota, kind}``
+body so the client knows it was ITS quota, not server overload.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TenantThrottledError(Exception):
+    """A tenant exceeded its qps or slot quota and was shed (HTTP 503
+    + Retry-After with a structured ``tenantThrottled`` body at the
+    API edge).  Deliberately NOT an ExecutionError subclass: the
+    generic 400 mapping must never swallow a quota shed."""
+
+    def __init__(self, msg: str, tenant: str, quota: float,
+                 kind: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.quota = quota
+        self.kind = kind  # "qps" | "slots"
+        self.retry_after = retry_after
+
+
+class TenantQos:
+    """Per-tenant admission state.  One lock over tiny dict updates —
+    the admit check is a few float ops, far off the dispatch path."""
+
+    def __init__(self, qps_quota: float = 0.0, slot_quota: int = 0,
+                 stats=None):
+        from pilosa_tpu.obs import NopStats
+        self.qps_quota = float(qps_quota)
+        self.slot_quota = int(slot_quota)
+        self._stats = stats or NopStats()
+        self._lock = threading.Lock()
+        self._buckets: dict[str, list] = {}   # tenant -> [tokens, ts]
+        self._inflight: dict[str, int] = {}
+        self._sheds: dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.qps_quota > 0 or self.slot_quota > 0
+
+    def admit(self, tenant: str) -> None:
+        """Admit one query for ``tenant`` or raise
+        :class:`TenantThrottledError`.  On success the caller MUST
+        pair with :meth:`release` (the slot-quota half is a no-op when
+        that quota is off, but release is always safe)."""
+        now = time.monotonic()
+        with self._lock:
+            if self.qps_quota > 0:
+                burst = max(1.0, self.qps_quota)
+                tok, last = self._buckets.get(tenant, (burst, now))
+                tok = min(burst, tok + (now - last) * self.qps_quota)
+                if tok < 1.0:
+                    self._buckets[tenant] = [tok, now]
+                    self._shed(tenant, self.qps_quota, "qps",
+                               retry_after=(1.0 - tok) / self.qps_quota)
+                self._buckets[tenant] = [tok - 1.0, now]
+            if self.slot_quota > 0:
+                used = self._inflight.get(tenant, 0)
+                if used >= self.slot_quota:
+                    self._shed(tenant, self.slot_quota, "slots",
+                               retry_after=0.5)
+                self._inflight[tenant] = used + 1
+
+    def release(self, tenant: str) -> None:
+        if self.slot_quota <= 0:
+            return
+        with self._lock:
+            left = self._inflight.get(tenant, 0) - 1
+            if left > 0:
+                self._inflight[tenant] = left
+            else:
+                self._inflight.pop(tenant, None)
+
+    def _shed(self, tenant: str, quota: float, kind: str,
+              retry_after: float) -> None:
+        # caller holds self._lock
+        self._sheds[tenant] = self._sheds.get(tenant, 0) + 1
+        self._stats.count("tenant_shed_total", 1, tenant=tenant)
+        raise TenantThrottledError(
+            f"tenant {tenant!r} over its {kind} quota ({quota:g}); "
+            f"retry later", tenant, quota, kind,
+            retry_after=max(0.05, retry_after))
+
+    def sheds(self, tenant: str) -> int:
+        return self._sheds.get(tenant, 0)
+
+    def payload(self) -> dict:
+        """The /status tenancy block's QoS half."""
+        with self._lock:
+            return {"qpsQuota": self.qps_quota,
+                    "slotQuota": self.slot_quota,
+                    "inflight": dict(self._inflight),
+                    "sheds": dict(self._sheds),
+                    "shedTotal": int(sum(self._sheds.values()))}

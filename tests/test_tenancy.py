@@ -1,0 +1,406 @@
+"""Multi-tenant HBM economy (r17): paged plane residency, the
+governor-driven eviction order, tenant byte quotas, and per-tenant QoS
+shedding.  Covers the ISSUE 17 satellite checklist: explicit eviction
+order (incl. the leased-entry-skipped case), paged-plane correctness
+under ingest (writes into a NON-resident page stay exact), the /status
+``tenancy`` block, and the new metrics' emit sites."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.obs import Stats
+from pilosa_tpu.store import Holder
+from pilosa_tpu.tenancy import (PlanePager, ResidencyGovernor, TenantQos,
+                                TenantThrottledError)
+
+
+# ---------------------------------------------------------------- eviction
+
+class FakePlaneSet:
+    def __init__(self, nbytes=1024):
+        self.plane = np.zeros(max(1, nbytes // 4), dtype=np.uint32)
+
+
+def _cache(budget=1 << 30, governor=None):
+    from pilosa_tpu.exec.planes import PlaneCache
+    return PlaneCache(place=lambda h: h, budget_bytes=budget,
+                      governor=governor)
+
+
+def _seed(cache, keys, nbytes=1024):
+    for k in keys:
+        cache._insert_entry(k, (0,), FakePlaneSet(nbytes), nbytes)
+
+
+class TestEvictionOrder:
+    """Satellite 1: eviction order is explicit and unit-testable —
+    stamped LRU fallback, governor cost/value override, leases pin."""
+
+    K1 = ("plane", "a", "f", "standard", (0,))
+    K2 = ("plane", "a", "g", "standard", (0,))
+    K3 = ("plane", "b", "f", "standard", (0,))
+
+    def test_lru_fallback_without_governor(self):
+        cache = _cache()
+        _seed(cache, [self.K1, self.K2, self.K3])
+        cache._touch(self.K1)  # K1 newest → evicted last
+        order = cache._eviction_order(set())
+        assert order == [self.K2, self.K3, self.K1]
+
+    def test_governor_score_overrides_recency(self):
+        g = ResidencyGovernor()
+        cache = _cache(governor=g)
+        _seed(cache, [self.K1, self.K2])
+        # K2 is hot AND expensive to rebuild: keep-score ranks it
+        # after K1 even though K1 was touched more recently
+        for _ in range(5):
+            g.note_hit(self.K2)
+        g.note_build(self.K2, 2.0)
+        cache._touch(self.K1)
+        order = cache._eviction_order(set())
+        assert order[0] == self.K1 and order[-1] == self.K2
+
+    def test_leased_entries_are_skipped(self):
+        cache = _cache()
+        _seed(cache, [self.K1, self.K2])
+        cache.begin_query()
+        try:
+            cache._lease(self.K1)
+            freed = cache.evict_unpinned()
+            assert self.K1 in cache._entries      # pinned survives
+            assert self.K2 not in cache._entries  # unpinned went
+            assert freed == 1024
+        finally:
+            cache.end_query()
+
+    def test_target_bytes_stops_early(self):
+        cache = _cache()
+        _seed(cache, [self.K1, self.K2, self.K3])
+        freed = cache.evict_unpinned(target_bytes=1)
+        assert freed == 1024 and len(cache._entries) == 2
+
+    def test_eviction_reasons_counted_and_emitted(self):
+        stats = Stats()
+        cache = _cache()
+        cache._stats = stats
+        _seed(cache, [self.K1, self.K2])
+        cache.evict_unpinned(reason="oom")
+        assert cache.evictions == 2
+        assert cache._evictions_by_reason == {"oom": 2}
+        ctrs = stats.snapshot()["counters"]["plane_evictions_total"]
+        assert any(("reason", "oom") in k for k in ctrs)
+
+    def test_evict_tenant_scopes_to_one_index(self):
+        cache = _cache()
+        _seed(cache, [self.K1, self.K2, self.K3])
+        freed = cache.evict_tenant("a", need_bytes=1 << 30)
+        assert freed == 2048
+        assert self.K3 in cache._entries  # tenant "b" untouched
+        assert cache.tenant_bytes("a") == 0
+        assert cache.tenant_bytes("b") == 1024
+
+
+class TestGovernor:
+    def test_no_hits_means_zero_score_lru_tiebreak(self):
+        g = ResidencyGovernor()
+        assert g.keep_score(("k",), 4096) == 0.0
+
+    def test_score_scales_with_hits_bytes_and_cost(self):
+        g = ResidencyGovernor()
+        g.note_hit(("k",))
+        base = g.keep_score(("k",), 1000)
+        g.note_build(("k",), 10.0)
+        assert g.keep_score(("k",), 1000) > base
+
+    def test_byte_quota_admission(self):
+        g = ResidencyGovernor(byte_quota=100)
+        assert g.admit_bytes(40, 60)
+        assert not g.admit_bytes(50, 60)
+        assert ResidencyGovernor().admit_bytes(1 << 60, 1)  # quota off
+
+
+# ------------------------------------------------------------ paged planes
+
+def _fill(ex, index, field, n_shards, n_rows, per_row=3):
+    """Deterministic bits; returns the per-row Count oracle."""
+    pql, want = [], [0] * n_rows
+    for s in range(n_shards):
+        for r in range(n_rows):
+            for o in range(per_row):
+                pql.append(f"Set({s * SHARD_WIDTH + o * 11 + r}, "
+                           f"{field}={r})")
+                want[r] += 1
+    ex.execute(index, " ".join(pql))
+    return want
+
+
+def _counts(ex, index, field, n_rows):
+    return ex.execute(index, "".join(f"Count(Row({field}={r}))"
+                                     for r in range(n_rows)))
+
+
+@pytest.fixture
+def paged(tmp_path):
+    """3-shard plane (~1.5 MiB at r_pad 4) over a 1.2 MiB budget —
+    paging engages, ~2 single-shard pages fit at once."""
+    holder = Holder(str(tmp_path)).open()
+    holder.create_index("t1").create_field("f")
+    ex = Executor(holder, plane_budget=1200 * 1024,
+                  plane_page_bytes=1 << 20, stats=Stats())
+    yield holder, ex
+    ex.translate.close()
+    holder.close()
+
+
+class TestPagedPlanes:
+    def test_cold_and_warm_counts_oracle_exact(self, paged):
+        _, ex = paged
+        want = _fill(ex, "t1", "f", 3, 4)
+        assert _counts(ex, "t1", "f", 4) == want       # cold: page-ins
+        st = ex.tenancy_status()
+        assert st["paging"] and st["pageIns"] >= 2
+        assert st["residentPages"] >= 1
+        assert ex.planes.builds == 0                    # never a full build
+        for _ in range(3):                              # warm: page hits
+            assert _counts(ex, "t1", "f", 4) == want
+        t = ex.tenancy_status()["tenants"]["t1"]
+        assert t["pageHits"] >= 1 and t["hitRatio"] > 0
+        assert ex.planes.builds == 0
+
+    def test_write_into_non_resident_page_stays_exact(self, paged):
+        """Satellite 3: a write landing in a page that is NOT resident
+        goes to the journal/overlay and the next paged read answers it
+        exactly — no full rebuild."""
+        _, ex = paged
+        want = _fill(ex, "t1", "f", 3, 4)
+        assert _counts(ex, "t1", "f", 4) == want
+        # shrink residency to at most one page, so at least one of the
+        # three shards' pages is non-resident when the write lands
+        ex.planes.evict_unpinned(reason="test")
+        assert ex.tenancy_status()["residentPages"] == 0
+        ex.execute("t1", f"Set({2 * SHARD_WIDTH + 99999}, f=0) "
+                         f"Set({1 * SHARD_WIDTH + 55555}, f=1)")
+        want[0] += 1
+        want[1] += 1
+        assert _counts(ex, "t1", "f", 4) == want
+        assert ex.planes.builds == 0
+
+    def test_write_into_resident_page_absorbs_exact(self, paged):
+        _, ex = paged
+        want = _fill(ex, "t1", "f", 3, 4)
+        assert _counts(ex, "t1", "f", 4) == want
+        resident_before = ex.tenancy_status()["residentPages"]
+        assert resident_before >= 1
+        ex.execute("t1", f"Set(77777, f=2)")  # shard 0
+        want[2] += 1
+        assert _counts(ex, "t1", "f", 4) == want
+        assert ex.planes.builds == 0
+
+    def test_under_budget_plane_never_pages(self, tmp_path):
+        holder = Holder(str(tmp_path)).open()
+        holder.create_index("t1").create_field("f")
+        ex = Executor(holder)  # default budget: whole plane fits
+        try:
+            want = _fill(ex, "t1", "f", 3, 4)
+            assert _counts(ex, "t1", "f", 4) == want
+            st = ex.tenancy_status()
+            assert st["pageIns"] == 0 and st["residentPages"] == 0
+            assert ex.planes.builds >= 1  # classic whole-plane path
+        finally:
+            ex.translate.close()
+            holder.close()
+
+    def test_byte_quota_denial_serves_oracle(self, tmp_path):
+        """A tenant quota too small for even one page: every page is
+        answered by the directory oracle — still exact, zero resident
+        bytes for that tenant."""
+        holder = Holder(str(tmp_path)).open()
+        holder.create_index("t1").create_field("f")
+        ex = Executor(holder, plane_budget=1200 * 1024,
+                      plane_page_bytes=1 << 20,
+                      tenant_byte_quota=64 * 1024)
+        try:
+            want = _fill(ex, "t1", "f", 3, 4)
+            assert _counts(ex, "t1", "f", 4) == want
+            st = ex.tenancy_status()
+            assert st["oracleServes"] >= 1 or st["quotaDenials"] >= 1
+            assert st["tenants"]["t1"]["residentBytes"] <= 64 * 1024
+        finally:
+            ex.translate.close()
+            holder.close()
+
+    def test_page_in_seconds_metric_observed(self, paged):
+        _, ex = paged
+        _fill(ex, "t1", "f", 3, 4)
+        _counts(ex, "t1", "f", 4)
+        snap = ex.stats.full_snapshot()
+        h = snap["histograms"].get("plane_page_in_seconds")
+        assert h is not None and h["series"][0]["count"] >= 1
+
+    def test_resident_pages_gauge_refreshes_on_scrape(self, paged):
+        _, ex = paged
+        _fill(ex, "t1", "f", 3, 4)
+        _counts(ex, "t1", "f", 4)
+        n = ex.tenancy_status()["residentPages"]  # payload() scrapes
+        gauges = ex.stats.snapshot()["gauges"]["plane_resident_pages"]
+        assert any(v == n for v in gauges.values())
+
+
+# -------------------------------------------------------------------- QoS
+
+class TestTenantQos:
+    def test_qps_bucket_sheds_and_refills(self):
+        qos = TenantQos(qps_quota=1.0)
+        qos.admit("a")  # burst token
+        with pytest.raises(TenantThrottledError) as ei:
+            qos.admit("a")
+        e = ei.value
+        assert e.tenant == "a" and e.kind == "qps" and e.quota == 1.0
+        assert e.retry_after > 0
+        qos.admit("b")  # an in-quota tenant is unaffected
+        assert qos.sheds("a") == 1 and qos.sheds("b") == 0
+
+    def test_slot_quota_caps_inflight(self):
+        qos = TenantQos(slot_quota=2)
+        qos.admit("a")
+        qos.admit("a")
+        with pytest.raises(TenantThrottledError) as ei:
+            qos.admit("a")
+        assert ei.value.kind == "slots"
+        qos.release("a")
+        qos.admit("a")  # a release frees a slot
+        assert qos.payload()["inflight"] == {"a": 2}
+
+    def test_disabled_quotas_admit_everything(self):
+        qos = TenantQos()
+        assert not qos.enabled
+        for _ in range(100):
+            qos.admit("a")
+            qos.release("a")
+        assert qos.payload()["shedTotal"] == 0
+
+    def test_shed_emits_tenant_labelled_metric(self):
+        stats = Stats()
+        qos = TenantQos(slot_quota=1, stats=stats)
+        qos.admit("a")
+        with pytest.raises(TenantThrottledError):
+            qos.admit("a")
+        ctrs = stats.snapshot()["counters"]["tenant_shed_total"]
+        assert any(("tenant", "a") in k for k in ctrs)
+
+
+class TestQosHttpEdge:
+    def test_shed_is_structured_503_with_retry_after(self, tmp_path):
+        """Satellite: quota sheds ride the existing 503 + Retry-After
+        machinery with a structured tenantThrottled body — and another
+        tenant keeps serving through the shed."""
+        from pilosa_tpu.api import API, Server
+
+        holder = Holder(str(tmp_path)).open()
+        holder.create_index("a").create_field("f")
+        holder.create_index("b").create_field("f")
+        ex = Executor(holder, tenant_slot_quota=1)
+        api = API(holder, ex)
+        server = Server(api, "127.0.0.1", 0, stats=Stats()).start()
+        port = server.address[1]
+        try:
+            # hold tenant a's only slot open from inside the executor
+            ex.qos.admit("a")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/a/query",
+                data=b"Count(Row(f=1))", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            err = ei.value
+            assert err.code == 503
+            assert err.headers.get("Retry-After") is not None
+            body = json.loads(err.read())
+            tt = body["tenantThrottled"]
+            assert tt["tenant"] == "a" and tt["kind"] == "slots"
+            assert tt["quota"] == 1
+            # tenant b serves through a's shed
+            req_b = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/b/query",
+                data=b"Count(Row(f=1))", method="POST")
+            with urllib.request.urlopen(req_b) as resp:
+                assert resp.status == 200
+            ex.qos.release("a")
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/index/a/query",
+                    data=b"Count(Row(f=1))", method="POST")) as resp:
+                assert resp.status == 200
+        finally:
+            server.close()
+            ex.translate.close()
+            holder.close()
+
+
+# ------------------------------------------------------------ status block
+
+class TestStatusAndDiagnostics:
+    def test_status_tenancy_block_shape(self, paged):
+        from pilosa_tpu.api import API
+
+        holder, ex = paged
+        want = _fill(ex, "t1", "f", 3, 4)
+        assert _counts(ex, "t1", "f", 4) == want
+        api = API(holder, ex)
+        ten = api.status()["tenancy"]
+        assert ten["paging"] is True
+        assert "qos" in ten and "evictionsByReason" in ten
+        t1 = ten["tenants"]["t1"]
+        for k in ("residentBytes", "residentPages", "pageHits",
+                  "pageMisses", "hitRatio", "pageIns", "sheds"):
+            assert k in t1, k
+        assert t1["residentPages"] >= 1
+
+    def test_diagnostics_payload_counts_only(self, paged):
+        from pilosa_tpu.obs.diagnostics import build_payload
+
+        holder, ex = paged
+        _fill(ex, "t1", "f", 3, 4)
+        _counts(ex, "t1", "f", 4)
+        payload = build_payload(holder, executor=ex)
+        ten = payload["tenancy"]
+        assert ten["tenants"] == 1 and ten["residentPages"] >= 1
+        assert ten["pageIns"] >= 1
+        # anonymized: no index names anywhere in the block
+        assert "t1" not in json.dumps(ten)
+
+
+# ------------------------------------------------------------- pager unit
+
+class TestPagerPartition:
+    def test_partition_respects_budget_clamp(self, paged):
+        _, ex = paged
+        _fill(ex, "t1", "f", 3, 4)
+        field = ex.holder.index("t1").field("f")
+        pages = ex.pager.partition(field, "standard", (0, 1, 2))
+        assert pages is not None
+        assert [s for p in pages for s in p] == [0, 1, 2]
+        # every page must fit under half the budget (or one slab)
+        est = ex.planes.plane_bytes(field, "standard", (0, 1, 2))
+        slab = est // 3
+        for p in pages:
+            assert len(p) * slab <= max(slab, ex.planes.budget // 2)
+
+    def test_single_shard_plane_never_partitions(self, paged):
+        _, ex = paged
+        _fill(ex, "t1", "f", 1, 4)
+        field = ex.holder.index("t1").field("f")
+        assert ex.pager.partition(field, "standard", (0,)) is None
+
+    def test_oracle_counts_match_fragment_truth(self, paged):
+        _, ex = paged
+        want = _fill(ex, "t1", "f", 3, 4)
+        field = ex.holder.index("t1").field("f")
+        row_ids = ex.planes._union_row_ids(field, "standard", (0, 1, 2))
+        got = ex.pager.oracle_counts(field, "standard", (0, 1, 2),
+                                     np.asarray(row_ids))
+        assert got[:4] == want
