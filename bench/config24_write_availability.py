@@ -233,6 +233,8 @@ def await_drained(client, timeout: float = 60.0) -> float:
 def main():
     import tempfile
 
+    from pilosa_tpu.api.client import ClientError
+    from pilosa_tpu.engine.words import SHARD_WIDTH
     from pilosa_tpu.fault.chaos import prom_counter_total
 
     from pilosa_tpu.testing import run_process_cluster
@@ -269,6 +271,27 @@ def main():
                         kill_fn=cluster.nodes[victim_i].kill9)
             log(f"[{mix_name}] failure window (kill -9 at "
                 f"t+{KILL_AT}s): {b}")
+            # Under full-suite load the failure window can land few or
+            # no writes after the kill; top up on a dedicated lane
+            # (worker index WORKERS, disjoint from the measure
+            # workers) until at least one op is hinted so the drain
+            # path below is actually exercised.
+            topup = lanes.cols_of(WORKERS)
+            topup_deadline = time.monotonic() + 30.0
+            seq = 0
+            while (entry.write_health().get("hintBacklogOps", 0) < 1
+                   and time.monotonic() < topup_deadline):
+                s = seq % N_SHARDS
+                col = (s * SHARD_WIDTH + WORKERS * LANE
+                       + (seq // N_SHARDS) % LANE)
+                seq += 1
+                try:
+                    entry.query(INDEX, f"Set({col}, {FIELD}={WRITE_ROW})")
+                except (ClientError, OSError):
+                    time.sleep(0.2)
+                    continue
+                topup[col] = True
+                time.sleep(0.05)
             backlog = entry.write_health().get("hintBacklogOps", 0)
             # restart + membership, then time the hint drain
             t0 = time.perf_counter()

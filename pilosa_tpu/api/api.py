@@ -856,6 +856,10 @@ class API:
                 # residency/hit-ratio/page-ins/sheds, QoS quotas,
                 # eviction reasons
                 "tenancy": ex.tenancy_status(),
+                # time-view planes (r23): which time fields serve range
+                # queries from a resident bucketed plane (device speed)
+                # vs the span-union fallback
+                "timeViews": ex.time_status(),
                 # per-stage overhead attribution (parse/plan/admit/
                 # dispatch/read/assemble) — the diagnostics dump behind
                 # bench/config18's concurrency-gap breakdown

@@ -192,14 +192,16 @@ class Client:
                                 _retried=True, timeout=timeout)
             raise ClientError(f"connection reset by {self.base}",
                               kind="unreachable") from e
-        except (http.client.BadStatusLine, ConnectionResetError,
-                BrokenPipeError) as e:
-            # the response was lost AFTER the request was sent: the
-            # peer may already have processed it, so an automatic retry
-            # is at-least-once.  Retry only idempotent requests (safe
-            # methods, or POSTs under the cluster's idempotency
-            # contract) — a default client surfaces the error and lets
-            # the caller decide (module docstring, ADVICE r5)
+        except (http.client.BadStatusLine, http.client.IncompleteRead,
+                ConnectionResetError, BrokenPipeError) as e:
+            # the response was lost AFTER the request was sent (a peer
+            # dying mid-response-write surfaces as IncompleteRead, not
+            # a reset): the peer may already have processed it, so an
+            # automatic retry is at-least-once.  Retry only idempotent
+            # requests (safe methods, or POSTs under the cluster's
+            # idempotency contract) — a default client surfaces the
+            # error and lets the caller decide (module docstring,
+            # ADVICE r5)
             conn.close()
             idempotent = (method in self.IDEMPOTENT_METHODS
                           or self.idempotent_posts)
@@ -293,7 +295,8 @@ class Client:
                 sink.write(chunk)
                 wrote += len(chunk)
         except (http.client.CannotSendRequest, http.client.BadStatusLine,
-                ConnectionResetError, BrokenPipeError) as e:
+                http.client.IncompleteRead, ConnectionResetError,
+                BrokenPipeError) as e:
             conn.close()
             if not _retried and wrote == 0:
                 return self.download(path, sink, chunk_size,

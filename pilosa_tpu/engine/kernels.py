@@ -190,6 +190,14 @@ TREE_AND = 4
 TREE_OR = 5
 TREE_ANDNOT = 6  # a & ~b (Difference; Not via ANDNOT(exists, x))
 TREE_XOR = 7
+TREE_SHIFT = 8   # unary: shift top of stack by STATIC arg n columns
+TREE_LIMIT = 9   # unary: keep bits ranked [off, off+lim); STATIC args
+
+# STATIC ops carry their argument IN the skeleton (a ``(op, arg)``
+# entry instead of a bare opcode): shift distances and limit bounds
+# are compile-time structure, exactly like the fused "shift" node's
+# ``n`` — the LRU program cache bounds the key space they open.
+TREE_STATIC_OPS = (TREE_SHIFT, TREE_LIMIT)
 
 # postfix evaluation of a depth-d call tree needs ~d+1 live values;
 # the planner rejects (falls back past) this bound so a hostile tree
@@ -225,7 +233,8 @@ def tree_fold(rows, skeleton: tuple, row_args: jax.Array,
     fetch = rows if callable(rows) else (lambda a: rows[a])
     stack: list = []
     ri = xi = 0
-    for op in skeleton:
+    for entry in skeleton:
+        op, sarg = entry if isinstance(entry, tuple) else (entry, None)
         if op == TREE_PUSH:
             stack.append(fetch(row_args[ri]))
             ri += 1
@@ -237,6 +246,10 @@ def tree_fold(rows, skeleton: tuple, row_args: jax.Array,
                          else zero)
         elif op == TREE_NOP:
             continue
+        elif op == TREE_SHIFT:
+            stack.append(shift(stack.pop(), sarg))
+        elif op == TREE_LIMIT:
+            stack.append(rank_limit(stack.pop(), sarg[0], sarg[1]))
         else:
             b = stack.pop()
             a = stack.pop()
@@ -321,6 +334,34 @@ def shift(words: jax.Array, n: int = 1) -> jax.Array:
              words[..., :-1]], axis=-1) >> (32 - bit_n)
         words = (words << bit_n) | carry_in
     return words
+
+
+def rank_limit(words: jax.Array, offset: int, limit: int) -> jax.Array:
+    """Keep only the bits whose global rank falls in ``[offset,
+    offset + limit)`` — the device form of ``Limit(x, limit, offset)``.
+
+    ``words``: uint32[S, W] in GLOBAL column order (shard axis in the
+    serving shard order, words ascending, bits LSB-first within each
+    word — the same order the host ``_limit_bitmap`` oracle walks);
+    ``offset``/``limit`` are STATIC (``limit < 0`` = unbounded).  Rank
+    arithmetic is int32 — safe while the shard axis stays under the
+    executor's ``_REDUCE_SHARD_MAX`` (2^31 bits total), the same bound
+    every fused count family already lives by."""
+    shape = words.shape
+    flat = words.reshape(-1)                       # [N] shard-major
+    pw = popcount(flat).astype(jnp.int32)          # per-word set bits
+    start = jnp.cumsum(pw) - pw                    # exclusive prefix
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((flat[:, None] >> lanes[None, :])
+            & jnp.uint32(1)).astype(jnp.int32)     # [N, 32]
+    within = jnp.cumsum(bits, axis=1) - bits       # exclusive, per word
+    rank = start[:, None] + within
+    keep = (bits != 0) & (rank >= offset)
+    if limit >= 0:
+        keep = keep & (rank < offset + limit)
+    packed = jnp.sum(jnp.where(keep, jnp.uint32(1) << lanes[None, :],
+                               jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+    return packed.reshape(shape)
 
 
 # ---------------------------------------------------------------------------

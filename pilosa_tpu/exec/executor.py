@@ -472,6 +472,16 @@ class Executor:
         count — None when serving single-device."""
         return self.planes.mesh_stats()
 
+    def time_status(self) -> dict:
+        """The ``/status`` ``timeViews`` block (r23): resident
+        bucketed time planes (index/field/bucket/byte geometry, delta
+        overlay state) — which time fields answer range queries at
+        device speed versus the span-union fallback."""
+        planes = self.planes.time_plane_status()
+        return {"planes": planes,
+                "residentBytes": sum(p["bytes"] for p in planes),
+                "buckets": sum(p["buckets"] for p in planes)}
+
     def tenancy_status(self) -> dict:
         """The ``/status`` ``tenancy`` block (r17): knobs, per-tenant
         residency/hit-ratio/page-in/shed counts, QoS state, eviction
@@ -1053,6 +1063,8 @@ class Executor:
         self.stats.observe("tree_fusion_depth", float(spec.depth))
         if spec.cse_hits:
             self.stats.count("tree_cse_hits_total", spec.cse_hits)
+        if spec.static_ops:
+            self.stats.count("tree_static_ops_total", spec.static_ops)
 
     def _run_tree_specs(self, ctx: _Ctx, specs, timer) -> list[int] | None:
         """Materialize + dispatch lowered tree specs: row ids resolve
@@ -1184,6 +1196,23 @@ class Executor:
                 return None
             return self.planes.row_words(ctx.index.name, field, vname,
                                          rid, ctx.shards)
+        if kind == "trange":
+            # time-range leaf inside a compound tree (r23): the words
+            # come from the fused bucket-range scan when the time plane
+            # resides, else the span oracle — the TREE stays fused
+            # either way (this is one extra operand)
+            _, fname, rid, frm, to = spec
+            field = ctx.index.field(fname)
+            if field is None or not field.options.time_quantum:
+                return None
+            start = parse_pql_time(frm) if frm is not None else None
+            end = parse_pql_time(to) if to is not None else None
+            words = self._time_range_words(ctx, field, rid, start, end)
+            if words is None:
+                words = self._time_row_span(ctx, field, rid, start, end)
+            return words
+        if kind == "constrow":
+            return self._const_row_cols(ctx, spec[1])
         fname = spec[1]
         field = ctx.index.field(fname)
         if field is None or field.options.type not in BSI_TYPES:
@@ -1568,6 +1597,18 @@ class Executor:
                 elif espec[0] == "row":
                     set_fields[espec[1]] = None
                     deps[(espec[1], espec[2])] = None
+                elif espec[0] == "trange":
+                    # every timestamped write also lands in the
+                    # standard view (store.field fan-out), so its
+                    # generations are a faithful write proxy for the
+                    # bucket views; the cover itself is re-derived per
+                    # hit, but new VIEWS appearing (first write in a
+                    # fresh period) don't bump generations the entry
+                    # tracks — stay generation-checked, not survivable
+                    deps[(espec[1], VIEW_STANDARD)] = None
+                    survivable = False
+                elif espec[0] == "constrow":
+                    pass  # literal columns: nothing to depend on
         for fname in set_fields:
             f = index.field(fname)
             if f is None:
@@ -2051,6 +2092,9 @@ class Executor:
         cols = call.args.get("columns")
         if cols is None:
             raise ExecutionError("ConstRow: missing columns argument")
+        return self._const_row_cols(ctx, cols)
+
+    def _const_row_cols(self, ctx: _Ctx, cols) -> jax.Array:
         host = np.zeros((len(ctx.shards), WORDS_PER_SHARD), np.uint32)
         shard_slot = {s: si for si, s in enumerate(ctx.shards)}
         for c in cols:
@@ -2264,6 +2308,53 @@ class Executor:
         q = field.options.time_quantum
         if not q:
             raise ExecutionError(f"field {field.name!r} is not a time field")
+        # legacy positional form: Range(f=1, <from-ts>, <to-ts>)
+        frm = call.args.get("from", call.args.get("_timestamp"))
+        to = call.args.get("to", call.args.get("_timestamp2"))
+        start = parse_pql_time(str(frm)) if frm is not None else None
+        end = parse_pql_time(str(to)) if to is not None else None
+        words = self._time_range_words(ctx, field, row_id, start, end)
+        if words is not None:
+            return words
+        return self._time_row_span(ctx, field, row_id, start, end)
+
+    def _time_range_words(self, ctx: _Ctx, field: Field, row_id: int,
+                          start, end) -> "jax.Array | None":
+        """Fused time-range path (r23): answer ``row seen in [start,
+        end)`` as ONE OR-scan over the contiguous bucket slot range of
+        the field's resident :class:`timeviews.TimePlaneSet` —
+        equivalent bit for bit to the mixed-granularity cover union
+        (finest views carry every bit; ``tests/test_timeviews.py``
+        pins it).  None = not runnable at device speed right now
+        (degraded device, plane over budget / not built, too many
+        shards) — the caller stays on the op-at-a-time span oracle."""
+        if (self.batcher is not None
+                and not self.batcher.governor.fastlane_ok()):
+            return None
+        if len(ctx.shards) > self._REDUCE_SHARD_MAX:
+            return None
+        tps = self.planes.time_plane_nowait(ctx.index.name, field,
+                                            ctx.shards)
+        if tps is None:
+            return None
+        idx = tps.slot_of.get(int(row_id))
+        if idx is None:
+            return self._zeros(ctx)
+        b0, b1 = tps.bucket_range(start, end)
+        if b1 <= b0:
+            return self._zeros(ctx)
+        self.stats.observe("time_range_cover_size", float(b1 - b0))
+        return self.fused.run_time_range(
+            tps.plane, idx * tps.n_buckets + b0, b1 - b0,
+            delta=tps.delta)
+
+    def _time_row_span(self, ctx: _Ctx, field: Field, row_id: int,
+                       start, end) -> jax.Array:
+        """Op-at-a-time time-range oracle: union one device row fetch
+        per minimal-cover view.  Kept as the correctness oracle the
+        fused path is pinned against and as the serving fallback when
+        the time plane isn't residing (budget, degraded device)."""
+        q = field.options.time_quantum
         # clamp the range to the span actually covered by existing views:
         # an omitted bound would otherwise enumerate views unit-by-unit
         # across the whole calendar
@@ -2279,11 +2370,8 @@ class Executor:
             return self._zeros(ctx)
         vmin = min(s for s, _ in spans)
         vmax = max(e for _, e in spans)
-        # legacy positional form: Range(f=1, <from-ts>, <to-ts>)
-        frm = call.args.get("from", call.args.get("_timestamp"))
-        to = call.args.get("to", call.args.get("_timestamp2"))
-        start = max(parse_pql_time(str(frm)) if frm is not None else vmin, vmin)
-        end = min(parse_pql_time(str(to)) if to is not None else vmax, vmax)
+        start = vmin if start is None else max(start, vmin)
+        end = vmax if end is None else min(end, vmax)
         acc = self._zeros(ctx)
         for vname in views_by_time_range(VIEW_STANDARD, start, end, q):
             if field.view(vname) is None:
@@ -2291,6 +2379,31 @@ class Executor:
             acc = kernels.union(acc, self.planes.row_words(
                 ctx.index.name, field, vname, row_id, ctx.shards))
         return acc
+
+    def _time_cover_views(self, field: Field, frm, to) -> list[str]:
+        """Existing view names minimally covering a Rows/GroupBy time
+        filter, with the oracle's span clamping — the shared answer to
+        "which views can contribute rows in [from, to)"."""
+        q = field.options.time_quantum
+        if not q:
+            raise ExecutionError(f"field {field.name!r} is not a time field")
+        spans = []
+        prefix = VIEW_STANDARD + "_"
+        for vname in field.views:
+            if vname.startswith(prefix):
+                try:
+                    spans.append(view_span(vname[len(prefix):]))
+                except ValueError:
+                    continue
+        if not spans:
+            return []
+        vmin = min(s for s, _ in spans)
+        vmax = max(e for _, e in spans)
+        start = vmin if frm is None else max(parse_pql_time(str(frm)), vmin)
+        end = vmax if to is None else min(parse_pql_time(str(to)), vmax)
+        return [vname
+                for vname in views_by_time_range(VIEW_STANDARD, start, end, q)
+                if field.view(vname) is not None]
 
     def _bsi_condition(self, ctx: _Ctx, field: Field,
                        cond: Condition) -> jax.Array:
@@ -3046,7 +3159,21 @@ class Executor:
         return RowIdsResult(rows=rows)
 
     def _rows_of(self, ctx: _Ctx, field: Field, call: Call) -> np.ndarray:
-        """Row IDs with ≥1 bit, honoring column=, previous=, limit=."""
+        """Row IDs with ≥1 bit, honoring column=, from=/to= (time
+        fields: only rows seen in the range's minimal view cover),
+        previous=, limit=."""
+        frm = call.args.get("from")
+        to = call.args.get("to")
+        if frm is not None or to is not None:
+            # time filter: the candidate views are the range's minimal
+            # cover instead of the all-time standard view (r23) —
+            # GroupBy time filters inherit this via its _rows_of calls
+            views = [field.views.get(v)
+                     for v in self._time_cover_views(field, frm, to)]
+            views = [v for v in views if v is not None]
+        else:
+            views = ([field.standard_view()]
+                     if field.standard_view() is not None else [])
         column = call.args.get("column")
         if column is not None:
             # column filter needs the bits: check membership per shard
@@ -3055,19 +3182,22 @@ class Executor:
             if col_id is None:
                 return np.empty(0, np.uint64)
             shard, off = col_id // SHARD_WIDTH, col_id % SHARD_WIDTH
-            view = field.standard_view()
-            frag = view.fragment(shard) if view is not None else None
-            if frag is None or shard not in ctx.shards:
+            if shard not in ctx.shards:
                 return np.empty(0, np.uint64)
             # vectorized inverted check (generation-cached) instead of a
             # per-row contains() loop — 100k-row fields answer in ms
-            rows = frag.rows_containing(off)
+            row_set: set[int] = set()
+            for view in views:
+                frag = view.fragment(shard)
+                if frag is not None:
+                    row_set.update(int(r)
+                                   for r in frag.rows_containing(off))
+            rows = np.array(sorted(row_set), dtype=np.uint64)
         else:
             # live rows come straight from the fragment indexes — no
             # plane materialization or device round trip needed
-            view = field.standard_view()
-            row_set: set[int] = set()
-            if view is not None:
+            row_set = set()
+            for view in views:
                 for s in ctx.shards:
                     if s == PAD_SHARD:
                         continue

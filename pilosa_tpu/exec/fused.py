@@ -229,9 +229,14 @@ class PingPong:
 def _pad_skeleton(prog: tuple) -> tuple:
     """A postfix program's STATIC opcode skeleton, NOP-padded to the
     pow2 length bucket — the one bucketing rule every tree entry
-    (solo, window item) keys on, so the paths cannot drift apart."""
+    (solo, window item) keys on, so the paths cannot drift apart.
+    STATIC ops (Shift/Limit, r23) keep their argument in the skeleton
+    as an ``(op, arg)`` entry: the argument is compiled structure
+    (like the fused "shift" node's ``n``), so it must live in the
+    program key, not the traced operands."""
     p_pad = pow2_bucket(max(1, len(prog)))
-    return (tuple(op for op, _ in prog)
+    return (tuple((op, arg) if op in kernels.TREE_STATIC_OPS else op
+                  for op, arg in prog)
             + (kernels.TREE_NOP,) * (p_pad - len(prog)))
 
 
@@ -712,6 +717,47 @@ class FusedCache:
         calls (Row trees, Store/filter sources)."""
         return self._tree_program(plane, slots, (prog,), extras, delta,
                                   "words")
+
+    def run_time_range(self, plane, start: int, length: int,
+                       delta=None) -> jax.Array:
+        """One time field's ``[t0, t1)`` bitmap off its bucketed time
+        plane (``pilosa_tpu.timeviews``) in ONE program: gather the
+        CONTIGUOUS slot run ``[start, start + length)`` (clip-padded
+        to the pow2 length bucket — dead lanes clip to the last slot
+        and are masked AFTER the delta overlay, so the overlay's
+        first-lane matching always lands on a live lane), overlay
+        pending (row, bucket) delta cells, and OR-reduce the bucket
+        lanes.  Returns uint32[S, W]; the program key is the plane
+        shape + pow2 length bucket (start/length stay traced), so any
+        range of the same padded width reuses one executable."""
+        l_pad = pow2_bucket(max(1, length))
+        has_delta = delta is not None
+        key = (("trange", plane.shape, sharding_key(plane), l_pad,
+                delta.rows.shape[0] if has_delta else None), "words")
+
+        def build():
+            def program(p, st, n, *dl):
+                r_pad = p.shape[-2]
+                lane = jnp.arange(l_pad, dtype=jnp.int32)
+                idx = jnp.clip(st[0] + lane, 0, r_pad - 1)
+                sel = jnp.take(p, idx, axis=-2)      # [S, L_pad, W]
+                if has_delta:
+                    from pilosa_tpu.ingest.delta import \
+                        overlay_gathered_rows
+                    sel = overlay_gathered_rows(sel, idx, *dl, r_pad)
+                sel = jnp.where((lane < n[0])[None, :, None], sel,
+                                jnp.uint32(0))
+                return jax.lax.reduce(
+                    sel, jnp.uint32(0),
+                    lambda x, y: jnp.bitwise_or(x, y),
+                    dimensions=(sel.ndim - 2,))
+            return program
+
+        args = (plane, self._slot_idx((int(start),)),
+                self._slot_idx((int(length),)))
+        if has_delta:
+            args += (delta.rows, delta.words, delta.vals)
+        return self._cached(key, build)(*args)
 
     def run_readback_pack(self, arrays: tuple,
                           scratch=None) -> jax.Array:

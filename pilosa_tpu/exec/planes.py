@@ -479,6 +479,206 @@ class PlaneCache:
         t.start()
         return None
 
+    def time_plane_nowait(self, index: str, field: Field,
+                          shards: tuple[int, ...]):
+        """One time field's bucketed device plane
+        (:class:`pilosa_tpu.timeviews.TimePlaneSet`) if resident and
+        serving-fresh, else None after kicking a build — the r23 time
+        family's residency entry point, mirroring
+        :meth:`field_plane_nowait`'s lock discipline.
+
+        Validity is the SUFFIX-TAGGED per-bucket-view generation
+        tuple (``timeviews.time_gens``): a bumped fragment absorbs
+        into the (row, bucket)-keyed delta overlay (zero rebuilds
+        under sustained event ingest); a new bucket, new row, or
+        whole-row clear rebuilds.  Callers fall back to the
+        op-at-a-time ``_time_row_span`` oracle on None."""
+        from pilosa_tpu import timeviews
+        key = ("tplane", index, field.name, shards)
+        # lock-free fast path: fresh entry serves as-is, overlay and
+        # all (run_time_range merges it in-program)
+        hit = self._entries.get(key)
+        if hit is not None and hit[0] == timeviews.time_gens(
+                field, shards, fast=True):
+            self._touch(key)
+            self._lease_fast(key)
+            self.hits += 1
+            return hit[1]
+        gens = timeviews.time_gens(field, shards)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0] == gens:
+                self._touch(key)
+                self._lease(key)
+                self.hits += 1
+                return hit[1]
+        if hit is not None:
+            tps = self._time_absorb(key, field, shards, hit)
+            if tps is not None:
+                with self._lock:
+                    self._lease(key)
+                self.hits += 1
+                return tps
+        plan = timeviews.plan_time_plane(field, shards)
+        self.misses += 1
+        if plan is None:
+            return None  # no time views yet: nothing to serve from
+        nbytes = plan[-1]
+        if nbytes > self.budget:
+            return None  # caller stays on the oracle path
+        import time as _time
+        t0 = _time.perf_counter()
+        tps = timeviews.build_time_plane(field, shards, self.place,
+                                         plan=plan)
+        dt = _time.perf_counter() - t0
+        self.builds += 1
+        self.build_seconds_total += dt
+        self.build_bytes_total += nbytes
+        self._stats.observe("plane_build_seconds", dt)
+        self._stats.count("plane_build_bytes_total", nbytes)
+        self._stats.gauge("time_view_buckets", float(len(plan[0])))
+        self._insert_entry(key, gens, tps, nbytes, lease=True)
+        return tps
+
+    def _time_absorb(self, key, field: Field, shards: tuple[int, ...],
+                     hit, attempts: int = 3):
+        """Absorb the write gap of a stale "tplane" entry into its
+        bounded device overlay (cells keyed by flat (row, bucket)
+        slot) and advance the suffix-tagged generations — the step
+        that keeps sustained time-bucketed ingest ZERO-rebuild.  None
+        = can't absorb (disabled, new bucket/row, whole-row clear,
+        overlay full, journal gap): the caller rebuilds — time planes
+        have no fold path (the bucketed row axis doesn't match any
+        single view's scatter), and rebuilds are sized by the live
+        (row × bucket) set, not the field's full history.  Losing the
+        entry-swap race to a concurrent reader's absorb retries
+        against the new entry (up to ``attempts``) rather than
+        degrading to a rebuild — under write+read concurrency that
+        race is routine, a rebuild is not."""
+        if self.delta_cells <= 0:
+            return None
+        from pilosa_tpu.ingest.delta import DeltaMirror
+        from pilosa_tpu.timeviews import TimePlaneSet
+        while True:
+            old_gens, tps, nbytes = hit
+            got = self._time_collect_changes(field, shards, hit,
+                                             self.delta_cells)
+            if got is None:
+                return None
+            cells, actual = got
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is None or cur[1] is not tps:
+                    if cur is not None and cur[0] == actual:
+                        return cur[1]  # raced absorb, serving-fresh
+                    if cur is None or attempts <= 0:
+                        return None
+                    attempts -= 1
+                    hit = cur  # re-collect against the new entry
+                    continue
+                if actual == tuple(old_gens):
+                    return tps  # no real gap (benign generation race)
+                mir = self._delta_mirrors.get(key)
+                if mir is None or mir[0] is not tps.plane:
+                    mir = (tps.plane, DeltaMirror(self.delta_cells))
+                    self._delta_mirrors[key] = mir
+                mirror = mir[1]
+                if not mirror.would_fit(cells):
+                    return None  # overlay full: rebuild supersedes it
+                mirror.absorb(cells)
+                overlay = mirror.build_overlay(
+                    self._overlay_put(),
+                    tps.plane.shape[0] * tps.plane.shape[1])
+                new_tps = TimePlaneSet(tps.plane, tps.shards,
+                                       tps.row_ids, tps.slot_of,
+                                       tps.buckets, tps.bucket_starts,
+                                       tps.unit, delta=overlay)
+                self._entries[key] = (actual, new_tps, nbytes)
+                self._stamps.insert(key)
+            self.delta_absorbs += 1
+            return new_tps
+
+    def _time_collect_changes(self, field: Field,
+                              shards: tuple[int, ...], hit, cap: int):
+        """Gather a "tplane" entry's write gap across its bucket
+        views as overwrite cells ``({(flat_row, word): value},
+        covered-through suffix-tagged gens)``; None = rebuild (bucket
+        directory changed, new fragment/row, whole-row clear, over
+        cap)."""
+        from pilosa_tpu import timeviews
+        from pilosa_tpu.store.view import VIEW_STANDARD
+        old_gens, tps, _nbytes = hit
+        if tuple(timeviews.bucket_suffixes(field)) != tps.buckets \
+                or tuple(s for s, _ in old_gens) != tps.buckets:
+            return None  # bucket appeared/vanished: geometry changed
+        rb_pad = tps.plane.shape[1]
+        nb = tps.n_buckets
+        cells: dict = {}
+        actual = []
+        for bi, (suf, gens) in enumerate(old_gens):
+            view = field.views.get(VIEW_STANDARD + "_" + suf)
+            if view is None or len(gens) != len(shards):
+                return None
+            new_gens = list(gens)
+            for si, s in enumerate(shards):
+                if s == PAD_SHARD:
+                    continue
+                frag = view.fragment(s)
+                if frag is None:
+                    if gens[si] != -1:
+                        return None  # fragment vanished: rebuild
+                    continue
+                with frag.lock:
+                    if gens[si] == -1:
+                        return None  # new fragment: row set unknown
+                    if frag.generation == gens[si]:
+                        continue
+                    changed = frag.changed_cells_since(gens[si])
+                    if changed is None:
+                        return None
+                    for r, words in changed.items():
+                        slot = tps.slot_of.get(int(r))
+                        if slot is None:
+                            return None  # new row: shape changed
+                        if words is None:
+                            return None  # whole-row clear: rebuild
+                        flat = si * rb_pad + slot * nb + bi
+                        row_words = np.asarray(
+                            frag.row(int(r)).words(), np.uint32)
+                        w_arr = np.fromiter(words, np.int64,
+                                            len(words))
+                        for w, v in zip(w_arr.tolist(),
+                                        row_words[w_arr].tolist()):
+                            cells[(flat, int(w))] = int(v)
+                        if len(cells) > cap:
+                            return None
+                    new_gens[si] = frag.generation
+            actual.append((suf, tuple(new_gens)))
+        return cells, tuple(actual)
+
+    def time_plane_status(self) -> list[dict]:
+        """Resident "tplane" entries for the /status timeViews block:
+        one row per (index, field) with bucket/row/byte geometry and
+        overlay state — the operator's view of which time fields are
+        serving at device speed."""
+        out = []
+        with self._lock:
+            entries = list(self._entries.items())
+        for key, (gens, tps, nbytes) in entries:
+            if key[0] != "tplane":
+                continue
+            out.append({
+                "index": key[1],
+                "field": key[2],
+                "shards": len(key[3]),
+                "buckets": int(tps.n_buckets),
+                "unit": tps.unit,
+                "rows": int(len(tps.row_ids)),
+                "bytes": int(nbytes),
+                "delta": tps.delta is not None,
+            })
+        return out
+
     def wait_builds(self, timeout: float = 300.0) -> None:
         """Join in-flight background builds (OOM recovery's exclusive
         stage must not race GBs of invisible build residency)."""

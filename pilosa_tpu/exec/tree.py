@@ -28,20 +28,26 @@ compound call to a canonical kernel spec —
 
 What lowers: ``Intersect/Union/Difference/Xor/Not/UnionRows`` trees
 over plain set-field rows, with BSI range conditions as leaf row
-filters (predicate bitmaps enter as extra operands) and ``All`` as the
-existence row.  What falls back (``Unfusable`` → the generic fused /
-eager paths, identical answers): time-range rows, ``Shift``/``Limit``/
-``ConstRow``, trees with no plain-row leaf to anchor the gather, and
-trees deeper than the fixed operand stack or longer than
-``TREE_MAX_PROG`` steps.
+filters (predicate bitmaps enter as extra operands), ``All`` as the
+existence row, time-range rows as extra operands off the bucketed time
+plane (r23, ``pilosa_tpu.timeviews``), ``ConstRow`` as a literal extra
+operand, and ``Shift``/``Limit`` as STATIC postfix ops folded into the
+skeleton (their arguments are compiled structure, like the fused
+"shift" node's ``n``).  What falls back (``Unfusable`` → the generic
+fused / eager paths, identical answers): trees with no plain-row leaf
+to anchor the gather (pure time/ConstRow trees ride the generic fused
+planner instead), and trees deeper than the fixed operand stack or
+longer than ``TREE_MAX_PROG`` steps.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-from pilosa_tpu.engine.kernels import (TREE_AND, TREE_PUSH, TREE_PUSHX,
-                                       TREE_STACK_DEPTH, TREE_ZERO)
+from pilosa_tpu.engine.kernels import (TREE_AND, TREE_LIMIT, TREE_PUSH,
+                                       TREE_PUSHX, TREE_SHIFT,
+                                       TREE_STACK_DEPTH,
+                                       TREE_STATIC_OPS, TREE_ZERO)
 from pilosa_tpu.exec.fused import Unfusable
 from pilosa_tpu.pql.ast import BETWEEN_OPS, BOOL_CALLS, Call, Condition
 from pilosa_tpu.store.field import BSI_TYPES
@@ -49,7 +55,8 @@ from pilosa_tpu.store.view import VIEW_STANDARD
 
 # the compound-call names the tree compiler owns (a bare Row/All Count
 # keeps the existing selected/whole-plane serving spine)
-TREE_CALLS = frozenset(BOOL_CALLS) | {"Not", "UnionRows"}
+TREE_CALLS = (frozenset(BOOL_CALLS)
+              | {"Not", "UnionRows", "Shift", "Limit", "ConstRow"})
 
 # program-length cap: a UnionRows over thousands of rows would explode
 # the postfix program (and its pow2 bucket); past this the tree falls
@@ -128,6 +135,7 @@ class TreeSpec:
     volatile: bool    # row-set resolution depends on data (UnionRows)
     keyed_rows: bool  # some row id came from a key translation
     bsi_depths: tuple  # ((field, bit_depth), ...) predicate bakes
+    static_ops: int = 0  # Shift/Limit ops folded into the skeleton
 
 
 class _Lower:
@@ -151,11 +159,14 @@ class _Lower:
         self.volatile = False
         self.keyed_rows = False
         self.bsi_depths: dict[str, int] = {}
+        self.static_ops = 0
 
     # -- emission -----------------------------------------------------------
 
     def _emit(self, op: int, arg=0) -> None:
-        if op >= TREE_AND:
+        if op in TREE_STATIC_OPS:
+            self.static_ops += 1  # unary: pop one, push one (sp-neutral)
+        elif op >= TREE_AND:
             self.sp -= 1
         else:  # PUSH / ZERO
             self.sp += 1
@@ -213,6 +224,20 @@ class _Lower:
         if name == "UnionRows":
             self._union_rows(call)
             return
+        if name == "Shift":
+            from pilosa_tpu.exec.executor import ExecutionError
+            if len(call.children) != 1:
+                raise ExecutionError("Shift: exactly one child required")
+            n = self.ex._shift_n(call)  # validates, same errors as eager
+            self.lower(call.children[0], depth + 1)
+            self._emit(TREE_SHIFT, n)
+            return
+        if name == "Limit":
+            self._limit(call, depth)
+            return
+        if name == "ConstRow":
+            self._const_row(call)
+            return
         def emit_fold(op, kids):
             kids[0]()
             for child in kids[1:]:
@@ -243,14 +268,28 @@ class _Lower:
                     else Condition("==", value))
             self._bsi(field, cond)
             return
-        if ("from" in call.args or "to" in call.args
-                or "_timestamp" in call.args):
-            raise Unfusable("time-range rows stay on the generic path")
         if field.options.keys:
             self.keyed_rows = True
         row_id = self.ex._row_id(self.ctx, field, value, create=False)
         if row_id is None:
             self._emit(TREE_ZERO)
+            return
+        if ("from" in call.args or "to" in call.args
+                or "_timestamp" in call.args):
+            # time-range rows (r23): one extra operand off the bucketed
+            # time plane — a fused OR-scan over the contiguous bucket
+            # range, the oracle path when the plane isn't resident.
+            # Order matters: the eager path resolves the row FIRST
+            # (unknown row → zeros, never a not-a-time-field error).
+            if not field.options.time_quantum:
+                raise ExecutionError(
+                    f"field {field.name!r} is not a time field")
+            frm = call.args.get("from", call.args.get("_timestamp"))
+            to = call.args.get("to", call.args.get("_timestamp2"))
+            self._emit(TREE_PUSH, self._extra(
+                ("trange", field.name, int(row_id),
+                 None if frm is None else str(frm),
+                 None if to is None else str(to))))
             return
         self._push_field_row(field, int(row_id))
 
@@ -276,6 +315,36 @@ class _Lower:
                     self._emit(_OP_CODE["or"])
         if n == 0:
             self._emit(TREE_ZERO)
+
+    def _limit(self, call: Call, depth: int) -> None:
+        """``Limit(x, limit=, offset=)`` as a STATIC postfix op: the
+        rank-window kernel (``engine.kernels.rank_limit``) keeps bits
+        by global column rank in-program — the host column round trip
+        the eager ``_limit_bitmap`` pays disappears.  Bounds are
+        compiled structure (skeleton key), like Shift's ``n``."""
+        from pilosa_tpu.exec.executor import ExecutionError
+        if len(call.children) != 1:
+            raise ExecutionError(
+                "Limit: exactly one bitmap child required")
+        offset = int(call.args.get("offset", 0))
+        limit = call.args.get("limit")
+        if offset < 0 or (limit is not None and int(limit) < 0):
+            raise ExecutionError("Limit: limit/offset must be >= 0")
+        self.lower(call.children[0], depth + 1)
+        self._emit(TREE_LIMIT,
+                   (offset, -1 if limit is None else int(limit)))
+
+    def _const_row(self, call: Call) -> None:
+        """``ConstRow(columns=[...])`` as a literal extra operand; key
+        columns translate per hit (the plan survival rules mark keyed
+        specs non-survivable, same as keyed rows)."""
+        from pilosa_tpu.exec.executor import ExecutionError
+        cols = call.args.get("columns")
+        if cols is None:
+            raise ExecutionError("ConstRow: missing columns argument")
+        if any(isinstance(c, str) for c in cols):
+            self.keyed_rows = True
+        self._emit(TREE_PUSH, self._extra(("constrow", tuple(cols))))
 
     def _bsi(self, field, cond: Condition) -> None:
         from pilosa_tpu.exec.executor import (_SCALAR_TO_KEY,
@@ -333,13 +402,14 @@ def lower_count_tree(ex, ctx, call: Call) -> TreeSpec:
     # operand stack each push reads
     prog = tuple(
         ((TREE_PUSH, arg[1]) if arg[0] == "r" else (TREE_PUSHX, arg[1]))
-        if isinstance(arg, tuple) else (op, arg)
+        if (op == TREE_PUSH and isinstance(arg, tuple)) else (op, arg)
         for op, arg in low.prog)
     return TreeSpec(field=low.field.name, rows=tuple(low.rows),
                     extras=tuple(low.extras), prog=prog,
                     depth=low.depth, cse_hits=low.cse_hits,
                     volatile=low.volatile, keyed_rows=low.keyed_rows,
-                    bsi_depths=tuple(low.bsi_depths.items()))
+                    bsi_depths=tuple(low.bsi_depths.items()),
+                    static_ops=low.static_ops)
 
 
 def assemble_items(items) -> tuple:

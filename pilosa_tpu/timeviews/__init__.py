@@ -1,0 +1,170 @@
+"""Time-quantum views as first-class device planes (r23 tentpole).
+
+PAPER.md's PQL surface includes time-range queries over time-quantum
+views; until this module every time-range Row was answered by
+``Executor._time_row_span`` — a host-side loop unioning one device row
+fetch per cover view.  Here a time field's finest-unit views land as
+ONE plane with a per-quantum row axis: plane row slot
+``slot_of[row_id] * n_buckets + bucket`` holds ``row_id``'s bits for
+calendar bucket ``bucket`` (suffixes sorted ascending — digit order IS
+calendar order at a fixed suffix length), so "row seen in [t0, t1)"
+lowers to a fused OR-scan over one CONTIGUOUS slot range — static pow2
+length bucket, traced start offset, the same program-key discipline as
+every fused family — and time-bucketed ingest absorbs into the
+existing delta-overlay machinery keyed per (row, bucket) flat slot.
+
+Only the FINEST quantum unit's views materialize into the plane: every
+timestamped write lands in ALL granularity views
+(:func:`pilosa_tpu.store.timeq.views_by_time`), so the finest views
+alone carry every bit, and a union over the finest buckets whose span
+starts fall in ``[floor(from), floor(to))`` equals the oracle's
+mixed-granularity minimal cover (``views_by_time_range``) bit for bit
+— the equivalence ``tests/test_timeviews.py`` pins.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from dataclasses import dataclass
+
+import numpy as np
+
+from pilosa_tpu.engine.words import WORDS_PER_SHARD
+from pilosa_tpu.store import timeq
+from pilosa_tpu.store.view import VIEW_STANDARD
+
+# view-name suffix length per quantum unit (standard_2017 /
+# standard_201701 / standard_20170102 / standard_2017010203)
+_SUFFIX_LEN = {"Y": 4, "M": 6, "D": 8, "H": 10}
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def finest_unit(quantum: str) -> str:
+    """The smallest granularity unit of a validated quantum string."""
+    return timeq.validate_quantum(quantum)[-1]
+
+
+def bucket_suffixes(field) -> list[str]:
+    """Sorted finest-unit time-view suffixes present on ``field`` —
+    the plane's bucket directory."""
+    unit = finest_unit(field.options.time_quantum)
+    n = _SUFFIX_LEN[unit]
+    pre = VIEW_STANDARD + "_"
+    out = []
+    for name in list(field.views):
+        suf = name[len(pre):] if name.startswith(pre) else ""
+        if len(suf) == n and suf.isdigit():
+            out.append(suf)
+    return sorted(out)
+
+
+@dataclass
+class TimePlaneSet:
+    """One time field's views as a single bucketed device plane.
+
+    Like :class:`pilosa_tpu.exec.planes.PlaneSet`, ``plane`` is the
+    IMMUTABLE base and ``delta`` an optional device write overlay
+    (cells keyed by flat (row, bucket) slot) merged in-program."""
+
+    plane: object             # uint32[S, RB_pad, W]; RB_pad = pow2(R*B)
+    shards: tuple
+    row_ids: np.ndarray       # uint64[R] sorted rows across all buckets
+    slot_of: dict             # row id -> row index (slot = idx*B + b)
+    buckets: tuple            # finest-unit view suffixes, ascending
+    bucket_starts: tuple      # datetime span start per bucket
+    unit: str                 # finest quantum unit (Y/M/D/H)
+    delta: object | None = None
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def bucket_range(self, start, end) -> tuple[int, int]:
+        """Half-open bucket index range answering ``[start, end)``
+        after flooring both endpoints to the finest unit (``None`` =
+        unbounded), matching the oracle's truncation semantics: bucket
+        ``b`` is in range iff ``floor(start) <= starts[b] <
+        floor(end)``."""
+        b0 = (0 if start is None else bisect_left(
+            self.bucket_starts, timeq._floor(start, self.unit)))
+        b1 = (self.n_buckets if end is None else bisect_left(
+            self.bucket_starts, timeq._floor(end, self.unit)))
+        return b0, max(b0, b1)
+
+
+def time_gens(field, shards, fast: bool = False) -> tuple:
+    """Per-bucket-view fragment generations, suffix-tagged — the
+    "tplane" cache entry's validity snapshot.  Embedding the suffix in
+    each element means a NEW bucket appearing (first write into a
+    fresh calendar period) reads as a mismatch, not merely a bumped
+    generation the delta absorber could paper over."""
+    out = []
+    for suf in bucket_suffixes(field):
+        v = field.views.get(VIEW_STANDARD + "_" + suf)
+        if v is None:
+            gens = ()
+        else:
+            gens = (v.generations_fast(shards) if fast
+                    else v.generations(shards))
+        out.append((suf, gens))
+    return tuple(out)
+
+
+def plan_time_plane(field, shards):
+    """Bucket directory + row union + padded geometry — the host-only
+    admission half of the build, so the plane cache can budget-gate on
+    ``nbytes`` before touching any fragment payloads.  Returns
+    ``(buckets, bucket_starts, unit, row_ids, slot_of, rb_pad,
+    nbytes)`` or ``None`` when the field has no time views yet."""
+    buckets = tuple(bucket_suffixes(field))
+    if not buckets:
+        return None
+    unit = finest_unit(field.options.time_quantum)
+    bucket_starts = tuple(timeq.parse_view_time(s)[0] for s in buckets)
+    ids = []
+    for suf in buckets:
+        v = field.views.get(VIEW_STANDARD + "_" + suf)
+        if v is None:
+            continue
+        for shard in shards:
+            frag = v.fragments.get(shard)
+            if frag is not None:
+                arr = frag.row_ids_array()
+                if len(arr):
+                    ids.append(np.asarray(arr, np.uint64))
+    row_ids = (np.unique(np.concatenate(ids)) if ids
+               else np.empty(0, np.uint64))
+    slot_of = {int(r): i for i, r in enumerate(row_ids)}
+    rb_pad = _pow2(max(1, len(row_ids) * len(buckets)))
+    nbytes = len(shards) * rb_pad * WORDS_PER_SHARD * 4
+    return buckets, bucket_starts, unit, row_ids, slot_of, rb_pad, nbytes
+
+
+def build_time_plane(field, shards, place, plan=None):
+    """Materialize the bucketed time plane: one host assembly pass per
+    (bucket view, shard) through ``Fragment.plane_rows`` (rows absent
+    from a bucket leave their slots all-zero), then one device
+    placement.  Returns a :class:`TimePlaneSet`, or ``None`` when the
+    field has no time views."""
+    if plan is None:
+        plan = plan_time_plane(field, shards)
+    if plan is None:
+        return None
+    buckets, bucket_starts, unit, row_ids, slot_of, rb_pad, _ = plan
+    nb = len(buckets)
+    host = np.zeros((len(shards), rb_pad, WORDS_PER_SHARD), np.uint32)
+    rows = [int(r) for r in row_ids]
+    for b, suf in enumerate(buckets):
+        v = field.views.get(VIEW_STANDARD + "_" + suf)
+        if v is None:
+            continue
+        slots = [slot_of[r] * nb + b for r in rows]
+        for si, shard in enumerate(shards):
+            frag = v.fragments.get(shard)
+            if frag is not None and rows:
+                frag.plane_rows(rows, host[si], slots=slots)
+    return TimePlaneSet(place(host), tuple(shards), row_ids, slot_of,
+                        buckets, bucket_starts, unit)

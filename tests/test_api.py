@@ -522,3 +522,19 @@ class TestClientRetryPolicy:
                          idempotent_posts=True)
         assert c._do("POST", "/internal/fragment/merge", b"x") == \
             {"ok": True}
+
+    def test_truncated_response_is_lost_response_class(self):
+        # a peer killed mid-response-write surfaces as IncompleteRead
+        # (not a reset): same lost-response class — an idempotent
+        # request retries, a default POST surfaces a transport-kind
+        # ClientError so read failover / write hinting can route
+        # around the dead peer instead of bubbling a raw 500
+        import http.client
+        from pilosa_tpu.api.client import ClientError
+        c = self._client(http.client.IncompleteRead(b"", 29),
+                         idempotent_posts=True)
+        assert c._do("POST", "/internal/query", b"x") == {"ok": True}
+        c = self._client(http.client.IncompleteRead(b"", 29))
+        with pytest.raises(ClientError) as ei:
+            c._do("POST", "/index/i/query", b"Set(1, f=1)")
+        assert ei.value.kind == "unreachable"

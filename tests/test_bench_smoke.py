@@ -680,3 +680,46 @@ def test_config32_multitenant_smoke():
     assert d["worst_tenant_p99_inv"] is not None
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config33_event_analytics_smoke():
+    """bench/config33 (event analytics over time-view planes, ISSUE
+    18) in --smoke mode: recency/retention/sliding-window shapes plus
+    the drained unfusable tail (Shift/Limit/ConstRow) and time-
+    filtered Rows/GroupBy, then the mixed shape set under sustained
+    time-bucketed ingest.  The ISSUE 18 acceptance bars are asserted
+    IN-BENCH while measuring — every answer bit-exact against the
+    op-at-a-time oracle live AND quiesced, ZERO time-plane rebuilds
+    during mixed serving (the per-(row,bucket) overlay absorbs every
+    write), the fused time-range path provably engaged
+    (time_range_cover_size observed) and the static tree ops counted
+    (tree_static_ops_total > 0, i.e. no silent eager fallback) — and
+    re-checked here on the artifact."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config33_event_analytics.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("event_analytics_qps")
+    assert out["unit"] == "qps" and out["value"] > 0
+    d = out["detail"]
+    # the whole surface measured: every shape has qps
+    assert set(d["shapes"]) == {"recency", "retention", "sliding",
+                                "rows_time", "groupby_time", "shift",
+                                "limit", "constrow"}
+    assert all(v["qps"] > 0 for v in d["shapes"].values())
+    # the ISSUE 18 contracts, re-checked on the artifact
+    assert d["plane_rebuilds_during_serving"] == 0
+    assert d["delta_absorbs"] >= 1
+    assert d["time_range_scans"] > 0
+    assert d["tree_static_ops"] > 0
+    assert d["mixed_under_ingest"]["qps"] > 0
+    # the same-metric history guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -157,7 +157,15 @@ class TestClientSeams:
         with pytest.raises(ClientError):
             c.query("i", "Set(1, f=1)")
         fault.clear()
-        # ... and the write DID apply server-side (at-least-once)
+        # ... and the write DID apply server-side (at-least-once).
+        # The Set lands asynchronously relative to the dropped
+        # response, so poll briefly instead of asserting the very
+        # first read (ordering-dependent flake under the full suite).
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if client.query("i", "Count(Row(f=1))") == [1]:
+                break
+            time.sleep(0.01)
         assert client.query("i", "Count(Row(f=1))") == [1]
 
     def test_server_drop_response_processes_then_drops(self, srv):

@@ -1,0 +1,371 @@
+"""Time-view planes (r23, ISSUE 18): the fused bucket-range scan must
+answer every time-range shape bit-exactly like the op-at-a-time span
+oracle (``Executor._time_row_span``), the static postfix tail
+(Shift/Limit/ConstRow) must answer identically through the fused tree
+path and the eager path, and in-bucket ingest must absorb into the
+time plane's delta overlay with ZERO rebuilds."""
+
+from datetime import datetime, timedelta
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import ExecutionError, Executor
+from pilosa_tpu.store import FieldOptions, Holder
+from pilosa_tpu.store import timeq
+
+T0 = datetime(2020, 1, 1)
+
+
+def ts(h: int) -> str:
+    return (T0 + timedelta(hours=h)).strftime("%Y-%m-%dT%H:%M")
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("t", FieldOptions(type="time", time_quantum="YMDH"))
+    ex = Executor(holder)
+    return holder, idx, ex
+
+
+def q(ex, pql, index="i"):
+    return ex.execute(index, pql)
+
+
+def seed_events(idx, events):
+    """events: list of (row, col, hour)."""
+    rows = np.array([e[0] for e in events], np.uint64)
+    cols = np.array([e[1] for e in events], np.uint64)
+    stamps = [T0 + timedelta(hours=e[2]) for e in events]
+    idx.field("t").import_bits(rows, cols, stamps)
+
+
+def oracle_cols(ex, field, row_id, start, end):
+    """Columns via the op-at-a-time span oracle, directly."""
+    from pilosa_tpu.exec.executor import _Ctx
+    idx = ex.holder.index("i")
+    ctx = _Ctx(idx, tuple(idx.available_shards()), False)
+    words = ex._time_row_span(ctx, field, row_id, start, end)
+    host = np.asarray(words)
+    out = []
+    for si, s in enumerate(ctx.shards):
+        w = host[si]
+        bits = np.unpackbits(w.view(np.uint8), bitorder="little")
+        out.extend(int(s) * SHARD_WIDTH + int(o)
+                   for o in np.nonzero(bits)[0])
+    return sorted(out)
+
+
+class TestFusedVsOracle:
+    """The tentpole equivalence: fused bucket-range union over finest
+    existing buckets == the oracle's mixed-granularity minimal cover,
+    for random and boundary ranges."""
+
+    def test_random_ranges_bit_exact(self, env):
+        holder, idx, ex = env
+        rng = np.random.default_rng(18)
+        events = []
+        for i in range(120):
+            row = int(rng.integers(1, 4))
+            col = int(rng.integers(0, 3) * SHARD_WIDTH
+                      + rng.integers(0, 200))
+            h = int(rng.integers(0, 72))
+            events.append((row, col, h))
+        seed_events(idx, events)
+        field = idx.field("t")
+        for _ in range(25):
+            row = int(rng.integers(1, 4))
+            h0 = int(rng.integers(0, 72))
+            h1 = int(rng.integers(h0, 73))
+            (r,) = q(ex, f"Row(t={row}, from={ts(h0)}, to={ts(h1)})")
+            start = T0 + timedelta(hours=h0)
+            end = T0 + timedelta(hours=h1)
+            want = oracle_cols(ex, field, row, start, end)
+            assert [int(c) for c in r.columns] == want, (row, h0, h1)
+
+    def test_quantum_boundary_ranges(self, env):
+        """Endpoints exactly on / just off year, month, day and hour
+        boundaries — the minimal-cover recursion's edge cases."""
+        holder, idx, ex = env
+        # events at the edges of calendar units
+        events = [(1, 1, 0),          # 2020-01-01T00
+                  (1, 2, 23),         # 2020-01-01T23
+                  (1, 3, 24),         # 2020-01-02T00
+                  (1, 4, 31 * 24),    # 2020-02-01T00
+                  (1, 5, 31 * 24 - 1)]  # 2020-01-31T23
+        seed_events(idx, events)
+        field = idx.field("t")
+        cases = [(0, 24), (0, 23), (1, 24), (23, 25), (24, 31 * 24),
+                 (0, 31 * 24), (31 * 24 - 1, 31 * 24 + 1),
+                 (0, 31 * 24 + 1)]
+        for h0, h1 in cases:
+            (r,) = q(ex, f"Row(t=1, from={ts(h0)}, to={ts(h1)})")
+            want = oracle_cols(ex, field, 1,
+                               T0 + timedelta(hours=h0),
+                               T0 + timedelta(hours=h1))
+            assert [int(c) for c in r.columns] == want, (h0, h1)
+
+    def test_omitted_bounds_clamp_to_existing_span(self, env):
+        """from/to omitted (one or both) clamps to the covered span —
+        same answer fused and oracle, no calendar enumeration."""
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 48), (1, 3, 71)])
+        field = idx.field("t")
+        (r,) = q(ex, f"Row(t=1, from={ts(24)})")
+        assert [int(c) for c in r.columns] == \
+            oracle_cols(ex, field, 1, T0 + timedelta(hours=24), None)
+        (r,) = q(ex, f"Row(t=1, to={ts(49)})")
+        assert [int(c) for c in r.columns] == \
+            oracle_cols(ex, field, 1, None, T0 + timedelta(hours=49))
+        # half-open: the hour-48 event is INSIDE to=49, outside to=48
+        assert [int(c) for c in r.columns] == [1, 2]
+        (r,) = q(ex, f"Row(t=1, to={ts(48)})")
+        assert [int(c) for c in r.columns] == [1]
+
+    def test_legacy_positional_range(self, env):
+        """Range(f=1, <ts>, <ts>) — positional timestamps land in
+        _timestamp/_timestamp2 and must hit the same fused path."""
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 30), (1, 3, 60)])
+        (r,) = q(ex, f"Range(t=1, {ts(0)}, {ts(31)})")
+        assert [int(c) for c in r.columns] == [1, 2]
+        want = oracle_cols(ex, idx.field("t"), 1, T0,
+                           T0 + timedelta(hours=31))
+        assert [int(c) for c in r.columns] == want
+
+    def test_empty_range_and_absent_row(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 5)])
+        (r,) = q(ex, f"Row(t=1, from={ts(10)}, to={ts(10)})")
+        assert len(r.columns) == 0
+        (r,) = q(ex, f"Row(t=1, from={ts(6)}, to={ts(5)})")  # inverted
+        assert len(r.columns) == 0
+        (r,) = q(ex, f"Row(t=99, from={ts(0)}, to={ts(10)})")
+        assert len(r.columns) == 0
+
+    def test_not_a_time_field_errors(self, env):
+        holder, idx, ex = env
+        q(ex, "Set(1, f=1)")
+        with pytest.raises(ExecutionError, match="not a time field"):
+            q(ex, f"Row(f=1, from={ts(0)}, to={ts(1)})")
+
+
+class TestTimeqCover:
+    """store.timeq minimal-cover edge cases the plane's bucket-range
+    equivalence rests on."""
+
+    def test_cover_prefers_coarse_units(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2020, 1, 1), datetime(2021, 1, 1),
+            "YMDH")
+        assert views == ["standard_2020"]
+
+    def test_cover_splits_partial_units(self):
+        views = timeq.views_by_time_range(
+            "standard", datetime(2020, 1, 31, 22), datetime(2020, 3, 1),
+            "YMDH")
+        assert views == ["standard_2020013122", "standard_2020013123",
+                         "standard_202002"]
+
+    def test_cover_floors_sub_unit_endpoints(self):
+        # minutes floor away at the finest unit (H)
+        a = timeq.views_by_time_range(
+            "standard", datetime(2020, 1, 1, 3, 59),
+            datetime(2020, 1, 1, 5, 1), "YMDH")
+        b = timeq.views_by_time_range(
+            "standard", datetime(2020, 1, 1, 3),
+            datetime(2020, 1, 1, 5), "YMDH")
+        assert a == b
+
+    def test_cover_empty_for_inverted_range(self):
+        assert timeq.views_by_time_range(
+            "standard", datetime(2020, 2, 1), datetime(2020, 1, 1),
+            "YMDH") == []
+
+    def test_bucket_range_floors_endpoints(self, env):
+        """TimePlaneSet.bucket_range matches the oracle's truncation:
+        bucket b is selected iff floor(start) <= start_b < floor(end)."""
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 1), (1, 3, 2)])
+        tps = ex.planes.time_plane_nowait("i", idx.field("t"),
+                                          tuple(idx.available_shards()))
+        assert tps is not None and tps.n_buckets == 3
+        # minutes inside hour 1 floor to hour 1
+        b0, b1 = tps.bucket_range(T0 + timedelta(hours=1, minutes=30),
+                                  T0 + timedelta(hours=2, minutes=59))
+        assert (b0, b1) == (1, 2)
+        assert tps.bucket_range(None, None) == (0, 3)
+        b0, b1 = tps.bucket_range(T0 + timedelta(hours=9),
+                                  T0 + timedelta(hours=10))
+        assert b0 == b1  # off the end: empty
+
+
+class TestStaticTreeOps:
+    """Shift/Limit/ConstRow through the fused tree path answer exactly
+    like the eager op-at-a-time path, including error parity."""
+
+    def seed(self, ex):
+        cols = [1, 40, SHARD_WIDTH - 1, SHARD_WIDTH + 3,
+                2 * SHARD_WIDTH + 7]
+        q(ex, " ".join(f"Set({c}, f=1)" for c in cols))
+        return cols
+
+    def test_shift_tree_vs_eager(self, env, tmp_path):
+        holder, idx, ex = env
+        self.seed(ex)
+        eager = Executor(holder, tree_fusion=False)
+        for n in (0, 1, 40):
+            pql = f"Count(Shift(Row(f=1), n={n}))"
+            assert q(ex, pql) == q(eager, pql), n
+
+    def test_limit_tree_vs_eager(self, env):
+        holder, idx, ex = env
+        cols = self.seed(ex)
+        eager = Executor(holder, tree_fusion=False)
+        for off, lim in [(0, 2), (1, 2), (2, None), (4, 10), (0, None),
+                         (3, 1), (99, 2)]:
+            lim_s = "" if lim is None else f", limit={lim}"
+            pql = f"Limit(Row(f=1), offset={off}{lim_s})"
+            (a,) = q(ex, pql)
+            (b,) = q(eager, pql)
+            want = cols[off:(None if lim is None else off + lim)]
+            assert [int(c) for c in a.columns] == want, (off, lim)
+            assert [int(c) for c in b.columns] == want, (off, lim)
+
+    def test_constrow_tree_vs_eager(self, env):
+        holder, idx, ex = env
+        self.seed(ex)
+        eager = Executor(holder, tree_fusion=False)
+        pql = ("Count(Intersect(Row(f=1), "
+               f"ConstRow(columns=[1, 40, {3 * SHARD_WIDTH}])))")
+        assert q(ex, pql) == q(eager, pql) == [2]
+
+    def test_compound_static_and_time(self, env):
+        """A tree mixing a time-range leaf, a static Shift and a plain
+        anchor row — the full r23 tail in one program."""
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 30), (2, 2, 10)])
+        q(ex, "Set(1, f=1) Set(2, f=1) Set(3, f=1)")
+        eager = Executor(holder, tree_fusion=False)
+        pql = (f"Count(Intersect(Row(f=1), "
+               f"Shift(Row(t=1, from={ts(0)}, to={ts(31)}), n=0)))")
+        assert q(ex, pql) == q(eager, pql) == [2]
+
+    def test_error_parity(self, env):
+        holder, idx, ex = env
+        self.seed(ex)
+        eager = Executor(holder, tree_fusion=False)
+        for pql, msg in [
+                ("Count(Shift(Row(f=1), n=-1))", "n must be in"),
+                (f"Count(Shift(Row(f=1), n={SHARD_WIDTH}))",
+                 "n must be in"),
+                ("Count(Limit(Row(f=1), limit=-1))", "must be >= 0"),
+                ("Count(Limit(Row(f=1), offset=-2))", "must be >= 0"),
+                ("Count(ConstRow())", "missing columns")]:
+            with pytest.raises(ExecutionError, match=msg):
+                q(ex, pql)
+            with pytest.raises(ExecutionError, match=msg):
+                q(eager, pql)
+
+
+class TestIngestAbsorb:
+    """Time-bucketed ingest into EXISTING buckets absorbs into the
+    plane's delta overlay: zero rebuilds, answers exact."""
+
+    def test_in_bucket_write_absorbs(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 5)])
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(6)})")
+        assert [int(c) for c in r.columns] == [1, 2]
+        builds0 = ex.planes.builds
+        # same row, same hour bucket, new column -> overlay absorb
+        seed_events(idx, [(1, 7, 5)])
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(6)})")
+        assert [int(c) for c in r.columns] == [1, 2, 7]
+        assert ex.planes.builds == builds0
+        assert ex.planes.delta_absorbs >= 1
+        # the absorbed bit respects bucket boundaries
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(5)})")
+        assert [int(c) for c in r.columns] == [1]
+
+    def test_new_bucket_rebuilds_and_serves(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0)])
+        q(ex, f"Row(t=1, from={ts(0)}, to={ts(1)})")
+        builds0 = ex.planes.builds
+        seed_events(idx, [(1, 2, 3)])  # fresh hour bucket
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(4)})")
+        assert [int(c) for c in r.columns] == [1, 2]
+        assert ex.planes.builds == builds0 + 1
+
+    def test_new_row_rebuilds_and_serves(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0)])
+        q(ex, f"Row(t=1, from={ts(0)}, to={ts(1)})")
+        seed_events(idx, [(5, 2, 0)])  # fresh row, existing bucket
+        (r,) = q(ex, f"Row(t=5, from={ts(0)}, to={ts(1)})")
+        assert [int(c) for c in r.columns] == [2]
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(1)})")
+        assert [int(c) for c in r.columns] == [1]
+
+
+class TestRowsTimeFilter:
+    """Rows()/GroupBy from=/to= restrict candidates to the range's
+    minimal view cover."""
+
+    def test_rows_time_filtered(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (2, 2, 30), (3, 3, 60)])
+        (r,) = q(ex, f"Rows(t, from={ts(0)}, to={ts(31)})")
+        assert sorted(int(x) for x in r.rows) == [1, 2]
+        (r,) = q(ex, f"Rows(t, from={ts(31)})")
+        assert sorted(int(x) for x in r.rows) == [3]
+        (r,) = q(ex, "Rows(t)")
+        assert sorted(int(x) for x in r.rows) == [1, 2, 3]
+
+    def test_rows_time_filter_with_column(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 9, 0), (2, 9, 30), (2, 1, 0)])
+        (r,) = q(ex, f"Rows(t, column=9, from={ts(0)}, to={ts(1)})")
+        assert sorted(int(x) for x in r.rows) == [1]
+        (r,) = q(ex, "Rows(t, column=9)")
+        assert sorted(int(x) for x in r.rows) == [1, 2]
+
+    def test_groupby_time_filtered(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 0), (2, 2, 30)])
+        (g,) = q(ex, f"GroupBy(Rows(t, from={ts(0)}, to={ts(1)}))")
+        got = {gc.group[0].row_id: gc.count for gc in g.groups}
+        assert got == {1: 2}
+        (g,) = q(ex, "GroupBy(Rows(t))")
+        got = {gc.group[0].row_id: gc.count for gc in g.groups}
+        assert got == {1: 2, 2: 1}
+
+
+class TestStatusAndMetrics:
+    def test_time_status_block(self, env):
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 5)])
+        q(ex, f"Row(t=1, from={ts(0)}, to={ts(6)})")
+        st = ex.time_status()
+        assert st["planes"] and st["planes"][0]["field"] == "t"
+        assert st["planes"][0]["buckets"] == 2
+        assert st["residentBytes"] > 0
+
+    def test_fallback_when_degraded(self, env):
+        """A degraded device governor keeps time ranges OFF the fused
+        plane path — answers still exact via the span oracle."""
+        holder, idx, ex = env
+        seed_events(idx, [(1, 1, 0), (1, 2, 30)])
+        if ex.batcher is None:
+            pytest.skip("no batcher wired")
+        gov = ex.batcher.governor
+        for _ in range(gov.FAULT_THRESHOLD):
+            gov.record_fault()
+        assert not gov.fastlane_ok()
+        (r,) = q(ex, f"Row(t=1, from={ts(0)}, to={ts(31)})")
+        assert [int(c) for c in r.columns] == [1, 2]
