@@ -334,6 +334,16 @@ class API:
             if err is not None:
                 root.tags["error"] = str(err)
         duration = _time.perf_counter() - t0
+        # device-time join (r19): the cost ledger's measured device
+        # seconds charged to THIS trace land on the profiled query's
+        # root — the span tree then shows how much of the wall was
+        # device work versus queueing/host time
+        dev_s = self.executor.ledger.trace_seconds(root.trace_id)
+        if dev_s is not None and dev_s > 0:
+            root.tags["deviceSeconds"] = round(dev_s, 6)
+            if duration > 0:
+                root.tags["deviceShare"] = round(
+                    min(1.0, dev_s / duration), 4)
         slow = (self.slow_query_threshold > 0
                 and duration >= self.slow_query_threshold)
         if sampled:
@@ -412,6 +422,11 @@ class API:
             "shards": list(shards) if shards is not None else None,
             "durationMs": round(duration * 1e3, 3),
             "traceId": root.trace_id,
+            # which path answered (r19 satellite): fused /
+            # op-at-a-time fallback / paged / row-directory oracle /
+            # degraded governor — the first triage question for any
+            # slow entry is "was this even on the fast path"
+            "path": self.executor.serving_path(),
             "error": str(err) if err is not None else None,
             "profile": root.to_json()}
 
@@ -856,6 +871,10 @@ class API:
                 # residency/hit-ratio/page-ins/sheds, QoS quotas,
                 # eviction reasons
                 "tenancy": ex.tenancy_status(),
+                # device-cost ledger (r19): measured device seconds /
+                # bytes scanned attributed per tenant, per query
+                # shape, per plane (top-K + other), compile totals
+                "costs": ex.cost_status(),
                 # time-view planes (r23): which time fields serve range
                 # queries from a resident bucketed plane (device speed)
                 # vs the span-union fallback

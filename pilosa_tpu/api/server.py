@@ -612,6 +612,36 @@ class Handler(BaseHTTPRequestHandler):
                      **api.slow_log.summary(),
                      "slow": api.slow_log.entries()})
 
+    def h_debug_flight(self) -> None:
+        """The dispatch flight recorder (r19): the last N lifecycle
+        events (enqueue/dispatch/readback/deliver, governor moves,
+        watchdog trips, quarantines, evictions, page-ins, compiles)
+        straight from the in-memory ring — no dump file needed.
+        ``?limit=`` trims to the newest N events; ``?cluster=1`` fans
+        in every peer's ring (same partial-result contract as
+        ``/status/cluster``)."""
+        ex = getattr(self.server.api, "executor", None)
+        flight = getattr(ex, "flight", None)
+        raw = self.query.get("limit", ["0"])[0]
+        try:
+            limit = int(raw)
+        except ValueError:
+            raise ApiError(f"bad limit param {raw!r}")
+        local = (flight.snapshot(limit=limit or None)
+                 if flight is not None
+                 else {"events": [], "lastSeq": 0, "capacity": 0,
+                       "dumps": []})
+        cluster = self.server.api.cluster
+        if self.query.get("cluster", ["0"])[0] not in ("1", "true"):
+            self._reply(local)
+            return
+        if cluster is None:
+            self._reply({"nodes": {"local": local}, "staleNodes": []})
+            return
+        snaps, stale = cluster.flight_snapshots(limit=limit)
+        snaps[cluster.node_id] = local
+        self._reply({"nodes": snaps, "staleNodes": stale})
+
     def h_debug_threads(self) -> None:
         """Python stack dump of every thread — the rebuild's
         /debug/pprof (reference mounts net/http/pprof; SURVEY.md §6)."""
@@ -681,6 +711,7 @@ def build_router() -> Router:
     r.add("POST", "/internal/restore", Handler.h_restore)
     r.add("GET", "/internal/traces", Handler.h_traces)
     r.add("GET", "/debug/slow", Handler.h_debug_slow)
+    r.add("GET", "/debug/flight", Handler.h_debug_flight)
     r.add("GET", "/debug/threads", Handler.h_debug_threads)
     r.add("POST", "/debug/profile", Handler.h_debug_profile)
     # node-to-node surface (deferred import: cluster depends on this

@@ -1640,6 +1640,16 @@ class Cluster:
             lambda client: client._do("GET", "/status",
                                       timeout=self.OBS_FANIN_TIMEOUT))
 
+    def flight_snapshots(self, limit: int = 0) \
+            -> tuple[dict[str, dict], list[str]]:
+        """Per-peer ``/debug/flight`` payloads (r19): one call pulls
+        every node's dispatch-lifecycle ring so a fleet-wide incident
+        timeline can be assembled without shelling into each box."""
+        path = "/debug/flight" + (f"?limit={int(limit)}" if limit else "")
+        return self._obs_fanin(
+            lambda client: client._do("GET", path,
+                                      timeout=self.OBS_FANIN_TIMEOUT))
+
     # -- introspection -------------------------------------------------------
 
     def health_payload(self) -> dict:

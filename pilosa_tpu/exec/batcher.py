@@ -95,6 +95,10 @@ import numpy as np
 
 from pilosa_tpu import fault
 from pilosa_tpu.engine import kernels
+# attribution context (r19): submits run on the CALLER's thread, so
+# the executor's thread-local (tenant, plane, trace) is read once at
+# _Pending construction and rides the item through the window
+from pilosa_tpu.obs.ledger import query_context as _query_ctx
 
 
 def _stall_error(msg: str, stage: str, elapsed: float = 0.0):
@@ -105,7 +109,8 @@ def _stall_error(msg: str, stage: str, elapsed: float = 0.0):
 
 class _Pending:
     __slots__ = ("kind", "nodes", "leaves", "delta", "event", "result",
-                 "error", "deadline", "abandoned", "stage", "delivered")
+                 "error", "deadline", "abandoned", "stage", "delivered",
+                 "tenant", "plane", "trace")
 
     def __init__(self, kind, nodes, leaves, delta=None, deadline=None):
         self.kind = kind      # "count" | "sum" | "minmax" | "rowcounts"
@@ -138,6 +143,11 @@ class _Pending:
         # alone cannot distinguish "answered" from "abandoned item
         # acknowledged" at the deadline boundary (see wait())
         self.delivered = False
+        # cost-ledger attribution (r19), stamped here because the
+        # submit runs on the caller thread: who pays for this item's
+        # share of its window, which plane it scanned, and the trace
+        # to exemplar the hottest shape bucket with
+        self.tenant, self.plane, self.trace = _query_ctx()
 
 
 class _Window:
@@ -146,7 +156,7 @@ class _Window:
 
     __slots__ = ("wid", "items", "stage", "t0", "pending",
                  "distinct_futs", "win_bytes", "slot_held", "inflight",
-                 "done", "faulted", "bounded")
+                 "done", "faulted", "bounded", "charge")
 
     def __init__(self, wid: int, items: list, slot_held: bool):
         self.wid = wid
@@ -167,6 +177,11 @@ class _Window:
         # whole-window watchdog defers, so a single hung group can
         # never take co-batched innocents down with it
         self.bounded = False
+        # cost-ledger entries (r19): (tenant, shape, plane, byte
+        # share, trace) per item, built alongside the win_bytes loop
+        # so the charge reuses the already-computed group bytes; the
+        # window's measured seconds apportion over these at readback
+        self.charge: list = []
 
 
 class CountBatcher:
@@ -187,10 +202,11 @@ class CountBatcher:
                  solo_fastlane: bool = True,
                  watchdog_s: float = 5.0,
                  probe_after_s: float = 5.0,
-                 placement_key=None):
+                 placement_key=None,
+                 ledger=None, flight=None):
         from pilosa_tpu.exec.fused import PingPong
         from pilosa_tpu.exec.health import DeviceHealthGovernor
-        from pilosa_tpu.obs import NopStats
+        from pilosa_tpu.obs import NULL_FLIGHT, NULL_LEDGER, NopStats
         from pilosa_tpu.obs.metrics import (BYTE_BUCKETS, COUNT_BUCKETS,
                                             RATIO_BUCKETS)
         self.fused = fused
@@ -260,11 +276,23 @@ class CountBatcher:
         self._busy = 0  # collector cycles mid-batch (watchdog idleness)
         self._trips = 0        # watchdog trips (mirror of the counter)
         self._quarantined = 0  # quarantined windows/groups
+        # device-cost ledger + pipeline flight recorder (r19): the
+        # ledger apportions each window's measured seconds/bytes to
+        # the items it served; the flight recorder rings every
+        # lifecycle event and dumps on incidents.  Both default to
+        # null objects so standalone batchers pay nothing.
+        self.ledger = ledger or NULL_LEDGER
+        self.flight = flight or NULL_FLIGHT
+        # per-group dispatch seconds captured in _dispatch_one and
+        # popped into the window charge at readback (id(group) keys —
+        # plain dict writes, GIL-atomic, no lock on the dispatch path)
+        self._disp_s: dict[int, float] = {}
         # device health governor (r18): healthy→degraded→probing
         # breaker fed by dispatch faults + watchdog trips; degraded
         # serving runs windows on the per-item fallback path
         self.governor = DeviceHealthGovernor(stats=self.stats,
-                                             probe_after_s=probe_after_s)
+                                             probe_after_s=probe_after_s,
+                                             flight=self.flight)
         # solo fast lane (r17 tentpole): with no queue pressure, a
         # width-1 request skips window formation entirely and rides a
         # pre-bound dispatch chain on the CALLER's thread — no enqueue,
@@ -360,6 +388,7 @@ class CountBatcher:
         self._ensure_watchdog()
 
     def _enqueue(self, p: _Pending) -> _Pending:
+        self.flight.record("enqueue", p.tenant, p.kind)
         with self._lock:
             self._queue.append(p)
             self._ensure_worker()
@@ -428,7 +457,8 @@ class CountBatcher:
         with self._fl_lock:
             self._fl_active -= 1
 
-    def _fastlane_done(self, kind: str, nbytes: int) -> None:
+    def _fastlane_done(self, kind: str, nbytes: int,
+                       wall: float = 0.0) -> None:
         # NO kernel_dispatch_seconds here: that family observes
         # enqueue-only on the windowed path (the read is deferred to
         # the packed readback), while a fast-lane call spans dispatch
@@ -440,6 +470,12 @@ class CountBatcher:
         if nbytes:
             self.stats.count("kernel_bytes_scanned_total", nbytes,
                              kind=kind)
+        # solo item = whole charge (r19): the lane spans dispatch plus
+        # the host read on the caller thread, so ``wall`` IS the
+        # item's device cost — no apportioning needed
+        tenant, plane, trace = _query_ctx()
+        self.ledger.charge_solo(tenant, kind, plane, wall, nbytes,
+                                trace_id=trace)
 
     def _fastlane_counts(self, nodes: tuple, leaves: tuple):
         """One request's Count run dispatched inline on the caller
@@ -447,6 +483,7 @@ class CountBatcher:
         (offset-0 single item), donated ping-pong scratch for the
         int32[K_pad, S] output.  None = fall back to the window."""
         from pilosa_tpu.exec.fused import pow2_bucket
+        t0 = time.perf_counter()
         try:
             padded = tuple(nodes) + (nodes[0],) * (
                 pow2_bucket(len(nodes)) - len(nodes))
@@ -460,7 +497,8 @@ class CountBatcher:
             self.governor.record_fault()
             return None
         self._fastlane_done("count",
-                            sum(getattr(a, "nbytes", 0) for a in leaves))
+                            sum(getattr(a, "nbytes", 0) for a in leaves),
+                            wall=time.perf_counter() - t0)
         return [int(row.sum()) for row in host[:len(nodes)]]
 
     def _fastlane_selected(self, plane, slots: tuple, delta):
@@ -470,6 +508,7 @@ class CountBatcher:
         from pilosa_tpu.exec.fused import pow2_bucket
         order = sorted(set(slots))
         pos = {s: i for i, s in enumerate(order)}
+        t0 = time.perf_counter()
         try:
             scratch = self._pp.scratch(
                 (pow2_bucket(len(order)),), "int32")
@@ -483,10 +522,12 @@ class CountBatcher:
             return None
         nbytes = (len(order) * plane.shape[0] * plane.shape[-1] * 4
                   + (delta.nbytes if delta is not None else 0))
-        self._fastlane_done("selcounts", nbytes)
+        self._fastlane_done("selcounts", nbytes,
+                            wall=time.perf_counter() - t0)
         return host[[pos[s] for s in slots]]
 
     def _fastlane_rowcounts(self, plane, filter_words, delta):
+        t0 = time.perf_counter()
         try:
             if delta is not None:
                 out = self.fused.run_rowcounts_delta(
@@ -508,11 +549,13 @@ class CountBatcher:
         self._fastlane_done(
             "rowcounts",
             plane.nbytes + (getattr(filter_words, "nbytes", 0) or 0)
-            + (delta.nbytes if delta is not None else 0))
+            + (delta.nbytes if delta is not None else 0),
+            wall=time.perf_counter() - t0)
         return host
 
     def _fastlane_tree(self, plane, slots: tuple, prog: tuple,
                        extras: tuple, delta):
+        t0 = time.perf_counter()
         try:
             out = self.fused.run_tree_counts(plane, tuple(slots),
                                              (tuple(prog),),
@@ -524,7 +567,8 @@ class CountBatcher:
         nbytes = (len(slots) * plane.shape[0] * plane.shape[-1] * 4
                   + sum(getattr(a, "nbytes", 0) for a in extras)
                   + (delta.nbytes if delta is not None else 0))
-        self._fastlane_done("tree", nbytes)
+        self._fastlane_done("tree", nbytes,
+                            wall=time.perf_counter() - t0)
         return val
 
     @staticmethod
@@ -539,6 +583,7 @@ class CountBatcher:
         from pilosa_tpu.engine import bsi as bsik
         flags = (filter_words is not None,)
         filters = (filter_words,) if filter_words is not None else ()
+        t0 = time.perf_counter()
         try:
             if kind == "sum":
                 out = self.fused.run_sum_plane_batch(
@@ -553,13 +598,14 @@ class CountBatcher:
             return None
         self._fastlane_done(kind, self._agg_bytes(
             plane, sum(getattr(f, "nbytes", 0) for f in filters),
-            delta))
+            delta), wall=time.perf_counter() - t0)
         return val
 
     def _fastlane_bsirange(self, plane, spec: tuple, operands: tuple,
                            delta):
         """One BSI Range-count inline: batch of one through
         ``run_range_batch``.  None = fall back to the window."""
+        t0 = time.perf_counter()
         try:
             out = self.fused.run_range_batch(plane, (spec,),
                                              tuple(operands),
@@ -568,7 +614,8 @@ class CountBatcher:
         except Exception:  # noqa: BLE001 — windowed path is the fallback
             self.governor.record_fault()
             return None
-        self._fastlane_done("bsirange", self._agg_bytes(plane, 0, delta))
+        self._fastlane_done("bsirange", self._agg_bytes(plane, 0, delta),
+                            wall=time.perf_counter() - t0)
         return val
 
     def _fastlane_groupby(self, args: tuple, agg_kind, meta: tuple):
@@ -576,6 +623,7 @@ class CountBatcher:
         fall back to the window."""
         from pilosa_tpu.exec import groupby as gb
         planes, ci, lp, fw, ap, dl = args
+        t0 = time.perf_counter()
         try:
             out = self.fused.run_groupby_batch(planes, ci, lp, fw, ap,
                                                agg_kind, delta=dl)
@@ -583,7 +631,8 @@ class CountBatcher:
         except Exception:  # noqa: BLE001 — windowed path is the fallback
             self.governor.record_fault()
             return None
-        self._fastlane_done("groupby", self._groupby_bytes(args))
+        self._fastlane_done("groupby", self._groupby_bytes(args),
+                            wall=time.perf_counter() - t0)
         return gb.unflatten_block(host, *meta, agg_kind)
 
     @staticmethod
@@ -1096,16 +1145,27 @@ class CountBatcher:
                 self.stats.count("kernel_bytes_scanned_total",
                                  nbytes, kind=key[0])
                 win_bytes += nbytes
+            # ledger entries (r19) built here so the charge reuses the
+            # group-bytes estimate: each item's weight is its equal
+            # split of its group's scan (the group's items share one
+            # fused pass — the plane is read once for all of them)
+            share = nbytes / max(1, len(group))
+            for p in group:
+                w.charge.append((p.tenant, p.kind, p.plane, share,
+                                 p.trace))
         w.pending = pending
         w.distinct_futs = distinct_futs
         w.win_bytes = win_bytes
         if not (pending or distinct_futs):
             # every dispatch fell back or was failed: nothing to read
-            self._window_done(w)
+            if self._window_done(w):
+                self.flight.record("deliver", f"w{w.wid}", "",
+                                   float(len(w.items)))
             return
         with self._pipe_lock:
             w.stage = "readback"
             w.t0 = time.monotonic()
+        self.flight.record("readback", f"w{w.wid}")
         for p in batch:
             p.stage = "readback"
         if slot_held:
@@ -1134,8 +1194,11 @@ class CountBatcher:
             if err is not None:
                 self._fail_window_items(
                     w, _wrap_readback_error(err))
-            if self._window_done(w) and err is None and not w.faulted:
-                self.governor.record_success()
+            if self._window_done(w):
+                self.flight.record("deliver", f"w{w.wid}", "",
+                                   float(len(w.items)))
+                if err is None and not w.faulted:
+                    self.governor.record_success()
 
     def _fail_stalled_group(self, key, group, bound: float) -> None:
         """One group's dispatch exceeded the watchdog bound while the
@@ -1147,6 +1210,15 @@ class CountBatcher:
         self.stats.count("pipeline_watchdog_trips_total", 1,
                          stage="dispatch")
         self.stats.count("pipeline_quarantined_windows_total", 1)
+        # flight events name the SAME stage the structured error below
+        # carries — the dump's quarantine line and the caller's
+        # exception must agree on what stalled (pinned in tests).
+        # Recorded + dumped BEFORE the governor trip so the governor's
+        # own degrade incident cannot dump first and rate-limit the
+        # quarantine artifact away.
+        self.flight.record("watchdog_trip", key[0], "dispatch", bound)
+        self.flight.record("quarantine", key[0], "dispatch", bound)
+        self.flight.incident("quarantine", key[0], "dispatch")
         self.governor.record_trip()
         err = _stall_error(
             f"{key[0]} dispatch stalled past the "
@@ -1164,6 +1236,8 @@ class CountBatcher:
             w = _Window(self._win_seq, batch, slot_held)
             if self.watchdog_s > 0:
                 self._windows[w.wid] = w
+        self.flight.record("dispatch", f"w{w.wid}", "",
+                           float(len(batch)))
         return w
 
     def _window_done(self, w: _Window) -> bool:
@@ -1187,6 +1261,10 @@ class CountBatcher:
             self.stats.gauge("dispatch_pipeline_depth", depth)
         if slot:
             self._pipe_slots.release()
+        # belt: a quarantined window never reaches _finish_window's
+        # pop, so its captured group dispatch seconds drain here
+        for _k, g, _o, _f in w.pending:
+            self._disp_s.pop(id(g), None)
         return True
 
     def _fail_window_items(self, w: _Window, err: Exception) -> None:
@@ -1279,6 +1357,15 @@ class CountBatcher:
         self._quarantined += 1
         self.stats.count("pipeline_watchdog_trips_total", 1, stage=stage)
         self.stats.count("pipeline_quarantined_windows_total", 1)
+        # same-stage contract as _fail_stalled_group: the quarantine
+        # flight event's detail is the stage the error names.  Flight
+        # events + incident dump run BEFORE the governor hears about
+        # the trip: its own degrade incident would otherwise dump
+        # first and rate-limit this one away — the artifact must carry
+        # the quarantine line (pinned in tests)
+        self.flight.record("watchdog_trip", f"w{w.wid}", stage, age)
+        self.flight.record("quarantine", f"w{w.wid}", stage, age)
+        self.flight.incident("quarantine", f"w{w.wid}", stage)
         self.governor.record_trip()
         err = _stall_error(
             f"dispatch-pipeline window stalled in {stage} for "
@@ -1337,8 +1424,11 @@ class CountBatcher:
                 # raised OUTSIDE _readback's per-item fallbacks; now
                 # every unfinished item is failed loudly
                 self._fail_window_items(w, _wrap_readback_error(err))
-            if self._window_done(w) and err is None and not w.faulted:
-                self.governor.record_success()
+            if self._window_done(w):
+                self.flight.record("deliver", f"w{w.wid}", "",
+                                   float(len(w.items)))
+                if err is None and not w.faulted:
+                    self.governor.record_success()
 
     def _finish_window(self, w: _Window) -> None:
         """Read one dispatched window back and finish its items — the
@@ -1355,6 +1445,16 @@ class CountBatcher:
         t0 = time.perf_counter()
         self._readback(w)
         wall = time.perf_counter() - t0
+        # cost-ledger charge (r19): this window's measured device time
+        # = per-group dispatch seconds (captured in _dispatch_one) +
+        # the packed readback wall, apportioned to the items by their
+        # bytes-scanned weight.  Exact-sum split — the ledger pins
+        # sum(shares) == window total bit-for-bit.
+        if w.charge:
+            disp = 0.0
+            for _key, group, _out, _fin in w.pending:
+                disp += self._disp_s.pop(id(group), 0.0)
+            self.ledger.charge_window(disp + wall, w.charge)
         if self.placement_key is not None and w.pending:
             # meshed window: the packed read blocks on the program's
             # residual compute INCLUDING its cross-shard collectives,
@@ -1408,8 +1508,12 @@ class CountBatcher:
             ret = self._dispatch_groupby(group)
         else:
             ret = self._dispatch_aggs(kind, group)
-        self.stats.observe("kernel_dispatch_seconds",
-                           time.perf_counter() - t0, kind=kind)
+        elapsed = time.perf_counter() - t0
+        self.stats.observe("kernel_dispatch_seconds", elapsed,
+                           kind=kind)
+        # the window charge picks this up at readback (keyed by group
+        # identity — the pending tuples carry the same list object)
+        self._disp_s[id(group)] = elapsed
         return ret
 
     @staticmethod

@@ -32,6 +32,9 @@ from pilosa_tpu.exec.planes import PAD_SHARD, PlaneCache
 from pilosa_tpu.exec.result import (ExtractResult, GroupCountsResult,
                                     Pair, PairsResult, RowIdsResult,
                                     RowResult, ValCount)
+from pilosa_tpu.obs.ledger import (clear_query_context,
+                                   set_query_context)
+from pilosa_tpu.obs.tracing import current_trace_id
 from pilosa_tpu.pql import parse_cached
 from pilosa_tpu.pql.ast import (BETWEEN_OPS, Call, Condition, Query,
                                 between_cmp_ops)
@@ -303,7 +306,9 @@ class Executor:
                  plane_page_bytes: int = 64 << 20,
                  tenant_byte_quota: int = 0,
                  tenant_qps_quota: float = 0.0,
-                 tenant_slot_quota: int = 0):
+                 tenant_slot_quota: int = 0,
+                 tenant_device_seconds_quota: float = 0.0,
+                 cost_observability: bool = True):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -338,7 +343,14 @@ class Executor:
         sizes one page.  ``tenant_byte_quota`` caps one tenant's
         resident plane/page bytes (0 = off); ``tenant_qps_quota`` /
         ``tenant_slot_quota`` shed an over-quota tenant's queries with
-        a structured 503 BEFORE they take an executor slot (0 = off)."""
+        a structured 503 BEFORE they take an executor slot (0 = off).
+        ``tenant_device_seconds_quota`` (r19): cap a tenant's RECENT
+        measured device seconds (the cost ledger's decayed window,
+        ~60s half-life) — sheds by what queries actually COST on
+        device, not how many arrived (0 = off).
+        ``cost_observability`` (r19): False swaps the cost ledger and
+        flight recorder for null objects — the instrumentation-off
+        tier the overhead bench (config34) measures against."""
         self.holder = holder
         self.translate = translate or TranslateStore(
             holder.path, health=getattr(holder, "storage_health", None))
@@ -350,6 +362,20 @@ class Executor:
         from pilosa_tpu.tenancy import (PlanePager, ResidencyGovernor,
                                         TenantQos)
         self.stats = stats or NopStats()
+        # device-cost ledger + flight recorder (r19): one ledger and
+        # one event ring per executor, threaded into every layer that
+        # spends device time (planes, pager, fused cache, batcher,
+        # governor) — attribution and incident capture are always on.
+        # Flight dumps land under the holder's data dir.
+        from pilosa_tpu.obs import (NULL_FLIGHT, NULL_LEDGER, CostLedger,
+                                    FlightRecorder)
+        if cost_observability:
+            self.ledger = CostLedger(stats=self.stats)
+            self.flight = FlightRecorder(
+                dump_dir=f"{holder.path}/_flight", stats=self.stats)
+        else:
+            self.ledger = NULL_LEDGER
+            self.flight = NULL_FLIGHT
         # tenancy (r17): the governor is always attached — with no
         # quotas and no telemetry its eviction ordering degrades to
         # the stamped LRU exactly, so the single-tenant default pays
@@ -362,17 +388,22 @@ class Executor:
                                  delta_cells=delta_cells,
                                  delta_compact_fraction=(
                                      delta_compact_fraction),
-                                 governor=self.governor, **kw)
+                                 governor=self.governor,
+                                 flight=self.flight, **kw)
         self.pager = (PlanePager(self.planes, self.governor,
                                  page_bytes=plane_page_bytes,
-                                 stats=self.stats)
+                                 stats=self.stats, flight=self.flight)
                       if plane_paging and placement is None else None)
         self.qos = TenantQos(tenant_qps_quota, tenant_slot_quota,
-                             stats=self.stats)
+                             stats=self.stats,
+                             device_seconds_quota=(
+                                 tenant_device_seconds_quota),
+                             ledger=self.ledger)
         self.tracer = tracer or GLOBAL_TRACER
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache(stats=self.stats,
-                                mesh_guard=placement is not None)
+                                mesh_guard=placement is not None,
+                                ledger=self.ledger, flight=self.flight)
         # whole-tree compilation (r16): compound boolean Counts gather
         # rows from the resident plane and fold a postfix program in
         # one fused XLA dispatch.  Off (`tree_fusion=False`) restores
@@ -409,7 +440,8 @@ class Executor:
                 watchdog_s=dispatch_watchdog_seconds,
                 probe_after_s=device_health_probe_seconds,
                 placement_key=(getattr(placement, "key", None)
-                               if placement is not None else None))
+                               if placement is not None else None),
+                ledger=self.ledger, flight=self.flight)
         # mesh serving telemetry (ISSUE 16): how many chips the plane
         # axis spans (1 = single-device serving)
         self.stats.gauge(
@@ -454,6 +486,29 @@ class Executor:
         carries so pipeline waits stay bounded (r18)."""
         return getattr(self._tls, "deadline", None)
 
+    # -- serving-path attribution (r19 satellite) ----------------------------
+
+    def _admission_path(self) -> str:
+        """The serving path this query starts on: the fused pipeline,
+        the op-at-a-time fallback (no batcher), or degraded-governor
+        per-item serving.  Down-stack sites refine it (paged /
+        row-directory oracle)."""
+        if self.batcher is None:
+            return "op-at-a-time fallback"
+        if self.batcher.governor.state != "healthy":
+            return "degraded governor"
+        return "fused"
+
+    def _note_path(self, path: str) -> None:
+        self._tls.spath = path
+
+    def serving_path(self) -> str:
+        """Which path answered the serving thread's LAST query —
+        ``fused`` / ``op-at-a-time fallback`` / ``paged`` /
+        ``row-directory oracle`` / ``degraded governor``.  Read by the
+        slow-query log so every slow entry names its path."""
+        return getattr(self._tls, "spath", "fused")
+
     def device_health(self) -> dict:
         """The ``/status`` deviceHealth block: the batcher's governor
         state, watchdog knob and quarantine counts (a batcher-less
@@ -481,6 +536,13 @@ class Executor:
         return {"planes": planes,
                 "residentBytes": sum(p["bytes"] for p in planes),
                 "buckets": sum(p["buckets"] for p in planes)}
+
+    def cost_status(self) -> dict:
+        """The ``/status`` ``costs`` block (r19): the device-cost
+        ledger's rollups — measured device seconds and bytes scanned
+        attributed per tenant, per query shape, and per plane (top-K
+        with an ``other`` fold), plus compile totals."""
+        return self.ledger.payload()
 
     def tenancy_status(self) -> dict:
         """The ``/status`` ``tenancy`` block (r17): knobs, per-tenant
@@ -634,6 +696,15 @@ class Executor:
             # carries it — wait() then blocks with a BOUNDED timeout
             # instead of forever behind a sick device
             self._tls.deadline = deadline
+            # cost-ledger attribution context (r19): tenant + trace on
+            # the serving thread — batcher items and fast-lane solo
+            # dispatches stamp their charges from this, and the plane
+            # cache fills in the plane as the query touches it
+            set_query_context(index_name, trace_id=current_trace_id())
+            # serving-path tag (r19 satellite): which path answered —
+            # refined down-stack (paged / oracle / op-at-a-time), read
+            # by the slow-query log after execute returns
+            self._tls.spath = self._admission_path()
         self._tls.depth = depth + 1
         try:
             if depth == 0 and fault.ACTIVE:
@@ -664,6 +735,10 @@ class Executor:
             if depth == 0:
                 self._tls.stage_timer = None
                 self._tls.deadline = None
+                # ledger context clears here; the serving-path tag
+                # survives until the NEXT admission on this thread —
+                # the API layer reads it after execute returns
+                clear_query_context()
                 self.planes.end_query()
                 self._leave_inflight()
                 if self._exec_slots is not None:
@@ -895,6 +970,7 @@ class Executor:
         pages = self.pager.partition(field, VIEW_STANDARD, ctx.shards)
         if pages is None:
             return None
+        self._note_path("paged")
         row_ids = [self._row_id(ctx, field, v, create=False)
                    for v in values]
         timer = getattr(self._tls, "stage_timer", None)
@@ -910,6 +986,7 @@ class Executor:
             else:
                 # quota denied the page-in: host truth answers this
                 # page exactly (directory sums, no bit expansion)
+                self._note_path("row-directory oracle")
                 part = self.pager.oracle_counts(
                     field, VIEW_STANDARD, page_shards, row_ids)
             for i, v in enumerate(part):
@@ -2354,6 +2431,7 @@ class Executor:
         per minimal-cover view.  Kept as the correctness oracle the
         fused path is pinned against and as the serving fallback when
         the time plane isn't residing (budget, degraded device)."""
+        self._note_path("op-at-a-time fallback")
         q = field.options.time_quantum
         # clamp the range to the span actually covered by existing views:
         # an omitted bound would otherwise enumerate views unit-by-unit

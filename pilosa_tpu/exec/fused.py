@@ -16,6 +16,7 @@ sign scalar, see ``engine.bsi.predicate_masks``), so ``amount > 5`` and
 from __future__ import annotations
 
 import threading as _threading
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -264,10 +265,11 @@ class FusedCache:
 
     MAX_PROGRAMS = 256
 
-    def __init__(self, stats=None, mesh_guard: bool = False):
+    def __init__(self, stats=None, mesh_guard: bool = False,
+                 ledger=None, flight=None):
         import threading
         from pilosa_tpu.exec._lru import Stamps
-        from pilosa_tpu.obs import NopStats
+        from pilosa_tpu.obs import NULL_FLIGHT, NULL_LEDGER, NopStats
         # mesh_guard (r21): this cache compiles collective-bearing
         # programs (its executor serves a placement), so every program
         # is wrapped in ``mesh_serialized`` at insert time — launches
@@ -285,6 +287,13 @@ class FusedCache:
         # (the class that once collapsed 32 clients to ~23 qps, see
         # pow2_bucket) visible on /metrics instead of only as latency
         self._stats = stats or NopStats()
+        # compile observability (r19): per-family compile seconds with
+        # first-compile trace exemplars land in the cost ledger, and
+        # every compile is a flight-recorder event — a recompile storm
+        # shows up on the incident timeline with the shapes that
+        # caused it, not just as a climbing built counter
+        self._ledger = ledger or NULL_LEDGER
+        self.flight = flight or NULL_FLIGHT
 
     @property
     def program_count(self) -> int:
@@ -316,6 +325,48 @@ class FusedCache:
         if evicted:
             self._stats.count("fused_programs_evicted_total", evicted)
 
+    @staticmethod
+    def _family(key) -> str:
+        """The program key's fused-family tag for compile attribution:
+        the head tuple's leading string (``"selcounts"``,
+        ``"tree-item"``, a plan node kind, ...) or the trailing want /
+        batch tag — every form is a BOUNDED vocabulary, so the
+        ``fused_compile_seconds{family}`` series set stays small."""
+        try:
+            head = key[0]
+            if isinstance(head, tuple) and head \
+                    and isinstance(head[0], str):
+                return head[0]
+            tail = key[-1]
+            if isinstance(tail, str):
+                return tail
+        except (IndexError, TypeError):
+            pass
+        return "fused"
+
+    def _timed_first_call(self, key, fn):
+        """jax.jit is LAZY — tracing + XLA compilation happen on the
+        program's FIRST invocation, not at jit() time — so compile
+        seconds are measured by wrapping exactly that call.  After the
+        first call the raw fn replaces the wrapper in the program dict
+        (GIL-atomic), so the steady-state hit path pays nothing."""
+        family = self._family(key)
+        once = []
+
+        def first(*args, **kw):
+            t0 = _time.perf_counter()
+            out = fn(*args, **kw)
+            if not once:
+                once.append(True)
+                dt = _time.perf_counter() - t0
+                if self._programs.get(key) is first:
+                    self._programs[key] = fn  # un-wrap: off hot path
+                self._ledger.note_compile(family, dt, first=True)
+                self.flight.record("compile", family, "", dt)
+            return out
+
+        return first
+
     def _cached(self, key, build, donate: tuple = ()):
         fn = self._get_fast(key)
         if fn is not None:
@@ -335,6 +386,7 @@ class FusedCache:
                 fn = jax.jit(build(), donate_argnums=donate)
                 if self._mesh_guard:
                     fn = mesh_serialized(fn)
+                fn = self._timed_first_call(key, fn)
                 self._insert(key, fn)
         return fn
 

@@ -55,9 +55,14 @@ class DeviceHealthGovernor:
 
     FAULT_THRESHOLD = 3
 
-    def __init__(self, stats=None, probe_after_s: float = 5.0):
-        from pilosa_tpu.obs import NopStats
+    def __init__(self, stats=None, probe_after_s: float = 5.0,
+                 flight=None):
+        from pilosa_tpu.obs import NULL_FLIGHT, NopStats
         self._stats = stats or NopStats()
+        # flight recorder (r19): every state transition lands on the
+        # incident timeline; a degrade ALSO triggers the ring dump —
+        # the run-up to the breaker opening is the postmortem
+        self.flight = flight or NULL_FLIGHT
         self.probe_after_s = max(0.05, float(probe_after_s))
         self._state = HEALTHY
         self._consecutive = 0
@@ -89,9 +94,16 @@ class DeviceHealthGovernor:
 
     def _transition(self, to: str) -> None:
         """Caller holds the lock."""
+        came = self._state
         self._state = to
         self._since = time.monotonic()
         self._stats.gauge("device_health_state", STATE_CODE[to])
+        self.flight.record("governor", "device", f"{came}->{to}")
+        if to == DEGRADED:
+            # incident capture: the moment the breaker opens is
+            # exactly when the preceding pipeline timeline matters
+            self.flight.incident("governor_degrade", "device",
+                                 f"from {came}")
 
     def record_fault(self) -> None:
         with self._lock:

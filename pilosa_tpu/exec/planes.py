@@ -153,7 +153,7 @@ class PlaneCache:
                  placement=None, stats=None, sidecars: bool = True,
                  delta_cells: int = 65536,
                  delta_compact_fraction: float = 0.5,
-                 governor=None):
+                 governor=None, flight=None):
         """``place(np_array) -> jax.Array`` controls device placement /
         mesh sharding; default is plain ``jax.device_put``.
         ``placement`` (the MeshPlacement the executor runs under, if
@@ -179,7 +179,7 @@ class PlaneCache:
         the LRU stamp; without it (or before any telemetry) ordering
         is the stamped LRU exactly."""
         from pilosa_tpu.exec._lru import Stamps
-        from pilosa_tpu.obs import NopStats
+        from pilosa_tpu.obs import NULL_FLIGHT, NopStats
         self.place = place or (placement.place if placement is not None
                                else jax.device_put)
         self.placement = placement
@@ -187,6 +187,14 @@ class PlaneCache:
         self._stats = stats or NopStats()
         self.sidecars = sidecars
         self.governor = governor
+        # flight recorder (r19): evictions land on the incident
+        # timeline with their reason — "why did that plane vanish at
+        # 03:14" is answerable from the dump
+        self.flight = flight or NULL_FLIGHT
+        # bound once: the ledger's plane-attribution stamp runs on the
+        # lock-free serving fast path
+        from pilosa_tpu.obs.ledger import set_plane_context
+        self._set_plane_ctx = set_plane_context
         # eviction accounting (r17 tenancy): every entry drop through
         # _evict_entry tallies here and on plane_evictions_total{reason}
         self.evictions = 0
@@ -294,6 +302,8 @@ class PlaneCache:
         self._evictions_by_reason[reason] = \
             self._evictions_by_reason.get(reason, 0) + 1
         self._stats.count("plane_evictions_total", 1, reason=reason)
+        self.flight.record("evict", f"{key[1]}/{key[2]}", reason,
+                           float(nbytes))
         if self.governor is not None:
             self.governor.note_evict(key)
         return nbytes
@@ -1333,6 +1343,10 @@ class PlaneCache:
 
     def _get(self, key, field: Field, view_name: str,
              shards: tuple[int, ...], build) -> PlaneSet:
+        # cost-ledger plane attribution (r19): stamp the serving
+        # thread with the plane this query is about to scan — one
+        # thread-local write, nothing else on the fast path
+        self._set_plane_ctx(f"{key[1]}/{key[2]}")
         # lock-free fast path: the common serving case is a fresh
         # resident plane — one dict read + one generation compare,
         # no cache lock, no view lock.  Delta-dirty entries never

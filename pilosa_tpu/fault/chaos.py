@@ -1415,6 +1415,26 @@ def scenario_hung_dispatch_serving(cluster, seed: int) -> ChaosHarness:
         raise h._fail("pipeline_watchdog_trips_total never incremented")
     if h.counter_total(0, "pipeline_quarantined_windows_total") < 1:
         raise h._fail("no window was ever quarantined")
+    # flight recorder (r19): the trip auto-dumped an artifact, the
+    # live ring resolves via /debug/flight, and the quarantine event
+    # names the stalled stage
+    flight = c._json("GET", "/debug/flight")
+    quar = [e for e in flight.get("events", ())
+            if e.get("kind") == "quarantine"]
+    if not quar:
+        raise h._fail("no quarantine event in /debug/flight after "
+                      "the watchdog trip")
+    if not any(e.get("detail") in ("dispatch", "readback")
+               for e in quar):
+        raise h._fail(f"quarantine flight event does not name a "
+                      f"pipeline stage: {quar[:3]}")
+    dumps = flight.get("dumps", ())
+    if not dumps:
+        raise h._fail("watchdog trip produced no flight-dump artifact")
+    import os as _os
+    if not _os.path.exists(dumps[-1]):
+        raise h._fail(f"flight dump path does not resolve on disk: "
+                      f"{dumps[-1]}")
     # recovered: A serves exact again (fresh collector, healthy state)
     if c.query(h.index, pql_a) != [want_a[r] for r in range(3)]:
         raise h._fail("index A diverged after recovery")

@@ -1,6 +1,9 @@
 """Cross-cutting observability (LX of SURVEY.md §2): metrics, tracing,
 logging."""
 
+from pilosa_tpu.obs.flight import (NULL_FLIGHT, FlightRecorder,
+                                   NullFlightRecorder)
+from pilosa_tpu.obs.ledger import NULL_LEDGER, CostLedger, NullLedger
 from pilosa_tpu.obs.logging import get_logger
 from pilosa_tpu.obs.metrics import (NopStats, StageTimer, Stats,
                                     StatsdStats)
@@ -12,4 +15,6 @@ from pilosa_tpu.obs.tracing import (GLOBAL_TRACER, NULL_TRACER,
 __all__ = ["Stats", "NopStats", "StageTimer", "StatsdStats",
            "get_logger", "Tracer", "GLOBAL_TRACER", "SlowQueryLog",
            "LiteTracer", "NullTracer", "NULL_TRACER",
-           "fast_trace_id", "fast_span_id", "parse_traceparent"]
+           "fast_trace_id", "fast_span_id", "parse_traceparent",
+           "FlightRecorder", "NullFlightRecorder", "NULL_FLIGHT",
+           "CostLedger", "NullLedger", "NULL_LEDGER"]

@@ -112,6 +112,27 @@ def build_payload(holder, cluster=None, stats=None, slow_log=None,
             }
         except Exception:  # noqa: BLE001 — diagnostics never break serving
             pass
+        try:
+            costs = executor.cost_status()
+            # counts-only here too: the ledger's per-tenant/shape/plane
+            # breakdowns carry index and field names — only aggregate
+            # totals and cardinalities leave the node
+            payload["costs"] = {
+                "windows": int(costs.get("windows", 0)),
+                "soloDispatches": int(costs.get("soloDispatches", 0)),
+                "deviceSecondsTotal": float(
+                    costs.get("deviceSecondsTotal", 0.0)),
+                "bytesScannedTotal": int(
+                    costs.get("bytesScannedTotal", 0)),
+                "compileSecondsTotal": float(
+                    costs.get("compileSecondsTotal", 0.0)),
+                "compileCount": int(costs.get("compileCount", 0)),
+                "tenants": int(costs.get("trackedTenants", 0)),
+                "shapes": int(costs.get("trackedShapes", 0)),
+                "planes": int(costs.get("trackedPlanes", 0)),
+            }
+        except Exception:  # noqa: BLE001 — diagnostics never break serving
+            pass
     return payload
 
 

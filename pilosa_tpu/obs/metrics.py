@@ -58,6 +58,41 @@ CLUSTER_STALE_NODES = "cluster_metrics_stale_nodes"
 # not a literal call site)
 STAGE_METRIC = "query_stage_seconds"
 
+# -- label-cardinality bounds (r19 satellite) ---------------------------------
+#
+# A label whose values the USER controls (tenant = index name, peer =
+# node id) grows one series per distinct value forever — a churny
+# multi-tenant deployment turns `tenant_shed_total{tenant}` into an
+# unbounded scrape.  Families listed here are capped at registry level:
+# the first K distinct values of the bounded label keep their own
+# series, every later value folds into the ``other`` series.  The
+# capped rollup stays a faithful TOTAL (folding moves a count between
+# series, it never drops one); per-entity detail for the long tail
+# lives in the /status blocks, which are maps, not scrape series.
+#
+# Module constant (family -> (label, K)) so the metrics-inventory
+# cardinality lint can enforce that every family with a user-controlled
+# label declares its bound here.
+DEFAULT_LABEL_BOUND = 32
+OTHER_LABEL = "other"
+BOUNDED_LABELS: dict[str, tuple[str, int]] = {
+    # per-tenant families (tenant = index name: user-controlled)
+    "tenant_shed_total": ("tenant", DEFAULT_LABEL_BOUND),
+    "tenant_device_seconds_total": ("tenant", DEFAULT_LABEL_BOUND),
+    "tenant_device_bytes_total": ("tenant", DEFAULT_LABEL_BOUND),
+    # per-plane ledger rollup (plane key derives from index/field names)
+    "plane_device_seconds_total": ("plane", DEFAULT_LABEL_BOUND),
+    # per-peer families (node ids churn across replaces/restarts)
+    "hint_handoff_total": ("peer", 64),
+    "hint_appended_total": ("peer", 64),
+    "hint_replay_dropped_total": ("peer", 64),
+    "hint_backlog_ops": ("peer", 64),
+    "read_failover_total": ("peer", 64),
+    "read_hedged_total": ("peer", 64),
+    "peer_breaker_state": ("peer", 64),
+    "breaker_transitions_total": ("peer", 64),
+}
+
 
 def escape_label_value(v) -> str:
     """Prometheus exposition escaping for label VALUES: backslash,
@@ -94,18 +129,55 @@ class Stats:
         # — the LATEST exemplar per bucket, bounded per series by the
         # bucket count
         self._exemplars: dict[tuple, dict[int, tuple]] = {}
+        # label-cardinality caps: (family, label) -> K, plus the set of
+        # label values already holding their own series
+        self._label_bounds: dict[tuple, int] = {
+            (fam, lab): k for fam, (lab, k) in BOUNDED_LABELS.items()}
+        self._label_seen: dict[tuple, set] = {}
+
+    def bound_label(self, name: str, label: str,
+                    top_k: int = DEFAULT_LABEL_BOUND) -> None:
+        """Cap one family's label cardinality: the first ``top_k``
+        distinct values of ``label`` keep their own series; later
+        values fold into the ``other`` series.  Families in
+        :data:`BOUNDED_LABELS` are capped automatically."""
+        with self._lock:
+            self._label_bounds[(name, label)] = int(top_k)
+
+    def _cap(self, name: str, labels: dict) -> dict:
+        """Fold over-cardinality label values into ``other``.  Caller
+        holds the lock; ``labels`` is the call's own kwargs dict, so
+        in-place mutation is safe."""
+        for lab in labels:
+            k = self._label_bounds.get((name, lab))
+            if k is None:
+                continue
+            v = str(labels[lab])
+            if v == OTHER_LABEL:
+                continue
+            seen = self._label_seen.setdefault((name, lab), set())
+            if v in seen:
+                continue
+            if len(seen) < k:
+                seen.add(v)
+            else:
+                labels[lab] = OTHER_LABEL
+        return labels
 
     # -- StatsClient surface (reference parity) -----------------------------
 
     def count(self, name: str, value: float = 1, **labels) -> None:
-        key = _labels_key(labels)
         with self._lock:
+            key = _labels_key(self._cap(name, labels) if labels
+                              else labels)
             m = self._counters[name]
             m[key] = m.get(key, 0) + value
 
     def gauge(self, name: str, value: float, **labels) -> None:
         with self._lock:
-            self._gauges[name][_labels_key(labels)] = value
+            key = _labels_key(self._cap(name, labels) if labels
+                              else labels)
+            self._gauges[name][key] = value
 
     def set_buckets(self, name: str, buckets: tuple) -> None:
         """Declare one family's histogram buckets (upper bounds,
@@ -134,8 +206,9 @@ class Stats:
         OpenMetrics exemplar — the join point between a latency bucket
         and ``/internal/traces?trace_id=`` (the lite serving path
         passes its cheap trace id here; cost is one tuple write)."""
-        key = _labels_key(labels)
         with self._lock:
+            key = _labels_key(self._cap(name, labels) if labels
+                              else labels)
             buckets = self._hist_buckets.setdefault(name, _BUCKETS)
             h = self._hists[name].get(key)
             if h is None:
@@ -452,6 +525,9 @@ class NopStats:
         pass
 
     def set_buckets(self, *a, **k):
+        pass
+
+    def bound_label(self, *a, **k):
         pass
 
     def histogram_summary(self, name):

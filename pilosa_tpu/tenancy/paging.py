@@ -39,12 +39,13 @@ class PlanePager:
     """
 
     def __init__(self, cache, governor=None, page_bytes: int = 64 << 20,
-                 stats=None):
-        from pilosa_tpu.obs import NopStats
+                 stats=None, flight=None):
+        from pilosa_tpu.obs import NULL_FLIGHT, NopStats
         self.cache = cache
         self.governor = governor
         self.page_bytes = max(1 << 20, int(page_bytes))
         self._stats = stats or NopStats()
+        self.flight = flight or NULL_FLIGHT
         self._lock = threading.Lock()
         self.page_ins = 0
         self.page_in_seconds_total = 0.0
@@ -158,6 +159,8 @@ class PlanePager:
         if g is not None:
             g.note_build(key, dt)
         self._stats.observe("plane_page_in_seconds", dt)
+        self.flight.record("pagein", f"{index}/{field.name}",
+                           f"{len(page_shards)} shards", dt)
         with self._lock:
             self.page_ins += 1
             self.page_in_seconds_total += dt

@@ -9,6 +9,11 @@ Tenant = index name.  Two independent quotas, both off by default:
 - **slot quota** — a per-tenant cap on concurrently EXECUTING queries,
   strictly below the executor-wide ``max_concurrent``: one tenant can
   never occupy every admission slot.
+- **device-seconds quota** (r19) — a cap on the tenant's RECENT
+  measured device seconds (the cost ledger's exponentially decayed
+  window).  qps counts requests; this counts what they actually cost
+  on device, so one tenant's pathological shapes cannot soak the
+  device from inside a modest request rate.
 
 A shed raises :class:`TenantThrottledError`, which the API layer maps
 to the same 503 + Retry-After contract the saturated executor already
@@ -33,7 +38,7 @@ class TenantThrottledError(Exception):
         super().__init__(msg)
         self.tenant = tenant
         self.quota = quota
-        self.kind = kind  # "qps" | "slots"
+        self.kind = kind  # "qps" | "slots" | "device-seconds"
         self.retry_after = retry_after
 
 
@@ -42,10 +47,15 @@ class TenantQos:
     the admit check is a few float ops, far off the dispatch path."""
 
     def __init__(self, qps_quota: float = 0.0, slot_quota: int = 0,
-                 stats=None):
+                 stats=None, device_seconds_quota: float = 0.0,
+                 ledger=None):
         from pilosa_tpu.obs import NopStats
         self.qps_quota = float(qps_quota)
         self.slot_quota = int(slot_quota)
+        # device-seconds quota needs the measured side: the cost
+        # ledger's decayed per-tenant recent-seconds window
+        self.device_seconds_quota = float(device_seconds_quota)
+        self._ledger = ledger
         self._stats = stats or NopStats()
         self._lock = threading.Lock()
         self._buckets: dict[str, list] = {}   # tenant -> [tokens, ts]
@@ -54,7 +64,9 @@ class TenantQos:
 
     @property
     def enabled(self) -> bool:
-        return self.qps_quota > 0 or self.slot_quota > 0
+        return (self.qps_quota > 0 or self.slot_quota > 0
+                or (self.device_seconds_quota > 0
+                    and self._ledger is not None))
 
     def admit(self, tenant: str) -> None:
         """Admit one query for ``tenant`` or raise
@@ -78,6 +90,28 @@ class TenantQos:
                     self._shed(tenant, self.slot_quota, "slots",
                                retry_after=0.5)
                 self._inflight[tenant] = used + 1
+            if self.device_seconds_quota > 0 and self._ledger is not None:
+                # measured feedback loop (r19): the ledger's decayed
+                # recent device seconds — a tenant past its share of
+                # actual device time sheds until the window decays
+                # back under quota, whatever its request RATE was
+                spent = self._ledger.recent_seconds(tenant)
+                if spent >= self.device_seconds_quota:
+                    self._inflight_undo(tenant)
+                    self._shed(tenant, self.device_seconds_quota,
+                               "device-seconds", retry_after=1.0)
+
+    def _inflight_undo(self, tenant: str) -> None:
+        """Caller holds the lock: back out the slot this admit just
+        took before a later quota check sheds (the caller never runs
+        its paired release() when admit raises)."""
+        if self.slot_quota <= 0:
+            return
+        left = self._inflight.get(tenant, 0) - 1
+        if left > 0:
+            self._inflight[tenant] = left
+        else:
+            self._inflight.pop(tenant, None)
 
     def release(self, tenant: str) -> None:
         if self.slot_quota <= 0:
@@ -107,6 +141,7 @@ class TenantQos:
         with self._lock:
             return {"qpsQuota": self.qps_quota,
                     "slotQuota": self.slot_quota,
+                    "deviceSecondsQuota": self.device_seconds_quota,
                     "inflight": dict(self._inflight),
                     "sheds": dict(self._sheds),
                     "shedTotal": int(sum(self._sheds.values()))}

@@ -723,3 +723,40 @@ def test_config33_event_analytics_smoke():
     assert d["mixed_under_ingest"]["qps"] > 0
     # the same-metric history guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config34_cost_observability_smoke():
+    """bench/config34 (cost-ledger + flight-recorder overhead vs
+    cost_observability=False on the config18 concurrency workload,
+    ISSUE 19) in --smoke mode: tiny plane, CPU, sweep 1/2/4 — the r19
+    attribution semantics (per-tenant/shape/plane rollups re-adding to
+    device totals, lifecycle events in the flight ring, the compile
+    family booked) are asserted INSIDE the bench while the cost is
+    measured, so the <3% full-scale bar can never report a number for
+    attribution that stopped attributing — runs under tier-1 so the
+    bench can never bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config34_cost_observability.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("cost_observability_overhead_pct")
+    assert out["unit"] == "pct" and out["vs_baseline"] > 0
+    d = out["detail"]
+    # both tiers measured at every swept level
+    assert set(d["qps_off"]) == {"1", "2", "4"}
+    assert set(d["qps_on"]) == {"1", "2", "4"}
+    assert d["qps_ratio_on_off"] > 0
+    # the semantics the overhead pays for actually fired
+    assert d["device_seconds"] > 0
+    assert d["windows"] + d["solo_dispatches"] > 0
+    assert d["flight_events"] > 0 and d["flight_last_seq"] > 0
+    # the detail guard must be wired (list, possibly empty)
+    assert isinstance(out["regressions"], list)

@@ -1,0 +1,430 @@
+"""r19: device-cost ledger + dispatch flight recorder.
+
+Pins the tentpole contracts:
+
+- **exact apportionment** — window shares re-sum bit-for-bit to the
+  measured wall (:func:`pilosa_tpu.obs.ledger.apportion`), so the
+  per-tenant rollups can be trusted to re-add to device totals;
+- **bounded cardinality** — 10k distinct tenants produce a bounded
+  number of scrape series (top-K + ``other``) and a bounded rollup
+  map, with the TOTALS exact either way;
+- **flight-recorder ordering under concurrency** — 32 mixed-kind
+  submitters with an injected dispatch hang: the incident dump
+  exists, every window's lifecycle events are individually in order,
+  and the quarantine event names the same stage as the caller's
+  structured error.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import fault
+from pilosa_tpu.engine.words import SHARD_WIDTH
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.executor import PipelineStalledError
+from pilosa_tpu.obs import CostLedger, FlightRecorder, Stats
+from pilosa_tpu.obs.ledger import apportion
+from pilosa_tpu.store import Holder
+
+WORDS = SHARD_WIDTH // 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# -- exact apportionment ------------------------------------------------------
+
+
+class TestApportion:
+    @pytest.mark.parametrize("total,weights", [
+        (0.123456789, [1, 2, 3]),
+        (1.0, [0, 0, 0]),                       # zero weights: equal split
+        (0.001724, [131072, 262144, 1, 98304]),
+        (3.0000000000000004, [0.1, 0.2, 0.30000000000000004]),
+        (1e-9, [7, 11, 13, 17, 19]),
+        (5.5, [1]),
+    ])
+    def test_shares_resum_exactly(self, total, weights):
+        shares = apportion(total, weights)
+        assert len(shares) == len(weights)
+        s = 0.0
+        for x in shares:
+            s += x
+        assert s == total  # bit-for-bit, left-to-right
+
+    def test_proportionality(self):
+        shares = apportion(1.0, [1, 3])
+        assert abs(shares[0] - 0.25) < 1e-12
+        assert abs(shares[1] - 0.75) < 1e-12
+
+    def test_empty(self):
+        assert apportion(1.0, []) == []
+
+
+class TestCostLedger:
+    def test_window_charges_sum_to_wall(self):
+        led = CostLedger()
+        wall = 0.0137
+        entries = [("ta", "count", "i/f", 131072, None),
+                   ("tb", "count", "i/f", 262144, "tr-1"),
+                   ("ta", "words", "i/g", 65536, None)]
+        led.charge_window(wall, entries)
+        p = led.payload(top_k=10)
+        assert p["windows"] == 1
+        assert p["bytesScannedTotal"] == 131072 + 262144 + 65536
+        # per-tenant rollups re-add to the measured wall exactly
+        # (modulo the payload's display rounding — so compare raw)
+        assert abs(led.total_seconds - wall) < 1e-15
+        tot = sum(row[0] for row in led._tenants.values())
+        assert tot == led.total_seconds == pytest.approx(wall, abs=0.0)
+        # every table saw every item
+        assert p["tenants"]["ta"]["items"] == 2
+        assert p["tenants"]["tb"]["items"] == 1
+        assert set(p["shapes"]) == {"count", "words"}
+        assert set(p["planes"]) == {"i/f", "i/g"}
+
+    def test_solo_and_trace_join(self):
+        led = CostLedger()
+        led.charge_solo("t", "count", "i/f", 0.004, 4096,
+                        trace_id="tr-9")
+        assert led.payload()["soloDispatches"] == 1
+        assert led.trace_seconds("tr-9") == pytest.approx(0.004)
+        assert led.trace_seconds("nope") is None
+        assert led.trace_seconds(None) is None
+
+    def test_recent_seconds_decays(self):
+        led = CostLedger(decay_seconds=1.0)
+        led.charge_solo("t", "count", "i/f", 1.0, 1)
+        r0 = led.recent_seconds("t")
+        assert 0.0 < r0 <= 1.0
+        # force the decay stamp into the past: ~10 half-lives
+        led._recent["t"][1] -= 10.0
+        assert led.recent_seconds("t") < r0 / 500.0
+        assert led.recent_seconds("stranger") == 0.0
+
+    def test_payload_top_k_folds_other(self):
+        led = CostLedger()
+        for i in range(8):
+            led.charge_solo(f"t{i}", "count", "i/f", float(i + 1), 10)
+        p = led.payload(top_k=3)
+        # hottest three by seconds keep their names
+        assert set(p["tenants"]) == {"t7", "t6", "t5", "other"}
+        # the fold is a faithful total: other carries the rest
+        assert p["tenants"]["other"]["items"] == 5
+        total = sum(v["deviceSeconds"] for v in p["tenants"].values())
+        assert total == pytest.approx(sum(range(1, 9)), abs=1e-4)
+
+    def test_rollup_maps_bounded(self):
+        from pilosa_tpu.obs.ledger import _MAX_KEYS
+        led = CostLedger()
+        for i in range(3 * _MAX_KEYS):
+            led.charge_solo(f"t{i}", "count", f"p{i}", 0.001, 1)
+        assert len(led._tenants) <= _MAX_KEYS
+        assert len(led._planes) <= _MAX_KEYS
+        # totals stay exact through pruning
+        assert led.payload()["soloDispatches"] == 3 * _MAX_KEYS
+
+    def test_compile_notes(self):
+        stats = Stats()
+        led = CostLedger(stats=stats)
+        led.note_compile("selcounts", 0.25, first=True)
+        led.note_compile("selcounts", 0.01, first=False)
+        p = led.payload()
+        assert p["compileCount"] == 2
+        assert p["compileSecondsTotal"] == pytest.approx(0.26)
+        snap = stats.snapshot()
+        assert "fused_compile_seconds_total" in snap["counters"]
+
+
+# -- bounded metric label cardinality (satellite 1) ---------------------------
+
+
+class TestLabelCardinality:
+    def test_10k_tenants_bounded_series(self):
+        """Hammer tenant_shed_total with 10k distinct tenants: the
+        registry keeps top-K series + ``other`` and the folded total
+        is exact."""
+        from pilosa_tpu.obs.metrics import (BOUNDED_LABELS, OTHER_LABEL)
+        stats = Stats()
+        n = 10_000
+        for i in range(n):
+            stats.count("tenant_shed_total", 1, tenant=f"t{i}")
+        series = stats.snapshot()["counters"]["tenant_shed_total"]
+        _, k = BOUNDED_LABELS["tenant_shed_total"]
+        assert len(series) == k + 1  # K named + other
+        assert sum(series.values()) == n  # folding never drops counts
+        other = series[(("tenant", OTHER_LABEL),)]
+        assert other == n - k
+
+    def test_10k_tenants_through_ledger(self):
+        """The same bound holds end-to-end through the ledger's scrape
+        families."""
+        from pilosa_tpu.obs.metrics import BOUNDED_LABELS
+        stats = Stats()
+        led = CostLedger(stats=stats)
+        for i in range(10_000):
+            led.charge_solo(f"t{i}", "count", f"t{i}/f", 1e-6, 64)
+        snap = stats.snapshot()["counters"]
+        _, kt = BOUNDED_LABELS["tenant_device_seconds_total"]
+        _, kp = BOUNDED_LABELS["plane_device_seconds_total"]
+        assert len(snap["tenant_device_seconds_total"]) <= kt + 1
+        assert len(snap["tenant_device_bytes_total"]) <= kt + 1
+        assert len(snap["plane_device_seconds_total"]) <= kp + 1
+        # bytes total survives the fold exactly
+        assert sum(snap["tenant_device_bytes_total"].values()) == \
+            10_000 * 64
+
+    def test_bound_label_is_per_family(self):
+        """An unbounded family with the same label name stays
+        unbounded — the cap is (family, label) scoped."""
+        stats = Stats()
+        stats.bound_label("capped_total", "tenant", top_k=2)
+        for i in range(5):
+            stats.count("capped_total", 1, tenant=f"t{i}")
+            stats.count("free_total", 1, tenant=f"t{i}")
+        snap = stats.snapshot()["counters"]
+        assert len(snap["capped_total"]) == 3
+        assert len(snap["free_total"]) == 5
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_and_snapshot_order(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(10):
+            fr.record("enqueue", f"t{i}", "count", float(i))
+        snap = fr.snapshot()
+        assert snap["lastSeq"] == 10
+        seqs = [e["seq"] for e in snap["events"]]
+        assert seqs == sorted(seqs)
+        ts = [e["ts"] for e in snap["events"]]
+        assert ts == sorted(ts)  # monotonic stamps
+
+    def test_wraparound_keeps_newest(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(200):
+            fr.record("e", str(i))
+        snap = fr.snapshot()
+        assert len(snap["events"]) <= 64
+        assert snap["events"][-1]["entity"] == "199"
+        assert snap["lastSeq"] == 200
+
+    def test_snapshot_limit(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(20):
+            fr.record("e", str(i))
+        snap = fr.snapshot(limit=5)
+        assert [e["entity"] for e in snap["events"]] == \
+            ["15", "16", "17", "18", "19"]
+
+    def test_incident_dumps_and_rate_limits(self, tmp_path):
+        stats = Stats()
+        fr = FlightRecorder(capacity=64, dump_dir=str(tmp_path),
+                            stats=stats)
+        fr.record("dispatch", "w1")
+        p1 = fr.incident("quarantine", "w1", "dispatch")
+        assert p1 and os.path.exists(p1)
+        doc = json.loads(open(p1).read())
+        assert doc["reason"] == "quarantine"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["dispatch", "incident"]
+        assert doc["events"][-1]["detail"] == "quarantine: dispatch"
+        # a second incident inside the rate-limit floor reuses the
+        # artifact instead of writing a new one
+        p2 = fr.incident("quarantine", "w2", "dispatch")
+        assert p2 == p1
+        snap = stats.snapshot()["counters"]
+        assert sum(snap["flight_incidents_total"].values()) == 2
+        assert sum(snap["flight_dumps_total"].values()) == 1
+        assert fr.last_dump == p1
+
+    def test_dump_count_bounded(self, tmp_path):
+        import pilosa_tpu.obs.flight as fl
+        fr = FlightRecorder(capacity=64, dump_dir=str(tmp_path))
+        for i in range(fl.MAX_DUMPS + 4):
+            fr._last_dump_t = 0.0  # defeat the rate limit
+            fr.record("e", str(i))
+            fr.incident(f"r{i}")
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-")]
+        assert len(files) == fl.MAX_DUMPS
+
+
+# -- flight ordering under concurrency (satellite 4) --------------------------
+
+
+STAGE_ORDER = {"dispatch": 0, "readback": 1, "deliver": 2}
+
+
+def _served_holder(tmp_path):
+    from pilosa_tpu.store import roaring
+    n_shards, n_rows = 2, 16
+    rng = np.random.default_rng(7)
+    plane = rng.integers(0, 1 << 32, size=(n_shards, n_rows, WORDS),
+                         dtype=np.uint32)
+    h = Holder(str(tmp_path)).open()
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("f")
+    h.close()
+    frag_dir = os.path.join(str(tmp_path), "i", "f", "views",
+                            "standard", "fragments")
+    os.makedirs(frag_dir, exist_ok=True)
+    for s in range(n_shards):
+        with open(os.path.join(frag_dir, str(s)), "wb") as fh:
+            fh.write(roaring.serialize_dense(plane[s]))
+    return Holder(str(tmp_path)).open()
+
+
+class TestFlightOrderingUnderConcurrency:
+    def test_32_way_mixed_kinds_with_watchdog_trip(self, tmp_path):
+        """32 concurrent submitters of mixed kinds race an injected
+        dispatch hang: the trip produces an incident dump whose
+        per-window lifecycle sequences are individually ordered
+        (dispatch before readback before deliver, seq and ts both
+        monotonic), and the quarantine event names the same stage as
+        the caller's structured error."""
+        holder = _served_holder(tmp_path)
+        stats = Stats()
+        ex = Executor(holder, stats=stats, count_batch_window=0.002,
+                      solo_fastlane=False,
+                      dispatch_watchdog_seconds=5.0,
+                      device_health_probe_seconds=0.1)
+        try:
+            # warm both program families OUTSIDE the watchdog window
+            assert ex.execute("i", "Count(Row(f=1))")
+            assert ex.execute("i", "Row(f=1)")
+            ex.batcher.watchdog_s = 0.15
+            fault.set_fault("exec.dispatch_hang", "delay", times=1,
+                            match={"kind": "count"},
+                            args={"seconds": 3.0})
+            stalled: list = []
+            errors: list = []
+            start = threading.Barrier(32)
+
+            def worker(i: int) -> None:
+                pql = (f"Count(Row(f={i % 16}))" if i % 2
+                       else f"Row(f={i % 16})")
+                start.wait()
+                for _ in range(4):
+                    try:
+                        ex.execute("i", pql)
+                    except PipelineStalledError as e:
+                        stalled.append(e)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors[:3]
+            assert stalled, "the injected hang never tripped a watchdog"
+            err = stalled[0]
+            assert err.stage in ("dispatch", "readback")
+            # the incident auto-dumped an artifact
+            deadline = time.monotonic() + 5
+            while ex.flight.last_dump is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            dump = ex.flight.last_dump
+            assert dump is not None and os.path.exists(dump)
+            doc = json.loads(open(dump).read())
+            events = doc["events"]
+            assert events, "dump carried no events"
+            # quarantine event names the SAME stage as the structured
+            # error the caller saw
+            quar = [e for e in events if e["kind"] == "quarantine"]
+            assert quar, "no quarantine event in the dump"
+            assert any(e["detail"] == err.stage for e in quar)
+            assert any(e["kind"] == "watchdog_trip" for e in events)
+            assert any(e["kind"] == "incident" and
+                       "quarantine" in e["detail"] for e in events)
+            # per-window sequences individually monotonic and
+            # stage-ordered — check the LIVE ring too (it kept
+            # recording after the dump)
+            for evs in (events, ex.flight.snapshot()["events"]):
+                by_window: dict = {}
+                for e in evs:
+                    if e["kind"] in STAGE_ORDER and \
+                            e["entity"].startswith("w"):
+                        by_window.setdefault(e["entity"], []).append(e)
+                assert by_window, "no window lifecycle events recorded"
+                for wid, wevs in by_window.items():
+                    seqs = [e["seq"] for e in wevs]
+                    assert seqs == sorted(seqs), (wid, wevs)
+                    ts = [e["ts"] for e in wevs]
+                    assert ts == sorted(ts), (wid, wevs)
+                    stages = [STAGE_ORDER[e["kind"]] for e in wevs]
+                    assert stages == sorted(stages), \
+                        f"window {wid} lifecycle out of order: {wevs}"
+            # cost attribution flowed through the same storm
+            costs = ex.cost_status()
+            assert costs["windows"] >= 1
+            assert costs["deviceSecondsTotal"] > 0
+            assert "i" in costs["tenants"]
+        finally:
+            holder.close()
+
+
+# -- end-to-end /status + /debug/flight surfaces ------------------------------
+
+
+def test_status_costs_block_and_debug_flight(tmp_path):
+    import urllib.request
+
+    from pilosa_tpu.api import API, Server
+    holder = Holder(str(tmp_path)).open()
+    stats = Stats()
+    api = API(holder, Executor(holder, stats=stats))
+    srv = Server(api, host="127.0.0.1", port=0, stats=stats)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.address[1]}"
+    try:
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Set(1, f=2)")
+        api.query("i", "Count(Row(f=2))")
+        st = json.loads(urllib.request.urlopen(url + "/status").read())
+        costs = st["costs"]
+        assert costs["deviceSecondsTotal"] > 0
+        assert costs["bytesScannedTotal"] > 0
+        assert "i" in costs["tenants"]
+        assert "count" in costs["shapes"]
+        # compile observability: the first fused program was timed
+        assert costs["compileCount"] >= 1
+        assert costs["compileSecondsTotal"] > 0
+        fl = json.loads(
+            urllib.request.urlopen(url + "/debug/flight").read())
+        kinds = {e["kind"] for e in fl["events"]}
+        assert "compile" in kinds
+        assert fl["lastSeq"] >= 1
+        lim = json.loads(urllib.request.urlopen(
+            url + "/debug/flight?limit=1").read())
+        assert len(lim["events"]) == 1
+        # single-node cluster view still answers
+        cl = json.loads(urllib.request.urlopen(
+            url + "/debug/flight?cluster=1").read())
+        assert "local" in cl["nodes"] and cl["staleNodes"] == []
+        # scrape families present
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "tenant_device_seconds_total" in text
+        assert "query_device_seconds" in text
+        assert "fused_compile_seconds" in text
+        assert "flight_events_total" in text
+    finally:
+        srv.close()
+        holder.close()

@@ -73,6 +73,54 @@ def documented() -> tuple[set, set]:
     return names, wildcards
 
 
+# r19 satellite: labels whose value space the USER controls (tenant =
+# index name; peer = node id; plane = index/field key).  A family
+# emitted with one of these MUST declare a cardinality bound in
+# BOUNDED_LABELS or the scrape grows one series per distinct value
+# forever.  Labels with a bounded-by-construction vocabulary (shape /
+# family / kind come from the fused-program kind enum, reason from a
+# literal set) are exempt.
+USER_LABELS = ("tenant", "peer", "plane")
+
+LABELED_EMIT_RE = re.compile(
+    r'\.(?:count|gauge|observe|timing)\(\s*"([a-zA-Z0-9_]+)"'
+    r'[^)]*?\b(' + "|".join(USER_LABELS) + r')=',
+    re.DOTALL)
+
+
+def test_user_labeled_families_declare_cardinality_bound():
+    """Cardinality lint: every family emitted with a user-controlled
+    label (tenant/peer/plane) must appear in
+    ``obs.metrics.BOUNDED_LABELS`` with that label, so the registry
+    folds the long tail into ``other`` instead of growing unbounded
+    scrape series."""
+    from pilosa_tpu.obs.metrics import BOUNDED_LABELS
+    violations = []
+    for path in PKG.rglob("*.py"):
+        for family, label in LABELED_EMIT_RE.findall(path.read_text()):
+            bound = BOUNDED_LABELS.get(family)
+            if bound is None or bound[0] != label:
+                violations.append(
+                    f"{path.relative_to(REPO)}: {family}{{{label}}}")
+    assert not violations, (
+        "families emitted with a user-controlled label but no "
+        f"cardinality bound in BOUNDED_LABELS: {sorted(set(violations))}")
+
+
+def test_bounded_families_are_real():
+    """The reverse direction: every BOUNDED_LABELS entry names a
+    family the code actually emits with that label (a stale bound is
+    inventory drift too)."""
+    from pilosa_tpu.obs.metrics import BOUNDED_LABELS
+    seen = set()
+    for path in PKG.rglob("*.py"):
+        seen.update(LABELED_EMIT_RE.findall(path.read_text()))
+    stale = sorted(fam for fam, (lab, _k) in BOUNDED_LABELS.items()
+                   if lab in USER_LABELS and (fam, lab) not in seen)
+    assert not stale, (
+        f"BOUNDED_LABELS entries never emitted with that label: {stale}")
+
+
 def test_every_emitted_metric_is_documented():
     names, wildcards = documented()
     undocumented = sorted(

@@ -454,6 +454,37 @@ class TestDiagnostics:
         dumped = json.dumps(p)
         assert '"b"' not in dumped
 
+    def test_tenancy_and_costs_blocks_stay_counts_only(self, tmp_path):
+        """r19 satellite fix: the tenancy AND costs blocks on the
+        diagnostics payload carry counts/totals only — tenant (index)
+        names, shape kinds, and plane keys never leave the node, even
+        though /status exposes all three by name."""
+        import json
+
+        from pilosa_tpu.exec import Executor
+        from pilosa_tpu.obs.diagnostics import build_payload
+        from pilosa_tpu.store import Holder
+        h = Holder(str(tmp_path)).open()
+        idx = h.create_index("secretindex")
+        idx.create_field("secretfield")
+        idx.set_bit("secretfield", 1, 10)
+        ex = Executor(h)
+        assert ex.execute("secretindex",
+                          "Count(Row(secretfield=1))") == [1]
+        p = build_payload(h, executor=ex)
+        # the ledger saw the query by name...
+        costs_full = ex.cost_status()
+        assert "secretindex" in costs_full["tenants"]
+        # ...but the diagnostics payload carries only aggregates
+        assert p["costs"]["tenants"] >= 1
+        assert p["costs"]["deviceSecondsTotal"] > 0
+        assert p["costs"]["bytesScannedTotal"] > 0
+        dumped = json.dumps({"tenancy": p.get("tenancy"),
+                             "costs": p["costs"]})
+        assert "secretindex" not in dumped
+        assert "secretfield" not in dumped
+        h.close()
+
     def test_periodic_reporting(self, tmp_path):
         import time
         from pilosa_tpu.obs.diagnostics import Diagnostics

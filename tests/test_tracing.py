@@ -167,6 +167,12 @@ class TestSlowQueryCapture:
             assert entry["pql"] == "Count(Row(f=1))"
             assert entry["index"] == "i" and entry["durationMs"] > 0
             assert entry["traceId"]
+            # r19 satellite: every slow entry names which path
+            # answered — triage starts with "was this on the fast
+            # path at all"
+            assert entry["path"] in (
+                "fused", "op-at-a-time fallback", "paged",
+                "row-directory oracle", "degraded governor")
             spans = list(walk(entry["profile"]))
             assert any(s["name"] == "executor.Count" for s in spans)
             # slow traces are retained: the id resolves in the ring
