@@ -133,6 +133,30 @@ class Config:
     # admit ONE window back onto the fused pipeline as a probe;
     # success restores healthy serving.
     device_health_probe_seconds: float = 5.0
+    # Serving kernel tier (r24): "xla" (default) compiles every fused
+    # family through the XLA oracle tier; "pallas" routes the hottest
+    # families (selected-row gather scans, whole-plane count chains,
+    # filtered row-count reduces — delta-overlay variants included)
+    # through hand-written Pallas TPU kernels.  Per-family fail-safe:
+    # a family whose Pallas lowering fails falls back to XLA silently
+    # (pallas_fallback_total counts it), and degraded serving always
+    # runs the per-item XLA fallback whatever the tier.  On non-TPU
+    # backends "pallas" resolves to "xla" unless the test-only
+    # PILOSA_PALLAS_INTERPRET escape hatch forces interpret mode.
+    kernel_tier: str = "xla"
+    # On-device dispatch loops (r24): the batcher collapses a
+    # collection window's same-shape selected-count groups into ONE
+    # jitted fori_loop/scan dispatch over stacked operands instead of
+    # one program launch per group (dispatch_loop_iters histogram
+    # proves the collapse; per-item fallback covers failures).
+    dispatch_loop_fusion: bool = False
+    # Compile-ladder warm-up (r24): when a plane becomes resident, a
+    # background single-flight warmer pre-compiles the delta-aware
+    # fused program ladder (one program per pow2 overlay bucket per
+    # family) OFF the serving path, so the first post-ingest query
+    # hits a warm cache.  Compile seconds book into the cost ledger
+    # under "warmup".  Single-device only (mesh placement disables).
+    fused_warmup: bool = False
     # Storage integrity (r19).  Background scrubber: re-verify every
     # on-disk checksum (snapshot frames, op-log records, dense
     # sidecars, hint logs) each scrub_interval_seconds, reading at

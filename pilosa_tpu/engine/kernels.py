@@ -17,6 +17,13 @@ shard is 2^20 columns — and finished in int64 on the host where
 cluster-wide totals could overflow (:func:`shard_totals`).  TPUs have no
 native int64; keeping the device path int32 avoids ~1000x emulation
 overhead on the popcount matrix (see ``engine._jaxcfg``).
+
+Kernel tiers (r24): this module is the XLA ORACLE tier — the default
+serving tier, the bit-exactness reference every other tier is tested
+against, and the path degraded serving and Pallas lowering failures
+always fall back to.  ``engine.pallas_kernels`` carries the optional
+hand-written Pallas tier the executor's ``kernel_tier="pallas"`` knob
+selects for the hottest fused families.
 """
 
 from __future__ import annotations

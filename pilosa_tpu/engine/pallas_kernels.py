@@ -11,7 +11,22 @@ popcount matrix behind TopN, ``roaring/roaring.go`` /
   (and + popcount + per-shard reduce, one VMEM pass);
 - :func:`row_counts`: ``uint32[S, R, W] (× filter) → int32[S, R]``
   (the TopN matrix), gridded over shards × row blocks so each block
-  streams ~1MB through VMEM.
+  streams ~1MB through VMEM;
+- :func:`count`: ``uint32[S, W] → int32[S]`` (the whole-bitmap count
+  chain), word-blocked so a wide scan accumulates through VMEM-sized
+  tiles like :func:`kernels.count`'s tiled reduce;
+- :func:`selected_row_counts`: ``uint32[S, R, W] + int32[N] →
+  int32[S, N]`` — the TopN/product gather scan.  The slot list rides
+  the scalar-prefetch channel so Mosaic knows the next gathered row
+  block before the grid step runs (matches
+  ``kernels.selected_row_counts``'s sorted-slot contract: ascending
+  slots walk the row axis in ascending stride order).
+
+These are the ``kernel_tier="pallas"`` serving tier: ``exec/fused.py``
+routes the hottest fused families here when the knob is on, keeping
+the XLA kernels as the correctness oracle and fallback.  Delta-overlay
+adjustment (base⊕delta) stays one program: the fused layer composes
+these base scans with the overlay scatter inside a single jit.
 
 Popcount uses the SWAR bit-twiddling reduction (shift/mask adds) —
 portable across Mosaic versions regardless of ``population_count``
@@ -26,6 +41,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 def _popcount_u32(x: jax.Array) -> jax.Array:
     """SWAR popcount per uint32 lane -> int32.  Masks are weak python
@@ -107,15 +123,19 @@ def row_counts(plane: jax.Array, filter_words: jax.Array | None = None,
     # rows pad to one full block (<=128 rows) or to 128-row blocks
     rb = r if r <= _RB else _RB
     s_pad, r_pad = (-s) % _SB, (-r) % rb
-    wb = _WB if w % _WB == 0 else w
-    if s_pad or r_pad:
-        plane = jnp.pad(plane, ((0, s_pad), (0, r_pad), (0, 0)))
-        filter_words = jnp.pad(filter_words, ((0, s_pad), (0, 0)))
-    sp, rp = s + s_pad, r + r_pad
-    filt3 = filter_words.reshape(sp, 1, w)
+    # words pad with zeros to a _WB multiple (zero words popcount to
+    # zero under any filter) — NEVER stream the whole word axis in one
+    # grid step: an 8 x 128 x w tile blows the ~4MB VMEM budget at
+    # real plane widths when w % _WB != 0
+    wb, w_pad = (w, 0) if w <= _WB else (_WB, (-w) % _WB)
+    if s_pad or r_pad or w_pad:
+        plane = jnp.pad(plane, ((0, s_pad), (0, r_pad), (0, w_pad)))
+        filter_words = jnp.pad(filter_words, ((0, s_pad), (0, w_pad)))
+    sp, rp, wp = s + s_pad, r + r_pad, w + w_pad
+    filt3 = filter_words.reshape(sp, 1, wp)
     out = pl.pallas_call(
         _row_counts_kernel,
-        grid=(sp // _SB, rp // rb, w // wb),
+        grid=(sp // _SB, rp // rb, wp // wb),
         in_specs=[
             pl.BlockSpec((_SB, rb, wb), lambda i, j, k: (i, j, k)),
             pl.BlockSpec((_SB, 1, wb), lambda i, j, k: (i, 0, k)),
@@ -125,3 +145,96 @@ def row_counts(plane: jax.Array, filter_words: jax.Array | None = None,
         interpret=interpret,
     )(plane, filt3)
     return out[:s, :r]
+
+
+_CWB = 128 * 1024  # count word block: 8 x 128K x 4B = 4MB tile
+
+
+def _count_kernel(w_ref, out_ref):
+    k = pl.program_id(1)
+    counts = jnp.sum(_popcount_u32(w_ref[...]), axis=-1, keepdims=True)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = counts
+
+    @pl.when(k != 0)
+    def _acc():
+        out_ref[...] += counts
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def count(words: jax.Array, interpret: bool = False) -> jax.Array:
+    """Whole-bitmap count chain: uint32[S, W] -> int32[S].
+
+    The Pallas face of :func:`kernels.count`'s tiled reduce — grid
+    (shard blocks, word blocks), the output tile indexed by shard
+    block only so it persists across the word axis and accumulates
+    partial popcounts (each step streams a <=4MB tile through VMEM).
+    """
+    s, w = words.shape
+    s_pad = (-s) % _SB
+    wb, w_pad = (w, 0) if w <= _CWB else (_CWB, (-w) % _CWB)
+    if s_pad or w_pad:
+        words = jnp.pad(words, ((0, s_pad), (0, w_pad)))
+    sp, wp = s + s_pad, w + w_pad
+    out = pl.pallas_call(
+        _count_kernel,
+        grid=(sp // _SB, wp // wb),
+        in_specs=[pl.BlockSpec((_SB, wb), lambda i, k: (i, k))],
+        out_specs=pl.BlockSpec((_SB, 1), lambda i, k: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((sp, 1), jnp.int32),
+        interpret=interpret,
+    )(words)
+    return out[:s, 0]
+
+
+def _selected_kernel(idx_ref, plane_ref, out_ref):
+    del idx_ref  # consumed by the index maps
+    k = pl.program_id(2)
+    counts = jnp.sum(_popcount_u32(plane_ref[...]), axis=-1)  # (SB, 1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = counts
+
+    @pl.when(k != 0)
+    def _acc():
+        out_ref[...] += counts
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def selected_row_counts(plane: jax.Array, row_idx: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """Gathered-row popcounts: uint32[S, R, W] + int32[N] -> int32[S, N].
+
+    The Pallas face of :func:`kernels.selected_row_counts`: the slot
+    list rides the scalar-prefetch channel, so each grid step's block
+    index map reads ``idx_ref[j]`` and Mosaic can start the next
+    gathered row block's HBM→VMEM copy before the step runs.  Sorted
+    ascending slots (the fused layer's contract) make those copies
+    walk the row axis in ascending stride order.  Slots may repeat
+    (padded asks); each output column accumulates independently.
+    """
+    s, r, w = plane.shape
+    n = row_idx.shape[0]
+    s_pad = (-s) % _SB
+    wb, w_pad = (w, 0) if w <= _WB else (_WB, (-w) % _WB)
+    if s_pad or w_pad:
+        plane = jnp.pad(plane, ((0, s_pad), (0, 0), (0, w_pad)))
+    sp, wp = s + s_pad, w + w_pad
+    idx = row_idx.astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n, sp // _SB, wp // wb),
+        in_specs=[pl.BlockSpec((_SB, 1, wb),
+                               lambda j, i, k, idx_ref: (i, idx_ref[j], k))],
+        out_specs=pl.BlockSpec((_SB, 1), lambda j, i, k, idx_ref: (i, j)),
+    )
+    out = pl.pallas_call(
+        _selected_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((sp, n), jnp.int32),
+        interpret=interpret,
+    )(idx, plane)
+    return out[:s]

@@ -308,7 +308,10 @@ class Executor:
                  tenant_qps_quota: float = 0.0,
                  tenant_slot_quota: int = 0,
                  tenant_device_seconds_quota: float = 0.0,
-                 cost_observability: bool = True):
+                 cost_observability: bool = True,
+                 kernel_tier: str = "xla",
+                 dispatch_loop_fusion: bool = False,
+                 fused_warmup: bool = False):
         """``placement`` (a :class:`pilosa_tpu.parallel.MeshPlacement`)
         shards every plane's leading axis over the device mesh and pads
         shard lists to the mesh size; without it, planes live on the
@@ -350,7 +353,22 @@ class Executor:
         device, not how many arrived (0 = off).
         ``cost_observability`` (r19): False swaps the cost ledger and
         flight recorder for null objects — the instrumentation-off
-        tier the overhead bench (config34) measures against."""
+        tier the overhead bench (config34) measures against.
+
+        Kernel tier (r24): ``kernel_tier`` routes the hottest fused
+        families through hand-written Pallas kernels (``"pallas"``)
+        instead of the XLA-compiled oracle tier (``"xla"``, default).
+        Selection is per-family and fail-safe: a family whose Pallas
+        lowering fails falls back to XLA silently (counted in
+        ``pallas_fallback_total``), and XLA remains the bit-exact
+        correctness oracle and the governor's degraded-serving path.
+        ``dispatch_loop_fusion`` (r24) lets the batcher collapse a
+        collection window's same-shape selected-count groups into ONE
+        jitted on-device loop dispatch.  ``fused_warmup`` (r24) runs
+        the compile-ladder warmer: delta-aware fused programs for a
+        newly resident plane shape pre-compile on a background thread
+        so the first post-ingest query serves from a warm cache
+        (single-device only — disabled under a mesh placement)."""
         self.holder = holder
         self.translate = translate or TranslateStore(
             holder.path, health=getattr(holder, "storage_health", None))
@@ -403,7 +421,19 @@ class Executor:
         from pilosa_tpu.exec.fused import FusedCache
         self.fused = FusedCache(stats=self.stats,
                                 mesh_guard=placement is not None,
-                                ledger=self.ledger, flight=self.flight)
+                                ledger=self.ledger, flight=self.flight,
+                                kernel_tier=kernel_tier)
+        # compile-ladder warm-up (r24): single-device only — warmed
+        # keys carry shard=None, which is exactly the serve-time
+        # sharding_key of single-device operands; under a placement
+        # the keys would never match, so the warmer stays off.
+        self.warmer = None
+        if fused_warmup and placement is None:
+            from pilosa_tpu.exec.warmup import ProgramWarmer
+            self.warmer = ProgramWarmer(self.fused, stats=self.stats,
+                                        ledger=self.ledger,
+                                        flight=self.flight)
+            self.planes.warmer = self.warmer
         # whole-tree compilation (r16): compound boolean Counts gather
         # rows from the resident plane and fold a postfix program in
         # one fused XLA dispatch.  Off (`tree_fusion=False`) restores
@@ -441,7 +471,8 @@ class Executor:
                 probe_after_s=device_health_probe_seconds,
                 placement_key=(getattr(placement, "key", None)
                                if placement is not None else None),
-                ledger=self.ledger, flight=self.flight)
+                ledger=self.ledger, flight=self.flight,
+                loop_fusion=dispatch_loop_fusion)
         # mesh serving telemetry (ISSUE 16): how many chips the plane
         # axis spans (1 = single-device serving)
         self.stats.gauge(
@@ -514,12 +545,21 @@ class Executor:
         state, watchdog knob and quarantine counts (a batcher-less
         executor is trivially healthy — there is no shared pipeline
         to stall)."""
+        warm = (self.warmer.payload() if self.warmer is not None
+                else {"enabled": False, "shapesWarmed": 0,
+                      "programsWarmed": 0, "compileSeconds": 0.0,
+                      "pending": 0})
         if self.batcher is None:
             return {"state": "healthy", "stateCode": 0,
                     "watchdogSeconds": 0.0, "quarantinedWindows": 0,
                     "inflightWindows": 0, "consecutiveFaults": 0,
-                    "watchdogTrips": 0}
-        return self.batcher.health_payload()
+                    "watchdogTrips": 0,
+                    "kernelTier": getattr(self.fused, "effective_tier",
+                                          "xla"),
+                    "warmup": warm}
+        payload = self.batcher.health_payload()
+        payload["warmup"] = warm
+        return payload
 
     def mesh_status(self) -> dict | None:
         """The ``/status`` ``mesh`` block (ISSUE 16): device count,

@@ -266,7 +266,8 @@ class FusedCache:
     MAX_PROGRAMS = 256
 
     def __init__(self, stats=None, mesh_guard: bool = False,
-                 ledger=None, flight=None):
+                 ledger=None, flight=None, kernel_tier: str = "xla"):
+        import os
         import threading
         from pilosa_tpu.exec._lru import Stamps
         from pilosa_tpu.obs import NULL_FLIGHT, NULL_LEDGER, NopStats
@@ -294,10 +295,113 @@ class FusedCache:
         # caused it, not just as a climbing built counter
         self._ledger = ledger or NULL_LEDGER
         self.flight = flight or NULL_FLIGHT
+        # kernel tier (r24): "pallas" routes the hottest fused families
+        # (selcounts[-delta/-loop], rowcounts-batch/-delta, count-batch)
+        # through the Pallas/Mosaic kernels; "xla" (default) is today's
+        # proven path and stays the correctness oracle + the governor's
+        # degraded fallback.  Real pallas selection gates on the TPU
+        # backend at runtime — on any other backend the knob silently
+        # falls back to XLA (counted) unless the TEST-ONLY interpret
+        # escape hatch (PILOSA_PALLAS_INTERPRET=1) is set, which runs
+        # the same kernels through the pallas interpreter on CPU so
+        # tier-1 can pin bit-exactness without a device.
+        self.kernel_tier = kernel_tier
+        self._pallas_interpret = False
+        tier = "xla"
+        if kernel_tier == "pallas":
+            if jax.default_backend() == "tpu":
+                tier = "pallas"
+            elif os.environ.get("PILOSA_PALLAS_INTERPRET",
+                                "") not in ("", "0", "false"):
+                tier = "pallas"
+                self._pallas_interpret = True
+            else:
+                self._stats.count("pallas_fallback_total", 1,
+                                  reason="backend")
+        self._tier = tier
+        # tier token appended to pallas-built program keys (like
+        # sharding_key: same shape, different tier = different program);
+        # xla keys stay byte-identical to the pre-tier key space
+        self._tier_tok = ((("pallas-interpret" if self._pallas_interpret
+                            else "pallas"),) if tier == "pallas" else ())
+        self._pallas_bad: set = set()   # (family, shape) lowering fails
+        self.pallas_fallbacks = 0
+
+    @property
+    def effective_tier(self) -> str:
+        """The tier actually serving: "xla", "pallas", or
+        "pallas-interpret" (the test escape hatch)."""
+        if self._tier == "pallas":
+            return ("pallas-interpret" if self._pallas_interpret
+                    else "pallas")
+        return "xla"
 
     @property
     def program_count(self) -> int:
         return len(self._programs)
+
+    # -- kernel-tier routing (r24) ---------------------------------------
+
+    def _pallas_ok(self, sig) -> bool:
+        return self._tier == "pallas" and sig not in self._pallas_bad
+
+    def _pallas_failed(self, sig, exc) -> None:
+        self._pallas_bad.add(sig)
+        self.pallas_fallbacks += 1
+        self._stats.count("pallas_fallback_total", 1, reason="lowering")
+        self.flight.record("pallas_fallback", str(sig[0]),
+                           type(exc).__name__)
+
+    def _tier_run(self, sig, dispatch):
+        """Dispatch through the pallas tier when it covers ``sig`` (a
+        ``(family, plane shape)`` pair); a Mosaic lowering failure
+        marks the shape bad, counts ``pallas_fallback_total``, and
+        silently re-dispatches the XLA-tier program."""
+        if self._pallas_ok(sig):
+            try:
+                return dispatch(True)
+            except Unfusable:
+                raise
+            except Exception as e:  # noqa: BLE001 — lowering/compile
+                self._pallas_failed(sig, e)
+        return dispatch(False)
+
+    def _sel_kernel(self, pallas: bool, sorted_idx: bool):
+        """The selected-row gather base kernel for one tier: ``(plane,
+        idx) → int32[S, N]``."""
+        if pallas:
+            from pilosa_tpu.engine import pallas_kernels
+            interp = self._pallas_interpret
+            return lambda p, ix: pallas_kernels.selected_row_counts(
+                p, ix, interpret=interp)
+        return lambda p, ix: kernels.selected_row_counts(
+            p, ix, sorted_idx=sorted_idx)
+
+    def _rc_kernel(self, pallas: bool):
+        """The whole-plane row-counts base kernel for one tier:
+        ``(plane[, filter]) → int32[S, R]``."""
+        if pallas:
+            from pilosa_tpu.engine import pallas_kernels
+            interp = self._pallas_interpret
+            return lambda p, fw=None: pallas_kernels.row_counts(
+                p, fw, interpret=interp)
+        return kernels.row_counts
+
+    def _cnt_kernel(self, pallas: bool):
+        """The whole-bitmap count kernel for one tier.  The pallas form
+        is 2D-only; plan trees that fold to other ranks (zeros nodes
+        over BSI leaves) stay on the XLA reduce inside the same
+        program."""
+        if not pallas:
+            return kernels.count
+        from pilosa_tpu.engine import pallas_kernels
+        interp = self._pallas_interpret
+
+        def cnt(words):
+            if words.ndim != 2:
+                return kernels.count(words)
+            return pallas_kernels.count(words, interpret=interp)
+        return cnt
 
     def _get_fast(self, key):
         fn = self._programs.get(key)
@@ -420,17 +524,23 @@ class FusedCache:
         donate_ok = (scratch is not None
                      and tuple(scratch.shape) == out_shape)
 
-        def build():
-            def program(*ls):
-                return jnp.stack([kernels.count(_build(n, ls))
-                                  for n in nodes])
-            return program
-        key = ((nodes, donate_ok, sharding_key(leaves[0])),
-               "count-batch")
-        if donate_ok:
-            return self._cached(key, build,
-                                donate=(n_leaves,))(*leaves, scratch)
-        return self._cached(key, build)(*leaves)
+        def dispatch(pallas: bool):
+            cnt = self._cnt_kernel(pallas)
+
+            def build():
+                def program(*ls):
+                    return jnp.stack([cnt(_build(n, ls))
+                                      for n in nodes])
+                return program
+            tok = self._tier_tok if pallas else ()
+            key = ((nodes, donate_ok, sharding_key(leaves[0])) + tok,
+                   "count-batch")
+            if donate_ok:
+                return self._cached(key, build,
+                                    donate=(n_leaves,))(*leaves, scratch)
+            return self._cached(key, build)(*leaves)
+
+        return self._tier_run(("count", leaves[0].shape), dispatch)
 
     def run_rowcounts_batch(self, flags: tuple, leaves, scratch=None):
         """K whole-plane row-count items (same plane shape) in ONE
@@ -448,26 +558,32 @@ class FusedCache:
         donate_ok = (scratch is not None
                      and tuple(scratch.shape) == out_shape)
 
-        def build():
-            def program(*ls):
-                rows = []
-                i = 0
-                for has_filter in flags:
-                    plane = ls[i]
-                    flt = ls[i + 1] if has_filter else None
-                    i += 2 if has_filter else 1
-                    rows.append(jnp.sum(kernels.row_counts(plane, flt),
-                                        axis=0, dtype=jnp.int32))
-                return jnp.stack(rows)
-            return program
-        key = (flags, leaves[0].shape, sharding_key(leaves[0]),
-               donate_ok, "rowcounts-batch")
-        # (donate flag inside the key, tag kept LAST — callers
-        # introspect the program set by trailing tag)
-        if donate_ok:
-            return self._cached(key, build,
-                                donate=(n_leaves,))(*leaves, scratch)
-        return self._cached(key, build)(*leaves)
+        def dispatch(pallas: bool):
+            rc = self._rc_kernel(pallas)
+
+            def build():
+                def program(*ls):
+                    rows = []
+                    i = 0
+                    for has_filter in flags:
+                        plane = ls[i]
+                        flt = ls[i + 1] if has_filter else None
+                        i += 2 if has_filter else 1
+                        rows.append(jnp.sum(rc(plane, flt),
+                                            axis=0, dtype=jnp.int32))
+                    return jnp.stack(rows)
+                return program
+            tok = self._tier_tok if pallas else ()
+            key = (flags, leaves[0].shape, sharding_key(leaves[0]),
+                   donate_ok) + tok + ("rowcounts-batch",)
+            # (donate flag inside the key, tag kept LAST — callers
+            # introspect the program set by trailing tag)
+            if donate_ok:
+                return self._cached(key, build,
+                                    donate=(n_leaves,))(*leaves, scratch)
+            return self._cached(key, build)(*leaves)
+
+        return self._tier_run(("rowcounts", leaves[0].shape), dispatch)
 
     # bounded device-resident slot-index cache (r17 solo fast lane):
     # a repeating solo query shape re-dispatches the same slot tuple
@@ -518,36 +634,166 @@ class FusedCache:
         donate_ok = (scratch is not None
                      and tuple(scratch.shape) == (bucket,))
         if delta is not None:
-            from pilosa_tpu.ingest.delta import adjusted_selected_counts
-            key = (("selcounts-delta", plane.shape,
-                    sharding_key(plane), bucket,
-                    delta.rows.shape[0], sorted_idx, donate_ok),
-                   "count")
+            def dispatch(pallas: bool):
+                key = self._selcounts_delta_key(
+                    plane.shape, sharding_key(plane), bucket,
+                    delta.rows.shape[0], sorted_idx, donate_ok, pallas)
+                build = self._selcounts_delta_build(sorted_idx, pallas)
+                args = (plane, idx, delta.rows, delta.words, delta.vals)
+                if donate_ok:
+                    return self._cached(key, build,
+                                        donate=(5,))(*args, scratch)
+                return self._cached(key, build)(*args)
 
-            def build_delta():
-                def program(p, ix, dr, dw, dv, *sc):
-                    return adjusted_selected_counts(
-                        p, ix, dr, dw, dv, sorted_idx=sorted_idx)
-                return program
-            args = (plane, idx, delta.rows, delta.words, delta.vals)
+            return self._tier_run(("selcounts", plane.shape), dispatch)
+
+        def dispatch(pallas: bool):
+            key = self._selcounts_key(plane.shape, sharding_key(plane),
+                                      bucket, sorted_idx, donate_ok,
+                                      pallas)
+            build = self._selcounts_build(sorted_idx, pallas)
             if donate_ok:
-                return self._cached(key, build_delta,
-                                    donate=(5,))(*args, scratch)
-            return self._cached(key, build_delta)(*args)
+                return self._cached(key, build,
+                                    donate=(2,))(plane, idx, scratch)
+            return self._cached(key, build)(plane, idx)
+
+        return self._tier_run(("selcounts", plane.shape), dispatch)
+
+    # selcounts key/build helpers: SHARED between the serving path and
+    # the warm-up ladder (warm_delta_ladder), so a warmed program IS
+    # the serving program — the two can never drift apart on key shape
+
+    def _selcounts_key(self, shape, shard, bucket, sorted_idx,
+                       donate_ok, pallas: bool):
+        tok = self._tier_tok if pallas else ()
+        return (("selcounts", shape, shard, bucket, sorted_idx,
+                 donate_ok) + tok, "count")
+
+    def _selcounts_build(self, sorted_idx: bool, pallas: bool):
+        sel = self._sel_kernel(pallas, sorted_idx)
 
         def build():
             def program(p, ix, *sc):
-                return jnp.sum(
-                    kernels.selected_row_counts(p, ix,
-                                                sorted_idx=sorted_idx),
-                    axis=0, dtype=jnp.int32)
+                return jnp.sum(sel(p, ix), axis=0, dtype=jnp.int32)
             return program
-        key = (("selcounts", plane.shape, sharding_key(plane),
-                bucket, sorted_idx, donate_ok), "count")
-        if donate_ok:
-            return self._cached(key, build, donate=(2,))(plane, idx,
-                                                         scratch)
-        return self._cached(key, build)(plane, idx)
+        return build
+
+    def _selcounts_delta_key(self, shape, shard, bucket, dbucket,
+                             sorted_idx, donate_ok, pallas: bool):
+        tok = self._tier_tok if pallas else ()
+        return (("selcounts-delta", shape, shard, bucket, dbucket,
+                 sorted_idx, donate_ok) + tok, "count")
+
+    def _selcounts_delta_build(self, sorted_idx: bool, pallas: bool):
+        from pilosa_tpu.ingest.delta import adjusted_selected_counts
+        sel = self._sel_kernel(pallas, sorted_idx) if pallas else None
+
+        def build():
+            def program(p, ix, dr, dw, dv, *sc):
+                return adjusted_selected_counts(
+                    p, ix, dr, dw, dv, sorted_idx=sorted_idx,
+                    selected_fn=sel)
+            return program
+        return build
+
+    def run_selected_counts_loop(self, planes: tuple, slot_lists: tuple,
+                                 deltas: tuple,
+                                 sorted_idx: bool = True) -> jax.Array:
+        """A window's same-shape selected-count sequence in ONE jitted
+        program (r24 on-device dispatch loops): K (plane, slots[,
+        overlay]) items collapse to one enqueue + one packed readback
+        instead of K dispatches.  Returns int32[K_pad, bucket]; pad
+        lanes repeat item 0 (rows) and each item's last slot (columns),
+        so callers slice ``[j, :len(slots_j)]``.
+
+        Two forms behind one key family: when every item reads the
+        SAME resident plane (interleaved-ingest overlay snapshots),
+        the program is a true ``lax.scan`` over the stacked slot /
+        overlay operands — the pattern ``engine/bsi.py`` proves for
+        percentile; distinct planes enter as separate traced operands
+        (stacking resident planes would copy HBM) and the chain
+        unrolls inside the jit, which still costs one enqueue.  The
+        batcher's loop-fusion rule guarantees one overlay pow2 bucket
+        (or none) across items."""
+        k = len(planes)
+        k_pad = pow2_bucket(k)
+        bucket = pow2_bucket(max(len(sl) for sl in slot_lists))
+        padded = [tuple(sl) + (sl[-1],) * (bucket - len(sl))
+                  for sl in slot_lists]
+        padded += [padded[0]] * (k_pad - k)
+        idx = jnp.stack([self._slot_idx(p) for p in padded])
+        planes = tuple(planes) + (planes[0],) * (k_pad - k)
+        deltas = tuple(deltas) + (deltas[0],) * (k_pad - k)
+        has_delta = deltas[0] is not None
+        dbucket = deltas[0].rows.shape[0] if has_delta else 0
+        same_plane = all(p is planes[0] for p in planes)
+        shape, shard = planes[0].shape, sharding_key(planes[0])
+
+        def dispatch(pallas: bool):
+            from pilosa_tpu.ingest.delta import adjusted_selected_counts
+            tok = self._tier_tok if pallas else ()
+            key = (("selcounts-loop", shape, shard, k_pad, bucket,
+                    dbucket, sorted_idx, same_plane) + tok, "count")
+            sel = self._sel_kernel(pallas, sorted_idx)
+            sel_fn = sel if pallas else None
+            if same_plane and has_delta:
+                drs = jnp.stack([d.rows for d in deltas])
+                dws = jnp.stack([d.words for d in deltas])
+                dvs = jnp.stack([d.vals for d in deltas])
+
+                def build():
+                    def program(p, ix, dr, dw, dv):
+                        def step(c, xs):
+                            ixj, drj, dwj, dvj = xs
+                            return c, adjusted_selected_counts(
+                                p, ixj, drj, dwj, dvj,
+                                sorted_idx=sorted_idx,
+                                selected_fn=sel_fn)
+                        _, outs = jax.lax.scan(step, 0,
+                                               (ix, dr, dw, dv))
+                        return outs
+                    return program
+                return self._cached(key, build)(planes[0], idx,
+                                                drs, dws, dvs)
+            if same_plane:
+                def build():
+                    def program(p, ix):
+                        def step(c, ixj):
+                            return c, jnp.sum(sel(p, ixj), axis=0,
+                                              dtype=jnp.int32)
+                        _, outs = jax.lax.scan(step, 0, ix)
+                        return outs
+                    return program
+                return self._cached(key, build)(planes[0], idx)
+            if has_delta:
+                def build():
+                    def program(ix, *rest):
+                        ps = rest[:k_pad]
+                        outs = []
+                        for j in range(k_pad):
+                            dr, dw, dv = rest[k_pad + 3 * j:
+                                              k_pad + 3 * j + 3]
+                            outs.append(adjusted_selected_counts(
+                                ps[j], ix[j], dr, dw, dv,
+                                sorted_idx=sorted_idx,
+                                selected_fn=sel_fn))
+                        return jnp.stack(outs)
+                    return program
+                args = [idx] + list(planes)
+                for d in deltas:
+                    args += [d.rows, d.words, d.vals]
+                return self._cached(key, build)(*args)
+
+            def build():
+                def program(ix, *ps):
+                    return jnp.stack([
+                        jnp.sum(sel(ps[j], ix[j]), axis=0,
+                                dtype=jnp.int32)
+                        for j in range(k_pad)])
+                return program
+            return self._cached(key, build)(idx, *planes)
+
+        return self._tier_run(("selcounts", shape), dispatch)
 
     def run_rowcounts_delta(self, plane, delta, filter_words=None,
                             reduce: bool = True) -> jax.Array:
@@ -558,25 +804,148 @@ class FusedCache:
         shard bound) or int32[S, R_pad].  Overlay arrays are traced
         operands; the program set is bounded per (plane shape, overlay
         bucket, filtered, reduce)."""
-        from pilosa_tpu.ingest.delta import adjusted_row_counts
         has_filter = filter_words is not None
-        key = (("rowcounts-delta", plane.shape, sharding_key(plane),
-                delta.rows.shape[0], has_filter, reduce), "count")
+
+        def dispatch(pallas: bool):
+            key = self._rowcounts_delta_key(
+                plane.shape, sharding_key(plane), delta.rows.shape[0],
+                has_filter, reduce, pallas)
+            build = self._rowcounts_delta_build(has_filter, reduce,
+                                                pallas)
+            args = (plane, delta.rows, delta.words, delta.vals)
+            if has_filter:
+                args += (filter_words,)
+            return self._cached(key, build)(*args)
+
+        return self._tier_run(("rowcounts", plane.shape), dispatch)
+
+    def _rowcounts_delta_key(self, shape, shard, dbucket, has_filter,
+                             reduce, pallas: bool):
+        tok = self._tier_tok if pallas else ()
+        return (("rowcounts-delta", shape, shard, dbucket, has_filter,
+                 reduce) + tok, "count")
+
+    def _rowcounts_delta_build(self, has_filter: bool, reduce: bool,
+                               pallas: bool):
+        from pilosa_tpu.ingest.delta import adjusted_row_counts
+        rc = self._rc_kernel(pallas) if pallas else None
 
         def build():
             if has_filter:
                 def program(p, dr, dw, dv, fw):
                     return adjusted_row_counts(p, dr, dw, dv, fw,
-                                               reduce_shards=reduce)
+                                               reduce_shards=reduce,
+                                               row_counts_fn=rc)
             else:
                 def program(p, dr, dw, dv):
                     return adjusted_row_counts(p, dr, dw, dv, None,
-                                               reduce_shards=reduce)
+                                               reduce_shards=reduce,
+                                               row_counts_fn=rc)
             return program
-        args = (plane, delta.rows, delta.words, delta.vals)
-        if has_filter:
-            args += (filter_words,)
-        return self._cached(key, build)(*args)
+        return build
+
+    # -- compile-ladder warm-up (r24) -------------------------------------
+
+    #: slot width bucket the warmer pre-compiles for the selected-count
+    #: delta family: bucket 1 is the post-ingest first-serve shape (a
+    #: solo Count(Row) through the fast lane or a width-1 window)
+    WARM_SLOT_BUCKET = 1
+
+    def _warm_insert(self, key, build, avatars: tuple,
+                     donate: tuple = ()):
+        """AOT-compile ONE program from shape avatars and insert it
+        pre-warmed: ``jit().lower().compile()`` runs tracing + XLA
+        compilation HERE (off the serving path) instead of lazily on
+        first call, and the Compiled object lands directly in the
+        program dict (lower/compile does not populate jit's dispatch
+        cache).  Returns compile seconds, or None when the key was
+        already cached."""
+        if self._get_fast(key) is not None:
+            return None
+        lock = self._compiling.setdefault(key, self._threading.Lock())
+        with lock:
+            if key in self._programs:
+                return None
+            t0 = _time.perf_counter()
+            fn = jax.jit(build(), donate_argnums=donate)
+            fn = fn.lower(*avatars).compile()
+            dt = _time.perf_counter() - t0
+            if self._mesh_guard:
+                fn = mesh_serialized(fn)
+            self._insert(key, fn)
+        return dt
+
+    def _warm_jobs(self, shape: tuple, overlay_bucket: int) -> list:
+        """The delta-aware program ladder rungs for one resident plane
+        shape × one pow2 overlay bucket: the serving forms a first
+        post-ingest query hits (whole-plane rowcounts-delta with and
+        without a filter; width-1 selected-counts-delta, donated and
+        not).  Keys/builds come from the SAME helpers the serving path
+        uses."""
+        sds = jax.ShapeDtypeStruct
+        s, _r, w = shape
+        shard = None  # the warmer only runs un-placed (single-device)
+        plane_av = sds(tuple(shape), jnp.uint32)
+        flt_av = sds((s, w), jnp.uint32)
+        dr = sds((overlay_bucket,), jnp.int32)
+        dw = sds((overlay_bucket,), jnp.int32)
+        dv = sds((overlay_bucket,), jnp.uint32)
+        jobs = []
+        sig = ("rowcounts", tuple(shape))
+        pall = self._pallas_ok(sig)
+        for has_filter in (False, True):
+            jobs.append((
+                sig,
+                self._rowcounts_delta_key(tuple(shape), shard,
+                                          overlay_bucket, has_filter,
+                                          True, pall),
+                self._rowcounts_delta_build(has_filter, True, pall),
+                (plane_av, dr, dw, dv) + ((flt_av,) if has_filter
+                                          else ()),
+                ()))
+        sig = ("selcounts", tuple(shape))
+        pall = self._pallas_ok(sig)
+        b = self.WARM_SLOT_BUCKET
+        ix_av, scr_av = sds((b,), jnp.int32), sds((b,), jnp.int32)
+        for donate_ok in (False, True):
+            jobs.append((
+                sig,
+                self._selcounts_delta_key(tuple(shape), shard, b,
+                                          overlay_bucket, True,
+                                          donate_ok, pall),
+                self._selcounts_delta_build(True, pall),
+                (plane_av, ix_av, dr, dw, dv) + ((scr_av,)
+                                                 if donate_ok else ()),
+                (5,) if donate_ok else ()))
+        return jobs
+
+    def warm_delta_ladder(self, shape: tuple,
+                          overlay_bucket: int) -> tuple[int, float]:
+        """Pre-compile the delta-aware serving programs for one plane
+        shape × pow2 overlay bucket (r24 compile-ladder warm-up) —
+        returns (programs compiled, compile seconds).  A pallas-tier
+        lowering failure during warm-up marks the shape bad exactly
+        like a serving-path failure and the ladder re-warms the XLA
+        fallback programs, so the first post-ingest serve stays
+        compile-free either way."""
+        n, secs = 0, 0.0
+        retry = False
+        for sig, key, build, avatars, donate in self._warm_jobs(
+                shape, overlay_bucket):
+            try:
+                dt = self._warm_insert(key, build, avatars, donate)
+            except Exception as e:  # noqa: BLE001 — lowering/compile
+                if self._pallas_ok(sig):
+                    self._pallas_failed(sig, e)
+                    retry = True
+                continue
+            if dt is not None:
+                n += 1
+                secs += dt
+        if retry:
+            n2, s2 = self.warm_delta_ladder(shape, overlay_bucket)
+            n, secs = n + n2, secs + s2
+        return n, secs
 
     def _tree_cached(self, key, build):
         """``_cached`` + tree-family build telemetry: a climbing

@@ -191,6 +191,9 @@ class PlaneCache:
         # timeline with their reason — "why did that plane vanish at
         # 03:14" is answerable from the dump
         self.flight = flight or NULL_FLIGHT
+        # compile-ladder warmer (r24): set by the executor after
+        # construction; _insert_entry notes standard-plane residency
+        self.warmer = None
         # bound once: the ledger's plane-attribution stamp runs on the
         # lock-free serving fast path
         from pilosa_tpu.obs.ledger import set_plane_context
@@ -1441,6 +1444,16 @@ class PlaneCache:
                         continue
                     self._evict_entry(k, "budget")
             self._stamps.cleanup(self._entries)
+        # compile-ladder warm-up (r24): a standard plane just became
+        # resident — hand its shape to the background warmer so the
+        # delta-aware program ladder compiles off the serving path
+        # (outside the lock: note_resident is cheap but never worth
+        # holding the cache lock for)
+        if self.warmer is not None and key[0] == "plane":
+            try:
+                self.warmer.note_resident(tuple(ps.plane.shape))
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                pass
 
     # Incremental cap: beyond this many changed (row, word) cells a
     # full rebuild is cheaper than the scatter

@@ -1,0 +1,151 @@
+"""Compile-ladder warm-up (r24): pre-compile the delta-aware program
+ladder at plane-residency time, OFF the serving path.
+
+The first write after a plane becomes resident forms a delta overlay,
+and the first query after that write needs a delta-aware fused program
+— today that compile (tens of ms on CPU, more under load) lands on the
+serving path of exactly the query a fresh ingest cares most about.
+The warmer closes that tax: when ``exec.planes`` pages a plane in, it
+notes the plane shape here, and a single background thread AOT-compiles
+(``jit().lower().compile()``) one program per pow2 overlay bucket per
+resident fused family through ``FusedCache.warm_delta_ladder`` — the
+same key-builder helpers the serving path uses, so a warmed program IS
+the serving program and the first post-ingest serve hits a warm cache.
+
+Observability: compile seconds book into the CostLedger tagged
+``warmup`` with per-compile flight-recorder ``compile`` events, the
+``fused_warmup_compile_seconds`` histogram and
+``fused_warmup_programs_total`` counter tick per rung, and the
+``warmup`` block under ``/status`` deviceHealth carries lifetime
+totals.  Single-flight: one thread, one queue, shapes dedupe — a page-in
+storm warms each shape once.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+#: the overlay pow2 buckets the ladder pre-compiles, smallest first:
+#: ``DeltaMirror.build_overlay`` pads cell counts to pow2, so these are
+#: exactly the serve-time ``delta.rows.shape[0]`` values.  256 cells
+#: covers the early-ingest window where the compile tax hurts; larger
+#: overlays arrive seconds later, after the ladder (or compaction) has
+#: caught up.
+WARM_OVERLAY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class ProgramWarmer:
+    """Background single-flight warmer over one executor's FusedCache.
+
+    ``note_resident(shape)`` enqueues a plane shape (deduped for the
+    warmer's lifetime) and wakes the worker thread; the worker walks
+    the overlay-bucket ladder through ``fused.warm_delta_ladder``.
+    ``wait_idle`` lets tests and benches fence on a drained queue.
+    """
+
+    def __init__(self, fused, stats=None, ledger=None, flight=None):
+        from pilosa_tpu.obs import NULL_FLIGHT, NULL_LEDGER, NopStats
+        self.fused = fused
+        self.stats = stats or NopStats()
+        self.ledger = ledger or NULL_LEDGER
+        self.flight = flight or NULL_FLIGHT
+        self.enabled = True
+        self._seen: set = set()
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._running = False
+        self._closed = False
+        # lifetime totals for /status (and a convenient test surface)
+        self.programs_warmed = 0
+        self.compile_seconds = 0.0
+        self.shapes_warmed = 0
+
+    # -- residency hook (called by PlaneCache._insert_entry) ----------------
+
+    def note_resident(self, shape) -> None:
+        """A plane of ``shape`` just became resident: queue its ladder
+        (once per shape) and wake the worker.  Cheap and non-blocking —
+        this rides the page-in path."""
+        if not self.enabled or self._closed:
+            return
+        sig = tuple(shape)
+        start = False
+        with self._lock:
+            if sig in self._seen:
+                return
+            self._seen.add(sig)
+            self._q.append(sig)
+            self._idle.clear()
+            if not self._running:
+                # single-flight: exactly one worker; the exit decision
+                # below holds this same lock, so no enqueue strands
+                self._running = True
+                start = True
+        if start:
+            threading.Thread(target=self._run, name="pilosa-warmup",
+                             daemon=True).start()
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._closed:
+            with self._lock:
+                if not self._q:
+                    self._running = False
+                    self._idle.set()
+                    return  # drained; next note_resident restarts
+                sig = self._q.popleft()
+            try:
+                self._warm_shape(sig)
+            except Exception:  # noqa: BLE001 — warming must never fault serving
+                pass
+        with self._lock:
+            self._running = False
+            self._idle.set()
+
+    def _warm_shape(self, shape: tuple) -> None:
+        n_total, s_total = 0, 0.0
+        for bucket in WARM_OVERLAY_BUCKETS:
+            if self._closed:
+                break
+            n, secs = self.fused.warm_delta_ladder(shape, bucket)
+            if not n:
+                continue
+            n_total += n
+            s_total += secs
+            self.stats.observe("fused_warmup_compile_seconds", secs)
+            self.stats.count("fused_warmup_programs_total", n)
+            # compile attribution (r19 ledger): warm-up compiles book
+            # under the "warmup" family — a serving-path compile storm
+            # and background warming stay distinguishable
+            self.ledger.note_compile("warmup", secs, first=False)
+            self.flight.record("compile", "warmup",
+                               f"{shape}x{bucket}", secs)
+        with self._lock:
+            self.programs_warmed += n_total
+            self.compile_seconds += s_total
+            self.shapes_warmed += 1
+
+    # -- fencing / introspection --------------------------------------------
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until the queue is drained (tests/benches fence here
+        before asserting the zero-serving-compile property)."""
+        return self._idle.wait(timeout)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def payload(self) -> dict:
+        """The ``warmup`` block under /status deviceHealth."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "shapesWarmed": self.shapes_warmed,
+                "programsWarmed": self.programs_warmed,
+                "compileSeconds": round(self.compile_seconds, 3),
+                "pending": len(self._q),
+            }

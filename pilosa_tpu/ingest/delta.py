@@ -256,17 +256,22 @@ def _cell_diffs(plane: jax.Array, d_rows: jax.Array, d_words: jax.Array,
 def adjusted_row_counts(plane: jax.Array, d_rows: jax.Array,
                         d_words: jax.Array, d_vals: jax.Array,
                         filter_words: jax.Array | None = None,
-                        reduce_shards: bool = True) -> jax.Array:
+                        reduce_shards: bool = True,
+                        row_counts_fn=None) -> jax.Array:
     """Whole-plane per-row popcounts of base⊕delta.
 
     plane uint32[S, R, W]; overlay arrays int32/uint32[C_pad] →
     int32[R] (``reduce_shards``) or int32[S, R].  The base scan is
     byte-identical to the clean ``row_counts`` path; delta cells only
     adjust the touched (shard, row) entries, so N concurrent queries
-    over the same (plane, overlay) pair still dedupe to one scan."""
+    over the same (plane, overlay) pair still dedupe to one scan.
+    ``row_counts_fn`` swaps the base scan kernel (the pallas serving
+    tier routes here) — base⊕delta stays ONE program either way: the
+    adjustment traces into the same jit as the scan."""
     from pilosa_tpu.engine import kernels
     s, r, _ = plane.shape
-    counts = kernels.row_counts(plane, filter_words)  # int32[S, R]
+    rc = row_counts_fn if row_counts_fn is not None else kernels.row_counts
+    counts = rc(plane, filter_words)  # int32[S, R]
     diff, _slot = _cell_diffs(plane, d_rows, d_words, d_vals,
                               filter_words)
     flat = counts.reshape(s * r)
@@ -321,17 +326,23 @@ def overlay_row(val: jax.Array, slot, d_rows: jax.Array,
 def adjusted_selected_counts(plane: jax.Array, row_idx: jax.Array,
                              d_rows: jax.Array, d_words: jax.Array,
                              d_vals: jax.Array,
-                             sorted_idx: bool = False) -> jax.Array:
+                             sorted_idx: bool = False,
+                             selected_fn=None) -> jax.Array:
     """Selected-row popcounts of base⊕delta, shard axis reduced on
     device: int32[N] for ``row_idx`` int32[N] (plane row slots, the
     multi-query fused gather).  Each overlay cell contributes its diff
     to EVERY matching output lane (duplicate slots answer
     independently, like the clean gather).  ``sorted_idx``: the static
     ascending-stride gather promise (see
-    ``kernels.selected_row_counts``)."""
+    ``kernels.selected_row_counts``).  ``selected_fn`` swaps the base
+    gather kernel ``(plane, row_idx) → int32[S, N]`` (the pallas
+    serving tier) — the overlay adjustment traces into the same jit,
+    so base⊕delta stays one program."""
     from pilosa_tpu.engine import kernels
-    sel = jnp.sum(kernels.selected_row_counts(plane, row_idx,
-                                              sorted_idx=sorted_idx),
+    sel_fn = (selected_fn if selected_fn is not None else
+              lambda p, ix: kernels.selected_row_counts(
+                  p, ix, sorted_idx=sorted_idx))
+    sel = jnp.sum(sel_fn(plane, row_idx),
                   axis=-2, dtype=jnp.int32)              # int32[N]
     diff, slot = _cell_diffs(plane, d_rows, d_words, d_vals, None)
     match = slot[:, None] == row_idx[None, :]            # [C_pad, N]

@@ -125,7 +125,10 @@ class PilosaTPUServer:
             plane_page_bytes=self.cfg.plane_page_bytes,
             tenant_byte_quota=self.cfg.tenant_byte_quota,
             tenant_qps_quota=self.cfg.tenant_qps_quota,
-            tenant_slot_quota=self.cfg.tenant_slot_quota)
+            tenant_slot_quota=self.cfg.tenant_slot_quota,
+            kernel_tier=self.cfg.kernel_tier,
+            dispatch_loop_fusion=self.cfg.dispatch_loop_fusion,
+            fused_warmup=self.cfg.fused_warmup)
         self.api = API(self.holder, self.executor,
                        query_timeout=self.cfg.query_timeout,
                        trace_sample_rate=self.cfg.trace_sample_rate,

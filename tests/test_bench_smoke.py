@@ -760,3 +760,42 @@ def test_config34_cost_observability_smoke():
     assert d["flight_events"] > 0 and d["flight_last_seq"] > 0
     # the detail guard must be wired (list, possibly empty)
     assert isinstance(out["regressions"], list)
+
+
+def test_config35_kernel_tier_smoke():
+    """bench/config35 (kernel-tier harness, r24) in --smoke mode: the
+    per-tier per-kind GB/s table (pallas column interpreter-mode on
+    CPU), the loop-fusion proof (a window of 8 same-shape items must
+    collapse into ONE loop dispatch) and the warm-up proof (zero
+    serving-path compiles on the first post-ingest serve) are asserted
+    INSIDE the bench — runs under tier-1 so the bench can never
+    bitrot."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "bench", "config35_kernel_tier.py"),
+         "--smoke"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, lines  # exactly ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"].startswith("kernel_tier_gbps")
+    assert out["unit"] == "GBps" and out["value"] > 0
+    d = out["detail"]
+    # both tiers measured on every kind, oracle-checked in-bench
+    for tier in ("xla", "pallas"):
+        assert set(d["tiers"][tier]) == {"rowcounts", "count",
+                                         "selected"}
+        assert all(v["gbps"] > 0 for v in d["tiers"][tier].values())
+    assert d["pallas_mode"] == "interpret"  # CPU: the escape hatch
+    # the r24 contracts, re-checked on the artifact
+    assert d["loop"]["items"] == 8
+    assert d["loop"]["loop_dispatches"] == 1
+    assert d["loop"]["groups_fused"] == 8
+    assert d["warmup"]["programs_warmed"] > 0
+    assert d["warmup"]["serving_path_builds_after_ingest"] == 0
+    # the detail guard (XLA oracle kinds) must be wired
+    assert isinstance(out["regressions"], list)

@@ -67,3 +67,121 @@ class TestRowCounts:
         expect = np.asarray(kernels.row_counts(plane))
         assert got.shape == expect.shape
         np.testing.assert_array_equal(got, expect)
+
+    def test_wide_plane_non_divisible_width(self, rng):
+        # w > _WB forces the word-block grid; a non-multiple width
+        # exercises the word-axis padding fix (pre-fix: BlockSpec over
+        # a ragged word axis returned wrong counts for the tail block)
+        w = pallas_kernels._WB + 96
+        plane = rng.integers(0, 1 << 32, size=(2, 8, w), dtype=np.uint32)
+        filt = rng.integers(0, 1 << 32, size=(2, w), dtype=np.uint32)
+        got = np.asarray(pallas_kernels.row_counts(plane, filt,
+                                                   interpret=True))
+        np.testing.assert_array_equal(
+            got, np.asarray(kernels.row_counts(plane, filt)))
+
+
+def _np_popcount(words):
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(words).astype(np.int64)
+    return np.unpackbits(
+        words.view(np.uint8), bitorder="little").reshape(
+        *words.shape, 32).sum(-1).astype(np.int64)
+
+
+class TestCount:
+    """Whole-plane count chain: pallas_kernels.count vs kernels.count
+    and the numpy popcount oracle."""
+
+    @pytest.mark.parametrize("shape", [(1, 64), (3, 200), (5, 1300),
+                                       (2, 4096), (4, 130048)])
+    def test_parity_sweep(self, rng, shape):
+        words = rng.integers(0, 1 << 32, size=shape, dtype=np.uint32)
+        got = np.asarray(pallas_kernels.count(words, interpret=True))
+        np.testing.assert_array_equal(got, np.asarray(kernels.count(words)))
+        np.testing.assert_array_equal(
+            got.astype(np.int64), _np_popcount(words).sum(-1))
+
+    def test_all_ones_and_empty(self):
+        ones = np.full((2, 96), 0xFFFFFFFF, np.uint32)
+        got = np.asarray(pallas_kernels.count(ones, interpret=True))
+        np.testing.assert_array_equal(got, np.full(2, 96 * 32, np.int32))
+        zero = np.zeros((3, 160), np.uint32)
+        np.testing.assert_array_equal(
+            np.asarray(pallas_kernels.count(zero, interpret=True)),
+            np.zeros(3, np.int32))
+
+
+class TestSelectedRowCounts:
+    """Selected-row gather scan vs kernels.selected_row_counts — the
+    sorted-slot contract the fused serving tier relies on."""
+
+    @pytest.mark.parametrize("shape,n_sel", [
+        ((2, 8, 64), 3), ((3, 10, 160), 4), ((2, 7, 1300), 5),
+        ((4, 16, 2048), 8)])
+    def test_parity_sweep(self, rng, shape, n_sel):
+        plane = rng.integers(0, 1 << 32, size=shape, dtype=np.uint32)
+        idx = np.sort(rng.choice(shape[1], n_sel, replace=False))
+        idx = idx.astype(np.int32)
+        got = np.asarray(pallas_kernels.selected_row_counts(
+            plane, idx, interpret=True))
+        expect = np.asarray(kernels.selected_row_counts(
+            plane, idx, sorted_idx=True))
+        np.testing.assert_array_equal(got, expect)
+        np.testing.assert_array_equal(
+            got.astype(np.int64), _np_popcount(plane[:, idx]).sum(-1))
+
+    def test_repeated_slots(self, rng):
+        # padded slot lists repeat the last slot — the contract the
+        # batcher's loop-fused dispatch pads with
+        plane = rng.integers(0, 1 << 32, size=(2, 6, 128), dtype=np.uint32)
+        idx = np.array([1, 4, 4, 4], np.int32)
+        got = np.asarray(pallas_kernels.selected_row_counts(
+            plane, idx, interpret=True))
+        np.testing.assert_array_equal(
+            got, np.asarray(kernels.selected_row_counts(
+                plane, idx, sorted_idx=True)))
+
+    def test_all_ones_rows(self):
+        plane = np.zeros((1, 5, 96), np.uint32)
+        plane[0, 2] = 0xFFFFFFFF
+        idx = np.array([0, 2], np.int32)
+        got = np.asarray(pallas_kernels.selected_row_counts(
+            plane, idx, interpret=True))
+        np.testing.assert_array_equal(got, [[0, 96 * 32]])
+
+
+class TestRandomizedParity:
+    """Randomized sweep across awkward (non-pow2, non-block-aligned)
+    shapes — every pallas kernel vs its XLA oracle on the same draw."""
+
+    def test_sweep(self, rng):
+        for _ in range(6):
+            s = int(rng.integers(1, 4))
+            r = int(rng.integers(1, 20))
+            w = int(rng.integers(1, 300))
+            plane = rng.integers(0, 1 << 32, size=(s, r, w),
+                                 dtype=np.uint32)
+            filt = rng.integers(0, 1 << 32, size=(s, w), dtype=np.uint32)
+            np.testing.assert_array_equal(
+                np.asarray(pallas_kernels.row_counts(plane, filt,
+                                                     interpret=True)),
+                np.asarray(kernels.row_counts(plane, filt)))
+            np.testing.assert_array_equal(
+                np.asarray(pallas_kernels.count(filt, interpret=True)),
+                np.asarray(kernels.count(filt)))
+            n_sel = int(rng.integers(1, r + 1))
+            idx = np.sort(rng.choice(r, n_sel, replace=False)) \
+                .astype(np.int32)
+            np.testing.assert_array_equal(
+                np.asarray(pallas_kernels.selected_row_counts(
+                    plane, idx, interpret=True)),
+                np.asarray(kernels.selected_row_counts(
+                    plane, idx, sorted_idx=True)))
+
+    def test_empty_filter(self, rng):
+        plane = rng.integers(0, 1 << 32, size=(2, 5, 96), dtype=np.uint32)
+        filt = np.zeros((2, 96), np.uint32)
+        got = np.asarray(pallas_kernels.row_counts(plane, filt,
+                                                   interpret=True))
+        np.testing.assert_array_equal(got, np.zeros((2, 5), np.int32))
